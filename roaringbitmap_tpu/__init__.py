@@ -1,0 +1,42 @@
+"""roaringbitmap_tpu — a TPU-native compressed-bitmap set-algebra framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of the reference
+RoaringBitmap Java library (/root/reference): container-partitioned compressed
+bitmaps, the portable RoaringFormatSpec serialization, pairwise and *wide*
+set algebra (OR/AND/XOR over thousands of bitmaps) executing on device,
+bit-sliced indexes and range indexes on top.
+
+Execution model (two-tier, see SURVEY.md §7):
+- Host tier: NumPy struct-of-arrays container model for point ops,
+  construction, and serialization (roaringbitmap_tpu.core).
+- Device tier: containers packed into HBM-resident u32 word tensors; wide
+  aggregation, key-set algebra, and cardinality run as vmapped/pallas
+  kernels (roaringbitmap_tpu.ops, .parallel) and scale over a
+  jax.sharding.Mesh via shard_map.
+"""
+
+from .core.bitmap import (
+    RoaringBitmap,
+    and_,
+    and_cardinality,
+    andnot,
+    andnot_cardinality,
+    flip,
+    or_,
+    or_cardinality,
+    or_not,
+    xor,
+    xor_cardinality,
+)
+from .core import containers
+from .format import spec
+from .format.spec import InvalidRoaringFormat
+
+__all__ = [
+    "RoaringBitmap",
+    "and_", "or_", "xor", "andnot", "or_not", "flip",
+    "and_cardinality", "or_cardinality", "xor_cardinality", "andnot_cardinality",
+    "containers", "spec", "InvalidRoaringFormat",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from . import containers
+from .bitmap import RoaringBitmap
+
+__all__ = ["containers", "RoaringBitmap"]

@@ -1,0 +1,572 @@
+"""32-bit RoaringBitmap — host API over the container model.
+
+Public surface mirrors the reference's RoaringBitmap / ImmutableBitmapDataProvider
+(/root/reference/RoaringBitmap/src/main/java/org/roaringbitmap/RoaringBitmap.java:50,
+ImmutableBitmapDataProvider.java): point mutation, pairwise algebra, ranges,
+rank/select, navigation, serialization.  Point ops run on host (they are
+O(log K) + one small container op); bulk/wide ops are delegated to the device
+engine in roaringbitmap_tpu.parallel.
+
+Structure-of-arrays instead of RoaringArray's parallel object arrays
+(RoaringArray.java:34-38): `keys` is a sorted u16 NumPy array, `containers`
+the matching list.  Bulk construction is fully vectorized (sort + unique on
+the high-16 axis), replacing the reference's per-value insert loop
+(RoaringBitmap.java:1162).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from . import containers as C
+from .containers import Container
+from ..format import spec
+
+
+def _highbits(x: np.ndarray) -> np.ndarray:
+    return (x >> np.uint32(16)).astype(np.uint16)
+
+
+class RoaringBitmap:
+    """Compressed bitmap over the unsigned 32-bit universe."""
+
+    __slots__ = ("keys", "containers")
+
+    def __init__(self, keys: np.ndarray | None = None,
+                 containers: list[Container] | None = None):
+        self.keys = keys if keys is not None else np.empty(0, dtype=np.uint16)
+        self.containers = containers if containers is not None else []
+
+    # ------------------------------------------------------------------ build
+    @staticmethod
+    def bitmap_of(*values: int) -> "RoaringBitmap":
+        """RoaringBitmap.bitmapOf analog."""
+        return RoaringBitmap.from_values(np.array(values, dtype=np.uint32))
+
+    @staticmethod
+    def from_values(values: np.ndarray) -> "RoaringBitmap":
+        """Vectorized bulk construction from an unsorted u32 array.
+
+        The addMany/RoaringBitmapWriter ingest path: one sort + one
+        unique-split instead of per-value binary searches.
+        """
+        v = np.asarray(values, dtype=np.uint32)
+        if v.size == 0:
+            return RoaringBitmap()
+        v = np.unique(v)  # sorts and dedups
+        hi = _highbits(v)
+        keys, starts = np.unique(hi, return_index=True)
+        bounds = np.append(starts, v.size)
+        conts: list[Container] = [
+            C.from_values((v[bounds[i]:bounds[i + 1]] & np.uint32(0xFFFF)).astype(np.uint16))
+            for i in range(keys.size)
+        ]
+        return RoaringBitmap(keys.astype(np.uint16), conts)
+
+    @staticmethod
+    def from_range(start: int, stop: int) -> "RoaringBitmap":
+        """All values in [start, stop) — RoaringBitmap.add(long,long) on empty."""
+        rb = RoaringBitmap()
+        rb.add_range(start, stop)
+        return rb
+
+    def clone(self) -> "RoaringBitmap":
+        return RoaringBitmap(self.keys.copy(), list(self.containers))
+
+    # -------------------------------------------------------------- accessors
+    @property
+    def cardinality(self) -> int:
+        """getLongCardinality (RoaringBitmap.java:2195)."""
+        return sum(c.cardinality for c in self.containers)
+
+    def __len__(self) -> int:
+        return self.cardinality
+
+    def is_empty(self) -> bool:
+        return not self.containers
+
+    def __bool__(self) -> bool:
+        return not self.is_empty()
+
+    def _index(self, hb: int) -> int:
+        """Index of key hb, or -(insertion point)-1 (RoaringArray.getIndex:749)."""
+        i = int(np.searchsorted(self.keys, np.uint16(hb)))
+        if i < self.keys.size and self.keys[i] == hb:
+            return i
+        return -i - 1
+
+    def contains(self, x: int) -> bool:
+        i = self._index(x >> 16)
+        return i >= 0 and self.containers[i].contains(x & 0xFFFF)
+
+    def __contains__(self, x: int) -> bool:
+        return self.contains(x)
+
+    def contains_range(self, start: int, stop: int) -> bool:
+        """True iff every value in [start, stop) is present (RoaringBitmap.contains(long,long))."""
+        if start >= stop:
+            return True
+        for lo, hi_excl, hb in _chunk_ranges(start, stop):
+            i = self._index(hb)
+            if i < 0:
+                return False
+            c = self.containers[i]
+            lo_rank = c.rank(lo) - (1 if c.contains(lo) else 0)
+            if c.rank(hi_excl - 1) - lo_rank != hi_excl - lo:
+                return False
+        return True
+
+    def intersects_range(self, start: int, stop: int) -> bool:
+        """True iff any value in [start, stop) is present (RoaringBitmap.intersects(long,long))."""
+        if start >= stop:
+            return False
+        for lo, hi_excl, hb in _chunk_ranges(start, stop):
+            i = self._index(hb)
+            if i >= 0:
+                c = self.containers[i]
+                before = c.rank(lo) - (1 if c.contains(lo) else 0)
+                if c.rank(hi_excl - 1) > before:
+                    return True
+        return False
+
+    def rank(self, x: int) -> int:
+        """Number of members <= x (RoaringBitmap.rank:2622)."""
+        hb = x >> 16
+        i = int(np.searchsorted(self.keys, np.uint16(hb), side="left"))
+        total = sum(c.cardinality for c in self.containers[:i])
+        if i < self.keys.size and self.keys[i] == hb:
+            total += self.containers[i].rank(x & 0xFFFF)
+        return total
+
+    def select(self, j: int) -> int:
+        """j-th smallest member, 0-based (RoaringBitmap.select:2820)."""
+        for k, c in zip(self.keys, self.containers):
+            if j < c.cardinality:
+                return (int(k) << 16) | c.select(j)
+            j -= c.cardinality
+        raise ValueError("select: rank out of bounds")
+
+    def first(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        return (int(self.keys[0]) << 16) | self.containers[0].first()
+
+    def last(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        return (int(self.keys[-1]) << 16) | self.containers[-1].last()
+
+    def next_value(self, x: int) -> int:
+        """Smallest member >= x, or -1 (RoaringBitmap.nextValue)."""
+        r = self.rank(x - 1) if x > 0 else 0
+        if r >= self.cardinality:
+            return -1
+        return self.select(r)
+
+    def previous_value(self, x: int) -> int:
+        """Largest member <= x, or -1 (RoaringBitmap.previousValue)."""
+        r = self.rank(x)
+        return self.select(r - 1) if r > 0 else -1
+
+    def next_absent_value(self, x: int) -> int:
+        """Smallest non-member >= x (RoaringBitmap.nextAbsentValue)."""
+        y = x
+        while y <= 0xFFFFFFFF and self.contains(y):
+            i = self._index(y >> 16)
+            c = self.containers[i]
+            vals = c.values().astype(np.int64)
+            lo = int(np.searchsorted(vals, y & 0xFFFF))
+            run_end = lo
+            # first gap at/after position lo within this container
+            gap = np.flatnonzero(np.diff(vals[lo:]) != 1)
+            if gap.size:
+                return (int(y) & ~0xFFFF) + int(vals[lo + gap[0]]) + 1
+            y = ((y >> 16) + 1) << 16
+        return y
+
+    def previous_absent_value(self, x: int) -> int:
+        y = x
+        while y >= 0 and self.contains(y):
+            i = self._index(y >> 16)
+            vals = self.containers[i].values().astype(np.int64)
+            hi = int(np.searchsorted(vals, y & 0xFFFF))
+            gap = np.flatnonzero(np.diff(vals[:hi + 1]) != 1)
+            if gap.size:
+                return (int(y) & ~0xFFFF) + int(vals[gap[-1] + 1]) - 1
+            y = ((y >> 16) << 16) - 1 if vals[0] == 0 else (int(y) & ~0xFFFF) + int(vals[0]) - 1
+        return y
+
+    # ------------------------------------------------------------- iteration
+    def to_array(self) -> np.ndarray:
+        """All members, ascending, as u32 (RoaringBitmap.toArray)."""
+        if not self.containers:
+            return np.empty(0, dtype=np.uint32)
+        parts = [
+            (np.uint32(int(k) << 16) | c.values().astype(np.uint32))
+            for k, c in zip(self.keys, self.containers)
+        ]
+        return np.concatenate(parts)
+
+    def __iter__(self) -> Iterator[int]:
+        for k, c in zip(self.keys, self.containers):
+            base = int(k) << 16
+            for v in c.values():
+                yield base | int(v)
+
+    def batch_iterator(self, batch_size: int = 65536) -> Iterator[np.ndarray]:
+        """Container-at-a-time buffer fills (RoaringBatchIterator.java:19-28)."""
+        buf: list[np.ndarray] = []
+        n = 0
+        for k, c in zip(self.keys, self.containers):
+            part = np.uint32(int(k) << 16) | c.values().astype(np.uint32)
+            buf.append(part)
+            n += part.size
+            while n >= batch_size:
+                whole = np.concatenate(buf)
+                yield whole[:batch_size]
+                rest = whole[batch_size:]
+                buf = [rest] if rest.size else []
+                n = rest.size
+        if n:
+            yield np.concatenate(buf)
+
+    # -------------------------------------------------------------- mutation
+    def add(self, x: int) -> None:
+        """Point insert (RoaringBitmap.add:1162)."""
+        i = self._index(x >> 16)
+        if i >= 0:
+            self.containers[i] = self.containers[i].add(x & 0xFFFF)
+        else:
+            self._insert(-i - 1, np.uint16(x >> 16),
+                         C.ArrayContainer(np.array([x & 0xFFFF], dtype=np.uint16)))
+
+    def checked_add(self, x: int) -> bool:
+        if self.contains(x):
+            return False
+        self.add(x)
+        return True
+
+    def add_many(self, values: np.ndarray) -> None:
+        """Bulk insert (RoaringBitmap.add(int...) / addMany)."""
+        other = RoaringBitmap.from_values(values)
+        res = or_(self, other)
+        self.keys, self.containers = res.keys, res.containers
+
+    def remove(self, x: int) -> None:
+        i = self._index(x >> 16)
+        if i < 0:
+            return
+        c = self.containers[i].remove(x & 0xFFFF)
+        if c.cardinality == 0:
+            self._delete(i)
+        else:
+            self.containers[i] = c
+
+    def checked_remove(self, x: int) -> bool:
+        if not self.contains(x):
+            return False
+        self.remove(x)
+        return True
+
+    def add_range(self, start: int, stop: int) -> None:
+        """Set all of [start, stop) (RoaringBitmap.add(long,long))."""
+        for lo, hi_excl, hb in _chunk_ranges(start, stop):
+            i = self._index(hb)
+            full_chunk = lo == 0 and hi_excl == 0x10000
+            if i >= 0:
+                if full_chunk:
+                    self.containers[i] = C.full_container()
+                else:
+                    self.containers[i] = C.container_or(
+                        self.containers[i], C.range_container(lo, hi_excl))
+            else:
+                self._insert(-i - 1, np.uint16(hb), C.range_container(lo, hi_excl))
+
+    def remove_range(self, start: int, stop: int) -> None:
+        """Clear all of [start, stop) (RoaringBitmap.remove(long,long))."""
+        kill: list[int] = []
+        for lo, hi_excl, hb in _chunk_ranges(start, stop):
+            i = self._index(hb)
+            if i < 0:
+                continue
+            if lo == 0 and hi_excl == 0x10000:
+                kill.append(i)
+                continue
+            c = C.container_andnot(self.containers[i], C.range_container(lo, hi_excl))
+            if c.cardinality == 0:
+                kill.append(i)
+            else:
+                self.containers[i] = c
+        for i in reversed(kill):
+            self._delete(i)
+
+    def flip_range(self, start: int, stop: int) -> None:
+        """In-place complement of [start, stop) (RoaringBitmap.flip(long,long))."""
+        for lo, hi_excl, hb in _chunk_ranges(start, stop):
+            i = self._index(hb)
+            rc = C.range_container(lo, hi_excl) if not (lo == 0 and hi_excl == 0x10000) \
+                else C.full_container()
+            if i >= 0:
+                c = C.container_xor(self.containers[i], rc)
+                if c.cardinality == 0:
+                    self._delete(i)
+                else:
+                    self.containers[i] = c
+            else:
+                self._insert(-i - 1, np.uint16(hb), rc)
+
+    def _insert(self, pos: int, key: np.uint16, cont: Container) -> None:
+        self.keys = np.insert(self.keys, pos, key)
+        self.containers.insert(pos, cont)
+
+    def _delete(self, pos: int) -> None:
+        self.keys = np.delete(self.keys, pos)
+        del self.containers[pos]
+
+    def clear(self) -> None:
+        self.keys = np.empty(0, dtype=np.uint16)
+        self.containers = []
+
+    # ------------------------------------------------------- transformations
+    def run_optimize(self) -> bool:
+        """Recompress containers to run encoding where smaller (RoaringBitmap.runOptimize:2764)."""
+        changed = False
+        for i, c in enumerate(self.containers):
+            o = c.run_optimize()
+            if o is not c:
+                self.containers[i] = o
+                changed = changed or o.is_run()
+        return changed
+
+    def has_run_compression(self) -> bool:
+        return any(c.is_run() for c in self.containers)
+
+    def remove_run_compression(self) -> bool:
+        changed = False
+        for i, c in enumerate(self.containers):
+            if c.is_run():
+                self.containers[i] = C.from_values(c.values())
+                changed = True
+        return changed
+
+    def limit(self, max_cardinality: int) -> "RoaringBitmap":
+        """First max_cardinality members (RoaringBitmap.limit)."""
+        keys, conts = [], []
+        left = max_cardinality
+        for k, c in zip(self.keys, self.containers):
+            if left <= 0:
+                break
+            if c.cardinality <= left:
+                keys.append(k)
+                conts.append(c)
+                left -= c.cardinality
+            else:
+                keys.append(k)
+                conts.append(C.from_values(c.values()[:left]))
+                left = 0
+        return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
+
+    def add_offset(self, offset: int) -> "RoaringBitmap":
+        """Value-shifted copy (RoaringBitmap.addOffset:230); drops out-of-range bits."""
+        vals = self.to_array().astype(np.int64) + int(offset)
+        vals = vals[(vals >= 0) & (vals <= 0xFFFFFFFF)]
+        return RoaringBitmap.from_values(vals.astype(np.uint32))
+
+    # ----------------------------------------------------------- set algebra
+    def __and__(self, o: "RoaringBitmap") -> "RoaringBitmap":
+        return and_(self, o)
+
+    def __or__(self, o: "RoaringBitmap") -> "RoaringBitmap":
+        return or_(self, o)
+
+    def __xor__(self, o: "RoaringBitmap") -> "RoaringBitmap":
+        return xor(self, o)
+
+    def __sub__(self, o: "RoaringBitmap") -> "RoaringBitmap":
+        return andnot(self, o)
+
+    def iand(self, o: "RoaringBitmap") -> None:
+        r = and_(self, o)
+        self.keys, self.containers = r.keys, r.containers
+
+    def ior(self, o: "RoaringBitmap") -> None:
+        r = or_(self, o)
+        self.keys, self.containers = r.keys, r.containers
+
+    def ixor(self, o: "RoaringBitmap") -> None:
+        r = xor(self, o)
+        self.keys, self.containers = r.keys, r.containers
+
+    def iandnot(self, o: "RoaringBitmap") -> None:
+        r = andnot(self, o)
+        self.keys, self.containers = r.keys, r.containers
+
+    def intersects(self, o: "RoaringBitmap") -> bool:
+        common, ia, ib = np.intersect1d(self.keys, o.keys,
+                                        assume_unique=True, return_indices=True)
+        return any(
+            C.container_intersects(self.containers[i], o.containers[j])
+            for i, j in zip(ia, ib))
+
+    def is_subset_of(self, o: "RoaringBitmap") -> bool:
+        """RoaringBitmap.contains(RoaringBitmap) analog."""
+        common, ia, ib = np.intersect1d(self.keys, o.keys,
+                                        assume_unique=True, return_indices=True)
+        if common.size != self.keys.size:
+            return False
+        return all(
+            C.container_is_subset(self.containers[i], o.containers[j])
+            for i, j in zip(ia, ib))
+
+    def is_hamming_similar(self, o: "RoaringBitmap", tolerance: int) -> bool:
+        """Symmetric-difference cardinality <= tolerance (RoaringBitmap.isHammingSimilar:1831)."""
+        return xor_cardinality(self, o) <= tolerance
+
+    # ---------------------------------------------------------- equality/repr
+    def __eq__(self, o: object) -> bool:
+        if not isinstance(o, RoaringBitmap):
+            return NotImplemented
+        if self.keys.size != o.keys.size or not np.array_equal(self.keys, o.keys):
+            return False
+        return all(
+            a.cardinality == b.cardinality and np.array_equal(a.values(), b.values())
+            for a, b in zip(self.containers, o.containers))
+
+    def __hash__(self) -> int:
+        return hash(self.to_array().tobytes())
+
+    def __repr__(self) -> str:
+        card = self.cardinality
+        head = ",".join(str(v) for _, v in zip(range(8), self))
+        tail = "..." if card > 8 else ""
+        return f"RoaringBitmap(card={card}, keys={self.keys.size}, {{{head}{tail}}})"
+
+    # ------------------------------------------------------------------- I/O
+    def serialize(self) -> bytes:
+        return spec.serialize(self.keys, self.containers)
+
+    @staticmethod
+    def deserialize(buf: bytes | memoryview) -> "RoaringBitmap":
+        keys, conts = spec.deserialize(buf)
+        return RoaringBitmap(keys, conts)
+
+    def serialized_size_in_bytes(self) -> int:
+        return spec.serialized_size_in_bytes(self.keys, self.containers)
+
+    def get_size_in_bytes(self) -> int:
+        """Rough in-memory footprint (getLongSizeInBytes:2212 analog)."""
+        total = 8 + 2 * self.keys.size
+        for c in self.containers:
+            total += c.serialized_size_in_bytes()
+        return total
+
+    # ------------------------------------------------------------- statistics
+    def container_count(self) -> int:
+        return len(self.containers)
+
+
+def _chunk_ranges(start: int, stop: int):
+    """Split [start, stop) into per-chunk (lo, hi_excl, highbits) pieces."""
+    if start >= stop:
+        return
+    if start < 0 or stop > (1 << 32):
+        raise ValueError("range outside the 32-bit universe")
+    hb_first, hb_last = start >> 16, (stop - 1) >> 16
+    for hb in range(hb_first, hb_last + 1):
+        lo = start & 0xFFFF if hb == hb_first else 0
+        hi_excl = ((stop - 1) & 0xFFFF) + 1 if hb == hb_last else 0x10000
+        yield lo, hi_excl, hb
+
+
+# ---------------------------------------------------------------------------
+# Pairwise static algebra: two-pointer key merge (RoaringBitmap.or:860-894
+# skeleton), vectorized over the key axis with intersect1d/union1d.
+# ---------------------------------------------------------------------------
+
+def and_(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
+    common, ia, ib = np.intersect1d(a.keys, b.keys, assume_unique=True,
+                                    return_indices=True)
+    keys, conts = [], []
+    for k, i, j in zip(common, ia, ib):
+        c = C.container_and(a.containers[i], b.containers[j])
+        if c.cardinality:
+            keys.append(k)
+            conts.append(c)
+    return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
+
+
+def or_(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
+    return _merge_union(a, b, C.container_or)
+
+
+def xor(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
+    return _merge_union(a, b, C.container_xor, drop_empty=True)
+
+
+def andnot(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
+    keys, conts = [], []
+    b_idx = {int(k): j for j, k in enumerate(b.keys)}
+    for k, ca in zip(a.keys, a.containers):
+        j = b_idx.get(int(k))
+        c = ca if j is None else C.container_andnot(ca, b.containers[j])
+        if c.cardinality:
+            keys.append(k)
+            conts.append(c)
+    return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
+
+
+def or_not(a: RoaringBitmap, b: RoaringBitmap, range_end: int) -> RoaringBitmap:
+    """a | ~b restricted to [0, range_end) (RoaringBitmap.orNot:1431)."""
+    comp = b.clone()
+    comp.flip_range(0, range_end)
+    return or_(a, comp)
+
+
+def _merge_union(a: RoaringBitmap, b: RoaringBitmap, op, drop_empty: bool = False):
+    all_keys = np.union1d(a.keys, b.keys)
+    a_idx = {int(k): i for i, k in enumerate(a.keys)}
+    b_idx = {int(k): i for i, k in enumerate(b.keys)}
+    keys, conts = [], []
+    for k in all_keys:
+        i, j = a_idx.get(int(k)), b_idx.get(int(k))
+        if i is not None and j is not None:
+            c = op(a.containers[i], b.containers[j])
+        elif i is not None:
+            c = a.containers[i]
+        else:
+            c = b.containers[j]
+        if drop_empty and c.cardinality == 0:
+            continue
+        keys.append(k)
+        conts.append(c)
+    return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
+
+
+def and_cardinality(a: RoaringBitmap, b: RoaringBitmap) -> int:
+    common, ia, ib = np.intersect1d(a.keys, b.keys, assume_unique=True,
+                                    return_indices=True)
+    return sum(
+        C.container_and_cardinality(a.containers[i], b.containers[j])
+        for i, j in zip(ia, ib))
+
+
+def or_cardinality(a: RoaringBitmap, b: RoaringBitmap) -> int:
+    """Inclusion-exclusion (FastAggregation.or_cardinality analog)."""
+    return a.cardinality + b.cardinality - and_cardinality(a, b)
+
+
+def xor_cardinality(a: RoaringBitmap, b: RoaringBitmap) -> int:
+    return a.cardinality + b.cardinality - 2 * and_cardinality(a, b)
+
+
+def andnot_cardinality(a: RoaringBitmap, b: RoaringBitmap) -> int:
+    return a.cardinality - and_cardinality(a, b)
+
+
+def flip(a: RoaringBitmap, start: int, stop: int) -> RoaringBitmap:
+    out = a.clone()
+    out.containers = list(out.containers)
+    out.flip_range(start, stop)
+    return out

@@ -1,0 +1,73 @@
+"""real-roaring-dataset loader.
+
+Reads the reference's canonical dataset zips directly (each `.txt` zip member
+is one bitmap's comma-separated sorted int list — ZipRealDataRetriever
+analog, /root/reference/real-roaring-dataset/src/main/java/.../ZipRealDataRetriever.java).
+"""
+
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+from ..core.bitmap import RoaringBitmap
+
+REFERENCE_DATASET_DIR = (
+    "/root/reference/real-roaring-dataset/src/main/resources/real-roaring-dataset"
+)
+
+#: Datasets present in this mirror (BASELINE.md; seven larger ones stripped).
+AVAILABLE = (
+    "census1881", "census1881_srt", "uscensus2000",
+    "wikileaks-noquotes", "wikileaks-noquotes_srt",
+)
+
+
+def dataset_path(name: str) -> str:
+    return os.path.join(REFERENCE_DATASET_DIR, f"{name}.zip")
+
+
+def has_dataset(name: str) -> bool:
+    return os.path.exists(dataset_path(name))
+
+
+def load_value_arrays(name: str) -> list[np.ndarray]:
+    """Each zip member -> one sorted u32 value array."""
+    out = []
+    with zipfile.ZipFile(dataset_path(name)) as z:
+        for member in sorted(z.namelist()):
+            raw = z.read(member).decode()
+            parts = [p for p in raw.replace("\n", ",").split(",") if p]
+            out.append(np.array(parts, dtype=np.int64).astype(np.uint32))
+    return out
+
+
+def load_bitmaps(name: str) -> list[RoaringBitmap]:
+    return [RoaringBitmap.from_values(v) for v in load_value_arrays(name)]
+
+
+def synthetic_bitmaps(n: int, seed: int = 0, universe: int = 1 << 22,
+                      density: float = 0.01) -> list[RoaringBitmap]:
+    """Random bitmap set for tests/benches when datasets are unavailable.
+
+    Mix of sparse/dense/run-heavy chunks in the spirit of the fuzzer's
+    RandomisedTestData (fuzz-tests/.../RandomisedTestData.java:17-53).
+    """
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        kind = rng.integers(3)
+        count = max(1, int(universe * density))
+        if kind == 0:  # sparse uniform
+            v = rng.integers(0, universe, count)
+        elif kind == 1:  # dense clusters
+            centers = rng.integers(0, universe, 8)
+            v = (centers[:, None] + rng.integers(0, 1 << 14, (8, count // 8))).ravel()
+        else:  # runs
+            starts = rng.integers(0, universe, 64)
+            lens = rng.integers(1, 2048, 64)
+            v = np.concatenate([np.arange(s, s + l) for s, l in zip(starts, lens)])
+        out.append(RoaringBitmap.from_values((v % universe).astype(np.uint32)))
+    return out

@@ -173,29 +173,47 @@ class RoaringBitmap:
     def next_absent_value(self, x: int) -> int:
         """Smallest non-member >= x (RoaringBitmap.nextAbsentValue)."""
         y = x
-        while y <= 0xFFFFFFFF and self.contains(y):
+        while y <= 0xFFFFFFFF:
             i = self._index(y >> 16)
+            if i < 0:
+                return y
             c = self.containers[i]
+            lo = y & 0xFFFF
+            if not c.contains(lo):
+                return y
             vals = c.values().astype(np.int64)
-            lo = int(np.searchsorted(vals, y & 0xFFFF))
-            run_end = lo
-            # first gap at/after position lo within this container
-            gap = np.flatnonzero(np.diff(vals[lo:]) != 1)
-            if gap.size:
-                return (int(y) & ~0xFFFF) + int(vals[lo + gap[0]]) + 1
+            tail = vals[int(np.searchsorted(vals, lo)):]
+            expect = lo + np.arange(tail.size)
+            mism = np.flatnonzero(tail != expect)
+            if mism.size:
+                return (y & ~0xFFFF) + int(expect[mism[0]])
+            nxt = lo + tail.size  # contiguous through end of container
+            if nxt <= 0xFFFF:
+                return (y & ~0xFFFF) + nxt
             y = ((y >> 16) + 1) << 16
         return y
 
     def previous_absent_value(self, x: int) -> int:
+        """Largest non-member <= x (RoaringBitmap.previousAbsentValue)."""
         y = x
-        while y >= 0 and self.contains(y):
+        while y >= 0:
             i = self._index(y >> 16)
-            vals = self.containers[i].values().astype(np.int64)
-            hi = int(np.searchsorted(vals, y & 0xFFFF))
-            gap = np.flatnonzero(np.diff(vals[:hi + 1]) != 1)
-            if gap.size:
-                return (int(y) & ~0xFFFF) + int(vals[gap[-1] + 1]) - 1
-            y = ((y >> 16) << 16) - 1 if vals[0] == 0 else (int(y) & ~0xFFFF) + int(vals[0]) - 1
+            if i < 0:
+                return y
+            c = self.containers[i]
+            lo = y & 0xFFFF
+            if not c.contains(lo):
+                return y
+            vals = c.values().astype(np.int64)
+            head = vals[:int(np.searchsorted(vals, lo)) + 1][::-1]  # descending from lo
+            expect = lo - np.arange(head.size)
+            mism = np.flatnonzero(head != expect)
+            if mism.size:
+                return (y & ~0xFFFF) + int(expect[mism[0]])
+            prv = lo - head.size  # contiguous down to container start
+            if prv >= 0:
+                return (y & ~0xFFFF) + prv
+            y = ((y >> 16) << 16) - 1
         return y
 
     # ------------------------------------------------------------- iteration
@@ -518,8 +536,14 @@ def andnot(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
 
 
 def or_not(a: RoaringBitmap, b: RoaringBitmap, range_end: int) -> RoaringBitmap:
-    """a | ~b restricted to [0, range_end) (RoaringBitmap.orNot:1431)."""
+    """a | (~b over [0, range_end)) (RoaringBitmap.orNot:1431).
+
+    b's members at/above range_end do not contribute (the reference's key
+    loop stops at maxKey and copies only a's remaining containers); a's
+    members above range_end are kept.
+    """
     comp = b.clone()
+    comp.remove_range(range_end, 1 << 32)
     comp.flip_range(0, range_end)
     return or_(a, comp)
 

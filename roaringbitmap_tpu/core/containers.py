@@ -225,6 +225,26 @@ class BitmapContainer(Container):
     def contains(self, x: int) -> bool:
         return bool((int(self._words[x >> 6]) >> (x & 63)) & 1)
 
+    def add(self, x: int) -> "Container":
+        w = int(self._words[x >> 6])
+        bit = 1 << (x & 63)
+        if w & bit:
+            return self
+        words = self._words.copy()
+        words[x >> 6] = np.uint64(w | bit)
+        return BitmapContainer(words, self._card + 1)
+
+    def remove(self, x: int) -> "Container":
+        w = int(self._words[x >> 6])
+        bit = 1 << (x & 63)
+        if not (w & bit):
+            return self
+        words = self._words.copy()
+        words[x >> 6] = np.uint64(w & ~bit)
+        if self._card - 1 <= ARRAY_MAX_SIZE:  # demote (BitmapContainer.remove)
+            return ArrayContainer(words_to_values(words))
+        return BitmapContainer(words, self._card - 1)
+
 
 class RunContainer(Container):
     __slots__ = ("_runs",)

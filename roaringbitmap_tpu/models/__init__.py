@@ -1,0 +1,3 @@
+from . import flagship
+
+__all__ = ["flagship"]

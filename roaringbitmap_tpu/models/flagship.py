@@ -1,0 +1,40 @@
+"""Flagship pipeline: the end-to-end wide-aggregation "model".
+
+This is the framework's north-star workload (BASELINE.json): N compressed
+bitmaps -> group-by-key rotation -> HBM-resident word tensors -> one fused
+device pass producing the union/intersection/symmetric-difference and exact
+per-key cardinalities.  The driver's compile check (__graft_entry__.entry)
+jits `forward`; the multi-chip dry run shards it over a Mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.bitmap import RoaringBitmap
+from ..ops import dense, packing
+
+
+def forward(words: jnp.ndarray, seg_ids: jnp.ndarray, head_idx: jnp.ndarray):
+    """Single-chip jittable forward step: wide OR + fused cardinality.
+
+    words u32[M, 2048], seg_ids i32[M] (sorted), head_idx i32[K]
+    -> (u32[K, 2048] union words, i32[K] cardinalities).
+    """
+    n_steps = max(1, int(words.shape[0]).bit_length())
+    return dense.segmented_reduce("or", words, seg_ids, head_idx, n_steps)
+
+
+def example_inputs(n_bitmaps: int = 16, seed: int = 0):
+    """Tiny packed aggregation problem for compile checks."""
+    rng = np.random.default_rng(seed)
+    bitmaps = [
+        RoaringBitmap.from_values(
+            rng.integers(0, 1 << 18, 2048).astype(np.uint32))
+        for _ in range(n_bitmaps)
+    ]
+    packed = packing.pack_for_aggregation(bitmaps)
+    return (jnp.asarray(packed.words), jnp.asarray(packed.seg_ids),
+            jnp.asarray(packed.head_idx))

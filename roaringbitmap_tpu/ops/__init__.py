@@ -1,0 +1,3 @@
+from . import dense, kernels, packing
+
+__all__ = ["dense", "kernels", "packing"]

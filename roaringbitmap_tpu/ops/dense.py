@@ -1,0 +1,131 @@
+"""Dense device kernels (jnp/XLA tier): word algebra, popcount, segment reduce.
+
+This is the XLA-composable reference tier for every device op; the Pallas
+tier (roaringbitmap_tpu.ops.kernels) provides fused fast paths for the hot
+ones and is checked against these in tests.
+
+Containers live on device as u32[..., 2048] word tensors (2^16 bits per
+container, u32 because TPUs have no native 64-bit integer lanes).  The ops
+here replace the reference's word-loop kernels in Util.java (e.g.
+cardinalityInBitmapRange :415, fillArrayAND :300) and the lazy-or/repair
+machinery (BitmapContainer.lazyor :878-909, Container.repairAfterLazy :869):
+on TPU the "repair" popcount is just a fused reduction after the bitwise op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WORDS32 = 2048
+
+#: Single source of truth for the bitwise op vocabulary (kernels.py and
+#: parallel/sharding.py dispatch through this table too).
+OPS = {
+    "or": jnp.bitwise_or,
+    "and": jnp.bitwise_and,
+    "xor": jnp.bitwise_xor,
+    "andnot": lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
+}
+_OPS = OPS
+
+
+def popcount(words: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Set-bit count along an axis of u32 words -> int32 cardinalities."""
+    return jnp.sum(jax.lax.population_count(words).astype(jnp.int32), axis=axis)
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def pairwise(op: str, a: jnp.ndarray, b: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched pairwise container op with fused cardinality.
+
+    a, b: u32[K, 2048] aligned container payloads -> (u32[K, 2048], i32[K]).
+    The device analog of the 9-way container dispatch (Container.java:63-181)
+    collapsed to one uniform word kernel.
+    """
+    out = _OPS[op](a, b)
+    return out, popcount(out)
+
+
+def doubling_pass(fn, words: jnp.ndarray, seg_ids: jnp.ndarray,
+                  n_steps: int) -> jnp.ndarray:
+    """Parallel-doubling segmented scan: after the pass, row i holds the
+    reduction of rows [i, i + 2^n_steps) of its own segment; segment head
+    rows therefore hold the full per-segment reduction once
+    n_steps >= ceil(log2(max segment size)).  seg_ids must be sorted."""
+    m = words.shape[0]
+    d = 1
+    for _ in range(n_steps):
+        if d >= m:
+            break
+        shifted = jnp.concatenate(
+            [words[d:], jnp.zeros((d, words.shape[1]), words.dtype)])
+        same = jnp.concatenate(
+            [seg_ids[d:] == seg_ids[:-d], jnp.zeros((d,), dtype=bool)])
+        words = jnp.where(same[:, None], fn(words, shifted), words)
+        d *= 2
+    return words
+
+
+@functools.partial(jax.jit, static_argnames=("op", "n_steps"))
+def segmented_reduce(op: str, words: jnp.ndarray, seg_ids: jnp.ndarray,
+                     head_idx: jnp.ndarray, n_steps: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Ragged per-key reduction by parallel doubling over sorted segments.
+
+    words u32[M, 2048], seg_ids i32[M] sorted.  This is the device
+    replacement for ParallelAggregation's per-key fork-join reduce
+    (ParallelAggregation.java:160-222): O(M log G) word ops, no data-dependent
+    control flow, shapes static under jit.
+
+    Returns (u32[K, 2048] per-key words, i32[K] per-key cardinalities).
+    """
+    heads = doubling_pass(OPS[op], words, seg_ids, n_steps)[head_idx]
+    return heads, popcount(heads)
+
+
+@jax.jit
+def regular_reduce_and(words: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Wide AND over a regular block u32[K, N, 2048] (post key-intersection).
+
+    workShyAnd's per-key iand chain (FastAggregation.java:393-411) as one
+    reduction; the all-ones temporary disappears because the block is regular.
+    """
+    out = jax.lax.reduce(words, jnp.uint32(0xFFFFFFFF),
+                         jax.lax.bitwise_and, (1,))
+    return out, popcount(out)
+
+
+@jax.jit
+def key_mask_intersection(masks: jnp.ndarray) -> jnp.ndarray:
+    """AND-reduce of u32[N, 2048] key-presence masks -> u32[2048].
+
+    Device form of Util.intersectArrayIntoBitmap over container keys
+    (Util.java:531, used by FastAggregation.java:364-371).
+    """
+    return jax.lax.reduce(masks, jnp.uint32(0xFFFFFFFF),
+                          jax.lax.bitwise_and, (0,))
+
+
+@jax.jit
+def range_cardinality(words: jnp.ndarray, start: jnp.ndarray,
+                      stop: jnp.ndarray) -> jnp.ndarray:
+    """Popcount of bits [start, stop) inside a u32[2048] container image.
+
+    Util.cardinalityInBitmapRange (Util.java:415) without the word loop:
+    build the range mask with vectorized clamped shifts.
+    """
+    idx = jnp.arange(WORDS32, dtype=jnp.int32)
+    word_lo = idx * 32
+    lo = jnp.clip(start - word_lo, 0, 32)
+    hi = jnp.clip(stop - word_lo, 0, 32)
+    n = (hi - lo).astype(jnp.uint32)
+    mask = jnp.where(n >= 32, jnp.uint32(0xFFFFFFFF),
+                     ((jnp.uint32(1) << n) - 1) << lo.astype(jnp.uint32))
+    return popcount(words & mask, axis=-1)
+
+
+def n_steps_for(max_group: int) -> int:
+    return max(1, int(max(1, max_group - 1)).bit_length())

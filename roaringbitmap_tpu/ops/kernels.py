@@ -1,0 +1,133 @@
+"""Pallas TPU kernels — fused fast paths for the hot aggregation ops.
+
+The flagship kernel is the ragged segmented reduction: one sequential grid
+pass over M densified containers, accumulating each key's segment in VMEM and
+flushing to HBM once per key.  Versus the jnp doubling tier
+(ops.dense.segmented_reduce, O(M log G) HBM traffic) this touches each input
+row exactly once: O(M) reads + O(K) writes.
+
+It is the TPU re-design of the reference's lazy-or chain
+(Container.lazyOR/lazyIOR -> BitmapContainer.lazyor, BitmapContainer.java:878-909):
+"lazy" (skip per-step cardinality) becomes "accumulate in VMEM"; the final
+repairAfterLazy popcount (Container.java:869-873) runs as one fused pass on
+the way out.
+
+Layout note: container word images are reshaped u32[2048] -> u32[16, 128] so
+every block meets the (8, 128) fp32/i32 tile floor without padding waste.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import dense
+
+WORDS32 = 2048
+_SUB, _LANE = 16, 128  # 16*128 = 2048 u32 words = 2^16 bits
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+def _seg_reduce_kernel(op):
+    def kernel(seg_ref, words_ref, out_ref):
+        i = pl.program_id(0)
+        prev = seg_ref[jnp.maximum(i - 1, 0)]
+        is_head = jnp.logical_or(i == 0, seg_ref[i] != prev)
+
+        @pl.when(is_head)
+        def _init():
+            out_ref[...] = words_ref[...]
+
+        @pl.when(jnp.logical_not(is_head))
+        def _accum():
+            out_ref[...] = op(out_ref[...], words_ref[...])
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("op", "num_segments"))
+def segmented_reduce_pallas(op: str, words: jnp.ndarray, seg_ids: jnp.ndarray,
+                            num_segments: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Ragged per-key reduce: (u32[M,2048], sorted i32[M]) -> (u32[K,2048], i32[K]).
+
+    seg_ids must be sorted ascending; padding rows carry segment id K and land
+    in a scratch row that is dropped.  Sequential-grid VMEM accumulation: the
+    output BlockSpec maps every row of a segment to the same block, so the
+    accumulator stays on-chip until the segment ends (same mechanism as a
+    matmul k-loop).
+    """
+    ops = dense.OPS
+    m = words.shape[0]
+    w3 = words.reshape(m, _SUB, _LANE)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m,),
+        in_specs=[pl.BlockSpec((1, _SUB, _LANE), lambda i, seg: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, _SUB, _LANE), lambda i, seg: (seg[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        _seg_reduce_kernel(ops[op]),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_segments + 1, _SUB, _LANE), jnp.uint32),
+        interpret=_use_interpret(),
+    )(seg_ids, w3)
+    heads = out[:num_segments].reshape(num_segments, WORDS32)
+    cards = jnp.sum(jax.lax.population_count(heads).astype(jnp.int32), axis=-1)
+    return heads, cards
+
+
+def _pairwise_popcount_kernel(op):
+    def kernel(a_ref, b_ref, out_ref, card_ref):
+        r = op(a_ref[...], b_ref[...])
+        out_ref[...] = r
+        card_ref[...] = jnp.sum(
+            jax.lax.population_count(r).astype(jnp.int32), axis=(1, 2),
+            keepdims=False)[:, None]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("op", "block_k"))
+def pairwise_popcount_pallas(op: str, a: jnp.ndarray, b: jnp.ndarray,
+                             block_k: int = 8) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused batched pairwise op + cardinality: u32[K,2048] x2 -> (u32[K,2048], i32[K]).
+
+    One HBM pass instead of XLA's op-then-reduce two; the popcount rides the
+    VPU while the result block is still in VMEM (BitmapContainer.or's
+    branchless fused cardinality, BitmapContainer.java:1064-1085, done wide).
+    """
+    ops = dense.OPS
+    k = a.shape[0]
+    kp = -(-k // block_k) * block_k
+    if kp != k:
+        pad = ((0, kp - k), (0, 0))
+        a = jnp.pad(a, pad)
+        b = jnp.pad(b, pad)
+    a3 = a.reshape(kp, _SUB, _LANE)
+    b3 = b.reshape(kp, _SUB, _LANE)
+    grid = (kp // block_k,)
+    out, cards = pl.pallas_call(
+        _pairwise_popcount_kernel(ops[op]),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
+            pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
+            pl.BlockSpec((block_k, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((kp, _SUB, _LANE), jnp.uint32),
+            jax.ShapeDtypeStruct((kp, 1), jnp.int32),
+        ],
+        interpret=_use_interpret(),
+    )(a3, b3)
+    return out[:k].reshape(k, WORDS32), cards[:k, 0]

@@ -1,0 +1,142 @@
+"""Host->device packing: the group-by-key rotation, vectorized.
+
+The reference's ParallelAggregation.groupByKey (ParallelAggregation.java:136-152)
+rotates N bitmaps into key -> List<Container> before the fork-join reduce.
+Here the same rotation produces flat, fixed-shape tensors ready for HBM:
+
+  words    u32[M, 2048]   every container densified to its 2^16-bit word image
+  seg_ids  i32[M]         index into the distinct-key axis, sorted ascending
+  head_idx i32[K]         first row of each segment
+  keys     u16[K]         distinct high-16 keys, sorted
+
+Densifying everything to words is what the reference's own wide paths do on
+CPU anyway (FastAggregation.java:395-399 and ParallelAggregation.java:108,214
+accumulate into dense BitmapContainers); on TPU it additionally buys fully
+static shapes and a perfectly regular memory layout.
+
+Rows are padded to a bucket size (next power of two) so recompiles stop once
+the workload shape stabilizes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.bitmap import RoaringBitmap
+from ..core.containers import WORDS_PER_CONTAINER
+
+WORDS32 = 2 * WORDS_PER_CONTAINER  # 2048 u32 words per container
+
+
+def next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (int(n - 1).bit_length())
+
+
+def container_words_u32(c) -> np.ndarray:
+    """Dense u32[2048] image of one container (little-endian word split)."""
+    return c.words().view(np.uint32)
+
+
+@dataclass
+class PackedAggregation:
+    """One wide-aggregation problem, rotated and densified."""
+
+    keys: np.ndarray          # u16[K] distinct keys, sorted
+    words: np.ndarray         # u32[M_pad, 2048]; rows >= M are zero
+    seg_ids: np.ndarray       # i32[M_pad]; padding rows get segment K (out of range)
+    head_idx: np.ndarray      # i32[K] first row of each segment
+    seg_sizes: np.ndarray     # i32[K]
+    m: int                    # true row count
+    max_group: int            # largest segment size
+
+    @property
+    def num_keys(self) -> int:
+        return int(self.keys.size)
+
+
+def pack_for_aggregation(bitmaps: list[RoaringBitmap],
+                         pad_rows: bool = True) -> PackedAggregation:
+    """Rotate + densify N bitmaps for a wide OR/XOR (ragged segments)."""
+    all_keys = [b.keys for b in bitmaps]
+    flat_keys = np.concatenate(all_keys) if all_keys else np.empty(0, np.uint16)
+    order = np.argsort(flat_keys, kind="stable")
+    keys, seg_of_row = np.unique(flat_keys, return_inverse=True)
+    m = flat_keys.size
+
+    conts = [c for b in bitmaps for c in b.containers]
+    m_pad = next_pow2(m) if pad_rows else m
+    words = np.zeros((m_pad, WORDS32), dtype=np.uint32)
+    for out_row, src_row in enumerate(order):
+        words[out_row] = container_words_u32(conts[src_row])
+
+    seg_ids = np.full(m_pad, keys.size, dtype=np.int32)
+    seg_ids[:m] = seg_of_row[order]
+    head_idx = np.searchsorted(seg_ids[:m], np.arange(keys.size)).astype(np.int32)
+    seg_sizes = np.diff(np.append(head_idx, m)).astype(np.int32)
+    return PackedAggregation(
+        keys=keys.astype(np.uint16), words=words, seg_ids=seg_ids,
+        head_idx=head_idx, seg_sizes=seg_sizes, m=m,
+        max_group=int(seg_sizes.max()) if keys.size else 0)
+
+
+@dataclass
+class PackedIntersection:
+    """Wide-AND problem: only keys present in every bitmap survive
+    (FastAggregation.workShyAnd key-set intersection, FastAggregation.java:356-380),
+    so the payload is a perfectly regular [K, N, 2048] block."""
+
+    keys: np.ndarray    # u16[K] surviving keys
+    words: np.ndarray   # u32[K, N, 2048]
+
+
+def pack_for_intersection(bitmaps: list[RoaringBitmap]) -> PackedIntersection:
+    keys = bitmaps[0].keys
+    for b in bitmaps[1:]:
+        keys = np.intersect1d(keys, b.keys, assume_unique=True)
+        if keys.size == 0:
+            break
+    n = len(bitmaps)
+    words = np.zeros((keys.size, n, WORDS32), dtype=np.uint32)
+    for j, b in enumerate(bitmaps):
+        idx = np.searchsorted(b.keys, keys)
+        for i, bi in enumerate(idx):
+            words[i, j] = container_words_u32(b.containers[bi])
+    return PackedIntersection(keys=keys.astype(np.uint16), words=words)
+
+
+def key_presence_masks(bitmaps: list[RoaringBitmap]) -> np.ndarray:
+    """u32[N, 2048] — 65,536-bit key presence mask per bitmap.
+
+    The device form of workShyAnd's 1024-long key bitset
+    (FastAggregation.java:359-363): key-set intersection of N bitmaps is one
+    vectorized AND-reduce over this tensor.
+    """
+    n = len(bitmaps)
+    masks = np.zeros((n, WORDS32), dtype=np.uint32)
+    for i, b in enumerate(bitmaps):
+        k = b.keys.astype(np.int64)
+        np.bitwise_or.at(masks[i], k >> 5, np.uint32(1) << (k & 31).astype(np.uint32))
+    return masks
+
+
+def unpack_result(keys: np.ndarray, words: np.ndarray,
+                  cards: np.ndarray) -> RoaringBitmap:
+    """Device dense result -> host RoaringBitmap (normalize by cardinality)."""
+    from ..core import containers as C
+
+    words = np.asarray(words, dtype=np.uint32)
+    cards = np.asarray(cards)
+    out_keys, out_conts = [], []
+    for i in range(keys.size):
+        card = int(cards[i])
+        if card == 0:
+            continue
+        w64 = words[i].view(np.uint64)
+        out_keys.append(keys[i])
+        if card > C.ARRAY_MAX_SIZE:
+            out_conts.append(C.BitmapContainer(w64.copy(), card))
+        else:
+            out_conts.append(C.ArrayContainer(C.words_to_values(w64)))
+    return RoaringBitmap(np.array(out_keys, dtype=np.uint16), out_conts)

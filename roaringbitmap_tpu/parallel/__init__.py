@@ -1,0 +1,4 @@
+from . import aggregation, sharding
+from .aggregation import DeviceBitmapSet
+
+__all__ = ["aggregation", "sharding", "DeviceBitmapSet"]

@@ -1,0 +1,192 @@
+"""Wide aggregation engine — the FastAggregation / ParallelAggregation analog.
+
+Public entry points take N host bitmaps (or a resident DeviceBitmapSet),
+execute the wide OR/AND/XOR on device, and return a host RoaringBitmap with
+exact cardinalities.  Strategy map from the reference:
+
+- FastAggregation.horizontal_or's container-PQ + lazy-OR chain
+  (FastAggregation.java:124-160) -> group-by-key rotation (ops.packing) + one
+  segmented reduce kernel (ops.kernels / ops.dense).
+- ParallelAggregation's fork-join per-key parallelism
+  (ParallelAggregation.java:160-222) -> the kernel grid itself; there is no
+  thread pool to size.
+- FastAggregation.workShyAnd's key-set intersection (:356-380) ->
+  pack_for_intersection + one regular [K, N, 2048] AND-reduce.
+- repairAfterLazy (Container.java:869-873) -> fused popcount on the way out.
+
+Engine selection: "pallas" (fused single-pass kernel) on TPU, "xla" (doubling
+reduce) anywhere; "auto" picks by backend.  Both are tested for bit-equality.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.bitmap import RoaringBitmap
+from ..ops import dense, kernels, packing
+
+
+def _engine(engine: str) -> str:
+    if engine == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return engine
+
+
+def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
+                      engine: str) -> RoaringBitmap:
+    bitmaps = [b for b in bitmaps if not b.is_empty()]
+    if not bitmaps:
+        return RoaringBitmap()
+    if len(bitmaps) == 1:
+        return bitmaps[0].clone()
+    packed = packing.pack_for_aggregation(bitmaps)
+    heads, cards = _run_ragged(op, packed, engine)
+    return packing.unpack_result(packed.keys, np.asarray(heads), np.asarray(cards))
+
+
+def _run_ragged(op: str, packed: packing.PackedAggregation, engine: str):
+    if _engine(engine) == "pallas":
+        return kernels.segmented_reduce_pallas(
+            op, jnp.asarray(packed.words), jnp.asarray(packed.seg_ids),
+            packed.num_keys)
+    return dense.segmented_reduce(
+        op, jnp.asarray(packed.words), jnp.asarray(packed.seg_ids),
+        jnp.asarray(packed.head_idx), dense.n_steps_for(packed.max_group))
+
+
+def or_(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
+    """Wide union on device (FastAggregation.or :664 / ParallelAggregation.or :160)."""
+    return _aggregate_ragged("or", _flatten(bitmaps), engine)
+
+
+def xor(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
+    """Wide symmetric difference (FastAggregation.xor / ParallelAggregation.xor)."""
+    return _aggregate_ragged("xor", _flatten(bitmaps), engine)
+
+
+def and_(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
+    """Wide intersection (FastAggregation.and workShyAnd :356)."""
+    bitmaps = _flatten(bitmaps)
+    if not bitmaps:
+        return RoaringBitmap()
+    if any(b.is_empty() for b in bitmaps):
+        return RoaringBitmap()
+    if len(bitmaps) == 1:
+        return bitmaps[0].clone()
+    packed = packing.pack_for_intersection(bitmaps)
+    if packed.keys.size == 0:
+        return RoaringBitmap()
+    words, cards = dense.regular_reduce_and(jnp.asarray(packed.words))
+    return packing.unpack_result(packed.keys, np.asarray(words), np.asarray(cards))
+
+
+def or_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto") -> int:
+    """Cardinality of the wide union without materializing it on host."""
+    bitmaps = [b for b in _flatten(bitmaps) if not b.is_empty()]
+    if not bitmaps:
+        return 0
+    packed = packing.pack_for_aggregation(bitmaps)
+    _, cards = _run_ragged("or", packed, engine)
+    return int(np.asarray(jnp.sum(cards)))
+
+
+def and_cardinality(*bitmaps: RoaringBitmap) -> int:
+    bitmaps = _flatten(bitmaps)
+    if not bitmaps or any(b.is_empty() for b in bitmaps):
+        return 0
+    packed = packing.pack_for_intersection(bitmaps)
+    if packed.keys.size == 0:
+        return 0
+    _, cards = dense.regular_reduce_and(jnp.asarray(packed.words))
+    return int(np.asarray(jnp.sum(cards)))
+
+
+def xor_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto") -> int:
+    bitmaps = [b for b in _flatten(bitmaps) if not b.is_empty()]
+    if not bitmaps:
+        return 0
+    packed = packing.pack_for_aggregation(bitmaps)
+    _, cards = _run_ragged("xor", packed, engine)
+    return int(np.asarray(jnp.sum(cards)))
+
+
+def _flatten(bitmaps) -> list[RoaringBitmap]:
+    if len(bitmaps) == 1 and not isinstance(bitmaps[0], RoaringBitmap):
+        return list(bitmaps[0])
+    return list(bitmaps)
+
+
+class DeviceBitmapSet:
+    """N bitmaps packed once and kept HBM-resident for repeated wide queries.
+
+    The ImmutableRoaringBitmap-stays-mmap'd usage pattern (README.md:198-274)
+    translated to HBM: pack once, aggregate many times without re-transfer.
+    """
+
+    def __init__(self, bitmaps: list[RoaringBitmap]):
+        self.n = len(bitmaps)
+        self._packed = packing.pack_for_aggregation(bitmaps)
+        self.keys = self._packed.keys
+        self.words = jax.device_put(self._packed.words)
+        self.seg_ids = jax.device_put(self._packed.seg_ids)
+        self.head_idx = jax.device_put(self._packed.head_idx)
+        self.n_steps = dense.n_steps_for(self._packed.max_group)
+
+    def aggregate_device(self, op: str, engine: str = "auto"):
+        """Run the wide op; returns device (words u32[K,2048], cards i32[K]).
+
+        op is "or" or "xor".  AND is rejected: the ragged segment layout has
+        no rows for keys a bitmap lacks, so a segmented "and" would silently
+        ignore missing containers; use aggregation.and_ (workShy path).
+        """
+        if op not in ("or", "xor"):
+            raise ValueError(f"DeviceBitmapSet supports or/xor, not {op!r}; "
+                             "use aggregation.and_ for wide intersections")
+        if _engine(engine) == "pallas":
+            return kernels.segmented_reduce_pallas(
+                op, self.words, self.seg_ids, self.keys.size)
+        return dense.segmented_reduce(
+            op, self.words, self.seg_ids, self.head_idx, self.n_steps)
+
+    def aggregate(self, op: str, engine: str = "auto") -> RoaringBitmap:
+        words, cards = self.aggregate_device(op, engine)
+        return packing.unpack_result(self.keys, np.asarray(words), np.asarray(cards))
+
+    def hbm_bytes(self) -> int:
+        return int(self._packed.words.nbytes + self._packed.seg_ids.nbytes
+                   + self._packed.head_idx.nbytes)
+
+    def chained_wide_or(self, reps: int, engine: str = "auto"):
+        """Steady-state throughput probe: `reps` dependent wide-ORs in ONE jit.
+
+        Each iteration writes the union's first per-key row back into input
+        row 0 — idempotent for OR (row 0 belongs to segment 0, and OR-ing a
+        segment's own union back in changes nothing), but a true data
+        dependency, so neither XLA nor the runtime can elide or cache
+        repeated executions.  Returns the summed cardinality over all reps;
+        callers assert it equals reps * expected to prove every iteration
+        really ran bit-exact.  This is the measurement loop bench.py uses
+        (single dispatch, JMH-style steady state).
+        """
+        eng = _engine(engine)
+        seg_ids, head_idx, n_keys, n_steps = (
+            self.seg_ids, self.head_idx, self.keys.size, self.n_steps)
+
+        def body(i, state):
+            words, total = state
+            if eng == "pallas":
+                heads, cards = kernels.segmented_reduce_pallas(
+                    "or", words, seg_ids, n_keys)
+            else:
+                heads, cards = dense.segmented_reduce(
+                    "or", words, seg_ids, head_idx, n_steps)
+            words = words.at[0].set(heads[0])
+            return words, total + jnp.sum(cards)
+
+        def run(words):
+            # int32 accumulator: callers keep reps * cardinality < 2^31
+            return jax.lax.fori_loop(0, reps, body, (words, jnp.int32(0)))[1]
+
+        return jax.jit(run)

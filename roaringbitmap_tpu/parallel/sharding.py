@@ -1,0 +1,119 @@
+"""Multi-device wide aggregation over a jax.sharding.Mesh.
+
+The reference's only multi-worker execution is a single-JVM ForkJoinPool
+(ParallelAggregation.java:160-186).  Here the same rotation scales across
+chips: the container-row axis is sharded over the mesh's "rows" axis (the
+data-parallel analog), the 2048-word lane axis over "lanes" (tensor-parallel
+analog).  Each device reduces its resident rows into a dense per-key
+accumulator; cross-device combination is a bitwise OR/XOR/AND tree over ICI.
+
+Collective choice: bitwise ops are not in XLA's reduce-collective vocabulary
+(psum/pmax only), so the combine is an explicit log2(D) ppermute butterfly —
+each step exchanges accumulators with a partner at doubling distance and
+merges locally.  D accumulators of K x 8KB ride the ICI exactly once per
+step, and every device finishes with the full result (matching psum
+semantics for the downstream popcount).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import dense, packing
+
+WORDS32 = packing.WORDS32
+
+
+def _local_dense_accumulate(op: str, words, seg_ids, num_keys: int, n_steps: int):
+    """Reduce local rows -> dense u32[K+1, 2048] accumulator over ALL keys.
+
+    Rows are globally sorted by segment, so a shard's rows for one segment
+    are contiguous: after the doubling pass the shard-local head row of each
+    segment holds the shard's partial reduction.  Heads scatter into the
+    global key space; non-head rows land in the K-th scratch row.
+    """
+    words = dense.doubling_pass(dense.OPS[op], words, seg_ids, n_steps)
+    prev = jnp.concatenate([jnp.full((1,), -1, seg_ids.dtype), seg_ids[:-1]])
+    is_head = seg_ids != prev
+    dest = jnp.where(is_head & (seg_ids < num_keys), seg_ids, num_keys)
+    acc = jnp.zeros((num_keys + 1, words.shape[1]), words.dtype)
+    # one head per segment per shard -> unique destinations; scatter is exact
+    return acc.at[dest].set(words)
+
+
+def _butterfly_combine(op: str, acc, axis_name: str, axis_size: int):
+    """log2(D) ppermute butterfly; all devices end with the full reduction."""
+    fn = dense.OPS[op]
+    d = 1
+    while d < axis_size:
+        perm = [(i, i ^ d) for i in range(axis_size)]
+        other = jax.lax.ppermute(acc, axis_name, perm)
+        acc = fn(acc, other)
+        d *= 2
+    return acc
+
+
+def make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
+                            row_axis: str = "rows", lane_axis: str = "lanes"):
+    """Build a jitted SPMD wide-aggregation step for fixed (K, steps).
+
+    In:  words u32[M, 2048] sharded (rows, lanes); seg_ids i32[M] sharded (rows,)
+    Out: (u32[K, 2048] result sharded over lanes, i32[K] cardinalities, replicated)
+
+    op is "or" or "xor"; wide AND goes through the regular workShy path
+    (parallel.aggregation.and_), whose key intersection makes the block dense.
+    """
+    if op not in ("or", "xor"):
+        raise ValueError("sharded ragged aggregation supports or/xor only")
+    axis_size = mesh.shape[row_axis]
+
+    def step(words, seg_ids):
+        acc = _local_dense_accumulate(op, words, seg_ids, num_keys, n_steps)
+        acc = _butterfly_combine(op, acc, row_axis, axis_size)
+        heads = acc[:num_keys]
+        cards = jnp.sum(jax.lax.population_count(heads).astype(jnp.int32), axis=-1)
+        cards = jax.lax.psum(cards, lane_axis)
+        return heads, cards
+
+    # check_vma=False: after the ppermute butterfly every device holds the
+    # full reduction, but JAX cannot prove ppermute outputs replicated.
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(row_axis, lane_axis), P(row_axis)),
+        out_specs=(P(None, lane_axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def shard_packed(mesh: Mesh, packed: packing.PackedAggregation,
+                 row_axis: str = "rows", lane_axis: str = "lanes"):
+    """Pad rows to the mesh row-axis multiple and device_put with shardings."""
+    n_rows = mesh.shape[row_axis]
+    m_pad = -(-packed.words.shape[0] // n_rows) * n_rows
+    words = packed.words
+    seg_ids = packed.seg_ids
+    if m_pad != words.shape[0]:
+        extra = m_pad - words.shape[0]
+        words = np.concatenate([words, np.zeros((extra, WORDS32), np.uint32)])
+        seg_ids = np.concatenate(
+            [seg_ids, np.full(extra, packed.num_keys, np.int32)])
+    words_d = jax.device_put(words, NamedSharding(mesh, P(row_axis, lane_axis)))
+    segs_d = jax.device_put(seg_ids, NamedSharding(mesh, P(row_axis)))
+    return words_d, segs_d
+
+
+def wide_aggregate_sharded(mesh: Mesh, op: str,
+                           bitmaps) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """End to end: pack, shard, reduce across the mesh. Returns (keys, words, cards)."""
+    packed = packing.pack_for_aggregation(bitmaps)
+    step = make_sharded_aggregator(mesh, op, packed.num_keys,
+                                   dense.n_steps_for(packed.max_group))
+    words_d, segs_d = shard_packed(mesh, packed)
+    heads, cards = step(words_d, segs_d)
+    return packed.keys, np.asarray(heads), np.asarray(cards)

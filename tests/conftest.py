@@ -1,0 +1,32 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+The "fake cluster" strategy from SURVEY.md §4: multi-device code paths are
+exercised on the CPU backend with xla_force_host_platform_device_count=8,
+mirroring the reference's determinism tests under varied ForkJoinPool sizes
+(ParallelAggregationTest.java:26-40).  Must run before any jax import; the
+axon TPU plugin registered by sitecustomize is overridden via jax.config.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0xC0FFEE)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _devices():
+    assert jax.default_backend() == "cpu"
+    assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"

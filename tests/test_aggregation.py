@@ -1,0 +1,97 @@
+"""Device wide-aggregation parity: every engine vs the host fold oracle.
+
+The ParallelAggregationTest strategy (ParallelAggregationTest.java:18-40) —
+same op under different execution regimes must agree exactly."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.parallel import DeviceBitmapSet, aggregation
+from roaringbitmap_tpu.utils import datasets
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return datasets.synthetic_bitmaps(24, seed=7, universe=1 << 21, density=0.015)
+
+
+@pytest.fixture(scope="module")
+def oracles(workload):
+    o, x = RoaringBitmap(), RoaringBitmap()
+    a = workload[0].clone()
+    for b in workload:
+        o.ior(b)
+        x.ixor(b)
+    for b in workload[1:]:
+        a.iand(b)
+    return {"or": o, "xor": x, "and": a}
+
+
+@pytest.mark.parametrize("engine", ["xla", "pallas"])
+@pytest.mark.parametrize("op", ["or", "xor"])
+def test_ragged_engines_match_host(workload, oracles, op, engine):
+    fn = aggregation.or_ if op == "or" else aggregation.xor
+    assert fn(workload, engine=engine) == oracles[op]
+
+
+def test_wide_and_matches_host(workload, oracles):
+    assert aggregation.and_(workload) == oracles["and"]
+
+
+def test_wide_and_nonempty_result():
+    base = RoaringBitmap.from_values(np.arange(0, 300000, 3, dtype=np.uint32))
+    bms = [base.clone() for _ in range(8)]
+    bms[3] = base | RoaringBitmap.bitmap_of(1, 2)
+    got = aggregation.and_(bms)
+    assert got == base and got.cardinality == base.cardinality
+
+
+def test_cardinality_only_paths(workload, oracles):
+    assert aggregation.or_cardinality(workload) == oracles["or"].cardinality
+    assert aggregation.xor_cardinality(workload) == oracles["xor"].cardinality
+    assert aggregation.and_cardinality(workload) == oracles["and"].cardinality
+
+
+def test_edge_cases():
+    assert aggregation.or_().is_empty()
+    assert aggregation.and_().is_empty()
+    one = RoaringBitmap.bitmap_of(1, 2, 3)
+    assert aggregation.or_(one) == one
+    assert aggregation.and_(one, RoaringBitmap()) .is_empty()
+    # disjoint key sets
+    a = RoaringBitmap.from_values(np.arange(100, dtype=np.uint32))
+    b = RoaringBitmap.from_values(np.arange(1 << 20, (1 << 20) + 100, dtype=np.uint32))
+    assert aggregation.and_(a, b).is_empty()
+    assert aggregation.or_(a, b).cardinality == 200
+
+
+def test_device_bitmap_set_reuse(workload, oracles):
+    ds = DeviceBitmapSet(workload)
+    assert ds.aggregate("or", engine="xla") == oracles["or"]
+    assert ds.aggregate("or", engine="pallas") == oracles["or"]
+    assert ds.aggregate("xor", engine="xla") == oracles["xor"]
+    assert ds.hbm_bytes() > 0
+
+
+def test_xor_empty_container_dropped():
+    a = RoaringBitmap.bitmap_of(5, 70000)
+    b = RoaringBitmap.bitmap_of(5, 70001)
+    got = aggregation.xor(a, b)
+    assert got.to_array().tolist() == [70000, 70001]
+    # key 0 cancelled entirely; container must be dropped, not kept empty
+    assert got.container_count() == 1
+
+
+def test_device_set_rejects_and():
+    # regression: ragged segmented AND would silently ignore missing keys
+    ds = DeviceBitmapSet([RoaringBitmap.bitmap_of(1), RoaringBitmap.bitmap_of(0x10002)])
+    with pytest.raises(ValueError):
+        ds.aggregate("and")
+
+
+def test_chained_wide_or_parity(workload, oracles):
+    ds = DeviceBitmapSet(workload)
+    for eng in ("xla", "pallas"):
+        total = int(np.asarray(ds.chained_wide_or(4, engine=eng)(ds.words)))
+        assert total == 4 * oracles["or"].cardinality

@@ -1,0 +1,185 @@
+"""RoaringBitmap API tests vs Python-set oracle (randomized, seeded).
+
+Mirrors the reference's model-checking strategy: ops verified against
+java.util.BitSet / algebraic identities (Fuzzer.verifyInvariance,
+fuzz-tests/.../Fuzzer.java:31-80)."""
+
+import numpy as np
+import pytest
+
+import roaringbitmap_tpu as rt
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def rand_bitmap(rng, style="mixed", universe=1 << 22):
+    kind = style if style != "mixed" else ["sparse", "dense", "runs"][int(rng.integers(3))]
+    if kind == "sparse":
+        v = rng.integers(0, universe, 5000)
+    elif kind == "dense":
+        v = rng.integers(0, universe >> 6, 50000)
+    else:
+        starts = rng.integers(0, universe, 40)
+        v = np.concatenate([np.arange(s, s + int(l))
+                            for s, l in zip(starts, rng.integers(1, 3000, 40))])
+    return RoaringBitmap.from_values((v % universe).astype(np.uint32))
+
+
+def test_point_mutation(rng):
+    rb = RoaringBitmap()
+    ref = set()
+    for x in rng.integers(0, 1 << 20, 2000).tolist():
+        rb.add(x)
+        ref.add(x)
+    for x in rng.integers(0, 1 << 20, 2000).tolist():
+        rb.remove(x)
+        ref.discard(x)
+    assert set(rb.to_array().tolist()) == ref
+    assert rb.cardinality == len(ref)
+    x = rb.to_array()[0] if rb.cardinality else 0
+    assert rb.checked_remove(int(x)) == (int(x) in ref)
+    assert rb.checked_add(int(x)) is True
+
+
+def test_pairwise_algebra_vs_sets(rng):
+    for _ in range(5):
+        a, b = rand_bitmap(rng), rand_bitmap(rng)
+        sa, sb = set(a.to_array().tolist()), set(b.to_array().tolist())
+        assert set((a | b).to_array().tolist()) == sa | sb
+        assert set((a & b).to_array().tolist()) == sa & sb
+        assert set((a ^ b).to_array().tolist()) == sa ^ sb
+        assert set((a - b).to_array().tolist()) == sa - sb
+        assert rt.and_cardinality(a, b) == len(sa & sb)
+        assert rt.or_cardinality(a, b) == len(sa | sb)
+        assert rt.xor_cardinality(a, b) == len(sa ^ sb)
+        assert rt.andnot_cardinality(a, b) == len(sa - sb)
+        assert a.intersects(b) == bool(sa & sb)
+
+
+def test_inplace_variants(rng):
+    a, b = rand_bitmap(rng), rand_bitmap(rng)
+    expect = (a | b, a & b, a ^ b, a - b)
+    for op, want in zip(("ior", "iand", "ixor", "iandnot"), expect):
+        c = a.clone()
+        getattr(c, op)(b)
+        assert c == want
+
+
+def test_rank_select_navigation(rng):
+    rb = rand_bitmap(rng)
+    arr = rb.to_array()
+    for j in rng.integers(0, arr.size, 50).tolist():
+        assert rb.select(j) == int(arr[j])
+        assert rb.rank(int(arr[j])) == j + 1
+    assert rb.first() == int(arr[0]) and rb.last() == int(arr[-1])
+    # nextValue / previousValue
+    probe = int(arr[arr.size // 2])
+    assert rb.next_value(probe) == probe
+    assert rb.previous_value(probe) == probe
+    assert rb.next_value(int(arr[-1]) + 1) == -1
+    gap = int(arr[0]) - 1
+    if gap >= 0:
+        assert rb.previous_value(gap) == -1
+
+
+def test_range_ops_vs_sets(rng):
+    for _ in range(5):
+        rb = rand_bitmap(rng, universe=1 << 19)
+        ref = set(rb.to_array().tolist())
+        lo = int(rng.integers(0, 1 << 19))
+        hi = lo + int(rng.integers(1, 1 << 18))
+        r = rb.clone()
+        r.add_range(lo, hi)
+        assert set(r.to_array().tolist()) == ref | set(range(lo, hi))
+        r = rb.clone()
+        r.remove_range(lo, hi)
+        assert set(r.to_array().tolist()) == ref - set(range(lo, hi))
+        r = rb.clone()
+        r.flip_range(lo, hi)
+        assert set(r.to_array().tolist()) == ref ^ set(range(lo, hi))
+        assert rb.contains_range(lo, hi) == set(range(lo, hi)).issubset(ref)
+        assert rb.intersects_range(lo, hi) == bool(ref & set(range(lo, hi)))
+
+
+def test_subset_and_similarity(rng):
+    a = rand_bitmap(rng)
+    sub = a.limit(a.cardinality // 2)
+    assert sub.is_subset_of(a)
+    assert not a.is_subset_of(sub) or a == sub
+    assert a.is_hamming_similar(a, 0)
+    b = a.clone()
+    b.add(4242424242)
+    assert a.is_hamming_similar(b, 1) and not a.is_hamming_similar(b, 0)
+
+
+def test_iteration_and_batches(rng):
+    rb = rand_bitmap(rng)
+    arr = rb.to_array()
+    got = np.concatenate(list(rb.batch_iterator(1000)))
+    np.testing.assert_array_equal(got, arr)
+    assert list(rb)[:100] == arr[:100].tolist()
+
+
+def test_add_offset(rng):
+    rb = rand_bitmap(rng, universe=1 << 20)
+    off = rb.add_offset(1 << 21)
+    np.testing.assert_array_equal(off.to_array(),
+                                  rb.to_array().astype(np.int64) + (1 << 21))
+    back = off.add_offset(-(1 << 21))
+    assert back == rb
+
+
+def test_flip_static(rng):
+    rb = rand_bitmap(rng, universe=1 << 18)
+    ref = set(rb.to_array().tolist())
+    flipped = rt.flip(rb, 0, 1 << 18)
+    assert set(flipped.to_array().tolist()) == set(range(1 << 18)) - ref
+    assert rb == RoaringBitmap.from_values(np.array(sorted(ref), dtype=np.uint32))
+
+
+def test_or_not(rng):
+    a = rand_bitmap(rng, universe=1 << 18)
+    b = rand_bitmap(rng, universe=1 << 18)
+    sa, sb = set(a.to_array().tolist()), set(b.to_array().tolist())
+    got = rt.or_not(a, b, 1 << 18)
+    want = sa | (set(range(1 << 18)) - sb)
+    assert set(got.to_array().tolist()) == want
+
+
+def test_absent_value_navigation():
+    # regression: contiguous container tail must yield last+1, not next chunk
+    rb = RoaringBitmap.bitmap_of(5, 6, 7)
+    assert rb.next_absent_value(5) == 8
+    assert rb.next_absent_value(4) == 4
+    rb2 = RoaringBitmap.bitmap_of(0xFFFE, 0xFFFF, 0x10000)
+    assert rb2.next_absent_value(0xFFFE) == 0x10001
+    assert rb2.previous_absent_value(0x10000) == 0xFFFD
+    full = RoaringBitmap.from_range(0, 0x20000)
+    assert full.next_absent_value(0) == 0x20000
+    assert full.previous_absent_value(0x1FFFF) == -1
+    assert RoaringBitmap.bitmap_of(0).previous_absent_value(0) == -1
+
+
+def test_or_not_drops_b_above_range():
+    # regression: b's containers above range_end must not leak into the result
+    a = RoaringBitmap()
+    b = RoaringBitmap.bitmap_of(3, 0x20000)
+    got = rt.or_not(a, b, 10)
+    assert set(got.to_array().tolist()) == set(range(10)) - {3}
+    # a's values above range_end are kept
+    a2 = RoaringBitmap.bitmap_of(0x30000)
+    got2 = rt.or_not(a2, b, 10)
+    assert 0x30000 in got2 and 0x20000 not in got2
+
+
+def test_bitmap_container_point_ops_stay_wordlevel(rng):
+    dense = RoaringBitmap.from_values(np.arange(0, 20000, 2, dtype=np.uint32))
+    assert dense.containers[0].cardinality == 10000
+    dense.add(1)
+    dense.remove(0)
+    assert 1 in dense and 0 not in dense
+    # demotion at the 4096 boundary on remove
+    from roaringbitmap_tpu.core import containers as C
+    c = C.from_values(np.arange(4097, dtype=np.uint16))
+    assert isinstance(c, C.BitmapContainer)
+    c2 = c.remove(0)
+    assert isinstance(c2, C.ArrayContainer) and c2.cardinality == 4096

@@ -1,0 +1,97 @@
+"""Container model unit tests — promotion boundary, run codec, pairwise algebra.
+
+Oracle: Python sets / NumPy set ops, the strategy of the reference's
+randomized container tests (TestArrayContainer/TestBitmapContainer/
+TestRunContainer, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu.core import containers as C
+
+
+def random_values(rng, n, style):
+    if style == "sparse":
+        v = rng.choice(1 << 16, size=n, replace=False)
+    elif style == "dense":
+        v = rng.choice(1 << 16, size=min(60000, n * 16), replace=False)
+    else:  # runs
+        starts = rng.integers(0, 1 << 16, 32)
+        v = np.unique(np.concatenate(
+            [np.arange(s, min(s + int(l), 1 << 16))
+             for s, l in zip(starts, rng.integers(1, 500, 32))]))
+    return np.sort(v.astype(np.uint16))
+
+
+STYLES = ["sparse", "dense", "runs"]
+
+
+@pytest.mark.parametrize("style", STYLES)
+def test_roundtrip_representations(rng, style):
+    v = random_values(rng, 1000, style)
+    for c in (C.from_values(v), C.RunContainer(C.values_to_runs(v)),
+              C.BitmapContainer(C.values_to_words(v))):
+        assert c.cardinality == v.size
+        np.testing.assert_array_equal(c.values(), v)
+        np.testing.assert_array_equal(C.words_to_values(c.words()), v)
+
+
+def test_promotion_boundary():
+    v = np.arange(0, 2 * C.ARRAY_MAX_SIZE, 2, dtype=np.uint16)  # card 4096
+    assert isinstance(C.from_values(v), C.ArrayContainer)
+    v2 = np.arange(0, 2 * (C.ARRAY_MAX_SIZE + 1), 2, dtype=np.uint16)
+    assert isinstance(C.from_values(v2), C.BitmapContainer)
+
+
+@pytest.mark.parametrize("s1", STYLES)
+@pytest.mark.parametrize("s2", STYLES)
+def test_pairwise_ops_match_sets(rng, s1, s2):
+    a = random_values(rng, 800, s1)
+    b = random_values(rng, 800, s2)
+    reps_a = [C.from_values(a), C.RunContainer(C.values_to_runs(a)),
+              C.BitmapContainer(C.values_to_words(a))]
+    reps_b = [C.from_values(b), C.BitmapContainer(C.values_to_words(b))]
+    sa, sb = set(a.tolist()), set(b.tolist())
+    expected = {
+        "and": sorted(sa & sb), "or": sorted(sa | sb),
+        "xor": sorted(sa ^ sb), "andnot": sorted(sa - sb),
+    }
+    fns = {"and": C.container_and, "or": C.container_or,
+           "xor": C.container_xor, "andnot": C.container_andnot}
+    for ca in reps_a:
+        for cb in reps_b:
+            for op, fn in fns.items():
+                got = fn(ca, cb)
+                assert got.values().tolist() == expected[op], (op, type(ca), type(cb))
+                # result respects the serialization type invariant
+                if not got.is_run():
+                    assert (got.cardinality <= C.ARRAY_MAX_SIZE) == \
+                        isinstance(got, C.ArrayContainer)
+
+
+def test_run_optimize_picks_smallest():
+    runs = C.from_values(np.arange(0, 10000, dtype=np.uint16)).run_optimize()
+    assert runs.is_run() and runs.n_runs == 1
+    sparse = C.from_values(np.arange(0, 4000, 2, dtype=np.uint16)).run_optimize()
+    assert isinstance(sparse, C.ArrayContainer)
+
+
+def test_point_ops(rng):
+    v = random_values(rng, 500, "sparse")
+    c = C.from_values(v)
+    x = int(v[10])
+    assert c.contains(x) and not C.from_values(v).remove(x).contains(x)
+    assert c.rank(x) == 11
+    assert c.select(10) == x
+    assert c.first() == int(v[0]) and c.last() == int(v[-1])
+    run = C.RunContainer(C.values_to_runs(v))
+    assert run.contains(x) and not run.contains(int(v[10]) + 1 if int(v[10]) + 1 not in set(v.tolist()) else 0)
+
+
+def test_full_and_range_containers():
+    f = C.full_container()
+    assert f.cardinality == 1 << 16
+    r = C.range_container(100, 200)
+    assert r.values().tolist() == list(range(100, 200))
+    tiny = C.range_container(5, 7)
+    assert isinstance(tiny, C.ArrayContainer)

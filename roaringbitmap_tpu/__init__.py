@@ -29,11 +29,12 @@ from .core.bitmap import (
     xor_cardinality,
 )
 from .core import containers
+from .core.bitmap64 import Roaring64Bitmap, Roaring64NavigableMap
 from .format import spec
 from .format.spec import InvalidRoaringFormat
 
 __all__ = [
-    "RoaringBitmap",
+    "RoaringBitmap", "Roaring64Bitmap", "Roaring64NavigableMap",
     "and_", "or_", "xor", "andnot", "or_not", "flip",
     "and_cardinality", "or_cardinality", "xor_cardinality", "andnot_cardinality",
     "containers", "spec", "InvalidRoaringFormat",

@@ -512,7 +512,7 @@ def and_(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
         if c.cardinality:
             keys.append(k)
             conts.append(c)
-    return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
+    return type(a)(np.array(keys, dtype=a.keys.dtype), conts)
 
 
 def or_(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
@@ -532,7 +532,7 @@ def andnot(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
         if c.cardinality:
             keys.append(k)
             conts.append(c)
-    return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
+    return type(a)(np.array(keys, dtype=a.keys.dtype), conts)
 
 
 def or_not(a: RoaringBitmap, b: RoaringBitmap, range_end: int) -> RoaringBitmap:
@@ -565,7 +565,7 @@ def _merge_union(a: RoaringBitmap, b: RoaringBitmap, op, drop_empty: bool = Fals
             continue
         keys.append(k)
         conts.append(c)
-    return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
+    return type(a)(np.array(keys, dtype=a.keys.dtype), conts)
 
 
 def and_cardinality(a: RoaringBitmap, b: RoaringBitmap) -> int:

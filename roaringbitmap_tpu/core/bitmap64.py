@@ -1,0 +1,776 @@
+"""64-bit tier — the longlong package analog (SURVEY §2.3).
+
+Two classes, mirroring the reference's two 64-bit implementations:
+
+- ``Roaring64Bitmap`` (longlong/Roaring64Bitmap.java:50-62): values are split
+  high-48 / low-16.  The reference indexes the high 48 bits with an Adaptive
+  Radix Tree (art/Art.java:14-54); pointer-chasing trees are anti-TPU, so here
+  the key index is a sorted ``u64`` NumPy array searched with
+  ``np.searchsorted`` — same O(log K) point lookups, but bulk construction and
+  key merges are single vectorized passes, and the key axis batch-packs
+  straight into HBM tensors for the wide-aggregation engine.
+
+- ``Roaring64NavigableMap`` (longlong/Roaring64NavigableMap.java): high-32 /
+  low-32 split into a map of 32-bit RoaringBitmaps, with signed or unsigned
+  key ordering and BOTH serialization formats — the legacy Java format
+  (serializeLegacy :1229: bool signedLongs, i32-BE count, per-bucket i32-BE
+  high + 32-bit payload) and the portable CRoaring spec (serializePortable
+  :1254: u64-LE count, per-bucket u32-LE high + 32-bit payload) selected by
+  ``SERIALIZATION_MODE`` (:28-51).  Cumulative-cardinality caches accelerate
+  rank/select as in the reference (resetPerfHelpers).
+
+``Roaring64Bitmap`` serializes in the portable 64-bit spec.  The reference's
+own ``Roaring64Bitmap.serialize`` dumps its ART node graph
+(HighLowContainer.java:155-185) — an implementation-defined layout of the very
+tree this rebuild deliberately does not have; the portable spec is the
+interchange format both implementations share.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator
+
+import numpy as np
+
+from . import containers as C
+from .bitmap import RoaringBitmap, and_, andnot, or_, xor
+from .containers import Container
+from ..format import spec
+
+U64_MAX = (1 << 64) - 1
+
+# Roaring64NavigableMap.SERIALIZATION_MODE (:28-51); module-global default
+# like the reference's static field.
+SERIALIZATION_MODE_LEGACY = 0
+SERIALIZATION_MODE_PORTABLE = 1
+SERIALIZATION_MODE = SERIALIZATION_MODE_LEGACY
+
+
+# ---------------------------------------------------------------- LongUtils
+def high48(x: int) -> int:
+    """LongUtils.highPart analog (LongUtils.java:13) as an int key."""
+    return (x >> 16) & 0xFFFFFFFFFFFF
+
+
+def low16(x: int) -> int:
+    """LongUtils.lowPart (LongUtils.java:30)."""
+    return x & 0xFFFF
+
+
+def to_long(high: int, low: int) -> int:
+    """LongUtils.toLong (LongUtils.java:60)."""
+    return (high << 16) | low
+
+
+class Roaring64Bitmap:
+    """Compressed bitmap over the unsigned 64-bit universe.
+
+    Same structure-of-arrays shape as the 32-bit class — ``keys`` is the
+    sorted u64 array of high-48 prefixes, ``containers`` the matching low-16
+    containers — so the whole pairwise algebra in core.bitmap and the
+    group-by-key device packing in ops.packing apply unchanged.
+    """
+
+    __slots__ = ("keys", "containers")
+
+    def __init__(self, keys: np.ndarray | None = None,
+                 containers: list[Container] | None = None):
+        self.keys = keys if keys is not None else np.empty(0, dtype=np.uint64)
+        self.containers = containers if containers is not None else []
+
+    # ------------------------------------------------------------------ build
+    @staticmethod
+    def bitmap_of(*values: int) -> "Roaring64Bitmap":
+        return Roaring64Bitmap.from_values(np.array(values, dtype=np.uint64))
+
+    @staticmethod
+    def from_values(values: np.ndarray) -> "Roaring64Bitmap":
+        """Vectorized bulk build (the addLong loop :50-62, batched)."""
+        v = np.asarray(values, dtype=np.uint64)
+        if v.size == 0:
+            return Roaring64Bitmap()
+        v = np.unique(v)
+        hi = v >> np.uint64(16)
+        keys, starts = np.unique(hi, return_index=True)
+        bounds = np.append(starts, v.size)
+        conts = [
+            C.from_values((v[bounds[i]:bounds[i + 1]] & np.uint64(0xFFFF)).astype(np.uint16))
+            for i in range(keys.size)
+        ]
+        return Roaring64Bitmap(keys, conts)
+
+    @staticmethod
+    def from_range(start: int, stop: int) -> "Roaring64Bitmap":
+        rb = Roaring64Bitmap()
+        rb.add_range(start, stop)
+        return rb
+
+    def clone(self) -> "Roaring64Bitmap":
+        return Roaring64Bitmap(self.keys.copy(), list(self.containers))
+
+    # -------------------------------------------------------------- accessors
+    @property
+    def cardinality(self) -> int:
+        """getLongCardinality."""
+        return sum(c.cardinality for c in self.containers)
+
+    def __len__(self) -> int:
+        return self.cardinality
+
+    def is_empty(self) -> bool:
+        return not self.containers
+
+    def __bool__(self) -> bool:
+        return not self.is_empty()
+
+    def _index(self, hb: int) -> int:
+        i = int(np.searchsorted(self.keys, np.uint64(hb)))
+        if i < self.keys.size and self.keys[i] == hb:
+            return i
+        return -i - 1
+
+    def contains(self, x: int) -> bool:
+        i = self._index(high48(x))
+        return i >= 0 and self.containers[i].contains(low16(x))
+
+    def __contains__(self, x: int) -> bool:
+        return self.contains(x)
+
+    def rank(self, x: int) -> int:
+        """Members <= x (Roaring64Bitmap.rankLong)."""
+        hb = high48(x)
+        i = int(np.searchsorted(self.keys, np.uint64(hb), side="left"))
+        total = sum(c.cardinality for c in self.containers[:i])
+        if i < self.keys.size and self.keys[i] == hb:
+            total += self.containers[i].rank(low16(x))
+        return total
+
+    def select(self, j: int) -> int:
+        """j-th smallest member, 0-based (Roaring64Bitmap.select)."""
+        for k, c in zip(self.keys, self.containers):
+            if j < c.cardinality:
+                return to_long(int(k), c.select(j))
+            j -= c.cardinality
+        raise ValueError("select: rank out of bounds")
+
+    def first(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        return to_long(int(self.keys[0]), self.containers[0].first())
+
+    def last(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        return to_long(int(self.keys[-1]), self.containers[-1].last())
+
+    def next_value(self, x: int) -> int:
+        """Smallest member >= x, or -1."""
+        r = self.rank(x - 1) if x > 0 else 0
+        if r >= self.cardinality:
+            return -1
+        return self.select(r)
+
+    def previous_value(self, x: int) -> int:
+        """Largest member <= x, or -1."""
+        r = self.rank(x)
+        return self.select(r - 1) if r > 0 else -1
+
+    # ------------------------------------------------------------- iteration
+    def to_array(self) -> np.ndarray:
+        if not self.containers:
+            return np.empty(0, dtype=np.uint64)
+        parts = [
+            (np.uint64(int(k) << 16) | c.values().astype(np.uint64))
+            for k, c in zip(self.keys, self.containers)
+        ]
+        return np.concatenate(parts)
+
+    def __iter__(self) -> Iterator[int]:
+        for k, c in zip(self.keys, self.containers):
+            base = int(k) << 16
+            for v in c.values():
+                yield base | int(v)
+
+    def batch_iterator(self, batch_size: int = 65536) -> Iterator[np.ndarray]:
+        buf: list[np.ndarray] = []
+        n = 0
+        for k, c in zip(self.keys, self.containers):
+            part = np.uint64(int(k) << 16) | c.values().astype(np.uint64)
+            buf.append(part)
+            n += part.size
+            while n >= batch_size:
+                whole = np.concatenate(buf)
+                yield whole[:batch_size]
+                rest = whole[batch_size:]
+                buf = [rest] if rest.size else []
+                n = rest.size
+        if n:
+            yield np.concatenate(buf)
+
+    # -------------------------------------------------------------- mutation
+    def add(self, x: int) -> None:
+        """Point insert (Roaring64Bitmap.addLong :50-62)."""
+        i = self._index(high48(x))
+        if i >= 0:
+            self.containers[i] = self.containers[i].add(low16(x))
+        else:
+            self._insert(-i - 1, high48(x),
+                         C.ArrayContainer(np.array([low16(x)], dtype=np.uint16)))
+
+    def add_many(self, values: np.ndarray) -> None:
+        other = Roaring64Bitmap.from_values(values)
+        res = or_(self, other)
+        self.keys, self.containers = res.keys, res.containers
+
+    def remove(self, x: int) -> None:
+        i = self._index(high48(x))
+        if i < 0:
+            return
+        c = self.containers[i].remove(low16(x))
+        if c.cardinality == 0:
+            self._delete(i)
+        else:
+            self.containers[i] = c
+
+    def add_range(self, start: int, stop: int) -> None:
+        """Set all of [start, stop) (Roaring64Bitmap.addRange :211-248)."""
+        for lo, hi_excl, hb in _chunk_ranges64(start, stop):
+            i = self._index(hb)
+            full_chunk = lo == 0 and hi_excl == 0x10000
+            if i >= 0:
+                if full_chunk:
+                    self.containers[i] = C.full_container()
+                else:
+                    self.containers[i] = C.container_or(
+                        self.containers[i], C.range_container(lo, hi_excl))
+            else:
+                self._insert(-i - 1, hb, C.range_container(lo, hi_excl))
+
+    def remove_range(self, start: int, stop: int) -> None:
+        kill: list[int] = []
+        for lo, hi_excl, hb in _chunk_ranges64(start, stop):
+            i = self._index(hb)
+            if i < 0:
+                continue
+            if lo == 0 and hi_excl == 0x10000:
+                kill.append(i)
+                continue
+            c = C.container_andnot(self.containers[i], C.range_container(lo, hi_excl))
+            if c.cardinality == 0:
+                kill.append(i)
+            else:
+                self.containers[i] = c
+        for i in reversed(kill):
+            self._delete(i)
+
+    def flip_range(self, start: int, stop: int) -> None:
+        for lo, hi_excl, hb in _chunk_ranges64(start, stop):
+            i = self._index(hb)
+            rc = C.range_container(lo, hi_excl)
+            if i >= 0:
+                c = C.container_xor(self.containers[i], rc)
+                if c.cardinality == 0:
+                    self._delete(i)
+                else:
+                    self.containers[i] = c
+            else:
+                self._insert(-i - 1, hb, rc)
+
+    def flip(self, x: int) -> None:
+        """Single-value flip (Roaring64Bitmap.flip(long))."""
+        if self.contains(x):
+            self.remove(x)
+        else:
+            self.add(x)
+
+    def _insert(self, pos: int, key: int, cont: Container) -> None:
+        self.keys = np.insert(self.keys, pos, np.uint64(key))
+        self.containers.insert(pos, cont)
+
+    def _delete(self, pos: int) -> None:
+        self.keys = np.delete(self.keys, pos)
+        del self.containers[pos]
+
+    def clear(self) -> None:
+        self.keys = np.empty(0, dtype=np.uint64)
+        self.containers = []
+
+    def run_optimize(self) -> bool:
+        changed = False
+        for i, c in enumerate(self.containers):
+            o = c.run_optimize()
+            if o is not c:
+                self.containers[i] = o
+                changed = changed or o.is_run()
+        return changed
+
+    def has_run_compression(self) -> bool:
+        return any(c.is_run() for c in self.containers)
+
+    # ----------------------------------------------------------- set algebra
+    # The pairwise merges are the generic key-merge functions from
+    # core.bitmap — they construct type(a)(keys-with-a's-dtype, conts), so
+    # they work unchanged over the u64 key axis.
+    def __and__(self, o: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return and_(self, o)
+
+    def __or__(self, o: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return or_(self, o)
+
+    def __xor__(self, o: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return xor(self, o)
+
+    def __sub__(self, o: "Roaring64Bitmap") -> "Roaring64Bitmap":
+        return andnot(self, o)
+
+    def iand(self, o: "Roaring64Bitmap") -> None:
+        r = and_(self, o)
+        self.keys, self.containers = r.keys, r.containers
+
+    def ior(self, o: "Roaring64Bitmap") -> None:
+        r = or_(self, o)
+        self.keys, self.containers = r.keys, r.containers
+
+    def ixor(self, o: "Roaring64Bitmap") -> None:
+        r = xor(self, o)
+        self.keys, self.containers = r.keys, r.containers
+
+    def iandnot(self, o: "Roaring64Bitmap") -> None:
+        r = andnot(self, o)
+        self.keys, self.containers = r.keys, r.containers
+
+    # ---------------------------------------------------------- equality/repr
+    def __eq__(self, o: object) -> bool:
+        if not isinstance(o, Roaring64Bitmap):
+            return NotImplemented
+        if self.keys.size != o.keys.size or not np.array_equal(self.keys, o.keys):
+            return False
+        return all(
+            a.cardinality == b.cardinality and np.array_equal(a.values(), b.values())
+            for a, b in zip(self.containers, o.containers))
+
+    def __hash__(self) -> int:
+        return hash(self.to_array().tobytes())
+
+    def __repr__(self) -> str:
+        card = self.cardinality
+        head = ",".join(str(v) for _, v in zip(range(8), self))
+        tail = "..." if card > 8 else ""
+        return f"Roaring64Bitmap(card={card}, keys={self.keys.size}, {{{head}{tail}}})"
+
+    # ------------------------------------------------------------------- I/O
+    def _buckets32(self) -> list[tuple[int, RoaringBitmap]]:
+        """Group high-48 keys by their upper 32 bits into 32-bit bitmaps.
+
+        The container objects are shared, not copied: a bucket's 32-bit
+        bitmap has keys = middle 16 bits of the 48-bit prefix.
+        """
+        if not self.containers:
+            return []
+        hi32 = (self.keys >> np.uint64(16)).astype(np.uint32)
+        highs, starts = np.unique(hi32, return_index=True)
+        bounds = np.append(starts, self.keys.size)
+        out = []
+        for i, h in enumerate(highs):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            keys16 = (self.keys[lo:hi] & np.uint64(0xFFFF)).astype(np.uint16)
+            out.append((int(h), RoaringBitmap(keys16, self.containers[lo:hi])))
+        return out
+
+    def serialize(self) -> bytes:
+        """Portable 64-bit spec (Roaring64NavigableMap.serializePortable
+        :1254-1260 / RoaringFormatSpec 64-bit extension): u64-LE bucket
+        count, then per bucket u32-LE high bits + the 32-bit format."""
+        buckets = self._buckets32()
+        out = bytearray(struct.pack("<Q", len(buckets)))
+        for high, rb32 in buckets:
+            out += struct.pack("<I", high)
+            out += rb32.serialize()
+        return bytes(out)
+
+    @staticmethod
+    def deserialize(buf: bytes | memoryview) -> "Roaring64Bitmap":
+        mv = memoryview(buf)
+        if len(mv) < 8:
+            raise spec.InvalidRoaringFormat("truncated 64-bit header")
+        (n,) = struct.unpack_from("<Q", mv, 0)
+        pos = 8
+        keys_parts: list[np.ndarray] = []
+        conts: list[Container] = []
+        prev_high = -1
+        for _ in range(n):
+            if pos + 4 > len(mv):
+                raise spec.InvalidRoaringFormat("truncated 64-bit bucket header")
+            (high,) = struct.unpack_from("<I", mv, pos)
+            if high <= prev_high:
+                raise spec.InvalidRoaringFormat("64-bit bucket keys not ascending")
+            prev_high = high
+            pos += 4
+            view = spec.SerializedView(mv[pos:])
+            k16 = view.keys.copy()
+            bucket_conts = [view.container(i) for i in range(view.size)]
+            pos += view.serialized_end()
+            keys_parts.append((np.uint64(high) << np.uint64(16))
+                              | k16.astype(np.uint64))
+            conts.extend(bucket_conts)
+        keys = (np.concatenate(keys_parts) if keys_parts
+                else np.empty(0, dtype=np.uint64))
+        return Roaring64Bitmap(keys, conts)
+
+    def serialized_size_in_bytes(self) -> int:
+        return 8 + sum(4 + rb.serialized_size_in_bytes()
+                       for _, rb in self._buckets32())
+
+    def get_size_in_bytes(self) -> int:
+        total = 8 + 8 * self.keys.size
+        for c in self.containers:
+            total += c.serialized_size_in_bytes()
+        return total
+
+    def container_count(self) -> int:
+        return len(self.containers)
+
+
+def _chunk_ranges64(start: int, stop: int):
+    """Split [start, stop) into per-chunk (lo, hi_excl, high48) pieces."""
+    if start >= stop:
+        return
+    if start < 0 or stop > (1 << 64):
+        raise ValueError("range outside the 64-bit universe")
+    hb_first, hb_last = start >> 16, (stop - 1) >> 16
+    for hb in range(hb_first, hb_last + 1):
+        lo = start & 0xFFFF if hb == hb_first else 0
+        hi_excl = ((stop - 1) & 0xFFFF) + 1 if hb == hb_last else 0x10000
+        yield lo, hi_excl, hb
+
+
+# ---------------------------------------------------------------------------
+# Roaring64NavigableMap — the high-32/low-32 NavigableMap variant.
+# ---------------------------------------------------------------------------
+
+class Roaring64NavigableMap:
+    """Map of high-32-bit key -> 32-bit RoaringBitmap
+    (longlong/Roaring64NavigableMap.java), with signed or unsigned long
+    ordering and both serialization formats."""
+
+    def __init__(self, signed_longs: bool = False):
+        self.signed_longs = signed_longs
+        self._map: dict[int, RoaringBitmap] = {}  # unsigned u32 high -> bitmap
+        self._sorted_highs: list[int] | None = None
+        self._cum_cards: np.ndarray | None = None
+
+    # ----------------------------------------------------------------- build
+    @staticmethod
+    def bitmap_of(*values: int) -> "Roaring64NavigableMap":
+        rb = Roaring64NavigableMap()
+        for v in values:
+            rb.add(v)
+        return rb
+
+    @staticmethod
+    def from_values(values: np.ndarray,
+                    signed_longs: bool = False) -> "Roaring64NavigableMap":
+        rb = Roaring64NavigableMap(signed_longs)
+        v = np.unique(np.asarray(values, dtype=np.uint64))
+        if v.size == 0:
+            return rb
+        hi = (v >> np.uint64(32)).astype(np.uint32)
+        highs, starts = np.unique(hi, return_index=True)
+        bounds = np.append(starts, v.size)
+        for i, h in enumerate(highs):
+            lows = (v[bounds[i]:bounds[i + 1]] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            rb._map[int(h)] = RoaringBitmap.from_values(lows)
+        rb._invalidate()
+        return rb
+
+    # ------------------------------------------------------------- key order
+    def _key_order(self, high: int) -> int:
+        """Sort key for a stored (unsigned) high word under the active order."""
+        if self.signed_longs and high >= 1 << 31:
+            return high - (1 << 32)
+        return high
+
+    def _highs(self) -> list[int]:
+        if self._sorted_highs is None:
+            self._sorted_highs = sorted(self._map, key=self._key_order)
+        return self._sorted_highs
+
+    def _cum(self) -> np.ndarray:
+        """Cached cumulative cardinalities (the reference's perf helpers)."""
+        if self._cum_cards is None:
+            cards = [self._map[h].cardinality for h in self._highs()]
+            self._cum_cards = np.cumsum([0] + cards)
+        return self._cum_cards
+
+    def _invalidate(self) -> None:
+        self._sorted_highs = None
+        self._cum_cards = None
+
+    # -------------------------------------------------------------- accessors
+    @property
+    def cardinality(self) -> int:
+        return sum(b.cardinality for b in self._map.values())
+
+    def __len__(self) -> int:
+        return self.cardinality
+
+    def is_empty(self) -> bool:
+        return all(b.is_empty() for b in self._map.values())
+
+    def contains(self, x: int) -> bool:
+        x &= U64_MAX
+        b = self._map.get(x >> 32)
+        return b is not None and b.contains(x & 0xFFFFFFFF)
+
+    def __contains__(self, x: int) -> bool:
+        return self.contains(x)
+
+    def rank(self, x: int) -> int:
+        """Members <= x in the active long order (rankLong)."""
+        x &= U64_MAX
+        highs = self._highs()
+        cum = self._cum()
+        hx = self._key_order(x >> 32)
+        total = 0
+        for i, h in enumerate(highs):
+            kh = self._key_order(h)
+            if kh < hx:
+                total = int(cum[i + 1])
+            elif kh == hx:
+                total = int(cum[i]) + self._map[h].rank(x & 0xFFFFFFFF)
+        return total
+
+    def select(self, j: int) -> int:
+        """j-th member in the active long order (select), 0-based."""
+        highs = self._highs()
+        cum = self._cum()
+        i = int(np.searchsorted(cum, j, side="right")) - 1
+        if i < 0 or i >= len(highs) or j >= cum[-1]:
+            raise ValueError("select: rank out of bounds")
+        h = highs[i]
+        low = self._map[h].select(j - int(cum[i]))
+        return ((h << 32) | low) & U64_MAX
+
+    def first(self) -> int:
+        highs = self._highs()
+        if not highs:
+            raise ValueError("empty bitmap")
+        h = highs[0]
+        return ((h << 32) | self._map[h].first()) & U64_MAX
+
+    def last(self) -> int:
+        highs = self._highs()
+        if not highs:
+            raise ValueError("empty bitmap")
+        h = highs[-1]
+        return ((h << 32) | self._map[h].last()) & U64_MAX
+
+    # -------------------------------------------------------------- mutation
+    def add(self, x: int) -> None:
+        x &= U64_MAX
+        h = x >> 32
+        b = self._map.get(h)
+        if b is None:
+            b = RoaringBitmap()
+            self._map[h] = b
+            self._sorted_highs = None
+        b.add(x & 0xFFFFFFFF)
+        self._cum_cards = None
+
+    def add_long(self, x: int) -> None:
+        self.add(x)
+
+    def add_int(self, x: int) -> None:
+        """addInt: zero-extends a 32-bit int (Roaring64NavigableMap.addInt)."""
+        self.add(x & 0xFFFFFFFF)
+
+    def remove(self, x: int) -> None:
+        x &= U64_MAX
+        h = x >> 32
+        b = self._map.get(h)
+        if b is None:
+            return
+        b.remove(x & 0xFFFFFFFF)
+        if b.is_empty():
+            del self._map[h]
+            self._sorted_highs = None
+        self._cum_cards = None
+
+    def add_range(self, start: int, stop: int) -> None:
+        """addRange over [start, stop) split at 2^32 bucket boundaries."""
+        if start >= stop:
+            return
+        h_first, h_last = start >> 32, (stop - 1) >> 32
+        for h in range(h_first, h_last + 1):
+            lo = start & 0xFFFFFFFF if h == h_first else 0
+            hi = ((stop - 1) & 0xFFFFFFFF) + 1 if h == h_last else 1 << 32
+            b = self._map.setdefault(h, RoaringBitmap())
+            b.add_range(lo, hi)
+        self._invalidate()
+
+    # ----------------------------------------------------------- set algebra
+    def _binary_inplace(self, o: "Roaring64NavigableMap", op: str) -> None:
+        from .bitmap import and_ as rb_and, andnot as rb_andnot, or_ as rb_or, xor as rb_xor
+        ops = {"and": rb_and, "or": rb_or, "xor": rb_xor, "andnot": rb_andnot}
+        f = ops[op]
+        if op == "and":
+            keep = {}
+            for h, b in self._map.items():
+                ob = o._map.get(h)
+                if ob is not None:
+                    r = f(b, ob)
+                    if not r.is_empty():
+                        keep[h] = r
+            self._map = keep
+        else:
+            for h, ob in (o._map.items() if op != "andnot" else ()):
+                b = self._map.get(h)
+                r = f(b, ob) if b is not None else ob.clone()
+                if r.is_empty():
+                    self._map.pop(h, None)
+                else:
+                    self._map[h] = r
+            if op == "andnot":
+                for h in list(self._map):
+                    ob = o._map.get(h)
+                    if ob is not None:
+                        r = f(self._map[h], ob)
+                        if r.is_empty():
+                            del self._map[h]
+                        else:
+                            self._map[h] = r
+        self._invalidate()
+
+    def iand(self, o: "Roaring64NavigableMap") -> None:
+        self._binary_inplace(o, "and")
+
+    def ior(self, o: "Roaring64NavigableMap") -> None:
+        self._binary_inplace(o, "or")
+
+    def ixor(self, o: "Roaring64NavigableMap") -> None:
+        self._binary_inplace(o, "xor")
+
+    def iandnot(self, o: "Roaring64NavigableMap") -> None:
+        self._binary_inplace(o, "andnot")
+
+    # ------------------------------------------------------------- iteration
+    def __iter__(self) -> Iterator[int]:
+        for h in self._highs():
+            base = (h << 32) & U64_MAX
+            for v in self._map[h]:
+                yield base | v
+
+    def to_array(self) -> np.ndarray:
+        parts = [((np.uint64(h) << np.uint64(32)) | self._map[h].to_array().astype(np.uint64))
+                 for h in self._highs()]
+        return np.concatenate(parts) if parts else np.empty(0, dtype=np.uint64)
+
+    def run_optimize(self) -> bool:
+        return any([b.run_optimize() for b in self._map.values()])
+
+    def __eq__(self, o: object) -> bool:
+        if not isinstance(o, Roaring64NavigableMap):
+            return NotImplemented
+        return ({h: None for h in self._map} == {h: None for h in o._map}
+                and all(self._map[h] == o._map[h] for h in self._map))
+
+    def __hash__(self) -> int:
+        return hash(self.to_array().tobytes())
+
+    def __repr__(self) -> str:
+        return (f"Roaring64NavigableMap(card={self.cardinality}, "
+                f"buckets={len(self._map)}, signed={self.signed_longs})")
+
+    # ------------------------------------------------------------------- I/O
+    def serialize(self, mode: int | None = None) -> bytes:
+        mode = SERIALIZATION_MODE if mode is None else mode
+        if mode == SERIALIZATION_MODE_PORTABLE:
+            return self.serialize_portable()
+        return self.serialize_legacy()
+
+    def serialize_legacy(self) -> bytes:
+        """Legacy Java format (serializeLegacy :1229-1237): 1-byte boolean
+        signedLongs, then i32-BE count, then per bucket i32-BE high +
+        32-bit portable payload."""
+        out = bytearray()
+        out += struct.pack(">?i", self.signed_longs, len(self._map))
+        for h in self._highs():
+            out += struct.pack(">i", h - (1 << 32) if h >= 1 << 31 else h)
+            out += self._map[h].serialize()
+        return bytes(out)
+
+    def serialize_portable(self) -> bytes:
+        """Portable spec (serializePortable :1254-1260): u64-LE count, then
+        per bucket u32-LE high + 32-bit payload.  Unsigned key order."""
+        out = bytearray(struct.pack("<Q", len(self._map)))
+        for h in sorted(self._map):
+            out += struct.pack("<I", h)
+            out += self._map[h].serialize()
+        return bytes(out)
+
+    @staticmethod
+    def deserialize(buf: bytes | memoryview,
+                    mode: int | None = None) -> "Roaring64NavigableMap":
+        mode = SERIALIZATION_MODE if mode is None else mode
+        if mode == SERIALIZATION_MODE_PORTABLE:
+            return Roaring64NavigableMap.deserialize_portable(buf)
+        return Roaring64NavigableMap.deserialize_legacy(buf)
+
+    @staticmethod
+    def deserialize_legacy(buf: bytes | memoryview) -> "Roaring64NavigableMap":
+        mv = memoryview(buf)
+        if len(mv) < 5:
+            raise spec.InvalidRoaringFormat("truncated legacy 64-bit header")
+        signed, n = struct.unpack_from(">?i", mv, 0)
+        if n < 0:
+            raise spec.InvalidRoaringFormat("negative bucket count")
+        rb = Roaring64NavigableMap(signed_longs=bool(signed))
+        pos = 5
+        for _ in range(n):
+            if pos + 4 > len(mv):
+                raise spec.InvalidRoaringFormat("truncated legacy bucket")
+            (h,) = struct.unpack_from(">i", mv, pos)
+            pos += 4
+            view = spec.SerializedView(mv[pos:])
+            conts = [view.container(i) for i in range(view.size)]
+            pos += view.serialized_end()
+            rb._map[h & 0xFFFFFFFF] = RoaringBitmap(view.keys.copy(), conts)
+        return rb
+
+    @staticmethod
+    def deserialize_portable(buf: bytes | memoryview) -> "Roaring64NavigableMap":
+        mv = memoryview(buf)
+        if len(mv) < 8:
+            raise spec.InvalidRoaringFormat("truncated portable 64-bit header")
+        (n,) = struct.unpack_from("<Q", mv, 0)
+        rb = Roaring64NavigableMap(signed_longs=False)
+        pos = 8
+        for _ in range(n):
+            if pos + 4 > len(mv):
+                raise spec.InvalidRoaringFormat("truncated portable bucket")
+            (h,) = struct.unpack_from("<I", mv, pos)
+            pos += 4
+            view = spec.SerializedView(mv[pos:])
+            conts = [view.container(i) for i in range(view.size)]
+            pos += view.serialized_end()
+            rb._map[h] = RoaringBitmap(view.keys.copy(), conts)
+        return rb
+
+    def serialized_size_in_bytes(self, mode: int | None = None) -> int:
+        mode = SERIALIZATION_MODE if mode is None else mode
+        header = 8 if mode == SERIALIZATION_MODE_PORTABLE else 5
+        return header + sum(4 + b.serialized_size_in_bytes()
+                            for b in self._map.values())
+
+    # ------------------------------------------------------------- interop
+    def to_roaring64(self) -> Roaring64Bitmap:
+        """Lossless conversion to the array-keyed implementation."""
+        return Roaring64Bitmap.deserialize(self.serialize_portable())
+
+    @staticmethod
+    def from_roaring64(rb: Roaring64Bitmap,
+                       signed_longs: bool = False) -> "Roaring64NavigableMap":
+        out = Roaring64NavigableMap.deserialize_portable(rb.serialize())
+        out.signed_longs = signed_longs
+        return out

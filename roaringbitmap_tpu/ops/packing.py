@@ -7,7 +7,8 @@ Here the same rotation produces flat, fixed-shape tensors ready for HBM:
   words    u32[M, 2048]   every container densified to its 2^16-bit word image
   seg_ids  i32[M]         index into the distinct-key axis, sorted ascending
   head_idx i32[K]         first row of each segment
-  keys     u16[K]         distinct high-16 keys, sorted
+  keys     [K]            distinct container keys, sorted — u16 for the
+                          32-bit tier, u64 high-48 keys for core.bitmap64
 
 Densifying everything to words is what the reference's own wide paths do on
 CPU anyway (FastAggregation.java:395-399 and ParallelAggregation.java:108,214
@@ -43,7 +44,7 @@ def container_words_u32(c) -> np.ndarray:
 class PackedAggregation:
     """One wide-aggregation problem, rotated and densified."""
 
-    keys: np.ndarray          # u16[K] distinct keys, sorted
+    keys: np.ndarray          # [K] distinct keys, sorted (u16 or u64 tier)
     words: np.ndarray         # u32[M_pad, 2048]; rows >= M are zero
     seg_ids: np.ndarray       # i32[M_pad]; padding rows get segment K (out of range)
     head_idx: np.ndarray      # i32[K] first row of each segment
@@ -75,8 +76,10 @@ def pack_for_aggregation(bitmaps: list[RoaringBitmap],
     seg_ids[:m] = seg_of_row[order]
     head_idx = np.searchsorted(seg_ids[:m], np.arange(keys.size)).astype(np.int32)
     seg_sizes = np.diff(np.append(head_idx, m)).astype(np.int32)
+    # keys keep the input dtype: u16 for 32-bit bitmaps, u64 high-48 keys for
+    # the longlong tier (core.bitmap64) — the kernels only see seg_ids.
     return PackedAggregation(
-        keys=keys.astype(np.uint16), words=words, seg_ids=seg_ids,
+        keys=keys, words=words, seg_ids=seg_ids,
         head_idx=head_idx, seg_sizes=seg_sizes, m=m,
         max_group=int(seg_sizes.max()) if keys.size else 0)
 
@@ -87,7 +90,7 @@ class PackedIntersection:
     (FastAggregation.workShyAnd key-set intersection, FastAggregation.java:356-380),
     so the payload is a perfectly regular [K, N, 2048] block."""
 
-    keys: np.ndarray    # u16[K] surviving keys
+    keys: np.ndarray    # [K] surviving keys (u16 or u64 tier)
     words: np.ndarray   # u32[K, N, 2048]
 
 
@@ -103,7 +106,7 @@ def pack_for_intersection(bitmaps: list[RoaringBitmap]) -> PackedIntersection:
         idx = np.searchsorted(b.keys, keys)
         for i, bi in enumerate(idx):
             words[i, j] = container_words_u32(b.containers[bi])
-    return PackedIntersection(keys=keys.astype(np.uint16), words=words)
+    return PackedIntersection(keys=keys, words=words)
 
 
 def key_presence_masks(bitmaps: list[RoaringBitmap]) -> np.ndarray:
@@ -122,10 +125,17 @@ def key_presence_masks(bitmaps: list[RoaringBitmap]) -> np.ndarray:
 
 
 def unpack_result(keys: np.ndarray, words: np.ndarray,
-                  cards: np.ndarray) -> RoaringBitmap:
-    """Device dense result -> host RoaringBitmap (normalize by cardinality)."""
+                  cards: np.ndarray, out_cls=None) -> RoaringBitmap:
+    """Device dense result -> host bitmap (normalize by cardinality).
+
+    out_cls selects the host class: RoaringBitmap (default, u16 keys) or
+    core.bitmap64.Roaring64Bitmap (u64 high-48 keys) — both share the
+    (keys, containers) structure-of-arrays constructor.
+    """
     from ..core import containers as C
 
+    if out_cls is None:
+        out_cls = RoaringBitmap
     words = np.asarray(words, dtype=np.uint32)
     cards = np.asarray(cards)
     out_keys, out_conts = [], []
@@ -139,4 +149,4 @@ def unpack_result(keys: np.ndarray, words: np.ndarray,
             out_conts.append(C.BitmapContainer(w64.copy(), card))
         else:
             out_conts.append(C.ArrayContainer(C.words_to_values(w64)))
-    return RoaringBitmap(np.array(out_keys, dtype=np.uint16), out_conts)
+    return out_cls(np.array(out_keys, dtype=keys.dtype), out_conts)

@@ -35,15 +35,16 @@ def _engine(engine: str) -> str:
 
 
 def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
-                      engine: str) -> RoaringBitmap:
+                      engine: str, out_cls=None) -> RoaringBitmap:
     bitmaps = [b for b in bitmaps if not b.is_empty()]
     if not bitmaps:
-        return RoaringBitmap()
+        return (out_cls or RoaringBitmap)()
     if len(bitmaps) == 1:
         return bitmaps[0].clone()
     packed = packing.pack_for_aggregation(bitmaps)
     heads, cards = _run_ragged(op, packed, engine)
-    return packing.unpack_result(packed.keys, np.asarray(heads), np.asarray(cards))
+    return packing.unpack_result(packed.keys, np.asarray(heads),
+                                 np.asarray(cards), out_cls=out_cls)
 
 
 def _run_ragged(op: str, packed: packing.PackedAggregation, engine: str):
@@ -113,9 +114,44 @@ def xor_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto") -> int:
 
 
 def _flatten(bitmaps) -> list[RoaringBitmap]:
-    if len(bitmaps) == 1 and not isinstance(bitmaps[0], RoaringBitmap):
+    if len(bitmaps) == 1 and not hasattr(bitmaps[0], "keys"):
         return list(bitmaps[0])
     return list(bitmaps)
+
+
+# ------------------------------------------------------------- 64-bit tier
+# Wide aggregation over Roaring64Bitmap: identical engine, the segment axis
+# is the u64 high-48 key instead of the u16 key (SURVEY §2.3 — the 64-bit
+# extension reuses the same packed container pools).
+
+def or64(*bitmaps, engine: str = "auto"):
+    from ..core.bitmap64 import Roaring64Bitmap
+
+    return _aggregate_ragged("or", _flatten(bitmaps), engine,
+                             out_cls=Roaring64Bitmap)
+
+
+def xor64(*bitmaps, engine: str = "auto"):
+    from ..core.bitmap64 import Roaring64Bitmap
+
+    return _aggregate_ragged("xor", _flatten(bitmaps), engine,
+                             out_cls=Roaring64Bitmap)
+
+
+def and64(*bitmaps, engine: str = "auto"):
+    from ..core.bitmap64 import Roaring64Bitmap
+
+    bitmaps = _flatten(bitmaps)
+    if not bitmaps or any(b.is_empty() for b in bitmaps):
+        return Roaring64Bitmap()
+    if len(bitmaps) == 1:
+        return bitmaps[0].clone()
+    packed = packing.pack_for_intersection(bitmaps)
+    if packed.keys.size == 0:
+        return Roaring64Bitmap()
+    words, cards = dense.regular_reduce_and(jnp.asarray(packed.words))
+    return packing.unpack_result(packed.keys, np.asarray(words),
+                                 np.asarray(cards), out_cls=Roaring64Bitmap)
 
 
 class DeviceBitmapSet:

@@ -1,0 +1,256 @@
+"""64-bit tier tests — longlong package parity (SURVEY §2.3).
+
+Model-based checks against NumPy u64 set oracles, mirroring the reference's
+TestRoaring64Bitmap / TestRoaring64NavigableMap strategies, plus
+serialization round-trips for the portable spec and the legacy Java format.
+"""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import Roaring64Bitmap, Roaring64NavigableMap
+from roaringbitmap_tpu.core import bitmap64
+from roaringbitmap_tpu.parallel import aggregation
+
+
+def _sample(seed, n=5000):
+    """u64 values spread over low ints, >2^32, and near 2^64."""
+    rng = np.random.default_rng(seed)
+    parts = [
+        rng.integers(0, 1 << 20, n // 3, dtype=np.uint64),
+        (np.uint64(1) << np.uint64(33)) + rng.integers(0, 1 << 18, n // 3,
+                                                       dtype=np.uint64),
+        np.uint64(0xFFFFFFFFFF000000) + rng.integers(0, 1 << 22, n // 3,
+                                                     dtype=np.uint64),
+    ]
+    return np.unique(np.concatenate(parts))
+
+
+class TestRoaring64Bitmap:
+    def test_build_contains_cardinality(self):
+        v = _sample(1)
+        rb = Roaring64Bitmap.from_values(v)
+        assert rb.cardinality == v.size
+        assert np.array_equal(rb.to_array(), v)
+        for x in v[::511]:
+            assert int(x) in rb
+        assert (1 << 63) + 12345 not in rb
+
+    def test_point_mutation(self):
+        rb = Roaring64Bitmap()
+        big = (1 << 40) + 7
+        rb.add(big)
+        rb.add(3)
+        rb.add(2**64 - 1)
+        assert sorted(rb) == [3, big, 2**64 - 1]
+        rb.remove(big)
+        assert big not in rb and rb.cardinality == 2
+        rb.flip(5)
+        assert 5 in rb
+        rb.flip(5)
+        assert 5 not in rb
+
+    def test_algebra_matches_oracle(self):
+        a_v, b_v = _sample(2), _sample(3)
+        a = Roaring64Bitmap.from_values(a_v)
+        b = Roaring64Bitmap.from_values(b_v)
+        assert np.array_equal((a | b).to_array(), np.union1d(a_v, b_v))
+        assert np.array_equal((a & b).to_array(), np.intersect1d(a_v, b_v))
+        assert np.array_equal((a - b).to_array(), np.setdiff1d(a_v, b_v))
+        assert np.array_equal((a ^ b).to_array(), np.setxor1d(a_v, b_v))
+        c = a.clone()
+        c.ior(b)
+        assert c == (a | b)
+
+    def test_rank_select_navigation(self):
+        v = _sample(4, 900)
+        rb = Roaring64Bitmap.from_values(v)
+        for j in range(0, v.size, 97):
+            assert rb.select(j) == int(v[j])
+            assert rb.rank(int(v[j])) == j + 1
+        assert rb.first() == int(v[0])
+        assert rb.last() == int(v[-1])
+        assert rb.next_value(int(v[0]) + 1) == int(v[1]) if v[1] > v[0] + 1 \
+            else int(v[0]) + 1
+        assert rb.previous_value(int(v[-1]) - 1) <= int(v[-1])
+        assert rb.next_value(2**64 - 1) in (-1, int(v[-1]))
+
+    def test_ranges(self):
+        base = (1 << 35) + 1000
+        rb = Roaring64Bitmap.from_range(base, base + 200000)
+        assert rb.cardinality == 200000
+        assert rb.first() == base and rb.last() == base + 199999
+        rb.remove_range(base + 50, base + 100)
+        assert rb.cardinality == 200000 - 50
+        rb.flip_range(base, base + 50)
+        assert rb.cardinality == 200000 - 100
+        assert not rb.contains(base)
+
+    def test_run_optimize_preserves(self):
+        rb = Roaring64Bitmap.from_range(1 << 40, (1 << 40) + 70000)
+        arr = rb.to_array()
+        assert rb.run_optimize()
+        assert rb.has_run_compression()
+        assert np.array_equal(rb.to_array(), arr)
+
+    def test_portable_serialization_roundtrip(self):
+        v = _sample(5)
+        rb = Roaring64Bitmap.from_values(v)
+        rb.run_optimize()
+        data = rb.serialize()
+        assert len(data) == rb.serialized_size_in_bytes()
+        back = Roaring64Bitmap.deserialize(data)
+        assert back == rb
+
+    def test_empty_serialization(self):
+        rb = Roaring64Bitmap()
+        assert Roaring64Bitmap.deserialize(rb.serialize()).is_empty()
+
+    def test_batch_iterator(self):
+        v = _sample(6)
+        rb = Roaring64Bitmap.from_values(v)
+        got = np.concatenate(list(rb.batch_iterator(1024)))
+        assert np.array_equal(got, v)
+
+
+class TestRoaring64NavigableMap:
+    def test_build_and_membership(self):
+        v = _sample(7)
+        nm = Roaring64NavigableMap.from_values(v)
+        assert nm.cardinality == v.size
+        assert np.array_equal(nm.to_array(), v)
+        assert int(v[17]) in nm
+        nm.add(123456789012345)
+        assert 123456789012345 in nm
+        nm.remove(123456789012345)
+        assert 123456789012345 not in nm
+
+    def test_add_int_zero_extends(self):
+        nm = Roaring64NavigableMap()
+        nm.add_int(-1 & 0xFFFFFFFF)
+        assert 0xFFFFFFFF in nm
+
+    def test_algebra(self):
+        a_v, b_v = _sample(8), _sample(9)
+        a = Roaring64NavigableMap.from_values(a_v)
+        b = Roaring64NavigableMap.from_values(b_v)
+        c = Roaring64NavigableMap.from_values(a_v)
+        c.ior(b)
+        assert np.array_equal(c.to_array(), np.union1d(a_v, b_v))
+        c = Roaring64NavigableMap.from_values(a_v)
+        c.iand(b)
+        assert np.array_equal(c.to_array(), np.intersect1d(a_v, b_v))
+        c = Roaring64NavigableMap.from_values(a_v)
+        c.iandnot(b)
+        assert np.array_equal(c.to_array(), np.setdiff1d(a_v, b_v))
+        c = Roaring64NavigableMap.from_values(a_v)
+        c.ixor(b)
+        assert np.array_equal(c.to_array(), np.setxor1d(a_v, b_v))
+        assert a == Roaring64NavigableMap.from_values(a_v)
+
+    def test_rank_select_unsigned(self):
+        v = _sample(10, 600)
+        nm = Roaring64NavigableMap.from_values(v)
+        for j in range(0, v.size, 71):
+            assert nm.select(j) == int(v[j])
+            assert nm.rank(int(v[j])) == j + 1
+        assert nm.first() == int(v[0]) and nm.last() == int(v[-1])
+
+    def test_signed_ordering(self):
+        # In signed order, negative longs (top bit set) come first.
+        vals = [5, -3 & (2**64 - 1), 100, -1 & (2**64 - 1)]
+        nm = Roaring64NavigableMap(signed_longs=True)
+        for x in vals:
+            nm.add(x)
+        it = list(nm)
+        assert it == [-3 & (2**64 - 1), -1 & (2**64 - 1), 5, 100]
+        assert nm.first() == -3 & (2**64 - 1)
+        assert nm.last() == 100
+        assert nm.select(0) == -3 & (2**64 - 1)
+        assert nm.rank(2**64 - 1) == 2  # all "negative" longs are <= -1
+
+    def test_legacy_serialization_roundtrip(self):
+        v = _sample(11)
+        nm = Roaring64NavigableMap.from_values(v, signed_longs=True)
+        data = nm.serialize_legacy()
+        assert len(data) == nm.serialized_size_in_bytes(
+            bitmap64.SERIALIZATION_MODE_LEGACY)
+        back = Roaring64NavigableMap.deserialize_legacy(data)
+        assert back == nm and back.signed_longs
+
+    def test_portable_serialization_roundtrip(self):
+        v = _sample(12)
+        nm = Roaring64NavigableMap.from_values(v)
+        data = nm.serialize_portable()
+        back = Roaring64NavigableMap.deserialize_portable(data)
+        assert back == nm
+
+    def test_serialization_mode_global(self):
+        v = _sample(13, 300)
+        nm = Roaring64NavigableMap.from_values(v)
+        assert nm.serialize() == nm.serialize_legacy()  # default mode legacy
+        old = bitmap64.SERIALIZATION_MODE
+        try:
+            bitmap64.SERIALIZATION_MODE = bitmap64.SERIALIZATION_MODE_PORTABLE
+            assert nm.serialize() == nm.serialize_portable()
+        finally:
+            bitmap64.SERIALIZATION_MODE = old
+
+    def test_cross_class_portable_interop(self):
+        """Portable bytes are interchangeable between the two 64-bit classes
+        (the RoaringFormatSpec 64-bit extension is one format)."""
+        v = _sample(14)
+        rb = Roaring64Bitmap.from_values(v)
+        nm = Roaring64NavigableMap.deserialize_portable(rb.serialize())
+        assert np.array_equal(nm.to_array(), v)
+        rb2 = Roaring64Bitmap.deserialize(nm.serialize_portable())
+        assert rb2 == rb
+        assert nm.to_roaring64() == rb
+        assert Roaring64NavigableMap.from_roaring64(rb) == nm
+
+    def test_add_range(self):
+        lo = (1 << 33) - 100
+        nm = Roaring64NavigableMap()
+        nm.add_range(lo, lo + 300)  # crosses the 2^32 bucket boundary
+        assert nm.cardinality == 300
+        assert nm.first() == lo and nm.last() == lo + 299
+
+
+class TestWideAggregation64:
+    def test_wide_or64_matches_oracle(self):
+        rng = np.random.default_rng(20)
+        arrs = [
+            np.unique((np.uint64(1) << np.uint64(34))
+                      + rng.integers(0, 1 << 20, 4000, dtype=np.uint64))
+            for _ in range(12)
+        ]
+        bms = [Roaring64Bitmap.from_values(a) for a in arrs]
+        got = aggregation.or64(bms, engine="xla")
+        oracle = np.unique(np.concatenate(arrs))
+        assert np.array_equal(got.to_array(), oracle)
+        assert isinstance(got, Roaring64Bitmap)
+
+    def test_wide_xor64_matches_oracle(self):
+        rng = np.random.default_rng(21)
+        arrs = [np.unique(rng.integers(0, 1 << 22, 3000, dtype=np.uint64)
+                          + np.uint64(1 << 45)) for _ in range(7)]
+        bms = [Roaring64Bitmap.from_values(a) for a in arrs]
+        got = aggregation.xor64(bms, engine="xla")
+        acc = Roaring64Bitmap()
+        for b in bms:
+            acc.ixor(b)
+        assert got == acc
+
+    def test_wide_and64_matches_oracle(self):
+        rng = np.random.default_rng(22)
+        base = np.unique(rng.integers(0, 1 << 18, 5000, dtype=np.uint64)
+                         + np.uint64(1 << 50))
+        arrs = [np.union1d(base, rng.integers(0, 1 << 18, 1000,
+                                              dtype=np.uint64))
+                for _ in range(6)]
+        bms = [Roaring64Bitmap.from_values(a) for a in arrs]
+        got = aggregation.and64(bms)
+        oracle = arrs[0]
+        for a in arrs[1:]:
+            oracle = np.intersect1d(oracle, a)
+        assert np.array_equal(got.to_array(), oracle)

@@ -1,0 +1,12 @@
+"""Bit-sliced index (BSI) — the reference's bsi module (SURVEY §2.4).
+
+A BSI stores one integer value per row id: an existence bitmap ``ebM`` plus
+base-2 slice bitmaps ``bA[i]`` (row r is in slice i iff bit i of value(r) is
+set).  Comparison queries (EQ/NEQ/LT/LE/GT/GE/RANGE) reduce to bulk bitmap
+algebra over the slices — the ideal fused TPU workload (BASELINE config #5).
+"""
+
+from .slice_index import Operation, RoaringBitmapSliceIndex
+from .device import DeviceBSI
+
+__all__ = ["Operation", "RoaringBitmapSliceIndex", "DeviceBSI"]

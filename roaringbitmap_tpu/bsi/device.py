@@ -1,0 +1,200 @@
+"""Device-tier BSI engine — the fused O'Neil comparator on TPU.
+
+The reference evaluates compare/sum/topK as ~33 sequential host-side bitmap
+ops per query (RoaringBitmapSliceIndex.oNeilCompare :432-470).  Here the
+whole index is densified once into HBM:
+
+  slices  u32[S, K, 2048]   slice s, container key k, dense 2^16-bit image
+  ebm     u32[K, 2048]
+
+and each query is ONE jitted program: a `lax.scan` over the slice axis doing
+elementwise word algebra (VPU-bound, fully fused by XLA), a popcount on the
+way out, nothing touching the host until the final result materializes.
+Predicates are traced scalars, so every EQ/LT/GE/... query over the same
+index reuses one compiled executable.
+
+sum() is a single weighted-popcount contraction; top_k runs the Kaser scan
+(BitSliceIndexBase.topK :303-341) on device with `lax.cond` branches on
+popcount scalars.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.bitmap import RoaringBitmap
+from ..ops import packing
+from ..ops.dense import popcount
+from .slice_index import Operation, RoaringBitmapSliceIndex
+
+
+def _densify(rb: RoaringBitmap, keys: np.ndarray) -> np.ndarray:
+    """Dense [K, 2048] image of rb over the index's key set.  Containers
+    under keys outside the set are dropped (a found_set may cover rows the
+    index never stored; see DeviceBSI.compare for the NEQ remainder)."""
+    out = np.zeros((keys.size, packing.WORDS32), dtype=np.uint32)
+    idx = np.searchsorted(keys, rb.keys)
+    for row, key, c in zip(idx, rb.keys, rb.containers):
+        if row < keys.size and keys[row] == key:
+            out[row] = packing.container_words_u32(c)
+    return out
+
+
+class DeviceBSI:
+    """A RoaringBitmapSliceIndex packed once and kept HBM-resident."""
+
+    def __init__(self, bsi: RoaringBitmapSliceIndex):
+        self.min_value = bsi.min_value
+        self.max_value = bsi.max_value
+        # the ebM's key set covers every slice (slices are subsets of ebM)
+        self.keys = bsi.ebm.keys.copy()
+        self.depth = bsi.bit_count()
+        ebm_np = _densify(bsi.ebm, self.keys)
+        slices_np = (np.stack([_densify(s, self.keys) for s in bsi.slices])
+                     if self.depth else
+                     np.zeros((0,) + ebm_np.shape, dtype=np.uint32))
+        self.ebm = jax.device_put(ebm_np)
+        self.slices = jax.device_put(slices_np)
+
+    def hbm_bytes(self) -> int:
+        return int(self.ebm.nbytes + self.slices.nbytes)
+
+    # ------------------------------------------------------------ primitives
+    @partial(jax.jit, static_argnums=0)
+    def _oneil(self, predicate):
+        """One pass over slices -> (gt, lt, eq) word tensors.
+
+        Scan runs top bit down, mirroring oNeilCompare's descending loop."""
+        def step(state, xs):
+            gt, lt, eq = state
+            slice_words, bit = xs
+            lt = jnp.where(bit, lt | (eq & ~slice_words), lt)
+            gt = jnp.where(bit, gt, gt | (eq & slice_words))
+            eq = jnp.where(bit, eq & slice_words, eq & ~slice_words)
+            return (gt, lt, eq), None
+
+        bits = (predicate >> jnp.arange(self.depth - 1, -1, -1,
+                                        dtype=jnp.int32)) & 1
+        zero = jnp.zeros_like(self.ebm)
+        (gt, lt, eq), _ = jax.lax.scan(
+            step, (zero, zero, self.ebm),
+            (jnp.flip(self.slices, axis=0), bits))
+        return gt, lt, eq
+
+    @partial(jax.jit, static_argnums=(0, 1))
+    def _compare_words(self, op: str, predicate, end, found):
+        gt, lt, eq = self._oneil(predicate)
+        eq = found & eq
+        if op == "EQ":
+            res = eq
+        elif op == "NEQ":
+            res = found & ~eq
+        elif op == "GT":
+            res = gt & found
+        elif op == "LT":
+            res = lt & found
+        elif op == "LE":
+            res = (lt & found) | eq
+        elif op == "GE":
+            res = (gt & found) | eq
+        elif op == "RANGE":
+            gt2, lt2, eq2 = self._oneil(end)
+            res = ((gt & found) | eq) & ((lt2 & found) | (found & eq2))
+        else:
+            raise ValueError(f"unsupported operation {op}")
+        return res, popcount(res, axis=-1)
+
+    # --------------------------------------------------------------- queries
+    def _found_words(self, found_set: RoaringBitmap | None):
+        if found_set is None:
+            return self.ebm
+        return jnp.asarray(_densify(found_set, self.keys))
+
+    def compare(self, op: Operation, start_or_value: int, end: int = 0,
+                found_set: RoaringBitmap | None = None) -> RoaringBitmap:
+        """Fused device compare; bit-exact with the host comparator."""
+        found = self._found_words(found_set)
+        words, cards = self._compare_words(
+            op.value, jnp.int32(start_or_value), jnp.int32(end), found)
+        res = packing.unpack_result(self.keys, np.asarray(words),
+                                    np.asarray(cards))
+        if op is Operation.NEQ and found_set is not None:
+            # NEQ = foundSet \ EQ keeps foundSet rows the index never stored
+            # (oNeilCompare :459); those live under keys outside self.keys
+            # and are dropped by _densify, so re-attach them host-side.
+            extra = ~np.isin(found_set.keys, self.keys)
+            if extra.any():
+                from ..core.bitmap import or_ as rb_or
+
+                stray = RoaringBitmap(
+                    found_set.keys[extra],
+                    [c for c, e in zip(found_set.containers, extra) if e])
+                res = rb_or(res, stray)
+        return res
+
+    def compare_cardinality(self, op: Operation, start_or_value: int,
+                            end: int = 0,
+                            found_set: RoaringBitmap | None = None) -> int:
+        if op is Operation.NEQ and found_set is not None:
+            # needs the host-side stray-key remainder; see compare()
+            return self.compare(op, start_or_value, end, found_set).cardinality
+        found = self._found_words(found_set)
+        _, cards = self._compare_words(
+            op.value, jnp.int32(start_or_value), jnp.int32(end), found)
+        return int(np.asarray(jnp.sum(cards)))
+
+    def sum(self, found_set: RoaringBitmap | None = None) -> tuple[int, int]:
+        """Weighted popcount contraction (sum :581-592).  The per-slice
+        popcounts come back as i32 and the 2^i weighting happens in Python
+        ints, so values never overflow device integer widths."""
+        found = self._found_words(found_set)
+        cards = self._slice_cards(found)
+        count = int(np.asarray(jnp.sum(popcount(found))))
+        total = sum((1 << i) * int(c) for i, c in enumerate(np.asarray(cards)))
+        return total, count
+
+    @partial(jax.jit, static_argnums=0)
+    def _slice_cards(self, found):
+        return jax.vmap(lambda s: jnp.sum(popcount(s & found)))(self.slices)
+
+    @partial(jax.jit, static_argnums=(0, 1))
+    def _topk_words(self, k: int, found):
+        """Kaser top-K scan on device (BitSliceIndexBase.topK :303-341),
+        minus the final tie trim (host-side, needs value order)."""
+        def step(state, slice_words):
+            g, e = state
+            x = g | (e & slice_words)
+            n = jnp.sum(popcount(x))
+            g, e = jax.lax.cond(
+                n > k,
+                lambda: (g, e & slice_words),
+                lambda: jax.lax.cond(
+                    n < k,
+                    lambda: (x, e & ~slice_words),
+                    lambda: (g, e & slice_words)))
+            return (g, e), None
+
+        zero = jnp.zeros_like(found)
+        (g, e), _ = jax.lax.scan(step, (zero, found),
+                                 jnp.flip(self.slices, axis=0))
+        f = g | e
+        return f, popcount(f, axis=-1)
+
+    def top_k(self, k: int, found_set: RoaringBitmap | None = None
+              ) -> RoaringBitmap:
+        found = self._found_words(found_set)
+        if k < 0 or k > int(np.asarray(jnp.sum(popcount(found)))):
+            raise ValueError("TopK param error")
+        words, cards = self._topk_words(k, found)
+        f = packing.unpack_result(self.keys, np.asarray(words),
+                                  np.asarray(cards))
+        excess = f.cardinality - k
+        if excess > 0:  # drop smallest row ids, like the reference's trim
+            for v in f.to_array()[:excess]:
+                f.remove(int(v))
+        assert f.cardinality == k, "bugs found when compute topK"
+        return f

@@ -765,12 +765,24 @@ class Roaring64NavigableMap:
 
     # ------------------------------------------------------------- interop
     def to_roaring64(self) -> Roaring64Bitmap:
-        """Lossless conversion to the array-keyed implementation."""
-        return Roaring64Bitmap.deserialize(self.serialize_portable())
+        """Lossless in-memory conversion to the array-keyed implementation:
+        high48 = (high32 << 16) | key16, containers shared."""
+        keys_parts: list[np.ndarray] = []
+        conts: list[Container] = []
+        for h in sorted(self._map):
+            rb32 = self._map[h]
+            keys_parts.append((np.uint64(h) << np.uint64(16))
+                              | rb32.keys.astype(np.uint64))
+            conts.extend(rb32.containers)
+        keys = (np.concatenate(keys_parts) if keys_parts
+                else np.empty(0, dtype=np.uint64))
+        return Roaring64Bitmap(keys, conts)
 
     @staticmethod
     def from_roaring64(rb: Roaring64Bitmap,
                        signed_longs: bool = False) -> "Roaring64NavigableMap":
-        out = Roaring64NavigableMap.deserialize_portable(rb.serialize())
-        out.signed_longs = signed_longs
+        out = Roaring64NavigableMap(signed_longs)
+        for high, rb32 in rb._buckets32():
+            out._map[high] = RoaringBitmap(rb32.keys.copy(),
+                                           list(rb32.containers))
         return out

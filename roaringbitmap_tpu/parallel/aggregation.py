@@ -67,20 +67,23 @@ def xor(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
     return _aggregate_ragged("xor", _flatten(bitmaps), engine)
 
 
-def and_(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
+def and_(*bitmaps: RoaringBitmap, engine: str = "auto",
+         out_cls=None) -> RoaringBitmap:
     """Wide intersection (FastAggregation.and workShyAnd :356)."""
+    cls = out_cls or RoaringBitmap
     bitmaps = _flatten(bitmaps)
     if not bitmaps:
-        return RoaringBitmap()
+        return cls()
     if any(b.is_empty() for b in bitmaps):
-        return RoaringBitmap()
+        return cls()
     if len(bitmaps) == 1:
         return bitmaps[0].clone()
     packed = packing.pack_for_intersection(bitmaps)
     if packed.keys.size == 0:
-        return RoaringBitmap()
+        return cls()
     words, cards = dense.regular_reduce_and(jnp.asarray(packed.words))
-    return packing.unpack_result(packed.keys, np.asarray(words), np.asarray(cards))
+    return packing.unpack_result(packed.keys, np.asarray(words),
+                                 np.asarray(cards), out_cls=cls)
 
 
 def or_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto") -> int:
@@ -141,17 +144,7 @@ def xor64(*bitmaps, engine: str = "auto"):
 def and64(*bitmaps, engine: str = "auto"):
     from ..core.bitmap64 import Roaring64Bitmap
 
-    bitmaps = _flatten(bitmaps)
-    if not bitmaps or any(b.is_empty() for b in bitmaps):
-        return Roaring64Bitmap()
-    if len(bitmaps) == 1:
-        return bitmaps[0].clone()
-    packed = packing.pack_for_intersection(bitmaps)
-    if packed.keys.size == 0:
-        return Roaring64Bitmap()
-    words, cards = dense.regular_reduce_and(jnp.asarray(packed.words))
-    return packing.unpack_result(packed.keys, np.asarray(words),
-                                 np.asarray(cards), out_cls=Roaring64Bitmap)
+    return and_(*bitmaps, engine=engine, out_cls=Roaring64Bitmap)
 
 
 class DeviceBitmapSet:

@@ -70,8 +70,8 @@ class TestRoaring64Bitmap:
             assert rb.rank(int(v[j])) == j + 1
         assert rb.first() == int(v[0])
         assert rb.last() == int(v[-1])
-        assert rb.next_value(int(v[0]) + 1) == int(v[1]) if v[1] > v[0] + 1 \
-            else int(v[0]) + 1
+        assert rb.next_value(int(v[0]) + 1) == (
+            int(v[1]) if v[1] > v[0] + 1 else int(v[0]) + 1)
         assert rb.previous_value(int(v[-1]) - 1) <= int(v[-1])
         assert rb.next_value(2**64 - 1) in (-1, int(v[-1]))
 

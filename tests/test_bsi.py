@@ -1,0 +1,272 @@
+"""BSI tests (SURVEY §2.4) — model-based against NumPy oracles, plus
+host/device parity for the fused comparator and both serialization formats."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.bsi import DeviceBSI, Operation, RoaringBitmapSliceIndex
+from roaringbitmap_tpu.bsi.slice_index import read_vlong, write_vlong
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0xB51)
+    n = 20000
+    cols = np.unique(rng.integers(0, 1 << 22, n, dtype=np.uint32))
+    vals = rng.integers(0, 1 << 20, cols.size, dtype=np.int64)
+    return cols, vals
+
+
+@pytest.fixture(scope="module")
+def bsi(data):
+    cols, vals = data
+    return RoaringBitmapSliceIndex.from_pairs(cols, vals)
+
+
+def _oracle_filter(cols, vals, op, a, b=0):
+    if op is Operation.EQ:
+        m = vals == a
+    elif op is Operation.NEQ:
+        m = vals != a
+    elif op is Operation.LT:
+        m = vals < a
+    elif op is Operation.LE:
+        m = vals <= a
+    elif op is Operation.GT:
+        m = vals > a
+    elif op is Operation.GE:
+        m = vals >= a
+    else:
+        m = (vals >= a) & (vals <= b)
+    return cols[m]
+
+
+ALL_OPS = [Operation.EQ, Operation.NEQ, Operation.LT, Operation.LE,
+           Operation.GT, Operation.GE]
+
+
+class TestVint:
+    @pytest.mark.parametrize("v", [0, 1, -1, 127, -112, 128, -113, 255, 256,
+                                   1 << 20, -(1 << 20), 2**31 - 1, -(2**31)])
+    def test_roundtrip(self, v):
+        out = bytearray()
+        write_vlong(out, v)
+        got, pos = read_vlong(memoryview(bytes(out)), 0)
+        assert got == v and pos == len(out)
+
+    def test_single_byte_range(self):
+        for v in (-112, 127, 0):
+            out = bytearray()
+            write_vlong(out, v)
+            assert len(out) == 1
+
+
+class TestHostBSI:
+    def test_build_and_get(self, data, bsi):
+        cols, vals = data
+        assert bsi.cardinality == cols.size
+        assert bsi.min_value == int(vals.min())
+        assert bsi.max_value == int(vals.max())
+        for i in range(0, cols.size, 2500):
+            v, ok = bsi.get_value(int(cols[i]))
+            assert ok and v == int(vals[i])
+        assert bsi.get_value(0xDEAD0001)[1] is False
+        got, exists = bsi.get_values(cols[:100])
+        assert np.array_equal(got, vals[:100]) and exists.all()
+
+    @pytest.mark.parametrize("op", ALL_OPS)
+    def test_compare_matches_oracle(self, data, bsi, op):
+        cols, vals = data
+        pred = int(np.median(vals))
+        got = bsi.compare(op, pred).to_array()
+        assert np.array_equal(got, _oracle_filter(cols, vals, op, pred))
+
+    def test_range_matches_oracle(self, data, bsi):
+        cols, vals = data
+        a, b = int(np.quantile(vals, 0.25)), int(np.quantile(vals, 0.75))
+        got = bsi.compare(Operation.RANGE, a, b).to_array()
+        assert np.array_equal(got, _oracle_filter(cols, vals, Operation.RANGE, a, b))
+
+    def test_min_max_pruning_paths(self, data, bsi):
+        cols, vals = data
+        assert bsi.compare(Operation.LT, int(vals.max()) + 10).cardinality == cols.size
+        assert bsi.compare(Operation.GT, int(vals.max()) + 10).is_empty()
+        assert bsi.compare(Operation.GE, 0).cardinality == cols.size
+
+    def test_compare_with_found_set(self, data, bsi):
+        cols, vals = data
+        fs = RoaringBitmap.from_values(cols[::3])
+        pred = int(np.median(vals))
+        got = bsi.compare(Operation.GE, pred, found_set=fs).to_array()
+        oracle = np.intersect1d(_oracle_filter(cols, vals, Operation.GE, pred),
+                                cols[::3])
+        assert np.array_equal(got, oracle)
+
+    def test_sum(self, data, bsi):
+        cols, vals = data
+        total, count = bsi.sum()
+        assert total == int(vals.sum()) and count == cols.size
+        fs = RoaringBitmap.from_values(cols[:500])
+        total, count = bsi.sum(fs)
+        assert total == int(vals[:500].sum()) and count == 500
+
+    def test_top_k(self, data, bsi):
+        cols, vals = data
+        k = 250
+        got = bsi.top_k(k)
+        assert got.cardinality == k
+        kth = np.sort(vals)[-k]
+        got_vals, _ = bsi.get_values(got.to_array())
+        # every selected value must be >= the k-th largest value
+        assert got_vals.min() >= kth - 0  # ties allowed at the boundary
+        assert (got_vals >= kth).all()
+
+    def test_set_value_updates(self):
+        bsi = RoaringBitmapSliceIndex()
+        bsi.set_value(10, 5)
+        bsi.set_value(11, 300)
+        bsi.set_value(10, 7)  # overwrite
+        assert bsi.get_value(10) == (7, True)
+        assert bsi.get_value(11) == (300, True)
+        assert bsi.min_value <= 7 and bsi.max_value == 300
+
+    def test_add_with_carry(self):
+        a = RoaringBitmapSliceIndex.from_pairs(
+            np.array([1, 2, 3], dtype=np.uint32),
+            np.array([3, 7, 15], dtype=np.int64))
+        b = RoaringBitmapSliceIndex.from_pairs(
+            np.array([2, 3, 4], dtype=np.uint32),
+            np.array([1, 1, 9], dtype=np.int64))
+        a.add(b)
+        assert a.get_value(1) == (3, True)
+        assert a.get_value(2) == (8, True)    # 7+1 carries across all bits
+        assert a.get_value(3) == (16, True)   # 15+1 grows the bit depth
+        assert a.get_value(4) == (9, True)
+        assert a.max_value == 16 and a.min_value == 3
+
+    def test_merge_disjoint(self, data):
+        cols, vals = data
+        h = cols.size // 2
+        a = RoaringBitmapSliceIndex.from_pairs(cols[:h], vals[:h])
+        b = RoaringBitmapSliceIndex.from_pairs(cols[h:], vals[h:])
+        a.merge(b)
+        whole = RoaringBitmapSliceIndex.from_pairs(cols, vals)
+        assert a == whole
+
+    def test_merge_overlap_raises(self):
+        a = RoaringBitmapSliceIndex.from_pairs(
+            np.array([1], dtype=np.uint32), np.array([1], dtype=np.int64))
+        with pytest.raises(ValueError):
+            a.merge(a.clone())
+
+    def test_transpose_with_count(self):
+        cols = np.arange(10, dtype=np.uint32)
+        vals = np.array([5, 5, 5, 9, 9, 2, 2, 2, 2, 7], dtype=np.int64)
+        bsi = RoaringBitmapSliceIndex.from_pairs(cols, vals)
+        t = bsi.transpose_with_count()
+        assert t.get_value(5) == (3, True)
+        assert t.get_value(9) == (2, True)
+        assert t.get_value(2) == (4, True)
+        assert t.get_value(7) == (1, True)
+        assert t.get_value(4)[1] is False
+
+    def test_in_values(self, data, bsi):
+        cols, vals = data
+        wanted = {int(vals[5]), int(vals[100])}
+        got = bsi.in_values(wanted).to_array()
+        oracle = cols[np.isin(vals, sorted(wanted))]
+        assert np.array_equal(got, oracle)
+
+    def test_to_pair_list(self):
+        cols = np.array([3, 9], dtype=np.uint32)
+        vals = np.array([40, 2], dtype=np.int64)
+        bsi = RoaringBitmapSliceIndex.from_pairs(cols, vals)
+        assert bsi.to_pair_list() == [(3, 40), (9, 2)]
+
+    def test_negative_value_rejected(self):
+        with pytest.raises(ValueError):
+            RoaringBitmapSliceIndex.from_pairs(
+                np.array([1], dtype=np.uint32), np.array([-4], dtype=np.int64))
+
+    def test_stream_serialization_roundtrip(self, bsi):
+        data = bsi.serialize_stream()
+        back = RoaringBitmapSliceIndex.deserialize_stream(data)
+        assert back == bsi
+
+    def test_buffer_serialization_roundtrip(self, bsi):
+        data = bsi.serialize_buffer()
+        assert len(data) == bsi.serialized_size_in_bytes()
+        back = RoaringBitmapSliceIndex.deserialize_buffer(data)
+        assert back == bsi
+
+
+class TestDeviceBSI:
+    @pytest.fixture(scope="class")
+    def dev(self, bsi):
+        return DeviceBSI(bsi)
+
+    @pytest.mark.parametrize("op", ALL_OPS)
+    def test_device_matches_host(self, data, bsi, dev, op):
+        cols, vals = data
+        pred = int(np.quantile(vals, 0.6))
+        host = bsi.o_neil_compare(op, pred)
+        device = dev.compare(op, pred)
+        assert device == host
+
+    def test_device_range(self, data, bsi, dev):
+        cols, vals = data
+        a, b = int(np.quantile(vals, 0.3)), int(np.quantile(vals, 0.9))
+        host = bsi.compare(Operation.RANGE, a, b)
+        assert dev.compare(Operation.RANGE, a, b) == host
+
+    def test_device_found_set(self, data, bsi, dev):
+        cols, vals = data
+        fs = RoaringBitmap.from_values(cols[::5])
+        pred = int(np.median(vals))
+        assert dev.compare(Operation.LT, pred, found_set=fs) == \
+            bsi.compare(Operation.LT, pred, found_set=fs)
+
+    def test_device_predicate_reuse_no_recompile(self, data, dev, bsi):
+        # same compiled executable across predicates: just correctness here
+        for q in (0.1, 0.5, 0.9):
+            pred = int(np.quantile(data[1], q))
+            assert dev.compare(Operation.LE, pred) == \
+                bsi.compare(Operation.LE, pred)
+
+    def test_device_sum(self, data, bsi, dev):
+        assert dev.sum() == bsi.sum()
+        fs = RoaringBitmap.from_values(data[0][:1000])
+        assert dev.sum(fs) == bsi.sum(fs)
+
+    def test_device_top_k(self, data, bsi, dev):
+        for k in (1, 100, 999):
+            assert dev.top_k(k) == bsi.top_k(k)
+
+    def test_device_compare_cardinality(self, data, bsi, dev):
+        pred = int(np.median(data[1]))
+        assert dev.compare_cardinality(Operation.GT, pred) == \
+            bsi.compare(Operation.GT, pred).cardinality
+
+    def test_found_set_with_stray_keys(self, data, bsi, dev):
+        """foundSet rows the index never stored: NEQ must keep them
+        (oNeilCompare NEQ = foundSet \\ EQ), other ops must drop them."""
+        cols, vals = data
+        stray = np.array([0xFE000001, 0xFE000002], dtype=np.uint32)
+        fs = RoaringBitmap.from_values(np.concatenate([cols[:50], stray]))
+        pred = int(np.median(vals))
+        for op in ALL_OPS:
+            host = bsi.o_neil_compare(op, pred, fs)
+            device = dev.compare(op, pred, found_set=fs)
+            assert device == host, op
+        assert dev.compare_cardinality(Operation.NEQ, pred, found_set=fs) == \
+            bsi.o_neil_compare(Operation.NEQ, pred, fs).cardinality
+
+    def test_value_above_int32_rejected(self):
+        with pytest.raises(ValueError):
+            RoaringBitmapSliceIndex.from_pairs(
+                np.array([1], dtype=np.uint32),
+                np.array([1 << 31], dtype=np.int64))
+        bsi = RoaringBitmapSliceIndex()
+        with pytest.raises(ValueError):
+            bsi.set_value(1, 1 << 31)

@@ -44,6 +44,39 @@ def _densify(rb: RoaringBitmap, keys: np.ndarray) -> np.ndarray:
     return out
 
 
+def oneil_scan(slices, ebm, bits):
+    """One descending pass over base-2 slices -> (gt, lt, eq) word tensors.
+
+    The device form of oNeilCompare's loop (RoaringBitmapSliceIndex.java
+    :440-448).  `bits` is the predicate's bit array, top bit first (i32[S]) —
+    passing bits instead of a scalar keeps 64-bit thresholds exact (used by
+    core.rangebitmap) and reuses one compiled scan across predicates.
+    """
+    def step(state, xs):
+        gt, lt, eq = state
+        slice_words, bit = xs
+        lt = jnp.where(bit, lt | (eq & ~slice_words), lt)
+        gt = jnp.where(bit, gt, gt | (eq & slice_words))
+        eq = jnp.where(bit, eq & slice_words, eq & ~slice_words)
+        return (gt, lt, eq), None
+
+    zero = jnp.zeros_like(ebm)
+    (gt, lt, eq), _ = jax.lax.scan(
+        step, (zero, zero, ebm), (jnp.flip(slices, axis=0), bits))
+    return gt, lt, eq
+
+
+def _pack_index(ebm_bitmap: RoaringBitmap, slice_bitmaps):
+    """Densify an existence bitmap + its slices over the ebm's key set and
+    push both HBM-resident.  Returns (keys, ebm_dev, slices_dev)."""
+    keys = ebm_bitmap.keys.copy()
+    ebm_np = _densify(ebm_bitmap, keys)
+    slices_np = (np.stack([_densify(s, keys) for s in slice_bitmaps])
+                 if slice_bitmaps else
+                 np.zeros((0,) + ebm_np.shape, dtype=np.uint32))
+    return keys, jax.device_put(ebm_np), jax.device_put(slices_np)
+
+
 class DeviceBSI:
     """A RoaringBitmapSliceIndex packed once and kept HBM-resident."""
 
@@ -51,39 +84,17 @@ class DeviceBSI:
         self.min_value = bsi.min_value
         self.max_value = bsi.max_value
         # the ebM's key set covers every slice (slices are subsets of ebM)
-        self.keys = bsi.ebm.keys.copy()
         self.depth = bsi.bit_count()
-        ebm_np = _densify(bsi.ebm, self.keys)
-        slices_np = (np.stack([_densify(s, self.keys) for s in bsi.slices])
-                     if self.depth else
-                     np.zeros((0,) + ebm_np.shape, dtype=np.uint32))
-        self.ebm = jax.device_put(ebm_np)
-        self.slices = jax.device_put(slices_np)
+        self.keys, self.ebm, self.slices = _pack_index(bsi.ebm, bsi.slices)
 
     def hbm_bytes(self) -> int:
         return int(self.ebm.nbytes + self.slices.nbytes)
 
     # ------------------------------------------------------------ primitives
-    @partial(jax.jit, static_argnums=0)
     def _oneil(self, predicate):
-        """One pass over slices -> (gt, lt, eq) word tensors.
-
-        Scan runs top bit down, mirroring oNeilCompare's descending loop."""
-        def step(state, xs):
-            gt, lt, eq = state
-            slice_words, bit = xs
-            lt = jnp.where(bit, lt | (eq & ~slice_words), lt)
-            gt = jnp.where(bit, gt, gt | (eq & slice_words))
-            eq = jnp.where(bit, eq & slice_words, eq & ~slice_words)
-            return (gt, lt, eq), None
-
         bits = (predicate >> jnp.arange(self.depth - 1, -1, -1,
                                         dtype=jnp.int32)) & 1
-        zero = jnp.zeros_like(self.ebm)
-        (gt, lt, eq), _ = jax.lax.scan(
-            step, (zero, zero, self.ebm),
-            (jnp.flip(self.slices, axis=0), bits))
-        return gt, lt, eq
+        return oneil_scan(self.slices, self.ebm, bits)
 
     @partial(jax.jit, static_argnums=(0, 1))
     def _compare_words(self, op: str, predicate, end, found):
@@ -198,3 +209,157 @@ class DeviceBSI:
                 f.remove(int(v))
         assert f.cardinality == k, "bugs found when compute topK"
         return f
+
+
+class DeviceRangeBitmap:
+    """A core.rangebitmap.RangeBitmap packed HBM-resident.
+
+    Thresholds are decomposed into bit arrays host-side, so the fused scan
+    stays exact over the full unsigned-64-bit value range and one compiled
+    executable serves every threshold.
+    """
+
+    def __init__(self, rb):
+        from ..core.rangebitmap import RangeBitmap as HostRangeBitmap
+
+        assert isinstance(rb, HostRangeBitmap)
+        self.rows = rb.row_count
+        self.max_value = rb.max_value
+        self.depth = len(rb.slices)
+        all_rows = RoaringBitmap.from_range(0, self.rows)
+        self.keys, self.ebm, self.slices = _pack_index(all_rows, rb.slices)
+
+    def hbm_bytes(self) -> int:
+        return int(self.ebm.nbytes + self.slices.nbytes)
+
+    def _bits(self, threshold: int) -> jnp.ndarray:
+        return jnp.asarray(
+            [(threshold >> i) & 1 for i in range(self.depth - 1, -1, -1)],
+            dtype=jnp.int32)
+
+    @partial(jax.jit, static_argnums=(0, 1))
+    def _query_words(self, op: str, bits, bits2, found):
+        gt, lt, eq = oneil_scan(self.slices, self.ebm, bits)
+        if op == "lte":
+            res = (lt | eq) & found
+        elif op == "gte":
+            res = (gt | eq) & found
+        elif op == "eq":
+            res = eq & found
+        elif op == "neq":
+            res = found & ~eq
+        elif op == "between":
+            gt2, lt2, eq2 = oneil_scan(self.slices, self.ebm, bits2)
+            res = (gt | eq) & (lt2 | eq2) & found
+        else:
+            raise ValueError(f"unsupported op {op}")
+        return res, popcount(res, axis=-1)
+
+    def _found_words(self, context: RoaringBitmap | None):
+        if context is None:
+            return self.ebm
+        # clip to the valid row universe: the host tier computes
+        # all_rows ∩ context, so neq/_all must not see out-of-range rows
+        return jnp.asarray(_densify(context, self.keys)) & self.ebm
+
+    def _run(self, op: str, a: int, b: int,
+             context: RoaringBitmap | None) -> RoaringBitmap:
+        found = self._found_words(context)
+        words, cards = self._query_words(op, self._bits(a), self._bits(b),
+                                         found)
+        return packing.unpack_result(self.keys, np.asarray(words),
+                                     np.asarray(cards))
+
+    # query surface mirrors core.rangebitmap.RangeBitmap, with the same
+    # out-of-range guards so device == host bit-exactly
+    def lte(self, threshold, context=None):
+        if threshold < 0:
+            return RoaringBitmap()
+        if threshold >= self.max_value:
+            return self._all(context)
+        return self._run("lte", threshold, 0, context)
+
+    def lt(self, threshold, context=None):
+        if threshold <= 0:
+            return RoaringBitmap()
+        return self.lte(threshold - 1, context)
+
+    def gte(self, threshold, context=None):
+        if threshold <= 0:
+            return self._all(context)
+        if threshold > self.max_value:
+            return RoaringBitmap()
+        return self._run("gte", threshold, 0, context)
+
+    def gt(self, threshold, context=None):
+        return self.gte(threshold + 1, context)
+
+    def eq(self, value, context=None):
+        if value < 0 or value > self.max_value:
+            return RoaringBitmap()
+        return self._run("eq", value, 0, context)
+
+    def neq(self, value, context=None):
+        if value < 0 or value > self.max_value:
+            return self._all(context)
+        return self._run("neq", value, 0, context)
+
+    def _all(self, context):
+        """All rows (∩ context) — the guard fast path, kept on device."""
+        found = self._found_words(context)
+        cards = popcount(found, axis=-1)
+        return packing.unpack_result(self.keys, np.asarray(found),
+                                     np.asarray(cards))
+
+    def between(self, min_value, max_value, context=None):
+        lo = max(min_value, 0)
+        hi = min(max_value, self.max_value)
+        if lo > self.max_value or hi < 0 or lo > hi:
+            return RoaringBitmap()
+        return self._run("between", lo, hi, context)
+
+    # cardinality forms: sum the device-side per-key counts — one scalar
+    # back to host, no result materialization
+    def _card(self, op: str, a: int, b: int, context) -> int:
+        found = self._found_words(context)
+        _, cards = self._query_words(op, self._bits(a), self._bits(b), found)
+        return int(np.asarray(jnp.sum(cards)))
+
+    def _all_cardinality(self, context) -> int:
+        return int(np.asarray(jnp.sum(popcount(self._found_words(context)))))
+
+    def lte_cardinality(self, t, context=None):
+        if t < 0:
+            return 0
+        if t >= self.max_value:
+            return self._all_cardinality(context)
+        return self._card("lte", t, 0, context)
+
+    def lt_cardinality(self, t, context=None):
+        return 0 if t <= 0 else self.lte_cardinality(t - 1, context)
+
+    def gte_cardinality(self, t, context=None):
+        if t <= 0:
+            return self._all_cardinality(context)
+        if t > self.max_value:
+            return 0
+        return self._card("gte", t, 0, context)
+
+    def gt_cardinality(self, t, context=None):
+        return self.gte_cardinality(t + 1, context)
+
+    def eq_cardinality(self, v, context=None):
+        if v < 0 or v > self.max_value:
+            return 0
+        return self._card("eq", v, 0, context)
+
+    def neq_cardinality(self, v, context=None):
+        if v < 0 or v > self.max_value:
+            return self._all_cardinality(context)
+        return self._card("neq", v, 0, context)
+
+    def between_cardinality(self, a, b, context=None):
+        lo, hi = max(a, 0), min(b, self.max_value)
+        if lo > self.max_value or hi < 0 or lo > hi:
+            return 0
+        return self._card("between", lo, hi, context)

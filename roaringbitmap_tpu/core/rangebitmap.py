@@ -1,0 +1,282 @@
+"""RangeBitmap — succinct range index over appended values (SURVEY §2.1).
+
+Capability parity with the reference's `RangeBitmap`
+(RoaringBitmap/src/main/java/org/roaringbitmap/RangeBitmap.java): an
+append-only index mapping dense row ids 0..n-1 to unsigned 64-bit values,
+queryable with lt/lte/gt/gte/eq/neq/between — each returning a RoaringBitmap
+of row ids — plus *Cardinality forms and `context` (row-filter) overloads
+(:111-414), an `Appender` builder (:1378+) and a memory-mappable serialized
+form tagged with cookie 0xF00D (:25, `map(ByteBuffer)` :65).
+
+Representation: base-2 bit slices over row ids, the same encoding family the
+reference uses, held as ordinary RoaringBitmaps.  Queries run the O'Neil
+slice scan (shared with the bsi module) on host, or fused on device via
+``DeviceRangeBitmap`` (bsi.device) where thresholds are passed as bit arrays
+so full u64 ranges stay exact.
+
+The byte layout differs from the reference's (theirs interleaves its
+internal container stream; it is a Java-implementation detail, not part of
+RoaringFormatSpec).  Ours keeps the 0xF00D cookie and the mappable property:
+slice payloads are standard 32-bit RoaringFormatSpec streams located by an
+offset table, so `map()` only parses headers and wraps payload slices
+zero-copy (SerializedView).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from . import containers as C
+from .bitmap import RoaringBitmap, and_ as rb_and, andnot as rb_andnot, \
+    or_ as rb_or
+from ..format import spec
+
+COOKIE = 0xF00D  # RangeBitmap.java:25
+
+
+def _range_mask_bits(max_value: int) -> int:
+    """Slice count for a max value (rangeMask :-> Long.bitCount analog)."""
+    if max_value < 0:
+        raise ValueError("maxValue must be unsigned (0 <= v < 2^64)")
+    return max(max_value.bit_length(), 1)
+
+
+class RangeBitmap:
+    """Immutable range index; build with RangeBitmap.appender()."""
+
+    def __init__(self, slices: list[RoaringBitmap], row_count: int,
+                 max_value: int):
+        self._slices = slices
+        self._rows = row_count
+        self._max = max_value
+
+    # ----------------------------------------------------------------- build
+    @staticmethod
+    def appender(max_value: int) -> "Appender":
+        """RangeBitmap.appender (:39-52)."""
+        return Appender(max_value)
+
+    @property
+    def row_count(self) -> int:
+        return self._rows
+
+    @property
+    def max_value(self) -> int:
+        return self._max
+
+    def _all_rows(self) -> RoaringBitmap:
+        return RoaringBitmap.from_range(0, self._rows)
+
+    # --------------------------------------------------------------- queries
+    def _scan(self, threshold: int) -> tuple[RoaringBitmap, RoaringBitmap,
+                                             RoaringBitmap]:
+        """O'Neil descending slice scan -> (gt, lt, eq) over all rows."""
+        gt = RoaringBitmap()
+        lt = RoaringBitmap()
+        eq = self._all_rows()
+        for i in range(len(self._slices) - 1, -1, -1):
+            if (threshold >> i) & 1:
+                lt = rb_or(lt, rb_andnot(eq, self._slices[i]))
+                eq = rb_and(eq, self._slices[i])
+            else:
+                gt = rb_or(gt, rb_and(eq, self._slices[i]))
+                eq = rb_andnot(eq, self._slices[i])
+        return gt, lt, eq
+
+    def _apply_context(self, rb: RoaringBitmap,
+                       context: RoaringBitmap | None) -> RoaringBitmap:
+        return rb if context is None else rb_and(rb, context)
+
+    def lte(self, threshold: int,
+            context: RoaringBitmap | None = None) -> RoaringBitmap:
+        """Rows with value <= threshold (lte :162-174)."""
+        if threshold < 0:
+            return RoaringBitmap()
+        if threshold >= (1 << len(self._slices)) - 1 or threshold >= self._max:
+            return self._apply_context(self._all_rows(), context)
+        gt, lt, eq = self._scan(threshold)
+        return self._apply_context(rb_or(lt, eq), context)
+
+    def lt(self, threshold: int,
+           context: RoaringBitmap | None = None) -> RoaringBitmap:
+        if threshold <= 0:
+            return RoaringBitmap()
+        return self.lte(threshold - 1, context)
+
+    def gte(self, threshold: int,
+            context: RoaringBitmap | None = None) -> RoaringBitmap:
+        if threshold <= 0:
+            return self._apply_context(self._all_rows(), context)
+        if threshold > self._max:
+            return RoaringBitmap()
+        gt, lt, eq = self._scan(threshold)
+        return self._apply_context(rb_or(gt, eq), context)
+
+    def gt(self, threshold: int,
+           context: RoaringBitmap | None = None) -> RoaringBitmap:
+        return self.gte(threshold + 1, context)
+
+    def eq(self, value: int,
+           context: RoaringBitmap | None = None) -> RoaringBitmap:
+        if value < 0 or value > self._max:
+            return RoaringBitmap()
+        gt, lt, eq = self._scan(value)
+        return self._apply_context(eq, context)
+
+    def neq(self, value: int,
+            context: RoaringBitmap | None = None) -> RoaringBitmap:
+        base = self._apply_context(self._all_rows(), context)
+        return rb_andnot(base, self.eq(value))
+
+    def between(self, min_value: int, max_value: int,
+                context: RoaringBitmap | None = None) -> RoaringBitmap:
+        """Rows with min <= value <= max (between :111-126)."""
+        return rb_and(self.gte(min_value, context), self.lte(max_value, context))
+
+    # cardinality forms (:128-414)
+    def lte_cardinality(self, threshold: int,
+                        context: RoaringBitmap | None = None) -> int:
+        return self.lte(threshold, context).cardinality
+
+    def lt_cardinality(self, threshold: int,
+                       context: RoaringBitmap | None = None) -> int:
+        return self.lt(threshold, context).cardinality
+
+    def gte_cardinality(self, threshold: int,
+                        context: RoaringBitmap | None = None) -> int:
+        return self.gte(threshold, context).cardinality
+
+    def gt_cardinality(self, threshold: int,
+                       context: RoaringBitmap | None = None) -> int:
+        return self.gt(threshold, context).cardinality
+
+    def eq_cardinality(self, value: int,
+                       context: RoaringBitmap | None = None) -> int:
+        return self.eq(value, context).cardinality
+
+    def neq_cardinality(self, value: int,
+                        context: RoaringBitmap | None = None) -> int:
+        return self.neq(value, context).cardinality
+
+    def between_cardinality(self, min_value: int, max_value: int,
+                            context: RoaringBitmap | None = None) -> int:
+        return self.between(min_value, max_value, context).cardinality
+
+    # ------------------------------------------------------------------- I/O
+    def serialize(self) -> bytes:
+        """Mappable layout: header (cookie 0xF00D, slice count, row count,
+        max value), u32-LE slice-payload offset table, then each slice as a
+        standard 32-bit RoaringFormatSpec stream."""
+        payloads = [s.serialize() for s in self._slices]
+        n = len(payloads)
+        out = bytearray(struct.pack("<IHHQQ", COOKIE, 1, n, self._rows,
+                                    self._max))
+        base = len(out) + 4 * n
+        off = 0
+        for p in payloads:
+            out += struct.pack("<I", base + off)
+            off += len(p)
+        for p in payloads:
+            out += p
+        return bytes(out)
+
+    def serialized_size_in_bytes(self) -> int:
+        return (24 + 4 * len(self._slices)
+                + sum(s.serialized_size_in_bytes() for s in self._slices))
+
+    @staticmethod
+    def map(buf: bytes | memoryview) -> "RangeBitmap":
+        """Zero-copy attach to a serialized RangeBitmap (map :65-85)."""
+        mv = memoryview(buf)
+        if len(mv) < 24:
+            raise spec.InvalidRoaringFormat("truncated RangeBitmap header")
+        cookie, version, n, rows, max_value = struct.unpack_from("<IHHQQ", mv, 0)
+        if cookie != COOKIE:
+            raise spec.InvalidRoaringFormat(
+                f"invalid RangeBitmap cookie {cookie:#x}")
+        if version != 1:
+            raise spec.InvalidRoaringFormat(f"unknown RangeBitmap version {version}")
+        if len(mv) < 24 + 4 * n:
+            raise spec.InvalidRoaringFormat("truncated RangeBitmap offsets")
+        offsets = np.frombuffer(mv[24:24 + 4 * n], dtype="<u4")
+        slices = []
+        for i in range(n):
+            view = spec.SerializedView(mv[int(offsets[i]):])
+            conts = [view.container(j) for j in range(view.size)]
+            slices.append(RoaringBitmap(view.keys.copy(), conts))
+        return RangeBitmap(slices, rows, max_value)
+
+    # ------------------------------------------------------------- internals
+    @property
+    def slices(self) -> list[RoaringBitmap]:
+        return self._slices
+
+
+class Appender:
+    """Append-only builder (RangeBitmap.Appender :1378+): add() assigns the
+    next dense row id; build() freezes into a queryable RangeBitmap.
+
+    Adds are buffered and the slice bitmaps are built vectorized per flush
+    (one mask + bitmap build per bit), replacing the reference's per-value
+    container update loop (:1511-1553).
+    """
+
+    def __init__(self, max_value: int):
+        self.max_value = max_value
+        self.depth = _range_mask_bits(max_value)
+        self._pending: list[np.ndarray] = []
+        self._slices = [RoaringBitmap() for _ in range(self.depth)]
+        self._rows = 0
+
+    def add(self, value: int) -> None:
+        """add (:1511): append one value at the next row id."""
+        if value < 0 or value > self.max_value:
+            raise ValueError(f"value {value} out of range [0, {self.max_value}]")
+        self.add_many(np.array([value], dtype=np.uint64))
+
+    def add_many(self, values: np.ndarray) -> None:
+        """Bulk append; row ids are assigned in order."""
+        v = np.asarray(values, dtype=np.uint64)
+        if v.size == 0:
+            return
+        if v.size and int(v.max()) > self.max_value:
+            raise ValueError("value exceeds appender maxValue")
+        self._pending.append(v)
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        vals = np.concatenate(self._pending)
+        rows = (self._rows + np.arange(vals.size)).astype(np.uint32)
+        if self._rows + vals.size > 0xFFFFFFFF:
+            raise ValueError("RangeBitmap supports at most 2^32-1 rows")
+        for i in range(self.depth):
+            hit = rows[(vals >> np.uint64(i)) & np.uint64(1) == 1]
+            if hit.size:
+                self._slices[i].ior(RoaringBitmap.from_values(hit))
+        self._rows += vals.size
+        self._pending = []
+
+    def build(self) -> RangeBitmap:
+        """build (:1415-1440)."""
+        self._flush()
+        slices = [s.clone() for s in self._slices]
+        return RangeBitmap(slices, self._rows, self.max_value)
+
+    def clear(self) -> None:
+        """clear (:1443): reuse the appender."""
+        self._pending = []
+        self._slices = [RoaringBitmap() for _ in range(self.depth)]
+        self._rows = 0
+
+    def serialized_size_in_bytes(self) -> int:
+        self._flush()
+        return (24 + 4 * len(self._slices)
+                + sum(s.serialized_size_in_bytes() for s in self._slices))
+
+    def serialize(self) -> bytes:
+        """Serialize without materializing a RangeBitmap first (:1483)."""
+        self._flush()
+        return RangeBitmap(self._slices, self._rows, self.max_value).serialize()

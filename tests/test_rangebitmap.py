@@ -1,0 +1,197 @@
+"""RangeBitmap tests — query parity against a NumPy oracle, appender
+semantics, 0xF00D mappable serialization, and host/device bit-exactness
+(mirrors RangeBitmapTest.java's threshold sweeps)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.bsi.device import DeviceRangeBitmap
+from roaringbitmap_tpu.core.rangebitmap import Appender, RangeBitmap
+from roaringbitmap_tpu.format.spec import InvalidRoaringFormat
+
+
+@pytest.fixture(scope="module")
+def values():
+    rng = np.random.default_rng(0xF00D)
+    return rng.integers(0, 1 << 40, 30000, dtype=np.uint64)
+
+
+@pytest.fixture(scope="module")
+def rbm(values):
+    app = RangeBitmap.appender(int(values.max()))
+    app.add_many(values)
+    return app.build()
+
+
+def _rows(mask):
+    return np.flatnonzero(mask).astype(np.uint32)
+
+
+class TestHostQueries:
+    @pytest.mark.parametrize("q", [0.0, 0.1, 0.5, 0.9, 1.0])
+    def test_threshold_sweep(self, values, rbm, q):
+        t = int(np.quantile(values.astype(np.float64), q))
+        assert np.array_equal(rbm.lte(t).to_array(), _rows(values <= t))
+        assert np.array_equal(rbm.lt(t).to_array(), _rows(values < t))
+        assert np.array_equal(rbm.gte(t).to_array(), _rows(values >= t))
+        assert np.array_equal(rbm.gt(t).to_array(), _rows(values > t))
+
+    def test_eq_neq(self, values, rbm):
+        v = int(values[123])
+        assert np.array_equal(rbm.eq(v).to_array(), _rows(values == v))
+        assert np.array_equal(rbm.neq(v).to_array(), _rows(values != v))
+        assert rbm.eq(int(values.max()) + 5).is_empty()
+        assert rbm.neq(int(values.max()) + 5).cardinality == values.size
+
+    def test_between(self, values, rbm):
+        a = int(np.quantile(values.astype(np.float64), 0.3))
+        b = int(np.quantile(values.astype(np.float64), 0.7))
+        assert np.array_equal(rbm.between(a, b).to_array(),
+                              _rows((values >= a) & (values <= b)))
+        assert rbm.between_cardinality(a, b) == int(((values >= a) & (values <= b)).sum())
+
+    def test_extremes(self, values, rbm):
+        assert rbm.lte(int(values.max())).cardinality == values.size
+        assert rbm.gte(0).cardinality == values.size
+        assert rbm.lt(0).is_empty()
+        assert rbm.gt(int(values.max())).is_empty()
+        assert rbm.lte(2**63).cardinality == values.size  # above max
+
+    def test_context(self, values, rbm):
+        ctx = RoaringBitmap.from_values(
+            np.arange(0, values.size, 7, dtype=np.uint32))
+        t = int(np.median(values.astype(np.float64)))
+        oracle = np.intersect1d(_rows(values <= t), ctx.to_array())
+        assert np.array_equal(rbm.lte(t, ctx).to_array(), oracle)
+        assert rbm.lte_cardinality(t, ctx) == oracle.size
+
+    def test_context_out_of_range_rows(self, values, rbm):
+        ctx = RoaringBitmap.from_values(
+            np.array([0, 1, values.size + 100], dtype=np.uint32))
+        got = rbm.neq(int(values[0]), ctx)
+        assert values.size + 100 not in got
+
+    def test_cardinality_forms(self, values, rbm):
+        t = int(np.median(values.astype(np.float64)))
+        assert rbm.lte_cardinality(t) == int((values <= t).sum())
+        assert rbm.lt_cardinality(t) == int((values < t).sum())
+        assert rbm.gte_cardinality(t) == int((values >= t).sum())
+        assert rbm.gt_cardinality(t) == int((values > t).sum())
+
+
+class TestAppender:
+    def test_incremental_add(self):
+        app = RangeBitmap.appender(1000)
+        for v in (5, 900, 0, 1000):
+            app.add(v)
+        rb = app.build()
+        assert rb.row_count == 4
+        assert np.array_equal(rb.eq(900).to_array(), [1])
+        assert np.array_equal(rb.lte(5).to_array(), [0, 2])
+
+    def test_value_above_max_rejected(self):
+        app = RangeBitmap.appender(100)
+        with pytest.raises(ValueError):
+            app.add(101)
+        with pytest.raises(ValueError):
+            app.add_many(np.array([5, 200], dtype=np.uint64))
+
+    def test_clear_reuse(self):
+        app = RangeBitmap.appender(50)
+        app.add(10)
+        app.clear()
+        app.add(20)
+        rb = app.build()
+        assert rb.row_count == 1
+        assert rb.eq(20).cardinality == 1
+        assert rb.eq(10).is_empty()
+
+    def test_build_twice_independent(self):
+        app = RangeBitmap.appender(50)
+        app.add(1)
+        r1 = app.build()
+        app.add(2)
+        r2 = app.build()
+        assert r1.row_count == 1 and r2.row_count == 2
+
+    def test_zero_max_value(self):
+        app = RangeBitmap.appender(0)
+        app.add(0)
+        rb = app.build()
+        assert rb.lte(0).cardinality == 1
+        assert rb.gt(0).is_empty()
+
+
+class TestSerialization:
+    def test_map_roundtrip(self, values, rbm):
+        data = rbm.serialize()
+        assert len(data) == rbm.serialized_size_in_bytes()
+        back = RangeBitmap.map(data)
+        assert back.row_count == rbm.row_count
+        t = int(np.median(values.astype(np.float64)))
+        assert back.lte(t) == rbm.lte(t)
+        assert back.between(t // 2, t) == rbm.between(t // 2, t)
+
+    def test_appender_serialize(self):
+        app = RangeBitmap.appender(99)
+        app.add_many(np.array([1, 50, 99], dtype=np.uint64))
+        data = app.serialize()
+        assert len(data) == app.serialized_size_in_bytes()
+        rb = RangeBitmap.map(data)
+        assert rb.row_count == 3
+
+    def test_bad_cookie_rejected(self, rbm):
+        data = bytearray(rbm.serialize())
+        data[0] ^= 0xFF
+        with pytest.raises(InvalidRoaringFormat):
+            RangeBitmap.map(bytes(data))
+
+    def test_truncated_rejected(self, rbm):
+        with pytest.raises(InvalidRoaringFormat):
+            RangeBitmap.map(rbm.serialize()[:10])
+
+
+class TestDeviceRangeBitmap:
+    @pytest.fixture(scope="class")
+    def dev(self, rbm):
+        return DeviceRangeBitmap(rbm)
+
+    @pytest.mark.parametrize("q", [0.0, 0.25, 0.5, 0.75, 1.0])
+    def test_device_matches_host(self, values, rbm, dev, q):
+        t = int(np.quantile(values.astype(np.float64), q))
+        assert dev.lte(t) == rbm.lte(t)
+        assert dev.lt(t) == rbm.lt(t)
+        assert dev.gte(t) == rbm.gte(t)
+        assert dev.gt(t) == rbm.gt(t)
+
+    def test_device_eq_neq_between(self, values, rbm, dev):
+        v = int(values[55])
+        assert dev.eq(v) == rbm.eq(v)
+        assert dev.neq(v) == rbm.neq(v)
+        a = int(np.quantile(values.astype(np.float64), 0.4))
+        b = int(np.quantile(values.astype(np.float64), 0.6))
+        assert dev.between(a, b) == rbm.between(a, b)
+
+    def test_device_context(self, values, rbm, dev):
+        ctx = RoaringBitmap.from_values(
+            np.arange(0, values.size, 11, dtype=np.uint32))
+        t = int(np.median(values.astype(np.float64)))
+        assert dev.lte(t, ctx) == rbm.lte(t, ctx)
+        assert dev.neq(int(values[3]), ctx) == rbm.neq(int(values[3]), ctx)
+        assert dev.between_cardinality(t // 2, t, ctx) == \
+            rbm.between_cardinality(t // 2, t, ctx)
+
+    def test_device_context_out_of_range(self, values, rbm, dev):
+        ctx = RoaringBitmap.from_values(
+            np.array([0, 1, values.size + 100], dtype=np.uint32))
+        v = int(values[0])
+        assert dev.neq(v, ctx) == rbm.neq(v, ctx)
+
+    def test_device_guards(self, values, rbm, dev):
+        assert dev.lte(2**63) == rbm.lte(2**63)
+        assert dev.gte(0) == rbm.gte(0)
+        assert dev.lt(0).is_empty()
+        assert dev.gt(int(values.max())).is_empty()
+        assert dev.eq(int(values.max()) + 5).is_empty()
+        assert dev.neq(int(values.max()) + 5).cardinality == values.size

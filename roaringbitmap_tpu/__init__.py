@@ -30,11 +30,17 @@ from .core.bitmap import (
 )
 from .core import containers
 from .core.bitmap64 import Roaring64Bitmap, Roaring64NavigableMap
+from .core.bitset import RoaringBitSet
+from .core.fastrank import FastRankRoaringBitmap
+from .core.rangebitmap import RangeBitmap
+from .core.writer import RoaringBitmapWriter
 from .format import spec
 from .format.spec import InvalidRoaringFormat
 
 __all__ = [
     "RoaringBitmap", "Roaring64Bitmap", "Roaring64NavigableMap",
+    "RangeBitmap", "FastRankRoaringBitmap", "RoaringBitSet",
+    "RoaringBitmapWriter",
     "and_", "or_", "xor", "andnot", "or_not", "flip",
     "and_cardinality", "or_cardinality", "xor_cardinality", "andnot_cardinality",
     "containers", "spec", "InvalidRoaringFormat",

@@ -1,0 +1,20 @@
+"""Buffer tier — the org.roaringbitmap.buffer package analog (SURVEY §2.2).
+
+The reference proves the whole algebra runs against flat, offset-addressed,
+little-endian buffers instead of object graphs (buffer/ImmutableRoaringBitmap
+et al.).  Here that role is split in two:
+
+- ``ImmutableRoaringBitmap``: a read-only bitmap attached to serialized bytes
+  (including a real mmap) — metadata parsed up front, container payloads
+  sliced zero-copy on demand.
+- The HBM-resident device sets (parallel.DeviceBitmapSet, bsi.DeviceBSI,
+  bsi.DeviceRangeBitmap) — the TPU equivalent of staying memory-mapped.
+
+``BufferFastAggregation``-style wide ops work directly on immutable inputs:
+the aggregation entry points in roaringbitmap_tpu.parallel accept any object
+with (keys, containers), which ImmutableRoaringBitmap provides lazily.
+"""
+
+from .immutable import ImmutableRoaringBitmap, MutableRoaringBitmap
+
+__all__ = ["ImmutableRoaringBitmap", "MutableRoaringBitmap"]

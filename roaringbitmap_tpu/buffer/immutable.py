@@ -1,0 +1,220 @@
+"""ImmutableRoaringBitmap — read-only bitmap over serialized bytes.
+
+The buffer/ImmutableRoaringBitmap.java analog: constructed over any
+bytes-like buffer holding the portable RoaringFormatSpec stream (a bytes
+object, a memoryview slice of a larger frame, or an mmap'd file).  The
+descriptive header is decoded eagerly into NumPy arrays
+(ImmutableRoaringArray ctor :43-53); container payloads remain in the buffer
+and are wrapped on demand (getContainerAtIndex :166-194), cached after first
+touch.  All binary ops return in-RAM RoaringBitmaps, exactly as the
+reference's ops on immutable inputs produce MutableRoaringBitmap results.
+
+``MutableRoaringBitmap`` completes the package mirror: the heap-mutable
+class (buffer/MutableRoaringBitmap.java) is our core RoaringBitmap, extended
+with the constant-time-upcast pairing (toImmutableRoaringBitmap /
+toMutableRoaringBitmap, README.md:203-233).
+"""
+
+from __future__ import annotations
+
+import mmap as mmap_mod
+from typing import Iterator
+
+import numpy as np
+
+from ..core import containers as C
+from ..core.bitmap import (
+    RoaringBitmap,
+    and_ as rb_and,
+    and_cardinality,
+    andnot as rb_andnot,
+    or_ as rb_or,
+    xor as rb_xor,
+)
+from ..format import spec
+
+
+class ImmutableRoaringBitmap:
+    """Read-only view over a serialized 32-bit roaring bitmap."""
+
+    RESULT_CLS = RoaringBitmap  # binary ops produce in-RAM results
+
+    def __init__(self, buf: bytes | memoryview):
+        self._view = spec.SerializedView(buf)
+        self._cache: dict[int, C.Container] = {}
+        self._all: list[C.Container] | None = None
+
+    # ----------------------------------------------------------- constructors
+    @staticmethod
+    def mapped(path: str) -> "ImmutableRoaringBitmap":
+        """Memory-map a serialized bitmap file (the MemoryMappingExample /
+        TestMemoryMapping usage: payload stays on disk)."""
+        with open(path, "rb") as f:
+            mm = mmap_mod.mmap(f.fileno(), 0, access=mmap_mod.ACCESS_READ)
+        return ImmutableRoaringBitmap(memoryview(mm))
+
+    @staticmethod
+    def from_bitmap(rb: RoaringBitmap) -> "ImmutableRoaringBitmap":
+        return ImmutableRoaringBitmap(rb.serialize())
+
+    # ------------------------------------------------------------- internals
+    @property
+    def keys(self) -> np.ndarray:
+        return self._view.keys
+
+    @property
+    def containers(self) -> list[C.Container]:
+        """Materialized container list — the seam the device packers and
+        pairwise algebra consume.  Built once and cached; the per-key loops
+        in core.bitmap index this property repeatedly."""
+        if self._all is None:
+            self._all = [self._container(i) for i in range(self._view.size)]
+        return self._all
+
+    def _container(self, i: int) -> C.Container:
+        c = self._cache.get(i)
+        if c is None:
+            c = self._view.container(i)
+            self._cache[i] = c
+        return c
+
+    def _index(self, hb: int) -> int:
+        keys = self._view.keys
+        i = int(np.searchsorted(keys, np.uint16(hb)))
+        if i < keys.size and keys[i] == hb:
+            return i
+        return -i - 1
+
+    # -------------------------------------------------------------- accessors
+    @property
+    def cardinality(self) -> int:
+        """From the descriptive header alone — no payload touched."""
+        return int(self._view.cardinalities.sum())
+
+    def __len__(self) -> int:
+        return self.cardinality
+
+    def is_empty(self) -> bool:
+        return self._view.size == 0
+
+    def __bool__(self) -> bool:
+        return not self.is_empty()
+
+    def contains(self, x: int) -> bool:
+        i = self._index(x >> 16)
+        return i >= 0 and self._container(i).contains(x & 0xFFFF)
+
+    def __contains__(self, x: int) -> bool:
+        return self.contains(x)
+
+    def rank(self, x: int) -> int:
+        hb = x >> 16
+        keys = self._view.keys
+        i = int(np.searchsorted(keys, np.uint16(hb), side="left"))
+        total = int(self._view.cardinalities[:i].sum())
+        if i < keys.size and keys[i] == hb:
+            total += self._container(i).rank(x & 0xFFFF)
+        return total
+
+    def select(self, j: int) -> int:
+        cum = np.cumsum(self._view.cardinalities)
+        i = int(np.searchsorted(cum, j, side="right"))
+        if i >= self._view.size:
+            raise ValueError("select: rank out of bounds")
+        prev = int(cum[i - 1]) if i else 0
+        return (int(self._view.keys[i]) << 16) | \
+            self._container(i).select(j - prev)
+
+    def first(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        return (int(self._view.keys[0]) << 16) | self._container(0).first()
+
+    def last(self) -> int:
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        n = self._view.size - 1
+        return (int(self._view.keys[n]) << 16) | self._container(n).last()
+
+    def has_run_compression(self) -> bool:
+        return bool(self._view.is_run.any())
+
+    # ------------------------------------------------------------- iteration
+    def to_array(self) -> np.ndarray:
+        return self.to_bitmap().to_array()
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.to_bitmap())
+
+    def batch_iterator(self, batch_size: int = 65536):
+        return self.to_bitmap().batch_iterator(batch_size)
+
+    # ------------------------------------------------------------ conversion
+    def to_bitmap(self) -> RoaringBitmap:
+        """toMutableRoaringBitmap: an in-RAM heap copy."""
+        return RoaringBitmap(self._view.keys.copy(), self.containers)
+
+    def to_mutable(self) -> "MutableRoaringBitmap":
+        return MutableRoaringBitmap(self._view.keys.copy(), self.containers)
+
+    # ----------------------------------------------------------- set algebra
+    # In-RAM results, like the reference's static ops on immutable inputs.
+    def __and__(self, o) -> RoaringBitmap:
+        return rb_and(self, o)
+
+    def __or__(self, o) -> RoaringBitmap:
+        return rb_or(self, o)
+
+    def __xor__(self, o) -> RoaringBitmap:
+        return rb_xor(self, o)
+
+    def __sub__(self, o) -> RoaringBitmap:
+        return rb_andnot(self, o)
+
+    def and_cardinality(self, o) -> int:
+        return and_cardinality(self, o)
+
+    def intersects(self, o) -> bool:
+        return RoaringBitmap.intersects(self, o)
+
+    def is_subset_of(self, o) -> bool:
+        return RoaringBitmap.is_subset_of(self, o)
+
+    # ---------------------------------------------------------- equality/repr
+    def __eq__(self, o: object) -> bool:
+        if isinstance(o, (ImmutableRoaringBitmap, RoaringBitmap)):
+            return self.to_bitmap() == (
+                o.to_bitmap() if isinstance(o, ImmutableRoaringBitmap) else o)
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(self.to_bitmap())
+
+    def __repr__(self) -> str:
+        return (f"ImmutableRoaringBitmap(card={self.cardinality}, "
+                f"keys={self._view.size})")
+
+    # ------------------------------------------------------------------- I/O
+    def serialize(self) -> bytes:
+        """The backing bytes, verbatim (already in portable format)."""
+        return bytes(self._view.buf[:self._view.serialized_end()])
+
+    def serialized_size_in_bytes(self) -> int:
+        return self._view.serialized_end()
+
+    def get_size_in_bytes(self) -> int:
+        return self.serialized_size_in_bytes()
+
+
+class MutableRoaringBitmap(RoaringBitmap):
+    """Heap-mutable twin (buffer/MutableRoaringBitmap.java): our core
+    RoaringBitmap plus the immutable-pairing conversions."""
+
+    def to_immutable(self) -> ImmutableRoaringBitmap:
+        """toImmutableRoaringBitmap (constant-time upcast in the reference;
+        here one serialization pass)."""
+        return ImmutableRoaringBitmap(self.serialize())
+
+    @staticmethod
+    def from_immutable(im: ImmutableRoaringBitmap) -> "MutableRoaringBitmap":
+        return im.to_mutable()

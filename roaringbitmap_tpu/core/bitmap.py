@@ -503,6 +503,13 @@ def _chunk_ranges(start: int, stop: int):
 # skeleton), vectorized over the key axis with intersect1d/union1d.
 # ---------------------------------------------------------------------------
 
+
+def _result_cls(a):
+    """Class used for op results: type(a), unless the class routes results
+    elsewhere (ImmutableRoaringBitmap ops produce in-RAM RoaringBitmaps via
+    RESULT_CLS, like the reference's immutable ops returning mutable)."""
+    return getattr(type(a), "RESULT_CLS", None) or type(a)
+
 def and_(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
     common, ia, ib = np.intersect1d(a.keys, b.keys, assume_unique=True,
                                     return_indices=True)
@@ -512,7 +519,7 @@ def and_(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
         if c.cardinality:
             keys.append(k)
             conts.append(c)
-    return type(a)(np.array(keys, dtype=a.keys.dtype), conts)
+    return _result_cls(a)(np.array(keys, dtype=a.keys.dtype), conts)
 
 
 def or_(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
@@ -532,7 +539,7 @@ def andnot(a: RoaringBitmap, b: RoaringBitmap) -> RoaringBitmap:
         if c.cardinality:
             keys.append(k)
             conts.append(c)
-    return type(a)(np.array(keys, dtype=a.keys.dtype), conts)
+    return _result_cls(a)(np.array(keys, dtype=a.keys.dtype), conts)
 
 
 def or_not(a: RoaringBitmap, b: RoaringBitmap, range_end: int) -> RoaringBitmap:
@@ -565,7 +572,7 @@ def _merge_union(a: RoaringBitmap, b: RoaringBitmap, op, drop_empty: bool = Fals
             continue
         keys.append(k)
         conts.append(c)
-    return type(a)(np.array(keys, dtype=a.keys.dtype), conts)
+    return _result_cls(a)(np.array(keys, dtype=a.keys.dtype), conts)
 
 
 def and_cardinality(a: RoaringBitmap, b: RoaringBitmap) -> int:

@@ -1,0 +1,77 @@
+"""Iterator flyweights (SURVEY §2.1 Iterators row).
+
+The reference's per-value iterator family: PeekableIntIterator (peekNext +
+advanceIfNeeded), reverse iterators, rank iterators
+(PeekableIntRankIterator), and the batch iterators already provided on the
+bitmap classes (RoaringBatchIterator.java:19-28).  These are host-side
+conveniences; bulk paths should prefer to_array()/batch_iterator or the
+device tier.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class PeekableIntIterator:
+    """Ascending iterator with peek_next and advance_if_needed
+    (PeekableIntIterator.java; flyweight IntIteratorFlyweight)."""
+
+    def __init__(self, rb):
+        self._arr = rb.to_array()
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < self._arr.size
+
+    def next(self) -> int:
+        v = int(self._arr[self._pos])
+        self._pos += 1
+        return v
+
+    def peek_next(self) -> int:
+        if not self.has_next():
+            raise StopIteration
+        return int(self._arr[self._pos])
+
+    def advance_if_needed(self, min_val: int) -> None:
+        """Skip values < min_val in O(log n) (advanceIfNeeded)."""
+        self._pos += int(np.searchsorted(self._arr[self._pos:], min_val))
+
+    def clone(self) -> "PeekableIntIterator":
+        out = PeekableIntIterator.__new__(PeekableIntIterator)
+        out._arr, out._pos = self._arr, self._pos
+        return out
+
+    def __iter__(self):
+        while self.has_next():
+            yield self.next()
+
+
+class PeekableIntRankIterator(PeekableIntIterator):
+    """PeekableIntRankIterator: also reports the rank of the next value."""
+
+    def peek_next_rank(self) -> int:
+        if not self.has_next():
+            raise StopIteration
+        return self._pos + 1  # rank is 1-based in the reference
+
+
+class ReverseIntIterator:
+    """Descending iterator (getReverseIntIterator)."""
+
+    def __init__(self, rb):
+        self._arr = rb.to_array()
+        self._pos = self._arr.size - 1
+
+    def has_next(self) -> bool:
+        return self._pos >= 0
+
+    def next(self) -> int:
+        v = int(self._arr[self._pos])
+        self._pos -= 1
+        return v
+
+    def __iter__(self):
+        while self.has_next():
+            yield self.next()

@@ -1,0 +1,13 @@
+"""insights package analog (SURVEY §2.1): container-mix analysis and writer
+recommendation (insights/BitmapAnalyser.java:15-35, BitmapStatistics.java,
+NaiveWriterRecommender.java:7-14)."""
+
+from .analysis import (
+    BitmapAnalyser,
+    BitmapStatistics,
+    NaiveWriterRecommender,
+    analyse,
+)
+
+__all__ = ["BitmapAnalyser", "BitmapStatistics", "NaiveWriterRecommender",
+           "analyse"]

@@ -1,0 +1,132 @@
+"""Bitmap introspection — BitmapAnalyser / BitmapStatistics /
+NaiveWriterRecommender.
+
+BitmapAnalyser.analyse walks containers counting the three types and their
+cardinalities (insights/BitmapAnalyser.java:15-35); BitmapStatistics holds
+the tallies and derived ratios; NaiveWriterRecommender turns the stats into
+RoaringBitmapWriter configuration advice (NaiveWriterRecommender.java:7-14 —
+expert rules on container mix).  Extended here with HBM accounting for the
+device tier (the JOL-memory-test analog, SURVEY §5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core import containers as C
+from ..core.bitmap import RoaringBitmap
+
+
+@dataclass
+class ArrayContainersStats:
+    """BitmapStatistics.ArrayContainersStats: count + total cardinality."""
+
+    containers_count: int = 0
+    cardinality_sum: int = 0
+
+    def average_cardinality(self) -> int:
+        if self.containers_count == 0:
+            return 2 ** 63 - 1  # Long.MAX_VALUE sentinel, as the reference
+        return self.cardinality_sum // self.containers_count
+
+
+@dataclass
+class BitmapStatistics:
+    """Container-mix tallies (insights/BitmapStatistics.java)."""
+
+    array_stats: ArrayContainersStats = field(default_factory=ArrayContainersStats)
+    bitmap_containers_count: int = 0
+    run_containers_count: int = 0
+    bitmaps_count: int = 0
+
+    def container_count(self) -> int:
+        return (self.array_stats.containers_count
+                + self.bitmap_containers_count + self.run_containers_count)
+
+    def container_fraction(self, count: int) -> float:
+        if self.container_count() == 0:
+            return float("nan")
+        return count / self.container_count()
+
+    # ------------------------------------------------------------- accounting
+    def merge(self, o: "BitmapStatistics") -> "BitmapStatistics":
+        return BitmapStatistics(
+            ArrayContainersStats(
+                self.array_stats.containers_count + o.array_stats.containers_count,
+                self.array_stats.cardinality_sum + o.array_stats.cardinality_sum),
+            self.bitmap_containers_count + o.bitmap_containers_count,
+            self.run_containers_count + o.run_containers_count,
+            self.bitmaps_count + o.bitmaps_count)
+
+
+class BitmapAnalyser:
+    """analyse() over one or many bitmaps (BitmapAnalyser.java:15-35)."""
+
+    @staticmethod
+    def analyse(rb: RoaringBitmap) -> BitmapStatistics:
+        stats = BitmapStatistics(bitmaps_count=1)
+        for c in rb.containers:
+            if isinstance(c, C.RunContainer):
+                stats.run_containers_count += 1
+            elif isinstance(c, C.BitmapContainer):
+                stats.bitmap_containers_count += 1
+            else:
+                stats.array_stats.containers_count += 1
+                stats.array_stats.cardinality_sum += c.cardinality
+        return stats
+
+    @staticmethod
+    def analyse_all(bitmaps) -> BitmapStatistics:
+        out = BitmapStatistics()
+        for rb in bitmaps:
+            out = out.merge(BitmapAnalyser.analyse(rb))
+        return out
+
+
+def analyse(rb: RoaringBitmap) -> BitmapStatistics:
+    return BitmapAnalyser.analyse(rb)
+
+
+class NaiveWriterRecommender:
+    """Expert rules mapping stats -> writer advice
+    (insights/NaiveWriterRecommender.java:7-14)."""
+
+    # thresholds mirror the reference's rules-of-thumb
+    RUN_FRACTION_FOR_RUN_OPT = 0.10
+    BITMAP_FRACTION_FOR_CONSTANT = 0.50
+    SMALL_ARRAY_AVG = 8
+
+    @staticmethod
+    def recommend(stats: BitmapStatistics) -> list[str]:
+        advice: list[str] = []
+        total = stats.container_count()
+        if total == 0:
+            return ["empty input: defaults are fine"]
+        if stats.container_fraction(stats.run_containers_count) \
+                >= NaiveWriterRecommender.RUN_FRACTION_FOR_RUN_OPT:
+            advice.append(".optimise_for_runs()")
+        else:
+            advice.append(".optimise_for_arrays()")
+        if stats.container_fraction(stats.bitmap_containers_count) \
+                >= NaiveWriterRecommender.BITMAP_FRACTION_FOR_CONSTANT:
+            advice.append(".constant_memory()")
+        avg = stats.array_stats.average_cardinality()
+        if avg < 2 ** 62 and avg <= NaiveWriterRecommender.SMALL_ARRAY_AVG:
+            advice.append(f".expected_container_size({max(avg, 1)})")
+        if stats.bitmaps_count > 0 and total // stats.bitmaps_count > 1:
+            advice.append(
+                f".initial_capacity({total // stats.bitmaps_count})")
+        return advice
+
+    @staticmethod
+    def recommend_for(rb: RoaringBitmap) -> list[str]:
+        return NaiveWriterRecommender.recommend(BitmapAnalyser.analyse(rb))
+
+
+def hbm_footprint_bytes(rb: RoaringBitmap) -> int:
+    """Bytes this bitmap occupies once densified into the device packing
+    (u32[K, 2048] rows) — the HBM-accounting analog of the reference's JOL
+    memory tests (SURVEY §5)."""
+    return rb.container_count() * C.WORDS_PER_CONTAINER * 8

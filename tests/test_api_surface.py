@@ -1,0 +1,251 @@
+"""Tests for the API-surface components: writer wizard + appenders,
+insights, FastRankRoaringBitmap, RoaringBitSet/BitSetUtil, iterator
+flyweights (SURVEY §2.1 rows: Builders, insights, FastRank, RoaringBitSet,
+BitSetUtil, Iterators)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import (
+    FastRankRoaringBitmap,
+    RoaringBitmap,
+    RoaringBitmapWriter,
+    RoaringBitSet,
+)
+from roaringbitmap_tpu.core import bitset as bsu
+from roaringbitmap_tpu.core.iterators import (
+    PeekableIntIterator,
+    PeekableIntRankIterator,
+    ReverseIntIterator,
+)
+from roaringbitmap_tpu.insights import (
+    BitmapAnalyser,
+    NaiveWriterRecommender,
+    analyse,
+)
+
+
+class TestWriter:
+    def test_wizard_fluent(self):
+        w = (RoaringBitmapWriter.wizard().optimise_for_runs()
+             .expected_range(0, 1 << 20).initial_capacity(8)
+             .expected_container_size(32).get())
+        assert isinstance(w, RoaringBitmapWriter)
+        assert w.optimize_for_runs
+
+    def test_out_of_order_adds(self, rng):
+        vals = rng.permutation(rng.integers(0, 1 << 22, 20000,
+                                            dtype=np.uint32))
+        w = RoaringBitmapWriter.wizard().get()
+        for v in vals[:100]:
+            w.add(int(v))
+        w.add_many(vals[100:])
+        got = w.get()
+        assert got == RoaringBitmap.from_values(vals)
+
+    def test_constant_memory_sequential(self):
+        w = RoaringBitmapWriter.wizard().constant_memory().get()
+        vals = np.arange(0, 200000, 3, dtype=np.uint32)
+        w.add_many(vals)
+        assert w.get() == RoaringBitmap.from_values(vals)
+
+    def test_constant_memory_key_revisit(self):
+        """Revisiting an earlier chunk after a flush still lands (ior)."""
+        w = RoaringBitmapWriter.wizard().constant_memory().get()
+        for v in (5, 70000, 6):
+            w.add(v)
+        assert sorted(w.get()) == [5, 6, 70000]
+
+    def test_run_optimized_output(self):
+        w = RoaringBitmapWriter.wizard().optimise_for_runs().get()
+        w.add_range(1000, 200000)
+        out = w.get()
+        assert out.has_run_compression()
+        assert out.cardinality == 199000
+
+    def test_default_writer_run_compresses(self):
+        """runCompress defaults on: consecutive values come out run-encoded
+        for the buffered writer, matching the constant-memory path."""
+        w = RoaringBitmapWriter.wizard().get()
+        w.add_many(np.arange(8000, dtype=np.uint32))
+        out = w.get()
+        assert out.has_run_compression()
+        assert out.serialized_size_in_bytes() < 100
+        w2 = RoaringBitmapWriter.wizard().run_compress(False).get()
+        w2.add_many(np.arange(8000, dtype=np.uint32))
+        assert not w2.get().has_run_compression()
+
+    def test_reset(self):
+        w = RoaringBitmapWriter.wizard().get()
+        w.add(1)
+        w.reset()
+        w.add(2)
+        assert sorted(w.get()) == [2]
+
+
+class TestInsights:
+    def test_analyse_counts(self, rng):
+        rb = RoaringBitmap.from_values(
+            rng.integers(0, 1 << 22, 200000, dtype=np.uint32))  # dense-ish
+        rb.ior(RoaringBitmap.from_values(
+            np.array([1 << 28, (1 << 28) + 2], dtype=np.uint32)))  # array
+        rb.add_range(1 << 30, (1 << 30) + 100000)
+        rb.run_optimize()
+        stats = analyse(rb)
+        assert stats.container_count() == rb.container_count()
+        assert stats.run_containers_count >= 1
+        assert stats.array_stats.containers_count >= 1
+        assert stats.bitmaps_count == 1
+        frac = stats.container_fraction(stats.run_containers_count)
+        assert 0 <= frac <= 1
+
+    def test_analyse_all_merge(self, rng):
+        bms = [RoaringBitmap.from_values(
+            rng.integers(0, 1 << 20, 5000, dtype=np.uint32)) for _ in range(4)]
+        stats = BitmapAnalyser.analyse_all(bms)
+        assert stats.bitmaps_count == 4
+        assert stats.container_count() == sum(b.container_count() for b in bms)
+
+    def test_recommender(self):
+        rb = RoaringBitmap.from_range(0, 1 << 20)
+        rb.run_optimize()
+        advice = NaiveWriterRecommender.recommend_for(rb)
+        assert any("optimise_for_runs" in a for a in advice)
+        empty_advice = NaiveWriterRecommender.recommend(analyse(RoaringBitmap()))
+        assert empty_advice
+
+
+class TestFastRank:
+    def test_rank_select_match_base(self, rng):
+        vals = np.unique(rng.integers(0, 1 << 24, 30000, dtype=np.uint32))
+        fr = FastRankRoaringBitmap.from_values(vals)
+        base = RoaringBitmap.from_values(vals)
+        for j in range(0, vals.size, 3001):
+            assert fr.select(j) == base.select(j) == int(vals[j])
+            assert fr.rank(int(vals[j])) == base.rank(int(vals[j]))
+        assert fr.cache_valid
+
+    def test_mutation_invalidates(self):
+        fr = FastRankRoaringBitmap.from_values(
+            np.array([1, 5, 100000], dtype=np.uint32))
+        assert fr.select(2) == 100000
+        assert fr.cache_valid
+        fr.add(50)
+        assert not fr.cache_valid
+        assert fr.select(1) == 5 and fr.select(2) == 50
+        assert fr.rank(100000) == 4
+
+    def test_clear_invalidates(self):
+        fr = FastRankRoaringBitmap.from_values(
+            np.array([1, 2, 3], dtype=np.uint32))
+        assert fr.select(0) == 1
+        fr.clear()
+        with pytest.raises(ValueError):
+            fr.select(0)
+
+    def test_is_roaring_bitmap(self):
+        fr = FastRankRoaringBitmap.from_values(np.array([3], dtype=np.uint32))
+        assert isinstance(fr, RoaringBitmap)
+        assert fr == RoaringBitmap.bitmap_of(3)
+
+
+class TestBitSetUtil:
+    def test_words_roundtrip(self, rng):
+        words = rng.integers(0, 2 ** 63, 2500, dtype=np.uint64)
+        rb = bsu.bitmap_of_words(words)
+        bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+        assert rb.cardinality == int(bits.sum())
+        back = bsu.bitset_of(rb, words.size)
+        assert np.array_equal(back, words)
+
+    def test_bool_array_roundtrip(self, rng):
+        mask = rng.random(100000) < 0.3
+        rb = bsu.bitmap_of_bool_array(mask)
+        assert np.array_equal(bsu.bool_array_of(rb, mask.size), mask)
+
+    def test_bitset_of_too_small_raises(self):
+        rb = RoaringBitmap.bitmap_of(1000)
+        with pytest.raises(ValueError):
+            bsu.bitset_of(rb, 1)
+
+
+class TestRoaringBitSet:
+    def test_set_get_clear_flip(self):
+        bs = RoaringBitSet()
+        bs.set(5)
+        bs.set(100, 200)
+        assert bs.get(5) and bs.get(150) and not bs.get(99)
+        assert bs.cardinality() == 101
+        bs.clear(100, 150)
+        assert bs.cardinality() == 51
+        bs.flip(5)
+        assert not bs.get(5)
+        bs.set(7, value=False)
+        assert not bs.get(7)
+
+    def test_java_style_set_value_overload(self):
+        bs = RoaringBitSet()
+        bs.set(7, True)  # BitSet.set(int, boolean)
+        assert bs.get(7)
+        bs.set(7, False)
+        assert not bs.get(7)
+
+    def test_logical_ops(self):
+        a = RoaringBitSet(RoaringBitmap.bitmap_of(1, 2, 3, 70000))
+        b = RoaringBitSet(RoaringBitmap.bitmap_of(2, 3, 4))
+        a.and_(b)
+        assert sorted(a.stream()) == [2, 3]
+        a2 = RoaringBitSet(RoaringBitmap.bitmap_of(1, 2))
+        a2.or_(b)
+        assert sorted(a2.stream()) == [1, 2, 3, 4]
+        a3 = RoaringBitSet(RoaringBitmap.bitmap_of(1, 2))
+        a3.xor(b)
+        assert sorted(a3.stream()) == [1, 3, 4]
+        a4 = RoaringBitSet(RoaringBitmap.bitmap_of(1, 2))
+        a4.and_not(b)
+        assert sorted(a4.stream()) == [1]
+
+    def test_navigation_and_length(self):
+        bs = RoaringBitSet(RoaringBitmap.bitmap_of(3, 10, 500000))
+        assert bs.next_set_bit(4) == 10
+        assert bs.next_clear_bit(3) == 4
+        assert bs.previous_set_bit(9) == 3
+        assert bs.length() == 500001
+        assert bs.size() % 64 == 0 and bs.size() >= bs.length()
+        assert bs.value_of(bs.to_word_array()) == bs
+
+
+class TestIterators:
+    def test_peekable(self):
+        rb = RoaringBitmap.bitmap_of(1, 5, 9, 70000)
+        it = PeekableIntIterator(rb)
+        assert it.peek_next() == 1
+        it.advance_if_needed(6)
+        assert it.peek_next() == 9
+        assert list(it) == [9, 70000]
+
+    def test_advance_not_backward(self):
+        rb = RoaringBitmap.bitmap_of(10, 20)
+        it = PeekableIntIterator(rb)
+        it.next()
+        it.advance_if_needed(5)  # no-op: already past
+        assert it.peek_next() == 20
+
+    def test_rank_iterator(self):
+        rb = RoaringBitmap.bitmap_of(4, 8, 15)
+        it = PeekableIntRankIterator(rb)
+        assert it.peek_next_rank() == 1
+        it.next()
+        assert it.peek_next_rank() == 2
+
+    def test_reverse(self):
+        rb = RoaringBitmap.bitmap_of(1, 5, 70000)
+        assert list(ReverseIntIterator(rb)) == [70000, 5, 1]
+
+    def test_clone_independent(self):
+        rb = RoaringBitmap.bitmap_of(1, 2, 3)
+        it = PeekableIntIterator(rb)
+        it.next()
+        c = it.clone()
+        it.next()
+        assert c.peek_next() == 2 and it.peek_next() == 3

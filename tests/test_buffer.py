@@ -1,0 +1,142 @@
+"""Buffer tier tests — ImmutableRoaringBitmap over bytes and mmap
+(the reference's buffer/ suite incl. TestMemoryMapping), algebra producing
+in-RAM results, and BufferFastAggregation-style wide ops on immutable
+inputs."""
+
+import os
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap, MutableRoaringBitmap
+from roaringbitmap_tpu.parallel import aggregation
+
+TESTDATA = "/root/reference/RoaringBitmap/src/test/resources/testdata"
+
+
+@pytest.fixture(scope="module")
+def sample(rng):
+    vals = rng.integers(0, 1 << 24, 40000, dtype=np.uint32)
+    rb = RoaringBitmap.from_values(vals)
+    rb.run_optimize()
+    return rb
+
+
+@pytest.fixture(scope="module")
+def imm(sample):
+    return ImmutableRoaringBitmap(sample.serialize())
+
+
+class TestImmutable:
+    def test_header_only_accessors(self, sample, imm):
+        assert imm.cardinality == sample.cardinality
+        assert not imm.is_empty()
+        assert imm.has_run_compression() == sample.has_run_compression()
+        assert imm.serialized_size_in_bytes() == sample.serialized_size_in_bytes()
+
+    def test_point_ops(self, sample, imm):
+        arr = sample.to_array()
+        for x in arr[::5000]:
+            assert int(x) in imm
+            assert imm.rank(int(x)) == sample.rank(int(x))
+        assert imm.first() == sample.first()
+        assert imm.last() == sample.last()
+        for j in range(0, sample.cardinality, 7001):
+            assert imm.select(j) == sample.select(j)
+
+    def test_lazy_container_cache(self, imm, sample):
+        fresh = ImmutableRoaringBitmap(sample.serialize())
+        assert len(fresh._cache) == 0
+        fresh.contains(int(sample.first()))
+        assert len(fresh._cache) == 1  # only the touched container parsed
+
+    def test_algebra_returns_inram(self, sample, imm, rng):
+        other = RoaringBitmap.from_values(
+            rng.integers(0, 1 << 24, 10000, dtype=np.uint32))
+        for res, ref in [
+            (imm & other, sample & other),
+            (imm | other, sample | other),
+            (imm ^ other, sample ^ other),
+            (imm - other, sample - other),
+        ]:
+            assert isinstance(res, RoaringBitmap)
+            assert res == ref
+        # immutable ∘ immutable too
+        o_imm = ImmutableRoaringBitmap(other.serialize())
+        assert (imm & o_imm) == (sample & other)
+
+    def test_serialize_verbatim(self, sample, imm):
+        assert imm.serialize() == sample.serialize()
+
+    def test_roundtrip_and_conversion(self, sample, imm):
+        assert imm.to_bitmap() == sample
+        m = imm.to_mutable()
+        assert isinstance(m, MutableRoaringBitmap)
+        m.add(0xFEEDBEEF)
+        assert 0xFEEDBEEF in m and 0xFEEDBEEF not in imm
+        assert m.to_immutable().cardinality == sample.cardinality + 1
+
+    def test_view_into_larger_frame(self, sample):
+        """An embedded bitmap mid-buffer, like ByteBuffer slices."""
+        blob = b"\xAA" * 37 + sample.serialize() + b"\xBB" * 11
+        imm = ImmutableRoaringBitmap(memoryview(blob)[37:])
+        assert imm.cardinality == sample.cardinality
+        assert imm.to_bitmap() == sample
+
+    def test_mmap_file(self, sample, tmp_path):
+        """Real memory-mapped file (TestMemoryMapping.java analog)."""
+        path = os.path.join(tmp_path, "bitmap.bin")
+        with open(path, "wb") as f:
+            f.write(sample.serialize())
+        imm = ImmutableRoaringBitmap.mapped(path)
+        assert imm.cardinality == sample.cardinality
+        assert imm.first() == sample.first()
+        assert (imm & sample) == sample
+        assert imm.to_bitmap() == sample
+
+    @pytest.mark.skipif(not os.path.isdir(TESTDATA),
+                        reason="reference corpus not mounted")
+    @pytest.mark.parametrize("name,card", [("bitmapwithruns.bin", 200100),
+                                           ("bitmapwithoutruns.bin", 200100)])
+    def test_reference_fixture(self, name, card):
+        with open(os.path.join(TESTDATA, name), "rb") as f:
+            data = f.read()
+        imm = ImmutableRoaringBitmap(data)
+        assert imm.cardinality == card
+        assert imm.serialize() == data
+
+
+class TestBufferWideAggregation:
+    """BufferFastAggregation analog: wide device ops straight off
+    immutable (serialized) inputs."""
+
+    def test_wide_or_on_immutables(self, rng):
+        arrs = [rng.integers(0, 1 << 20, 5000, dtype=np.uint32)
+                for _ in range(16)]
+        imms = [ImmutableRoaringBitmap(
+            RoaringBitmap.from_values(a).serialize()) for a in arrs]
+        got = aggregation.or_(imms, engine="xla")
+        oracle = np.unique(np.concatenate(arrs))
+        assert np.array_equal(got.to_array(), oracle)
+
+    def test_wide_and_on_immutables(self, rng):
+        base = np.unique(rng.integers(0, 1 << 18, 3000, dtype=np.uint32))
+        arrs = [np.union1d(base, rng.integers(0, 1 << 18, 500, dtype=np.uint32))
+                for _ in range(6)]
+        imms = [ImmutableRoaringBitmap(
+            RoaringBitmap.from_values(a).serialize()) for a in arrs]
+        got = aggregation.and_(imms)
+        oracle = arrs[0]
+        for a in arrs[1:]:
+            oracle = np.intersect1d(oracle, a)
+        assert np.array_equal(got.to_array(), oracle)
+
+    def test_device_set_from_immutables(self, rng):
+        arrs = [rng.integers(0, 1 << 20, 4000, dtype=np.uint32)
+                for _ in range(8)]
+        imms = [ImmutableRoaringBitmap(
+            RoaringBitmap.from_values(a).serialize()) for a in arrs]
+        ds = aggregation.DeviceBitmapSet(imms)
+        got = ds.aggregate("or", engine="xla")
+        assert np.array_equal(got.to_array(), np.unique(np.concatenate(arrs)))

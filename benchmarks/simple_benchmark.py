@@ -1,0 +1,104 @@
+"""simplebenchmark analog (simplebenchmark/src/main/java/simplebenchmark.java).
+
+Per dataset, prints one table row per representation with: bits/value
+compression, pairwise 2x2 AND/OR latency, wide-OR latency, contains latency —
+"minutes, not hours" (simplebenchmark/README.md:1-24).
+
+Representations benchmarked:
+  host    — the NumPy container tier (the JVM-normal analog)
+  device  — HBM-resident wide ops via the aggregation engine (the new tier)
+
+Usage: python benchmarks/simple_benchmark.py [dataset ...] [--reps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from roaringbitmap_tpu import RoaringBitmap, and_ as rb_and, or_ as rb_or
+from roaringbitmap_tpu.parallel import aggregation
+from roaringbitmap_tpu.utils import datasets
+
+
+def _time(fn, reps: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps * 1e9  # ns
+
+
+def bench_dataset(name: str, reps: int) -> None:
+    arrs = datasets.load_value_arrays(name)
+    bitmaps = [RoaringBitmap.from_values(a) for a in arrs]
+    for b in bitmaps:
+        b.run_optimize()
+    n_values = sum(a.size for a in arrs)
+    universe = max(int(a[-1]) for a in arrs) + 1
+
+    bits_per_value = sum(b.serialized_size_in_bytes() for b in bitmaps) \
+        * 8.0 / n_values
+
+    # pairwise 2x2 over successive pairs (simplebenchmark.java:70-76)
+    pairs = list(zip(bitmaps[:-1], bitmaps[1:]))
+
+    def pair_and():
+        for a, b in pairs:
+            rb_and(a, b)
+
+    def pair_or():
+        for a, b in pairs:
+            rb_or(a, b)
+
+    and_ns = _time(pair_and, max(1, reps // 10)) / len(pairs)
+    or_ns = _time(pair_or, max(1, reps // 10)) / len(pairs)
+
+    # wide OR: host fold vs device engine
+    def host_wide():
+        acc = bitmaps[0].clone()
+        for b in bitmaps[1:]:
+            acc.ior(b)
+        return acc
+
+    host_wide_ns = _time(host_wide, max(1, reps // 20))
+    ds = aggregation.DeviceBitmapSet(bitmaps)
+    ds.aggregate("or")  # warm compile
+    device_wide_ns = _time(lambda: ds.aggregate("or"), max(1, reps // 10))
+
+    # contains probes (hit + miss mix)
+    rng = np.random.default_rng(7)
+    probes = rng.integers(0, universe, 1000).astype(np.uint32)
+    probe_bm = bitmaps[len(bitmaps) // 2]
+
+    def contains_all():
+        for p in probes:
+            probe_bm.contains(int(p))
+
+    contains_ns = _time(contains_all, max(1, reps // 10)) / probes.size
+
+    print(f"{name:>24} {bits_per_value:10.2f} {and_ns:12.0f} {or_ns:12.0f} "
+          f"{host_wide_ns:14.0f} {device_wide_ns:14.0f} {contains_ns:10.1f}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("datasets", nargs="*",
+                    default=[d for d in datasets.AVAILABLE
+                             if datasets.has_dataset(d)])
+    ap.add_argument("--reps", type=int, default=100)
+    args = ap.parse_args()
+
+    print(f"{'dataset':>24} {'bits/value':>10} {'2x2 AND ns':>12} "
+          f"{'2x2 OR ns':>12} {'host wideOR ns':>14} {'dev wideOR ns':>14} "
+          f"{'contains ns':>10}")
+    for name in args.datasets:
+        bench_dataset(name, args.reps)
+
+
+if __name__ == "__main__":
+    main()

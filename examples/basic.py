@@ -1,0 +1,27 @@
+"""Basic usage (examples/Basic.java): build, combine, iterate, clone."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap, or_
+
+rb = RoaringBitmap.bitmap_of(1, 2, 3, 1000)
+rb2 = RoaringBitmap.from_values(np.arange(10000, 20000, dtype=np.uint32))
+
+print("rb:", rb)
+print("rb2 cardinality:", rb2.cardinality)
+
+union = rb | rb2
+print("union cardinality:", union.cardinality)
+print("3 in union:", 3 in union, "| 9999 in union:", 9999 in union)
+
+wide = or_(rb, rb2)
+assert wide == union
+
+clone = rb.clone()
+clone.add(7)
+print("clone:", sorted(clone), "original unchanged:", sorted(rb))

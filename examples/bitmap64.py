@@ -1,0 +1,19 @@
+"""64-bit bitmaps (examples/Bitmap64.java): values beyond 2^32."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from roaringbitmap_tpu import Roaring64Bitmap, Roaring64NavigableMap
+
+rb = Roaring64Bitmap.bitmap_of(1, 1 << 40, 2**64 - 1)
+rb.add_range(1 << 33, (1 << 33) + 1000)
+print("cardinality:", rb.cardinality, "first:", rb.first(), "last:", rb.last())
+
+nm = Roaring64NavigableMap.from_roaring64(rb)
+assert np.array_equal(nm.to_array(), rb.to_array())
+print("portable bytes:", len(rb.serialize()),
+      "| legacy bytes:", len(nm.serialize_legacy()))

@@ -1,0 +1,31 @@
+"""Compression behavior (examples/CompressionResults.java): bytes per int
+across sparse / dense / run-friendly data, before and after runOptimize."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def report(name, rb):
+    n = rb.cardinality
+    print(f"{name:>12}: {rb.serialized_size_in_bytes() / n:6.3f} bytes/int "
+          f"({rb.container_count()} containers)")
+
+
+sparse = RoaringBitmap.from_values(
+    np.random.default_rng(0).integers(0, 1 << 30, 100000, dtype=np.uint32))
+report("sparse", sparse)
+
+dense = RoaringBitmap.from_values(
+    np.random.default_rng(0).integers(0, 1 << 18, 200000, dtype=np.uint32))
+report("dense", dense)
+
+runs = RoaringBitmap.from_range(0, 1_000_000)
+report("runs (raw)", runs)
+runs.run_optimize()
+report("runs (opt)", runs)

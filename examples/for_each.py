@@ -1,0 +1,27 @@
+"""Iteration patterns (examples/ForEachExample.java, PagedIterator.java):
+per-value, reverse, peekable, and paged batch iteration."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.core.iterators import PeekableIntIterator, ReverseIntIterator
+
+rb = RoaringBitmap.from_values(
+    np.random.default_rng(1).integers(0, 1 << 20, 100000, dtype=np.uint32))
+
+total = sum(1 for _ in rb)  # forEach
+print("visited:", total)
+
+it = PeekableIntIterator(rb)
+it.advance_if_needed(500000)
+print("first value >= 500000:", it.peek_next())
+
+print("largest 3:", [v for v, _ in zip(ReverseIntIterator(rb), range(3))])
+
+pages = list(rb.batch_iterator(4096))  # PagedIterator
+print("pages of 4096:", len(pages), "last page:", pages[-1].size)

@@ -1,0 +1,26 @@
+"""Immutable bitmaps over buffers (examples/ImmutableRoaringBitmapExample.java):
+ops on serialized form without deserializing."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+
+rb1 = RoaringBitmap.bitmap_of(3, 4, 5)
+rb2 = RoaringBitmap.from_values(np.arange(4, 10, dtype=np.uint32))
+
+imm1 = ImmutableRoaringBitmap(rb1.serialize())
+imm2 = ImmutableRoaringBitmap(rb2.serialize())
+
+print("imm1:", imm1, "| cardinality without payload parse:", imm1.cardinality)
+print("intersection:", sorted(imm1 & imm2))
+print("union:", sorted(imm1 | imm2))
+
+m = imm1.to_mutable()
+m.add(999)
+print("mutable copy:", sorted(m), "| immutable untouched:", sorted(imm1.to_bitmap()))

@@ -1,0 +1,17 @@
+"""Range predicates (examples/IntervalCheck.java): contains/intersects over
+[start, stop) without materializing the range."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from roaringbitmap_tpu import RoaringBitmap
+
+rb = RoaringBitmap.from_range(100, 200)
+rb.add(1000)
+
+print("contains [110,120):", rb.contains_range(110, 120))
+print("contains [150,250):", rb.contains_range(150, 250))
+print("intersects [150,250):", rb.intersects_range(150, 250))
+print("intersects [500,900):", rb.intersects_range(500, 900))

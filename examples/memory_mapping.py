@@ -1,0 +1,39 @@
+"""Memory-mapped bitmaps (examples/MemoryMappingExample.java): serialize many
+bitmaps into one file, mmap it, query without loading payloads."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import os
+import tempfile
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+
+tmp = tempfile.mkdtemp()
+path = os.path.join(tmp, "bitmaps.bin")
+
+bitmaps = [
+    RoaringBitmap.from_values(
+        np.random.default_rng(i).integers(0, 1 << 22, 50000, dtype=np.uint32))
+    for i in range(3)
+]
+offsets = []
+with open(path, "wb") as f:
+    for rb in bitmaps:
+        offsets.append(f.tell())
+        f.write(rb.serialize())
+
+import mmap
+with open(path, "rb") as f:
+    mm = memoryview(mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ))
+
+views = [ImmutableRoaringBitmap(mm[o:]) for o in offsets]
+for i, (rb, imm) in enumerate(zip(bitmaps, views)):
+    assert imm.to_bitmap() == rb
+    print(f"bitmap {i}: mapped cardinality {imm.cardinality} == built {rb.cardinality}")
+print("mapped file:", os.path.getsize(path), "bytes")

@@ -1,0 +1,19 @@
+"""Run every example (examples/README.md's `runAll` task analog)."""
+
+import pathlib
+import runpy
+import sys
+
+HERE = pathlib.Path(__file__).parent
+sys.path.insert(0, str(HERE.parent))  # allow running from anywhere
+
+for script in sorted(HERE.glob("*.py")):
+    if script.name == "run_all.py":
+        continue
+    print(f"\n=== {script.name} " + "=" * max(0, 60 - len(script.name)))
+    try:
+        runpy.run_path(str(script), run_name="__main__")
+    except Exception as e:  # noqa: BLE001
+        print(f"FAILED {script.name}: {e!r}")
+        sys.exit(1)
+print("\nall examples OK")

@@ -1,0 +1,43 @@
+"""Serialization recipes (examples/SerializeToByteArrayExample.java,
+SerializeToByteBufferExample.java, SerializeToDiskExample.java,
+SerializeToStringExample.java): bytes, file, and base64-string transport."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import base64
+import os
+import tempfile
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+
+rb = RoaringBitmap.from_values(
+    np.random.default_rng(3).integers(0, 1 << 24, 100000, dtype=np.uint32))
+rb.run_optimize()
+
+# to byte array
+data = rb.serialize()
+assert RoaringBitmap.deserialize(data) == rb
+print("bytes:", len(data), "== declared:", rb.serialized_size_in_bytes())
+
+# to disk
+path = os.path.join(tempfile.mkdtemp(), "rb.bin")
+with open(path, "wb") as f:
+    f.write(data)
+with open(path, "rb") as f:
+    assert RoaringBitmap.deserialize(f.read()) == rb
+print("disk roundtrip OK:", path)
+
+# to string (base64), the SerializeToStringExample recipe
+s = base64.b64encode(data).decode()
+assert RoaringBitmap.deserialize(base64.b64decode(s)) == rb
+print("base64 chars:", len(s))
+
+# pickle (the Kryo/Externalizable analog)
+import pickle
+assert pickle.loads(pickle.dumps(rb)) == rb
+print("pickle roundtrip OK")

@@ -1,0 +1,21 @@
+"""Huge universes (examples/VeryLargeBitmap.java): billions of members via
+run containers — O(containers) memory, not O(values)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from roaringbitmap_tpu import RoaringBitmap, Roaring64Bitmap
+
+rb = RoaringBitmap.from_range(0, 1 << 31)  # 2.1 billion members
+print("cardinality:", rb.cardinality)
+rb.run_optimize()
+print("serialized size:", rb.serialized_size_in_bytes(), "bytes")
+print("contains 2^30:", (1 << 30) in rb)
+print("rank(2^30):", rb.rank(1 << 30))
+
+rb64 = Roaring64Bitmap.from_range(1 << 40, (1 << 40) + (1 << 28))
+rb64.run_optimize()
+print("64-bit slab cardinality:", rb64.cardinality,
+      "in", rb64.container_count(), "containers")

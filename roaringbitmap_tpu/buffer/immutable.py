@@ -151,11 +151,15 @@ class ImmutableRoaringBitmap:
 
     # ------------------------------------------------------------ conversion
     def to_bitmap(self) -> RoaringBitmap:
-        """toMutableRoaringBitmap: an in-RAM heap copy."""
-        return RoaringBitmap(self._view.keys.copy(), self.containers)
+        """toMutableRoaringBitmap: an in-RAM heap copy.  The container list
+        is copied — containers themselves are persistent, but sharing the
+        cached list object would let the copy's point mutations rebind our
+        entries."""
+        return RoaringBitmap(self._view.keys.copy(), list(self.containers))
 
     def to_mutable(self) -> "MutableRoaringBitmap":
-        return MutableRoaringBitmap(self._view.keys.copy(), self.containers)
+        return MutableRoaringBitmap(self._view.keys.copy(),
+                                    list(self.containers))
 
     # ----------------------------------------------------------- set algebra
     # In-RAM results, like the reference's static ops on immutable inputs.
@@ -193,6 +197,9 @@ class ImmutableRoaringBitmap:
     def __repr__(self) -> str:
         return (f"ImmutableRoaringBitmap(card={self.cardinality}, "
                 f"keys={self._view.size})")
+
+    def __reduce__(self):
+        return (ImmutableRoaringBitmap, (self.serialize(),))
 
     # ------------------------------------------------------------------- I/O
     def serialize(self) -> bytes:

@@ -67,10 +67,14 @@ class RoaringBitmap:
 
     @staticmethod
     def from_range(start: int, stop: int) -> "RoaringBitmap":
-        """All values in [start, stop) — RoaringBitmap.add(long,long) on empty."""
-        rb = RoaringBitmap()
-        rb.add_range(start, stop)
-        return rb
+        """All values in [start, stop) — RoaringBitmap.add(long,long) on
+        empty, built O(#chunks) (one run container per chunk, no per-chunk
+        array reallocation).  Bounds are enforced by _chunk_ranges."""
+        keys, conts = [], []
+        for lo, hi_excl, hb in _chunk_ranges(start, stop):
+            keys.append(hb)
+            conts.append(C.range_container(lo, hi_excl))
+        return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
 
     def clone(self) -> "RoaringBitmap":
         return RoaringBitmap(self.keys.copy(), list(self.containers))
@@ -464,6 +468,17 @@ class RoaringBitmap:
     # ------------------------------------------------------------------- I/O
     def serialize(self) -> bytes:
         return spec.serialize(self.keys, self.containers)
+
+    @classmethod
+    def _from_serialized(cls, data: bytes):
+        keys, conts = spec.deserialize(data)
+        return cls(keys, conts)
+
+    def __reduce__(self):
+        """Pickle via the portable format — the Externalizable/Kryo analog
+        (RoaringArray.java:804,964; README.md:277-307).  Subclasses
+        (FastRank, MutableRoaringBitmap) round-trip to their own class."""
+        return (type(self)._from_serialized, (self.serialize(),))
 
     @staticmethod
     def deserialize(buf: bytes | memoryview) -> "RoaringBitmap":

@@ -418,6 +418,10 @@ class Roaring64Bitmap:
                 else np.empty(0, dtype=np.uint64))
         return Roaring64Bitmap(keys, conts)
 
+    def __reduce__(self):
+        """Pickle via the portable 64-bit spec (Externalizable analog)."""
+        return (Roaring64Bitmap.deserialize, (self.serialize(),))
+
     def serialized_size_in_bytes(self) -> int:
         return 8 + sum(4 + rb.serialized_size_in_bytes()
                        for _, rb in self._buckets32())
@@ -762,6 +766,11 @@ class Roaring64NavigableMap:
         header = 8 if mode == SERIALIZATION_MODE_PORTABLE else 5
         return header + sum(4 + b.serialized_size_in_bytes()
                             for b in self._map.values())
+
+    def __reduce__(self):
+        """Pickle in the legacy format (which carries signedLongs)."""
+        return (Roaring64NavigableMap.deserialize_legacy,
+                (self.serialize_legacy(),))
 
     # ------------------------------------------------------------- interop
     def to_roaring64(self) -> Roaring64Bitmap:

@@ -1,0 +1,180 @@
+"""FastAggregation — the named wide-aggregation strategy set (SURVEY §2.1).
+
+The reference exposes several strategies with distinct cost profiles
+(FastAggregation.java): naive_* chains pairwise ops; priorityqueue_*
+combines smallest-first; horizontal_* walks a container-pointer priority
+queue with lazy OR + one repair; workShyAnd intersects key sets before
+touching payloads (:356); `and`/`or`/`xor` pick the recommended strategy.
+
+The TPU mapping keeps every name so callers can port code unchanged:
+
+- naive_or/naive_xor/naive_and — genuine host-side pairwise folds (the same
+  O(N·containers) chains as the reference; useful as the CPU baseline).
+- priorityqueue_or/priorityqueue_xor — size-ordered host fold (smallest
+  pair first, the reference's PQ heuristic), also host-side.
+- horizontal_or/horizontal_xor — the device engine: the group-by-key
+  rotation IS the container-pointer priority queue, the segmented reduce is
+  the lazy-OR chain, and the fused popcount is repairAfterLazy.
+- workShyAnd / workAndMemoryShyAnd / and — the device wide-AND (key-set
+  intersection then one regular [K, N] reduce — pack_for_intersection).
+- or/xor — recommended strategy: the device engine.
+
+Every strategy accepts RoaringBitmap or buffer.ImmutableRoaringBitmap
+inputs, varargs or an iterable, like the Java overloads.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+from ..core.bitmap import (
+    RoaringBitmap,
+    and_ as rb_and,
+    andnot as rb_andnot,
+    or_ as rb_or,
+    xor as rb_xor,
+)
+from . import aggregation
+
+
+def _as_list(bitmaps) -> list:
+    if len(bitmaps) == 1 and not hasattr(bitmaps[0], "keys"):
+        return list(bitmaps[0])
+    return list(bitmaps)
+
+
+def _materialize(b) -> RoaringBitmap:
+    return b if isinstance(b, RoaringBitmap) else b.to_bitmap()
+
+
+# ------------------------------------------------------------------- naive
+def naive_or(*bitmaps) -> RoaringBitmap:
+    """Left-to-right pairwise fold (naive_or :586-618)."""
+    acc = RoaringBitmap()
+    for b in _as_list(bitmaps):
+        acc = rb_or(acc, b)
+    return acc
+
+
+def naive_xor(*bitmaps) -> RoaringBitmap:
+    acc = RoaringBitmap()
+    for b in _as_list(bitmaps):
+        acc = rb_xor(acc, b)
+    return acc
+
+
+def naive_and(*bitmaps) -> RoaringBitmap:
+    """naive_and (:304-352): pairwise intersect, empty short-circuit."""
+    bs = _as_list(bitmaps)
+    if not bs:
+        return RoaringBitmap()
+    acc = _materialize(bs[0]).clone()
+    for b in bs[1:]:
+        acc = rb_and(acc, b)
+        if acc.is_empty():
+            return acc
+    return acc
+
+
+def naive_andnot(first, *others) -> RoaringBitmap:
+    """Difference chain: first \\ (or of the rest)."""
+    rest = _as_list(others)
+    if not rest:
+        return _materialize(first).clone()
+    return rb_andnot(first, aggregation.or_(rest))
+
+
+# ---------------------------------------------------------- priority queue
+def priorityqueue_or(*bitmaps) -> RoaringBitmap:
+    """Smallest-two-first merge (priorityqueue_or :677-790): minimizes
+    intermediate sizes, still host-side."""
+    bs = [_materialize(b) for b in _as_list(bitmaps)]
+    if not bs:
+        return RoaringBitmap()
+    if len(bs) == 1:
+        return bs[0].clone()
+    heap = [(b.serialized_size_in_bytes(), i, b) for i, b in enumerate(bs)]
+    heapq.heapify(heap)
+    tick = len(bs)
+    while len(heap) > 1:
+        _, _, a = heapq.heappop(heap)
+        _, _, b = heapq.heappop(heap)
+        m = rb_or(a, b)
+        heapq.heappush(heap, (m.serialized_size_in_bytes(), tick, m))
+        tick += 1
+    return heap[0][2]
+
+
+def priorityqueue_xor(*bitmaps) -> RoaringBitmap:
+    """priorityqueue_xor (:794-819)."""
+    bs = [_materialize(b) for b in _as_list(bitmaps)]
+    if not bs:
+        return RoaringBitmap()
+    if len(bs) == 1:
+        return bs[0].clone()
+    heap = [(b.serialized_size_in_bytes(), i, b) for i, b in enumerate(bs)]
+    heapq.heapify(heap)
+    tick = len(bs)
+    while len(heap) > 1:
+        _, _, a = heapq.heappop(heap)
+        _, _, b = heapq.heappop(heap)
+        m = rb_xor(a, b)
+        heapq.heappush(heap, (m.serialized_size_in_bytes(), tick, m))
+        tick += 1
+    return heap[0][2]
+
+
+# -------------------------------------------------------- horizontal (device)
+def horizontal_or(*bitmaps, engine: str = "auto") -> RoaringBitmap:
+    """Container-PQ lazy-OR with one repair (horizontal_or :124-160) — on
+    device: group-by-key rotation + segmented reduce + fused popcount."""
+    return aggregation.or_(_as_list(bitmaps), engine=engine)
+
+
+def horizontal_xor(*bitmaps, engine: str = "auto") -> RoaringBitmap:
+    return aggregation.xor(_as_list(bitmaps), engine=engine)
+
+
+# ------------------------------------------------------------ AND (device)
+def work_shy_and(*bitmaps) -> RoaringBitmap:
+    """workShyAnd (:356-411): key-set intersection then dense AND-reduce."""
+    return aggregation.and_(_as_list(bitmaps))
+
+
+def work_and_memory_shy_and(*bitmaps) -> RoaringBitmap:
+    """workAndMemoryShyAnd (:522): same key-shy plan; the memory-shy part
+    (reusing one scratch buffer) is the XLA allocator's job on device."""
+    return aggregation.and_(_as_list(bitmaps))
+
+
+# camelCase-parity aliases
+workShyAnd = work_shy_and
+workAndMemoryShyAnd = work_and_memory_shy_and
+
+
+# ------------------------------------------------------------- recommended
+def or_(*bitmaps, engine: str = "auto") -> RoaringBitmap:
+    """FastAggregation.or (:664): recommended = horizontal/device."""
+    return aggregation.or_(_as_list(bitmaps), engine=engine)
+
+
+def xor(*bitmaps, engine: str = "auto") -> RoaringBitmap:
+    return aggregation.xor(_as_list(bitmaps), engine=engine)
+
+
+def and_(*bitmaps) -> RoaringBitmap:
+    return aggregation.and_(_as_list(bitmaps))
+
+
+def or_cardinality(*bitmaps) -> int:
+    """orCardinality (:90-108) on device."""
+    return aggregation.or_cardinality(_as_list(bitmaps))
+
+
+def and_cardinality(*bitmaps) -> int:
+    """andCardinality (:71-88) on device."""
+    return aggregation.and_cardinality(_as_list(bitmaps))
+
+
+def xor_cardinality(*bitmaps) -> int:
+    return aggregation.xor_cardinality(_as_list(bitmaps))

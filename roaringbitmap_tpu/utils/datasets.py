@@ -48,6 +48,34 @@ def load_bitmaps(name: str) -> list[RoaringBitmap]:
     return [RoaringBitmap.from_values(v) for v in load_value_arrays(name)]
 
 
+# ZipRealDataRetriever.fetchBitPositions parity name
+fetch_bit_positions = load_value_arrays
+
+RANGE_DATASET_ZIP = os.path.join(
+    os.path.dirname(REFERENCE_DATASET_DIR), "random-generated-data",
+    "random_range.zip")
+
+
+def load_range_arrays() -> list[np.ndarray]:
+    """ZipRealDataRangeRetriever analog (ZipRealDataRangeRetriever.java
+    :40-66): each line of each member is comma-separated `start:end`
+    interval pairs -> one [N, 2] i64 array per line."""
+    out = []
+    with zipfile.ZipFile(RANGE_DATASET_ZIP) as z:
+        for member in sorted(z.namelist()):
+            raw = z.read(member).decode()
+            for line in raw.splitlines():
+                if not line.strip():
+                    continue
+                pairs = [p.split(":") for p in line.split(",") if p]
+                out.append(np.array(pairs, dtype=np.int64))
+    return out
+
+
+def has_range_dataset() -> bool:
+    return os.path.exists(RANGE_DATASET_ZIP)
+
+
 def synthetic_bitmaps(n: int, seed: int = 0, universe: int = 1 << 22,
                       density: float = 0.01) -> list[RoaringBitmap]:
     """Random bitmap set for tests/benches when datasets are unavailable.

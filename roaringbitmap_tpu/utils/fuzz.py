@@ -1,0 +1,97 @@
+"""Fuzzing harness — RandomisedTestData + Fuzzer + Reporter analogs
+(SURVEY §4.2; fuzz-tests/src/test/java/org/roaringbitmap/{RandomisedTestData,
+Fuzzer,Reporter}.java).
+
+- ``random_bitmap``: reproducible bitmaps whose 2^16 chunks are a random mix
+  of RLE / dense / sparse regions (RandomisedTestData.java:17-53), the
+  distribution that exercises all three container types and every promotion
+  boundary.
+- ``verify_invariance``: run a property across many seeded iterations;
+  failures raise with a JSON repro artifact containing base64-serialized
+  inputs (Reporter.java:20-38) so any failure replays exactly.
+- Iteration count via env ``ROARINGBITMAP_TPU_FUZZ_ITERATIONS`` (the
+  reference's `org.roaringbitmap.fuzz.iterations` sysprop).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Callable
+
+import numpy as np
+
+from ..core.bitmap import RoaringBitmap
+
+ITERATIONS = int(os.environ.get("ROARINGBITMAP_TPU_FUZZ_ITERATIONS", "100"))
+
+
+def random_bitmap(rng: np.random.Generator, max_keys: int = 24,
+                  rle_limit: float | None = None,
+                  dense_limit: float | None = None) -> RoaringBitmap:
+    """One random bitmap: for each chosen high-16 key, draw a region type
+    (rle/dense/sparse) and fill accordingly (RandomisedTestData:17-53)."""
+    rle_limit = rng.random() if rle_limit is None else rle_limit
+    dense_limit = rle_limit + (1 - rle_limit) * rng.random() \
+        if dense_limit is None else dense_limit
+    n_keys = int(rng.integers(1, max_keys + 1))
+    keys = np.sort(rng.choice(1 << 16, size=n_keys, replace=False))
+    parts = []
+    for k in keys:
+        base = int(k) << 16
+        roll = rng.random()
+        if roll < rle_limit:  # run region: few long runs
+            n_runs = int(rng.integers(1, 30))
+            starts = np.sort(rng.choice(1 << 16, n_runs, replace=False))
+            for s in starts:
+                length = int(rng.integers(1, 2048))
+                parts.append(base + np.arange(s, min(s + length, 1 << 16)))
+        elif roll < dense_limit:  # dense region, up to a FULL container
+            count = int(rng.integers(4097, (1 << 16) + 1))
+            parts.append(base + rng.choice(1 << 16, count, replace=False))
+        else:  # sparse region
+            count = int(rng.integers(1, 4096))
+            parts.append(base + rng.choice(1 << 16, count, replace=False))
+    vals = np.unique(np.concatenate(parts)).astype(np.uint32)
+    rb = RoaringBitmap.from_values(vals)
+    if rng.random() < 0.5:
+        rb.run_optimize()
+    return rb
+
+
+def report_failure(seed: int, iteration: int, bitmaps, error: str) -> str:
+    """Reporter.report analog: JSON artifact with base64 portable payloads."""
+    doc = {
+        "seed": seed,
+        "iteration": iteration,
+        "error": error,
+        "bitmaps": [base64.b64encode(b.serialize()).decode() for b in bitmaps],
+    }
+    return json.dumps(doc)
+
+
+def replay(artifact: str) -> list[RoaringBitmap]:
+    """Rebuild the inputs of a reported failure."""
+    doc = json.loads(artifact)
+    return [RoaringBitmap.deserialize(base64.b64decode(s))
+            for s in doc["bitmaps"]]
+
+
+def verify_invariance(prop: Callable[..., bool], n_bitmaps: int = 2,
+                      iterations: int | None = None, seed: int = 0xF022,
+                      max_keys: int = 24) -> None:
+    """Fuzzer.verifyInvariance (Fuzzer.java:31-80): generate inputs, assert
+    the property, dump a replayable artifact on failure."""
+    iterations = ITERATIONS if iterations is None else iterations
+    for it in range(iterations):
+        rng = np.random.default_rng((seed << 20) ^ it)
+        bitmaps = [random_bitmap(rng, max_keys) for _ in range(n_bitmaps)]
+        try:
+            ok = prop(*bitmaps)
+        except Exception as e:  # property crashed: still report
+            raise AssertionError(
+                report_failure(seed, it, bitmaps, repr(e))) from e
+        if not ok:
+            raise AssertionError(
+                report_failure(seed, it, bitmaps, "property violated"))

@@ -77,6 +77,15 @@ class TestImmutable:
         assert 0xFEEDBEEF in m and 0xFEEDBEEF not in imm
         assert m.to_immutable().cardinality == sample.cardinality + 1
 
+    def test_mutable_copy_does_not_alias(self, sample, imm):
+        """to_mutable/to_bitmap must not share the cached container list:
+        point mutations on the copy rebind list entries."""
+        snapshot = imm.to_bitmap().to_array()
+        m = imm.to_mutable()
+        m.add(0xFEEDBEEF)
+        m.remove(int(snapshot[0]))
+        assert np.array_equal(imm.to_bitmap().to_array(), snapshot)
+
     def test_view_into_larger_frame(self, sample):
         """An embedded bitmap mid-buffer, like ByteBuffer slices."""
         blob = b"\xAA" * 37 + sample.serialize() + b"\xBB" * 11

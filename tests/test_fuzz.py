@@ -1,0 +1,176 @@
+"""Fuzz/property tests (SURVEY §4.2) — algebraic identities and host/device
+parity over RandomisedTestData-style region-mix inputs, mirroring
+Fuzzer.java's invariance catalog."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import (
+    RoaringBitmap,
+    and_,
+    and_cardinality,
+    andnot,
+    or_,
+    or_not,
+    xor,
+)
+from roaringbitmap_tpu.parallel import aggregation, fast_aggregation
+from roaringbitmap_tpu.utils import fuzz
+
+IT = 15  # per-property seeded iterations (reference default 10k across CI)
+
+
+def _arr(rb: RoaringBitmap) -> np.ndarray:
+    return rb.to_array()
+
+
+class TestAlgebraicInvariants:
+    def test_roundtrip_serialization(self):
+        fuzz.verify_invariance(
+            lambda a: RoaringBitmap.deserialize(a.serialize()) == a,
+            n_bitmaps=1, iterations=IT)
+
+    def test_union_model(self):
+        fuzz.verify_invariance(
+            lambda a, b: np.array_equal(_arr(or_(a, b)),
+                                        np.union1d(_arr(a), _arr(b))),
+            iterations=IT)
+
+    def test_intersection_model(self):
+        fuzz.verify_invariance(
+            lambda a, b: np.array_equal(_arr(and_(a, b)),
+                                        np.intersect1d(_arr(a), _arr(b))),
+            iterations=IT)
+
+    def test_difference_model(self):
+        fuzz.verify_invariance(
+            lambda a, b: np.array_equal(_arr(andnot(a, b)),
+                                        np.setdiff1d(_arr(a), _arr(b))),
+            iterations=IT)
+
+    def test_xor_model(self):
+        fuzz.verify_invariance(
+            lambda a, b: np.array_equal(_arr(xor(a, b)),
+                                        np.setxor1d(_arr(a), _arr(b))),
+            iterations=IT)
+
+    def test_demorgan_via_ornot(self):
+        """a | ~b over a bounded range, against a NumPy complement model."""
+        def prop(a, b):
+            end = 1 << 20
+            comp = np.setdiff1d(np.arange(end, dtype=np.uint32), _arr(b))
+            expect = np.union1d(_arr(a), comp)
+            return np.array_equal(_arr(or_not(a, b, end)), expect)
+        fuzz.verify_invariance(prop, iterations=5)
+
+    def test_cardinality_inclusion_exclusion(self):
+        fuzz.verify_invariance(
+            lambda a, b: or_(a, b).cardinality
+            == a.cardinality + b.cardinality - and_cardinality(a, b),
+            iterations=IT)
+
+    def test_rank_select_inverse(self):
+        def prop(a):
+            card = a.cardinality
+            for j in range(0, card, max(1, card // 7)):
+                if a.rank(a.select(j)) != j + 1:
+                    return False
+            return True
+        fuzz.verify_invariance(prop, n_bitmaps=1, iterations=IT)
+
+    def test_flip_involution(self):
+        def prop(a):
+            c = a.clone()
+            c.containers = list(c.containers)
+            c.flip_range(1 << 10, 1 << 21)
+            c.flip_range(1 << 10, 1 << 21)
+            return c == a
+        fuzz.verify_invariance(prop, n_bitmaps=1, iterations=IT)
+
+
+class TestDeviceParityFuzz:
+    """jit-vs-host parity — the race-detector analog (SURVEY §5): device
+    reductions must be bit-exact with the host fold regardless of order."""
+
+    def test_wide_or_parity(self):
+        def prop(*bitmaps):
+            host = fast_aggregation.naive_or(*bitmaps)
+            dev = aggregation.or_(list(bitmaps), engine="xla")
+            return dev == host
+        fuzz.verify_invariance(prop, n_bitmaps=4, iterations=6, max_keys=8)
+
+    def test_wide_xor_parity(self):
+        def prop(*bitmaps):
+            host = fast_aggregation.naive_xor(*bitmaps)
+            dev = aggregation.xor(list(bitmaps), engine="xla")
+            return dev == host
+        fuzz.verify_invariance(prop, n_bitmaps=4, iterations=6, max_keys=8)
+
+    def test_wide_and_parity(self):
+        def prop(*bitmaps):
+            host = fast_aggregation.naive_and(*bitmaps)
+            dev = aggregation.and_(list(bitmaps))
+            return dev == host
+        fuzz.verify_invariance(prop, n_bitmaps=3, iterations=6, max_keys=8)
+
+
+class TestStrategyEquivalence:
+    """Every FastAggregation strategy returns the same set."""
+
+    def test_or_strategies_agree(self):
+        def prop(*bitmaps):
+            bs = list(bitmaps)
+            ref = fast_aggregation.naive_or(bs)
+            return (fast_aggregation.priorityqueue_or(bs) == ref
+                    and fast_aggregation.horizontal_or(bs, engine="xla") == ref
+                    and fast_aggregation.or_(bs, engine="xla") == ref)
+        fuzz.verify_invariance(prop, n_bitmaps=4, iterations=5, max_keys=6)
+
+    def test_xor_strategies_agree(self):
+        def prop(*bitmaps):
+            bs = list(bitmaps)
+            ref = fast_aggregation.naive_xor(bs)
+            return (fast_aggregation.priorityqueue_xor(bs) == ref
+                    and fast_aggregation.horizontal_xor(bs, engine="xla") == ref)
+        fuzz.verify_invariance(prop, n_bitmaps=4, iterations=5, max_keys=6)
+
+    def test_and_strategies_agree(self):
+        def prop(*bitmaps):
+            bs = list(bitmaps)
+            ref = fast_aggregation.naive_and(bs)
+            return (fast_aggregation.work_shy_and(bs) == ref
+                    and fast_aggregation.and_(bs) == ref)
+        fuzz.verify_invariance(prop, n_bitmaps=3, iterations=5, max_keys=6)
+
+    def test_cardinality_strategies(self):
+        def prop(*bitmaps):
+            bs = list(bitmaps)
+            return (fast_aggregation.or_cardinality(bs)
+                    == fast_aggregation.naive_or(bs).cardinality
+                    and fast_aggregation.and_cardinality(bs)
+                    == fast_aggregation.naive_and(bs).cardinality)
+        fuzz.verify_invariance(prop, n_bitmaps=3, iterations=4, max_keys=6)
+
+
+class TestReporter:
+    def test_failure_artifact_replays(self):
+        with pytest.raises(AssertionError) as e:
+            fuzz.verify_invariance(lambda a: a.cardinality < 0,
+                                   n_bitmaps=1, iterations=1, seed=7)
+        artifact = str(e.value)
+        replayed = fuzz.replay(artifact)
+        assert len(replayed) == 1
+        assert replayed[0].cardinality > 0
+
+    def test_crash_reported_with_inputs(self):
+        def boom(a):
+            raise RuntimeError("kaboom")
+        with pytest.raises(AssertionError) as e:
+            fuzz.verify_invariance(boom, n_bitmaps=1, iterations=1)
+        assert "kaboom" in str(e.value)
+        assert fuzz.replay(str(e.value))
+
+    def test_seeded_reproducibility(self):
+        rng1 = np.random.default_rng(42)
+        rng2 = np.random.default_rng(42)
+        assert fuzz.random_bitmap(rng1) == fuzz.random_bitmap(rng2)

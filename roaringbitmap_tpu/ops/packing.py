@@ -85,6 +85,45 @@ def pack_for_aggregation(bitmaps: list[RoaringBitmap],
 
 
 @dataclass
+class PackedBlocked:
+    """Segment-padded layout for the blocked Pallas reduce: every segment's
+    rows are padded with zero rows (the OR/XOR identity) to a multiple of
+    `block`, so each grid step reduces `block` same-segment rows in VMEM."""
+
+    keys: np.ndarray      # [K] distinct keys, sorted
+    words: np.ndarray     # u32[Mb_pad, 2048]
+    blk_seg: np.ndarray   # i32[Mb_pad/block]; padding blocks get segment K
+    block: int
+    n_blocks: int         # true block count
+
+
+def pack_blocked(bitmaps: list[RoaringBitmap], block: int = 8) -> PackedBlocked:
+    """Group-by-key rotation with per-segment zero padding (OR/XOR only)."""
+    flat_keys = np.concatenate([b.keys for b in bitmaps])
+    order = np.argsort(flat_keys, kind="stable")
+    keys, seg_of_row = np.unique(flat_keys, return_inverse=True)
+    m, k = flat_keys.size, keys.size
+    seg_sorted = seg_of_row[order]
+    head = np.searchsorted(seg_sorted, np.arange(k)).astype(np.int64)
+    g = np.diff(np.append(head, m))
+    gp = -(-g // block) * block
+    offs = np.concatenate(([0], np.cumsum(gp)))
+    n_blocks = int(offs[-1]) // block
+    nb_pad = next_pow2(n_blocks)
+    words = np.zeros((nb_pad * block, WORDS32), dtype=np.uint32)
+    within = np.arange(m) - head[seg_sorted]
+    dest = offs[seg_sorted] + within
+    conts = [c for b in bitmaps for c in b.containers]
+    for d, s in zip(dest, order):
+        words[d] = container_words_u32(conts[s])
+    blk_seg = np.full(nb_pad, k, dtype=np.int32)
+    blk_seg[:n_blocks] = np.repeat(np.arange(k, dtype=np.int32),
+                                   (gp // block).astype(np.int64))
+    return PackedBlocked(keys=keys, words=words, blk_seg=blk_seg,
+                         block=block, n_blocks=n_blocks)
+
+
+@dataclass
 class PackedIntersection:
     """Wide-AND problem: only keys present in every bitmap survive
     (FastAggregation.workShyAnd key-set intersection, FastAggregation.java:356-380),

@@ -34,6 +34,10 @@ def _engine(engine: str) -> str:
     return engine
 
 
+#: Blocked-layout rows reduced per Pallas grid step (ops.packing.pack_blocked).
+BLOCK = 8
+
+
 def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
                       engine: str, out_cls=None) -> RoaringBitmap:
     bitmaps = [b for b in bitmaps if not b.is_empty()]
@@ -41,17 +45,27 @@ def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
         return (out_cls or RoaringBitmap)()
     if len(bitmaps) == 1:
         return bitmaps[0].clone()
-    packed = packing.pack_for_aggregation(bitmaps)
-    heads, cards = _run_ragged(op, packed, engine)
-    return packing.unpack_result(packed.keys, np.asarray(heads),
+    if _engine(engine) == "pallas":
+        blocked = packing.pack_blocked(bitmaps, BLOCK)
+        heads, cards = kernels.segmented_reduce_pallas_blocked(
+            op, jnp.asarray(blocked.words), jnp.asarray(blocked.blk_seg),
+            blocked.keys.size, BLOCK)
+        keys = blocked.keys
+    else:
+        packed = packing.pack_for_aggregation(bitmaps)
+        heads, cards = _run_ragged(op, packed, engine)
+        keys = packed.keys
+    return packing.unpack_result(keys, np.asarray(heads),
                                  np.asarray(cards), out_cls=out_cls)
 
 
 def _run_ragged(op: str, packed: packing.PackedAggregation, engine: str):
     if _engine(engine) == "pallas":
-        return kernels.segmented_reduce_pallas(
-            op, jnp.asarray(packed.words), jnp.asarray(packed.seg_ids),
-            packed.num_keys)
+        # row-per-step kernel: the seg_ids scalar prefetch must fit SMEM
+        if packed.words.shape[0] <= (1 << 17):
+            return kernels.segmented_reduce_pallas(
+                op, jnp.asarray(packed.words), jnp.asarray(packed.seg_ids),
+                packed.num_keys)
     return dense.segmented_reduce(
         op, jnp.asarray(packed.words), jnp.asarray(packed.seg_ids),
         jnp.asarray(packed.head_idx), dense.n_steps_for(packed.max_group))
@@ -156,26 +170,35 @@ class DeviceBitmapSet:
 
     def __init__(self, bitmaps: list[RoaringBitmap]):
         self.n = len(bitmaps)
-        self._packed = packing.pack_for_aggregation(bitmaps)
+        # Blocked layout serves BOTH engines: segment-padded zero rows are
+        # the OR/XOR identity, so the layout is simultaneously a valid
+        # ragged input for the XLA doubling pass and the Pallas blocked
+        # kernel's native shape (and its per-block scalar array stays far
+        # under the SMEM prefetch ceiling at any realistic scale).
+        self._packed = packing.pack_blocked(bitmaps, BLOCK)
         self.keys = self._packed.keys
         self.words = jax.device_put(self._packed.words)
-        self.seg_ids = jax.device_put(self._packed.seg_ids)
-        self.head_idx = jax.device_put(self._packed.head_idx)
-        self.n_steps = dense.n_steps_for(self._packed.max_group)
+        self.blk_seg = jax.device_put(self._packed.blk_seg)
+        seg_rows = np.repeat(self._packed.blk_seg, BLOCK).astype(np.int32)
+        self.seg_ids = jax.device_put(seg_rows)
+        head = np.searchsorted(seg_rows, np.arange(self.keys.size))
+        self.head_idx = jax.device_put(head.astype(np.int32))
+        seg_sizes = np.diff(np.append(head, self._packed.n_blocks * BLOCK))
+        self.n_steps = dense.n_steps_for(int(seg_sizes.max()) if seg_sizes.size else 0)
 
     def aggregate_device(self, op: str, engine: str = "auto"):
         """Run the wide op; returns device (words u32[K,2048], cards i32[K]).
 
-        op is "or" or "xor".  AND is rejected: the ragged segment layout has
-        no rows for keys a bitmap lacks, so a segmented "and" would silently
+        op is "or" or "xor".  AND is rejected: the segment layout has no
+        rows for keys a bitmap lacks, so a segmented "and" would silently
         ignore missing containers; use aggregation.and_ (workShy path).
         """
         if op not in ("or", "xor"):
             raise ValueError(f"DeviceBitmapSet supports or/xor, not {op!r}; "
                              "use aggregation.and_ for wide intersections")
         if _engine(engine) == "pallas":
-            return kernels.segmented_reduce_pallas(
-                op, self.words, self.seg_ids, self.keys.size)
+            return kernels.segmented_reduce_pallas_blocked(
+                op, self.words, self.blk_seg, self.keys.size, BLOCK)
         return dense.segmented_reduce(
             op, self.words, self.seg_ids, self.head_idx, self.n_steps)
 

@@ -29,7 +29,7 @@ import numpy as np
 from ..core.bitmap import RoaringBitmap
 from ..ops import packing
 from ..ops.dense import popcount
-from .slice_index import Operation, RoaringBitmapSliceIndex
+from .slice_index import Operation, RoaringBitmapSliceIndex, minmax_decision
 
 
 def _densify(rb: RoaringBitmap, keys: np.ndarray) -> np.ndarray:
@@ -85,20 +85,25 @@ class DeviceBSI:
         self.max_value = bsi.max_value
         # the ebM's key set covers every slice (slices are subsets of ebM)
         self.depth = bsi.bit_count()
+        self._ebm_host = bsi.ebm.clone()  # for the pruning fast path
         self.keys, self.ebm, self.slices = _pack_index(bsi.ebm, bsi.slices)
 
     def hbm_bytes(self) -> int:
         return int(self.ebm.nbytes + self.slices.nbytes)
 
     # ------------------------------------------------------------ primitives
-    def _oneil(self, predicate):
-        bits = (predicate >> jnp.arange(self.depth - 1, -1, -1,
-                                        dtype=jnp.int32)) & 1
-        return oneil_scan(self.slices, self.ebm, bits)
+    def _bits(self, predicate: int) -> jnp.ndarray:
+        """Predicate -> top-bit-first bit array, decomposed with Python int
+        shifts so negative and >=2^31 predicates keep the host comparator's
+        exact bit pattern (sign extension included) instead of wrapping
+        through a device int32 cast."""
+        return jnp.asarray(
+            [(predicate >> i) & 1 for i in range(self.depth - 1, -1, -1)],
+            dtype=jnp.int32)
 
     @partial(jax.jit, static_argnums=(0, 1))
-    def _compare_words(self, op: str, predicate, end, found):
-        gt, lt, eq = self._oneil(predicate)
+    def _compare_words(self, op: str, bits, bits2, found):
+        gt, lt, eq = oneil_scan(self.slices, self.ebm, bits)
         eq = found & eq
         if op == "EQ":
             res = eq
@@ -113,7 +118,7 @@ class DeviceBSI:
         elif op == "GE":
             res = (gt & found) | eq
         elif op == "RANGE":
-            gt2, lt2, eq2 = self._oneil(end)
+            gt2, lt2, eq2 = oneil_scan(self.slices, self.ebm, bits2)
             res = ((gt & found) | eq) & ((lt2 & found) | (found & eq2))
         else:
             raise ValueError(f"unsupported operation {op}")
@@ -125,12 +130,29 @@ class DeviceBSI:
             return self.ebm
         return jnp.asarray(_densify(found_set, self.keys))
 
+    def _pruned(self, decision: str,
+                found_set: RoaringBitmap | None) -> RoaringBitmap:
+        """Min/max-pruned result, entirely host-side — a pruned query must
+        not pay densify/transfer/kernel cost ("all" = ebM ∩ foundSet,
+        matching the host's _compare_using_min_max)."""
+        from ..core.bitmap import and_ as rb_and
+
+        if decision == "empty":
+            return RoaringBitmap()
+        return (self._ebm_host.clone() if found_set is None
+                else rb_and(self._ebm_host, found_set))
+
     def compare(self, op: Operation, start_or_value: int, end: int = 0,
                 found_set: RoaringBitmap | None = None) -> RoaringBitmap:
-        """Fused device compare; bit-exact with the host comparator."""
+        """Fused device compare; bit-exact with the host comparator
+        (min/max pruning included, compareUsingMinMax :515-577)."""
+        decision = minmax_decision(op, start_or_value, end,
+                                   self.min_value, self.max_value)
+        if decision is not None:
+            return self._pruned(decision, found_set)
         found = self._found_words(found_set)
         words, cards = self._compare_words(
-            op.value, jnp.int32(start_or_value), jnp.int32(end), found)
+            op.value, self._bits(start_or_value), self._bits(end), found)
         res = packing.unpack_result(self.keys, np.asarray(words),
                                     np.asarray(cards))
         if op is Operation.NEQ and found_set is not None:
@@ -150,12 +172,16 @@ class DeviceBSI:
     def compare_cardinality(self, op: Operation, start_or_value: int,
                             end: int = 0,
                             found_set: RoaringBitmap | None = None) -> int:
+        decision = minmax_decision(op, start_or_value, end,
+                                   self.min_value, self.max_value)
+        if decision is not None:
+            return self._pruned(decision, found_set).cardinality
         if op is Operation.NEQ and found_set is not None:
             # needs the host-side stray-key remainder; see compare()
             return self.compare(op, start_or_value, end, found_set).cardinality
         found = self._found_words(found_set)
         _, cards = self._compare_words(
-            op.value, jnp.int32(start_or_value), jnp.int32(end), found)
+            op.value, self._bits(start_or_value), self._bits(end), found)
         return int(np.asarray(jnp.sum(cards)))
 
     def sum(self, found_set: RoaringBitmap | None = None) -> tuple[int, int]:

@@ -46,6 +46,51 @@ class Operation(enum.Enum):
     RANGE = "RANGE"
 
 
+def minmax_decision(op: Operation, start: int, end: int,
+                    mn: int, mx: int) -> str | None:
+    """[minValue, maxValue] range pruning (compareUsingMinMax :515-577).
+
+    Returns "all" (every stored row matches), "empty" (none can), or None
+    (the O'Neil scan must run).  Shared by the host comparator and
+    bsi.device.DeviceBSI so both prune — and therefore answer out-of-range
+    predicates — identically.
+    """
+    if op is Operation.LT:
+        if start > mx:
+            return "all"
+        if start <= mn:
+            return "empty"
+    elif op is Operation.LE:
+        if start >= mx:
+            return "all"
+        if start < mn:
+            return "empty"
+    elif op is Operation.GT:
+        if start < mn:
+            return "all"
+        if start >= mx:
+            return "empty"
+    elif op is Operation.GE:
+        if start <= mn:
+            return "all"
+        if start > mx:
+            return "empty"
+    elif op is Operation.EQ:
+        if mn == mx and mn == start:
+            return "all"
+        if start < mn or start > mx:
+            return "empty"
+    elif op is Operation.NEQ:
+        if mn == mx:
+            return "empty" if mn == start else "all"
+    elif op is Operation.RANGE:
+        if start <= mn and end >= mx:
+            return "all"
+        if start > mx or end < mn:
+            return "empty"
+    return None
+
+
 # ------------------------------------------------------------- Hadoop vints
 def write_vlong(out: bytearray, v: int) -> None:
     """Hadoop WritableUtils.writeVLong zero-compressed encoding
@@ -337,42 +382,13 @@ class RoaringBitmapSliceIndex:
                                ) -> RoaringBitmap | None:
         """Range pruning against [minValue, maxValue]
         (compareUsingMinMax :515-577)."""
-        all_ = self.ebm.clone() if found_set is None else rb_and(self.ebm, found_set)
-        empty = RoaringBitmap()
-        mn, mx = self.min_value, self.max_value
-        if op is Operation.LT:
-            if start > mx:
-                return all_
-            if start <= mn:
-                return empty
-        elif op is Operation.LE:
-            if start >= mx:
-                return all_
-            if start < mn:
-                return empty
-        elif op is Operation.GT:
-            if start < mn:
-                return all_
-            if start >= mx:
-                return empty
-        elif op is Operation.GE:
-            if start <= mn:
-                return all_
-            if start > mx:
-                return empty
-        elif op is Operation.EQ:
-            if mn == mx and mn == start:
-                return all_
-            if start < mn or start > mx:
-                return empty
-        elif op is Operation.NEQ:
-            if mn == mx:
-                return empty if mn == start else all_
-        elif op is Operation.RANGE:
-            if start <= mn and end >= mx:
-                return all_
-            if start > mx or end < mn:
-                return empty
+        decision = minmax_decision(op, start, end, self.min_value,
+                                   self.max_value)
+        if decision == "all":
+            return (self.ebm.clone() if found_set is None
+                    else rb_and(self.ebm, found_set))
+        if decision == "empty":
+            return RoaringBitmap()
         return None
 
     def compare(self, op: Operation, start_or_value: int, end: int = 0,

@@ -44,7 +44,7 @@ def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
     if not bitmaps:
         return (out_cls or RoaringBitmap)()
     if len(bitmaps) == 1:
-        return bitmaps[0].clone()
+        return _materialize(bitmaps[0])
     if _engine(engine) == "pallas":
         blocked = packing.pack_blocked(bitmaps, BLOCK)
         heads, cards = kernels.segmented_reduce_pallas_blocked(
@@ -91,7 +91,7 @@ def and_(*bitmaps: RoaringBitmap, engine: str = "auto",
     if any(b.is_empty() for b in bitmaps):
         return cls()
     if len(bitmaps) == 1:
-        return bitmaps[0].clone()
+        return _materialize(bitmaps[0])
     packed = packing.pack_for_intersection(bitmaps)
     if packed.keys.size == 0:
         return cls()
@@ -128,6 +128,12 @@ def xor_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto") -> int:
     packed = packing.pack_for_aggregation(bitmaps)
     _, cards = _run_ragged("xor", packed, engine)
     return int(np.asarray(jnp.sum(cards)))
+
+
+def _materialize(b) -> RoaringBitmap:
+    """Heap copy of a single input; buffer.ImmutableRoaringBitmap has no
+    clone() (it is read-only), so it materializes via to_bitmap()."""
+    return b.clone() if hasattr(b, "clone") else b.to_bitmap()
 
 
 def _flatten(bitmaps) -> list[RoaringBitmap]:
@@ -186,6 +192,15 @@ class DeviceBitmapSet:
         seg_sizes = np.diff(np.append(head, self._packed.n_blocks * BLOCK))
         self.n_steps = dense.n_steps_for(int(seg_sizes.max()) if seg_sizes.size else 0)
 
+    def _select_engine(self, engine: str) -> str:
+        """Engine choice with the SMEM guard: the per-block scalar prefetch
+        must fit SMEM (same bound as _run_ragged); beyond it every entry
+        point falls back to the doubling engine."""
+        eng = _engine(engine)
+        if eng == "pallas" and int(self.blk_seg.size) > (1 << 17):
+            eng = "xla"
+        return eng
+
     def aggregate_device(self, op: str, engine: str = "auto"):
         """Run the wide op; returns device (words u32[K,2048], cards i32[K]).
 
@@ -196,7 +211,7 @@ class DeviceBitmapSet:
         if op not in ("or", "xor"):
             raise ValueError(f"DeviceBitmapSet supports or/xor, not {op!r}; "
                              "use aggregation.and_ for wide intersections")
-        if _engine(engine) == "pallas":
+        if self._select_engine(engine) == "pallas":
             return kernels.segmented_reduce_pallas_blocked(
                 op, self.words, self.blk_seg, self.keys.size, BLOCK)
         return dense.segmented_reduce(
@@ -207,8 +222,8 @@ class DeviceBitmapSet:
         return packing.unpack_result(self.keys, np.asarray(words), np.asarray(cards))
 
     def hbm_bytes(self) -> int:
-        return int(self._packed.words.nbytes + self._packed.seg_ids.nbytes
-                   + self._packed.head_idx.nbytes)
+        return int(self.words.nbytes + self.blk_seg.nbytes
+                   + self.seg_ids.nbytes + self.head_idx.nbytes)
 
     def chained_wide_or(self, reps: int, engine: str = "auto"):
         """Steady-state throughput probe: `reps` dependent wide-ORs in ONE jit.
@@ -222,15 +237,16 @@ class DeviceBitmapSet:
         really ran bit-exact.  This is the measurement loop bench.py uses
         (single dispatch, JMH-style steady state).
         """
-        eng = _engine(engine)
-        seg_ids, head_idx, n_keys, n_steps = (
-            self.seg_ids, self.head_idx, self.keys.size, self.n_steps)
+        eng = self._select_engine(engine)
+        blk_seg, seg_ids, head_idx, n_keys, n_steps = (
+            self.blk_seg, self.seg_ids, self.head_idx, self.keys.size,
+            self.n_steps)
 
         def body(i, state):
             words, total = state
             if eng == "pallas":
-                heads, cards = kernels.segmented_reduce_pallas(
-                    "or", words, seg_ids, n_keys)
+                heads, cards = kernels.segmented_reduce_pallas_blocked(
+                    "or", words, blk_seg, n_keys, BLOCK)
             else:
                 heads, cards = dense.segmented_reduce(
                     "or", words, seg_ids, head_idx, n_steps)

@@ -74,6 +74,18 @@ def test_device_bitmap_set_reuse(workload, oracles):
     assert ds.hbm_bytes() > 0
 
 
+def test_single_immutable_input():
+    """len==1 paths must not call clone() on a clone-less immutable
+    (ADVICE r1): materialize via to_bitmap() instead."""
+    from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+
+    rb = RoaringBitmap.bitmap_of(1, 5, 70000)
+    imm = ImmutableRoaringBitmap.from_bitmap(rb)
+    assert aggregation.or_(imm) == rb
+    assert aggregation.xor(imm) == rb
+    assert aggregation.and_(imm) == rb
+
+
 def test_xor_empty_container_dropped():
     a = RoaringBitmap.bitmap_of(5, 70000)
     b = RoaringBitmap.bitmap_of(5, 70001)

@@ -262,6 +262,18 @@ class TestDeviceBSI:
         assert dev.compare_cardinality(Operation.NEQ, pred, found_set=fs) == \
             bsi.o_neil_compare(Operation.NEQ, pred, fs).cardinality
 
+    @pytest.mark.parametrize("op", ALL_OPS)
+    def test_device_out_of_range_predicates(self, data, bsi, dev, op):
+        """Predicates outside [min,max] — incl. negative and >= 2^31 — must
+        hit the shared min/max pruning, not wrap through an int32 cast
+        (ADVICE r1: DeviceBSI.compare predicate wrap)."""
+        for pred in (-1, -(1 << 35), 0, bsi.max_value + 1, 1 << 31, 1 << 40):
+            end = pred + 10
+            host = bsi.compare(op, pred, end)
+            device = dev.compare(op, pred, end)
+            assert device == host, (op, pred)
+            assert dev.compare_cardinality(op, pred, end) == host.cardinality
+
     def test_value_above_int32_rejected(self):
         with pytest.raises(ValueError):
             RoaringBitmapSliceIndex.from_pairs(

@@ -1,0 +1,119 @@
+"""Build + run the C++ CPU baseline and record baselines/cpu_baseline.json.
+
+The driver metric (BASELINE.json) compares our TPU wide aggregation against
+the CPU `ParallelAggregation.or`.  No JVM exists in this image (no `java`
+binary, zero egress to fetch one), so the baseline is the single-file C++
+translation of the same algorithm in wide_or_cpu.cpp, compiled -O3 — see
+that file's header for the algorithm mapping.  This script:
+
+1. serializes each dataset's bitmaps to the portable format and frames them
+   into a temp file (u32 count, then u32 len + payload each),
+2. compiles wide_or_cpu.cpp (cached on mtime),
+3. runs wide_or/wide_xor/wide_and/pairwise ops, asserting cardinality
+   parity against our host tier,
+4. writes baselines/cpu_baseline.json for bench.py's vs_baseline.
+
+Usage: python baselines/run_cpu_baseline.py [--reps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import struct
+import subprocess
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+SRC = os.path.join(HERE, "wide_or_cpu.cpp")
+BIN = os.path.join(HERE, "wide_or_cpu")
+OUT = os.path.join(HERE, "cpu_baseline.json")
+
+DATASETS = ("census1881", "wikileaks-noquotes", "census1881_srt",
+            "wikileaks-noquotes_srt", "uscensus2000")
+
+
+def build() -> str:
+    if (not os.path.exists(BIN)
+            or os.path.getmtime(BIN) < os.path.getmtime(SRC)):
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-std=c++17", "-o", BIN, SRC],
+            check=True)
+    return BIN
+
+
+def frame_file(bitmaps, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(struct.pack("<I", len(bitmaps)))
+        for b in bitmaps:
+            data = b.serialize()
+            f.write(struct.pack("<I", len(data)))
+            f.write(data)
+
+
+def run_dataset(name: str, reps: int) -> dict:
+    from roaringbitmap_tpu.parallel import fast_aggregation
+    from roaringbitmap_tpu.utils import datasets
+
+    bms = datasets.load_bitmaps(name)
+    with tempfile.NamedTemporaryFile(suffix=".frames", delete=False) as tf:
+        frame_file(bms, tf.name)
+        frames = tf.name
+    try:
+        out = subprocess.run([BIN, frames, str(reps), "all"], check=True,
+                             capture_output=True, text=True).stdout
+    finally:
+        os.unlink(frames)
+    rows = {}
+    for line in out.splitlines():
+        row = json.loads(line)
+        rows[row["op"]] = row
+    # parity: the C++ result cardinalities must match our host tier
+    expect = {
+        "wide_or": fast_aggregation.or_(*bms).cardinality,
+        "wide_xor": fast_aggregation.xor(*bms).cardinality,
+        "wide_and": fast_aggregation.and_(*bms).cardinality,
+    }
+    for op, want in expect.items():
+        got = rows[op]["result_cardinality"]
+        assert got == want, f"{name}/{op}: C++ {got} != host {want}"
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=100)
+    ap.add_argument("--datasets", nargs="*", default=list(DATASETS))
+    args = ap.parse_args()
+
+    build()
+    result = {
+        "description": "C++ -O3 single-thread CPU baseline "
+                       "(ParallelAggregation.or algorithm; no JVM in image)",
+        "host": {
+            "cpu_count": os.cpu_count(),
+            "platform": platform.platform(),
+            "compiler": subprocess.run(["g++", "--version"], check=True,
+                                       capture_output=True,
+                                       text=True).stdout.splitlines()[0],
+        },
+        "reps": args.reps,
+        "datasets": {},
+    }
+    for name in args.datasets:
+        print(f"measuring {name} ...", file=sys.stderr)
+        result["datasets"][name] = run_dataset(name, args.reps)
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result["datasets"], indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,404 @@
+// CPU baseline for the driver metric: wide aggregation over N roaring
+// bitmaps, single host thread, -O3.
+//
+// This is the stand-in for the reference JVM baseline
+// (org.roaringbitmap.ParallelAggregation.or, ParallelAggregation.java:160-222):
+// no JVM exists in this image (no `java` binary, zero egress), so the best
+// available CPU implementation is this C++ translation of the same
+// algorithm — group containers by key, accumulate each key slice into a
+// dense 1024xu64 word block (the OrCollector / lazy-or strategy the JVM
+// uses for every slice >= 16 containers), then one popcount "repair" pass
+// (Container.repairAfterLazy, Container.java:869-873) that downgrades to an
+// array container at cardinality <= 4096.  On this 1-core host the JVM's
+// ForkJoinPool would be sequential anyway, so a single thread is the
+// faithful equivalent.
+//
+// Input: a frame file produced by baselines/run_cpu_baseline.py:
+//   u32 n_bitmaps, then per bitmap { u32 byte_len, portable-format payload }.
+// The payload is the RoaringFormatSpec portable serialization
+// (https spec; cookies 12346/12347 — RoaringArray.java:23-24,851-893), so
+// parsing it here is also an interop check of our serializer.
+//
+// Output: one JSON line per requested op with ns/op over `reps` repetitions
+// plus the result cardinality for parity checking.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <chrono>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t SERIAL_COOKIE_NO_RUNCONTAINER = 12346;
+constexpr uint32_t SERIAL_COOKIE = 12347;
+constexpr int NO_OFFSET_THRESHOLD = 4;
+constexpr int WORDS = 1024;           // u64 words per 2^16-bit container
+constexpr int ARRAY_MAX = 4096;       // ArrayContainer.DEFAULT_MAX_SIZE
+
+enum class Kind : uint8_t { Array, Bitmap, Run };
+
+struct Cont {
+  uint16_t key;
+  Kind kind;
+  uint16_t card_minus_one;  // serialized cardinality - 1 (array/bitmap)
+  const uint8_t* payload;   // into the mapped frame buffer (zero-copy)
+  uint16_t n_runs;          // run containers only
+};
+
+struct Bitmap {
+  std::vector<Cont> conts;
+};
+
+uint16_t rd16(const uint8_t* p) { uint16_t v; std::memcpy(&v, p, 2); return v; }
+uint32_t rd32(const uint8_t* p) { uint32_t v; std::memcpy(&v, p, 4); return v; }
+
+// Parse one portable-format bitmap (RoaringArray.deserialize, :276-361).
+Bitmap parse(const uint8_t* buf, size_t len) {
+  Bitmap bm;
+  if (len < 4) { std::fprintf(stderr, "short stream\n"); std::exit(2); }
+  uint32_t cookie = rd32(buf);
+  size_t pos = 4;
+  int size;
+  bool has_run = (cookie & 0xFFFF) == SERIAL_COOKIE;
+  std::vector<uint8_t> run_bits;
+  if (has_run) {
+    size = (cookie >> 16) + 1;
+    size_t nb = (size + 7) / 8;
+    run_bits.assign(buf + pos, buf + pos + nb);
+    pos += nb;
+  } else {
+    if (cookie != SERIAL_COOKIE_NO_RUNCONTAINER) {
+      std::fprintf(stderr, "bad cookie %u\n", cookie); std::exit(2);
+    }
+    size = static_cast<int>(rd32(buf + pos));
+    pos += 4;
+  }
+  bm.conts.resize(size);
+  for (int i = 0; i < size; ++i) {
+    bm.conts[i].key = rd16(buf + pos);
+    bm.conts[i].card_minus_one = rd16(buf + pos + 2);
+    pos += 4;
+  }
+  if (!has_run || size >= NO_OFFSET_THRESHOLD) pos += 4u * size;  // offsets
+  for (int i = 0; i < size; ++i) {
+    Cont& c = bm.conts[i];
+    bool is_run = has_run && (run_bits[i / 8] >> (i % 8)) & 1;
+    if (is_run) {
+      c.kind = Kind::Run;
+      c.n_runs = rd16(buf + pos);
+      pos += 2;
+      c.payload = buf + pos;
+      pos += 4u * c.n_runs;
+    } else if (c.card_minus_one + 1 > ARRAY_MAX) {
+      c.kind = Kind::Bitmap;
+      c.payload = buf + pos;
+      pos += 8u * WORDS;
+    } else {
+      c.kind = Kind::Array;
+      c.payload = buf + pos;
+      pos += 2u * (c.card_minus_one + 1);
+    }
+  }
+  return bm;
+}
+
+// OR one container into a dense word accumulator
+// (BitmapContainer.lazyor variants, BitmapContainer.java:878-909).
+void or_into(const Cont& c, uint64_t* w) {
+  switch (c.kind) {
+    case Kind::Bitmap: {
+      uint64_t tmp[WORDS];
+      std::memcpy(tmp, c.payload, 8 * WORDS);  // payload may be unaligned
+      for (int i = 0; i < WORDS; ++i) w[i] |= tmp[i];
+      break;
+    }
+    case Kind::Array: {
+      int n = c.card_minus_one + 1;
+      for (int i = 0; i < n; ++i) {
+        uint16_t v = rd16(c.payload + 2 * i);
+        w[v >> 6] |= uint64_t{1} << (v & 63);
+      }
+      break;
+    }
+    case Kind::Run: {
+      for (int r = 0; r < c.n_runs; ++r) {
+        uint32_t start = rd16(c.payload + 4 * r);
+        uint32_t end = start + rd16(c.payload + 4 * r + 2);  // inclusive
+        // Util.setBitmapRange (Util.java:616)
+        int fw = start >> 6, lw = end >> 6;
+        if (fw == lw) {
+          w[fw] |= (~uint64_t{0} << (start & 63)) &
+                   (~uint64_t{0} >> (63 - (end & 63)));
+        } else {
+          w[fw] |= ~uint64_t{0} << (start & 63);
+          for (int i = fw + 1; i < lw; ++i) w[i] = ~uint64_t{0};
+          w[lw] |= ~uint64_t{0} >> (63 - (end & 63));
+        }
+      }
+      break;
+    }
+  }
+}
+
+void and_into(const Cont& c, uint64_t* w) {
+  uint64_t tmp[WORDS];
+  std::memset(tmp, 0, sizeof tmp);
+  or_into(c, tmp);
+  for (int i = 0; i < WORDS; ++i) w[i] &= tmp[i];
+}
+
+void xor_into(const Cont& c, uint64_t* w) {
+  uint64_t tmp[WORDS];
+  std::memset(tmp, 0, sizeof tmp);
+  or_into(c, tmp);
+  for (int i = 0; i < WORDS; ++i) w[i] ^= tmp[i];
+}
+
+// Result sink: the repaired output container set.  Mirrors what the JVM
+// materializes (repairAfterLazy converts card<=4096 down to arrays); values
+// are written so the work can't be dead-code-eliminated.
+struct Result {
+  std::vector<uint16_t> keys;
+  std::vector<int> cards;
+  std::vector<uint16_t> array_values;       // concatenated array containers
+  std::vector<uint64_t> bitmap_words;       // concatenated bitmap containers
+  uint64_t total_card = 0;
+  void clear() {
+    keys.clear(); cards.clear(); array_values.clear(); bitmap_words.clear();
+    total_card = 0;
+  }
+  void emit(uint16_t key, const uint64_t* w) {
+    int card = 0;
+    for (int i = 0; i < WORDS; ++i) card += __builtin_popcountll(w[i]);
+    if (card == 0) return;
+    keys.push_back(key);
+    cards.push_back(card);
+    total_card += card;
+    if (card <= ARRAY_MAX) {  // repairAfterLazy downgrade
+      for (int i = 0; i < WORDS; ++i) {
+        uint64_t x = w[i];
+        while (x) {
+          int b = __builtin_ctzll(x);
+          array_values.push_back(static_cast<uint16_t>((i << 6) | b));
+          x &= x - 1;
+        }
+      }
+    } else {
+      bitmap_words.insert(bitmap_words.end(), w, w + WORDS);
+    }
+  }
+};
+
+// ParallelAggregation.groupByKey (:136-152) + per-key reduce (:198-222).
+void wide_or(const std::vector<Bitmap>& bms, Result& res) {
+  static std::vector<const Cont*> slices[65536];
+  std::vector<uint16_t> present;
+  for (const Bitmap& b : bms)
+    for (const Cont& c : b.conts) {
+      if (slices[c.key].empty()) present.push_back(c.key);
+      slices[c.key].push_back(&c);
+    }
+  std::sort(present.begin(), present.end());
+  res.clear();
+  uint64_t w[WORDS];
+  for (uint16_t key : present) {
+    std::memset(w, 0, sizeof w);
+    for (const Cont* c : slices[key]) or_into(*c, w);
+    res.emit(key, w);
+    slices[key].clear();
+  }
+}
+
+void wide_xor(const std::vector<Bitmap>& bms, Result& res) {
+  static std::vector<const Cont*> slices[65536];
+  std::vector<uint16_t> present;
+  for (const Bitmap& b : bms)
+    for (const Cont& c : b.conts) {
+      if (slices[c.key].empty()) present.push_back(c.key);
+      slices[c.key].push_back(&c);
+    }
+  std::sort(present.begin(), present.end());
+  res.clear();
+  uint64_t w[WORDS];
+  for (uint16_t key : present) {
+    std::memset(w, 0, sizeof w);
+    for (const Cont* c : slices[key]) xor_into(*c, w);
+    res.emit(key, w);
+    slices[key].clear();
+  }
+}
+
+// FastAggregation.workShyAnd (:356-411): key-presence intersection, then a
+// dense AND chain per surviving key.
+void wide_and(const std::vector<Bitmap>& bms, Result& res) {
+  uint64_t keymask[WORDS];
+  std::memset(keymask, 0, sizeof keymask);
+  for (const Cont& c : bms[0].conts)
+    keymask[c.key >> 6] |= uint64_t{1} << (c.key & 63);
+  uint64_t other[WORDS];
+  for (size_t j = 1; j < bms.size(); ++j) {
+    std::memset(other, 0, sizeof other);
+    for (const Cont& c : bms[j].conts)
+      other[c.key >> 6] |= uint64_t{1} << (c.key & 63);
+    for (int i = 0; i < WORDS; ++i) keymask[i] &= other[i];
+  }
+  res.clear();
+  uint64_t w[WORDS];
+  for (int ki = 0; ki < WORDS; ++ki) {
+    uint64_t x = keymask[ki];
+    while (x) {
+      int b = __builtin_ctzll(x);
+      x &= x - 1;
+      uint16_t key = static_cast<uint16_t>((ki << 6) | b);
+      std::memset(w, 0xFF, sizeof w);
+      for (const Bitmap& bm : bms) {
+        // binary search this bitmap's sorted key array
+        const auto& cs = bm.conts;
+        size_t lo = 0, hi = cs.size();
+        while (lo < hi) {
+          size_t mid = (lo + hi) / 2;
+          if (cs[mid].key < key) lo = mid + 1; else hi = mid;
+        }
+        and_into(cs[lo], w);
+      }
+      res.emit(key, w);
+    }
+  }
+}
+
+// Successive pairwise a[i] OP a[i+1] over the whole set, simplebenchmark
+// style (simplebenchmark.java:70-76): result cardinality only.
+uint64_t pairwise_card(const std::vector<Bitmap>& bms, bool is_and) {
+  uint64_t total = 0;
+  uint64_t w[WORDS], t[WORDS];
+  for (size_t i = 0; i + 1 < bms.size(); ++i) {
+    const Bitmap &a = bms[i], &b = bms[i + 1];
+    size_t ia = 0, ib = 0;
+    while (ia < a.conts.size() || ib < b.conts.size()) {
+      uint16_t ka = ia < a.conts.size() ? a.conts[ia].key : 0xFFFF;
+      uint16_t kb = ib < b.conts.size() ? b.conts[ib].key : 0xFFFF;
+      if (ia < a.conts.size() && (ib >= b.conts.size() || ka < kb)) {
+        if (!is_and) {
+          std::memset(w, 0, sizeof w);
+          or_into(a.conts[ia], w);
+          for (int k = 0; k < WORDS; ++k) total += __builtin_popcountll(w[k]);
+        }
+        ++ia;
+      } else if (ib < b.conts.size() && (ia >= a.conts.size() || kb < ka)) {
+        if (!is_and) {
+          std::memset(w, 0, sizeof w);
+          or_into(b.conts[ib], w);
+          for (int k = 0; k < WORDS; ++k) total += __builtin_popcountll(w[k]);
+        }
+        ++ib;
+      } else {
+        std::memset(w, 0, sizeof w);
+        or_into(a.conts[ia], w);
+        std::memset(t, 0, sizeof t);
+        or_into(b.conts[ib], t);
+        for (int k = 0; k < WORDS; ++k) {
+          uint64_t r = is_and ? (w[k] & t[k]) : (w[k] | t[k]);
+          total += __builtin_popcountll(r);
+        }
+        ++ia; ++ib;
+      }
+    }
+  }
+  return total;
+}
+
+double now_ns() {
+  return std::chrono::duration<double, std::nano>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s FRAMEFILE REPS [op]\n  op: wide_or (default), "
+                 "wide_and, wide_xor, pairwise_and, pairwise_or, all\n",
+                 argv[0]);
+    return 1;
+  }
+  FILE* f = std::fopen(argv[1], "rb");
+  if (!f) { std::perror("open"); return 1; }
+  std::fseek(f, 0, SEEK_END);
+  long flen = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> buf(flen);
+  if (std::fread(buf.data(), 1, flen, f) != static_cast<size_t>(flen)) {
+    std::fprintf(stderr, "short read\n");
+    return 1;
+  }
+  std::fclose(f);
+
+  uint32_t n = rd32(buf.data());
+  size_t pos = 4;
+  std::vector<Bitmap> bms;
+  bms.reserve(n);
+  uint64_t serialized_bytes = 0;
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t blen = rd32(buf.data() + pos);
+    pos += 4;
+    bms.push_back(parse(buf.data() + pos, blen));
+    pos += blen;
+    serialized_bytes += blen;
+  }
+
+  int reps = std::atoi(argv[2]);
+  std::string op = argc > 3 ? argv[3] : "wide_or";
+  Result res;
+
+  auto bench_wide = [&](const char* name, auto fn) {
+    fn(bms, res);  // warmup + parity value
+    uint64_t card = res.total_card;
+    double best = 1e30, total = 0;
+    for (int r = 0; r < reps; ++r) {
+      double t0 = now_ns();
+      fn(bms, res);
+      double dt = now_ns() - t0;
+      total += dt;
+      if (dt < best) best = dt;
+    }
+    std::printf(
+        "{\"op\": \"%s\", \"n_bitmaps\": %u, \"reps\": %d, "
+        "\"ns_per_op_avg\": %.0f, \"ns_per_op_best\": %.0f, "
+        "\"result_cardinality\": %llu, \"serialized_bytes\": %llu}\n",
+        name, n, reps, total / reps, best,
+        static_cast<unsigned long long>(card),
+        static_cast<unsigned long long>(serialized_bytes));
+  };
+  auto bench_pair = [&](const char* name, bool is_and) {
+    uint64_t card = pairwise_card(bms, is_and);
+    double best = 1e30, total = 0;
+    for (int r = 0; r < reps; ++r) {
+      double t0 = now_ns();
+      uint64_t c = pairwise_card(bms, is_and);
+      double dt = now_ns() - t0;
+      if (c != card) { std::fprintf(stderr, "parity drift\n"); std::exit(3); }
+      total += dt;
+      if (dt < best) best = dt;
+    }
+    std::printf(
+        "{\"op\": \"%s\", \"n_bitmaps\": %u, \"reps\": %d, "
+        "\"ns_per_op_avg\": %.0f, \"ns_per_op_best\": %.0f, "
+        "\"result_cardinality\": %llu, \"serialized_bytes\": %llu}\n",
+        name, n, reps, total / reps, best,
+        static_cast<unsigned long long>(card),
+        static_cast<unsigned long long>(serialized_bytes));
+  };
+
+  if (op == "wide_or" || op == "all") bench_wide("wide_or", wide_or);
+  if (op == "wide_xor" || op == "all") bench_wide("wide_xor", wide_xor);
+  if (op == "wide_and" || op == "all") bench_wide("wide_and", wide_and);
+  if (op == "pairwise_and" || op == "all") bench_pair("pairwise_and", true);
+  if (op == "pairwise_or" || op == "all") bench_pair("pairwise_or", false);
+  return 0;
+}

@@ -36,12 +36,11 @@ def _densify(rb: RoaringBitmap, keys: np.ndarray) -> np.ndarray:
     """Dense [K, 2048] image of rb over the index's key set.  Containers
     under keys outside the set are dropped (a found_set may cover rows the
     index never stored; see DeviceBSI.compare for the NEQ remainder)."""
-    out = np.zeros((keys.size, packing.WORDS32), dtype=np.uint32)
     idx = np.searchsorted(keys, rb.keys)
-    for row, key, c in zip(idx, rb.keys, rb.containers):
-        if row < keys.size and keys[row] == key:
-            out[row] = packing.container_words_u32(c)
-    return out
+    hit = idx < keys.size
+    hit[hit] = keys[idx[hit]] == rb.keys[hit]
+    conts = [c for c, h in zip(rb.containers, hit) if h]
+    return packing.densify_containers(conts, idx[hit], keys.size)
 
 
 def oneil_scan(slices, ebm, bits):
@@ -175,7 +174,13 @@ class DeviceBSI:
         decision = minmax_decision(op, start_or_value, end,
                                    self.min_value, self.max_value)
         if decision is not None:
-            return self._pruned(decision, found_set).cardinality
+            if decision == "empty":
+                return 0
+            if found_set is None:
+                return self._ebm_host.cardinality
+            from ..core.bitmap import and_cardinality
+
+            return and_cardinality(self._ebm_host, found_set)
         if op is Operation.NEQ and found_set is not None:
             # needs the host-side stray-key remainder; see compare()
             return self.compare(op, start_or_value, end, found_set).cardinality

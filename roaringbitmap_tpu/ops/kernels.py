@@ -30,6 +30,11 @@ from . import dense
 WORDS32 = 2048
 _SUB, _LANE = 16, 128  # 16*128 = 2048 u32 words = 2^16 bits
 
+#: Ceiling on the scalar-prefetch array length (seg_ids / blk_seg) for the
+#: segmented kernels: the whole array is prefetched into SMEM, so callers
+#: must fall back to the XLA doubling engine past this many entries.
+SMEM_PREFETCH_MAX = 1 << 17
+
 
 def _use_interpret() -> bool:
     return jax.default_backend() not in ("tpu",)

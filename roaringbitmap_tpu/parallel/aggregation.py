@@ -45,7 +45,13 @@ def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
         return (out_cls or RoaringBitmap)()
     if len(bitmaps) == 1:
         return _materialize(bitmaps[0])
-    if _engine(engine) == "pallas":
+    use_blocked = (
+        _engine(engine) == "pallas"
+        # block count is computable from key counts alone — check the SMEM
+        # ceiling BEFORE densifying the blocked tensor
+        and packing.blocked_block_count(bitmaps, BLOCK)
+        <= kernels.SMEM_PREFETCH_MAX)
+    if use_blocked:
         blocked = packing.pack_blocked(bitmaps, BLOCK)
         heads, cards = kernels.segmented_reduce_pallas_blocked(
             op, jnp.asarray(blocked.words), jnp.asarray(blocked.blk_seg),
@@ -62,7 +68,7 @@ def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
 def _run_ragged(op: str, packed: packing.PackedAggregation, engine: str):
     if _engine(engine) == "pallas":
         # row-per-step kernel: the seg_ids scalar prefetch must fit SMEM
-        if packed.words.shape[0] <= (1 << 17):
+        if packed.words.shape[0] <= kernels.SMEM_PREFETCH_MAX:
             return kernels.segmented_reduce_pallas(
                 op, jnp.asarray(packed.words), jnp.asarray(packed.seg_ids),
                 packed.num_keys)
@@ -197,7 +203,7 @@ class DeviceBitmapSet:
         must fit SMEM (same bound as _run_ragged); beyond it every entry
         point falls back to the doubling engine."""
         eng = _engine(engine)
-        if eng == "pallas" and int(self.blk_seg.size) > (1 << 17):
+        if eng == "pallas" and int(self.blk_seg.size) > kernels.SMEM_PREFETCH_MAX:
             eng = "xla"
         return eng
 
@@ -232,10 +238,11 @@ class DeviceBitmapSet:
         row 0 — idempotent for OR (row 0 belongs to segment 0, and OR-ing a
         segment's own union back in changes nothing), but a true data
         dependency, so neither XLA nor the runtime can elide or cache
-        repeated executions.  Returns the summed cardinality over all reps;
-        callers assert it equals reps * expected to prove every iteration
-        really ran bit-exact.  This is the measurement loop bench.py uses
-        (single dispatch, JMH-style steady state).
+        repeated executions.  Returns the summed cardinality over all reps
+        **modulo 2^32** (uint32 accumulator — overflow-free for any reps x
+        cardinality); callers assert it equals (reps * expected) % 2^32 to
+        prove every iteration really ran bit-exact.  This is the measurement
+        loop bench.py uses (single dispatch, JMH-style steady state).
         """
         eng = self._select_engine(engine)
         blk_seg, seg_ids, head_idx, n_keys, n_steps = (
@@ -251,10 +258,9 @@ class DeviceBitmapSet:
                 heads, cards = dense.segmented_reduce(
                     "or", words, seg_ids, head_idx, n_steps)
             words = words.at[0].set(heads[0])
-            return words, total + jnp.sum(cards)
+            return words, total + jnp.sum(cards.astype(jnp.uint32))
 
         def run(words):
-            # int32 accumulator: callers keep reps * cardinality < 2^31
-            return jax.lax.fori_loop(0, reps, body, (words, jnp.int32(0)))[1]
+            return jax.lax.fori_loop(0, reps, body, (words, jnp.uint32(0)))[1]
 
         return jax.jit(run)

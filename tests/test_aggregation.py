@@ -86,6 +86,28 @@ def test_single_immutable_input():
     assert aggregation.and_(imm) == rb
 
 
+def test_densify_trailing_empty_run_container():
+    """Empty run containers (incl. as the last scatter entry) must densify
+    to zero rows, not crash the batched run expansion."""
+    from roaringbitmap_tpu.core import containers as C
+    from roaringbitmap_tpu.ops import packing
+
+    conts = [
+        C.RunContainer(np.array([5, 2], dtype=np.uint16)),   # {5,6,7}
+        C.RunContainer(np.empty(0, dtype=np.uint16)),
+        C.ArrayContainer(np.array([1], dtype=np.uint16)),
+        C.RunContainer(np.empty(0, dtype=np.uint16)),        # trailing empty
+    ]
+    out = packing.densify_containers(conts, [0, 1, 2, 3], 4)
+    assert out[0].view(np.uint64)[0] == (1 << 5) | (1 << 6) | (1 << 7)
+    assert not out[1].any() and not out[3].any()
+    assert out[2].view(np.uint64)[0] == 2
+    # all-empty list of run containers
+    out2 = packing.densify_containers(
+        [C.RunContainer(np.empty(0, dtype=np.uint16))], [0], 1)
+    assert not out2.any()
+
+
 def test_xor_empty_container_dropped():
     a = RoaringBitmap.bitmap_of(5, 70000)
     b = RoaringBitmap.bitmap_of(5, 70001)

@@ -1,0 +1,198 @@
+"""Real-data benchmark matrix — the jmh/realdata analog.
+
+Sweeps dataset x op x engine, mirroring the reference's
+jmh/src/jmh/java/org/roaringbitmap/realdata/ matrix
+(RealDataBenchmarkWideOrNaive/Pq, ParallelAggregatorBenchmark, and the
+iterate/contains micro-benchmarks) plus simplebenchmark.java:70-76's
+successive-pairwise sweep:
+
+  datasets   census1881(_srt), uscensus2000, wikileaks-noquotes(_srt)
+  ops        wide_or, wide_and, wide_xor, pairwise_and, pairwise_or,
+             contains, iterate
+  engines    host        our NumPy container tier
+             device-xla  XLA doubling / regular reduce
+             device-pallas  fused Pallas kernels
+             cpu-cpp     baselines/cpu_baseline.json (C++ -O3, read-in)
+
+Device wide ops are timed two ways: end-to-end dispatch latency (includes
+the host->device RTT — ~90 ms through the axon tunnel) and, for wide_or,
+the chained steady-state marginal cost (see bench.py).  Cardinality parity
+against the host tier is asserted for every cell.
+
+Usage:
+  python benchmarks/realdata.py [--datasets ...] [--ops ...] [--reps N]
+Emits one JSON document on stdout (and a markdown table on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ALL_DATASETS = ("census1881", "census1881_srt", "uscensus2000",
+                "wikileaks-noquotes", "wikileaks-noquotes_srt")
+ALL_OPS = ("wide_or", "wide_and", "wide_xor", "pairwise_and", "pairwise_or",
+           "contains", "iterate")
+
+
+def _timeit(fn, reps: int) -> float:
+    fn()  # warmup / compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_dataset(name: str, ops: list[str], reps: int) -> dict:
+    import jax.numpy as jnp
+
+    from roaringbitmap_tpu.parallel import DeviceBitmapSet, aggregation
+    from roaringbitmap_tpu.parallel import fast_aggregation
+    from roaringbitmap_tpu.utils import datasets
+
+    bms = datasets.load_bitmaps(name)
+    out: dict = {"n_bitmaps": len(bms)}
+    cells: dict = {}
+    out["cells"] = cells
+
+    wide_host = {
+        "wide_or": lambda: fast_aggregation.or_(*bms),
+        "wide_and": lambda: fast_aggregation.and_(*bms),
+        "wide_xor": lambda: fast_aggregation.xor(*bms),
+    }
+    oracle = {op: fn().cardinality for op, fn in wide_host.items()
+              if op in ops}
+
+    t0 = time.perf_counter()
+    ds = DeviceBitmapSet(bms)
+    ds.words.block_until_ready()
+    out["pack_transfer_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    out["hbm_mb"] = round(ds.hbm_bytes() / 1e6, 2)
+
+    dev_op = {"wide_or": "or", "wide_and": "and", "wide_xor": "xor"}
+    for op in ops:
+        if op not in wide_host:
+            continue
+        cells[f"{op}/host"] = {
+            "ms": round(_timeit(wide_host[op], reps) * 1e3, 3)}
+        for eng_name, eng in (("device-xla", "xla"),
+                              ("device-pallas", "pallas")):
+            def run(eng=eng, op=op):
+                words, cards = ds.aggregate_device(dev_op[op], engine=eng)
+                total = int(np.asarray(jnp.sum(cards)))
+                assert total == oracle[op], (name, op, eng, total)
+            cells[f"{op}/{eng_name}"] = {
+                "ms": round(_timeit(run, reps) * 1e3, 3),
+                "note": "e2e incl. dispatch RTT"}
+    if "wide_or" in ops:
+        # steady-state marginal, bench.py methodology
+        for eng_name, eng in (("device-xla", "xla"),
+                              ("device-pallas", "pallas")):
+            r1, r2 = 50, 300
+            f1 = ds.chained_wide_or(r1, engine=eng)
+            f2 = ds.chained_wide_or(r2, engine=eng)
+            e1 = (r1 * oracle["wide_or"]) % 2**32
+            e2 = (r2 * oracle["wide_or"]) % 2**32
+            assert int(np.asarray(f1(ds.words))) == e1
+            assert int(np.asarray(f2(ds.words))) == e2
+            t1 = _timeit(lambda: np.asarray(f1(ds.words)), 2)
+            t2 = _timeit(lambda: np.asarray(f2(ds.words)), 2)
+            if t2 > t1:
+                cells[f"wide_or/{eng_name}-marginal"] = {
+                    "ms": round((t2 - t1) / (r2 - r1) * 1e3, 4),
+                    "note": "steady-state per-op"}
+
+    if "pairwise_and" in ops or "pairwise_or" in ops:
+        pairs = list(zip(bms[:-1], bms[1:]))
+        for op in ("pairwise_and", "pairwise_or"):
+            if op not in ops:
+                continue
+            kind = op.split("_")[1]
+            host_cards = [((a & b) if kind == "and" else (a | b)).cardinality
+                          for a, b in pairs]
+            cells[f"{op}/host"] = {"ms": round(_timeit(
+                lambda: [(a & b) if kind == "and" else (a | b)
+                         for a, b in pairs], reps) * 1e3, 3)}
+            for eng_name, eng in (("device-xla", "xla"),
+                                  ("device-pallas", "pallas")):
+                def run(eng=eng, kind=kind):
+                    cards = aggregation.pairwise_cardinality(
+                        kind, pairs, engine=eng)
+                    assert cards.tolist() == host_cards, (name, kind, eng)
+                cells[f"{op}/{eng_name}"] = {
+                    "ms": round(_timeit(run, reps) * 1e3, 3),
+                    "note": "incl. pack + dispatch"}
+
+    if "contains" in ops:
+        union = fast_aggregation.or_(*bms)
+        vals = union.to_array()
+        probes = vals[:: max(1, vals.size // 10000)]
+
+        def run_contains():
+            for v in probes[:1000]:
+                assert union.contains(int(v))
+        cells["contains/host"] = {
+            "us_per_op": round(_timeit(run_contains, reps) * 1e6 / 1000, 3)}
+
+    if "iterate" in ops:
+        cells["iterate/host"] = {
+            "ms": round(_timeit(
+                lambda: [b.to_array() for b in bms], reps) * 1e3, 3),
+            "note": "to_array all bitmaps"}
+    return out
+
+
+def merge_cpu_baseline(result: dict) -> None:
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "baselines", "cpu_baseline.json")
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        cpu = json.load(f)
+    for ds_name, rows in cpu.get("datasets", {}).items():
+        if ds_name not in result["datasets"]:
+            continue
+        cells = result["datasets"][ds_name]["cells"]
+        for op, row in rows.items():
+            cells[f"{op}/cpu-cpp"] = {
+                "ms": round(row["ns_per_op_avg"] / 1e6, 3)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--datasets", nargs="*", default=list(ALL_DATASETS))
+    ap.add_argument("--ops", nargs="*", default=list(ALL_OPS))
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    result = {"backend": jax.default_backend(), "datasets": {}}
+    for name in args.datasets:
+        print(f"[realdata] {name} ...", file=sys.stderr)
+        result["datasets"][name] = bench_dataset(name, args.ops, args.reps)
+    merge_cpu_baseline(result)
+
+    # markdown summary to stderr
+    for name, data in result["datasets"].items():
+        print(f"\n### {name}  ({data['n_bitmaps']} bitmaps, "
+              f"{data.get('hbm_mb', '?')} MB HBM)", file=sys.stderr)
+        for cell, v in sorted(data["cells"].items()):
+            ms = v.get("ms", v.get("us_per_op"))
+            unit = "ms" if "ms" in v else "us/op"
+            note = f"  ({v['note']})" if "note" in v else ""
+            print(f"  {cell:38s} {ms:>10} {unit}{note}", file=sys.stderr)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

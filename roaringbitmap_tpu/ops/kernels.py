@@ -150,9 +150,12 @@ def _pairwise_popcount_kernel(op):
     def kernel(a_ref, b_ref, out_ref, card_ref):
         r = op(a_ref[...], b_ref[...])
         out_ref[...] = r
+        # per-lane partial popcounts (block_k, 128): the sublane reduction
+        # happens here on the VPU; a (block_k, 1) output block would violate
+        # Mosaic's lane-dimension layout floor, so the final 128-lane sum is
+        # left to XLA (it is K*128 i32 — trivial)
         card_ref[...] = jnp.sum(
-            jax.lax.population_count(r).astype(jnp.int32), axis=(1, 2),
-            keepdims=False)[:, None]
+            jax.lax.population_count(r).astype(jnp.int32), axis=1)
 
     return kernel
 
@@ -185,12 +188,12 @@ def pairwise_popcount_pallas(op: str, a: jnp.ndarray, b: jnp.ndarray,
         ],
         out_specs=[
             pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
-            pl.BlockSpec((block_k, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_k, _LANE), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((kp, _SUB, _LANE), jnp.uint32),
-            jax.ShapeDtypeStruct((kp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((kp, _LANE), jnp.int32),
         ],
         interpret=_use_interpret(),
     )(a3, b3)
-    return out[:k].reshape(k, WORDS32), cards[:k, 0]
+    return out[:k].reshape(k, WORDS32), jnp.sum(cards[:k], axis=-1)

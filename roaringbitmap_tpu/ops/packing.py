@@ -175,6 +175,8 @@ class PackedBlocked:
     blk_seg: np.ndarray   # i32[Mb_pad/block]; padding blocks get segment K
     block: int
     n_blocks: int         # true block count
+    seg_sizes: np.ndarray    # i64[K] true rows per segment
+    seg_offsets: np.ndarray  # i64[K] first (padded) row of each segment
 
 
 def blocked_block_count(bitmaps: list[RoaringBitmap], block: int = 8) -> int:
@@ -207,7 +209,8 @@ def pack_blocked(bitmaps: list[RoaringBitmap], block: int = 8) -> PackedBlocked:
     blk_seg[:n_blocks] = np.repeat(np.arange(k, dtype=np.int32),
                                    (gp // block).astype(np.int64))
     return PackedBlocked(keys=keys, words=words, blk_seg=blk_seg,
-                         block=block, n_blocks=n_blocks)
+                         block=block, n_blocks=n_blocks,
+                         seg_sizes=g, seg_offsets=offs[:-1])
 
 
 @dataclass
@@ -220,12 +223,10 @@ class PackedIntersection:
     words: np.ndarray   # u32[K, N, 2048]
 
 
-def pack_for_intersection(bitmaps: list[RoaringBitmap]) -> PackedIntersection:
-    keys = bitmaps[0].keys
-    for b in bitmaps[1:]:
-        keys = np.intersect1d(keys, b.keys, assume_unique=True)
-        if keys.size == 0:
-            break
+def pack_for_intersection(bitmaps: list[RoaringBitmap],
+                          keys: np.ndarray) -> PackedIntersection:
+    """keys is the precomputed surviving key set (every bitmap must hold a
+    container for each — see parallel.aggregation._intersect_keys)."""
     n = len(bitmaps)
     conts, dest = [], []
     for j, b in enumerate(bitmaps):
@@ -250,6 +251,46 @@ def key_presence_masks(bitmaps: list[RoaringBitmap]) -> np.ndarray:
         k = b.keys.astype(np.int64)
         np.bitwise_or.at(masks[i], k >> 5, np.uint32(1) << (k & 31).astype(np.uint32))
     return masks
+
+
+@dataclass
+class PackedPairwise:
+    """P bitmap pairs aligned on per-pair key unions for the batched
+    pairwise kernels (ops.kernels.pairwise_popcount_pallas /
+    ops.dense.pairwise).  Zero rows are the identity for or/xor/andnot and
+    annihilate correctly for and, so one union alignment serves all ops."""
+
+    keys: np.ndarray      # [M] per-pair union keys, concatenated
+    a_words: np.ndarray   # u32[M, 2048]
+    b_words: np.ndarray   # u32[M, 2048]
+    heads: np.ndarray     # i64[P+1] row bounds of each pair's segment
+
+
+def pack_pairwise(pairs: list[tuple[RoaringBitmap, RoaringBitmap]]
+                  ) -> PackedPairwise:
+    """Align each pair's containers on its key union; one densify per side.
+
+    The batched-device form of the reference's per-pair key merge loop
+    (RoaringBitmap.or two-pointer skeleton, RoaringBitmap.java:864-894).
+    """
+    key_sets = [np.union1d(a.keys, b.keys) for a, b in pairs]
+    heads = np.concatenate(
+        ([0], np.cumsum([k.size for k in key_sets]))).astype(np.int64)
+    m = int(heads[-1])
+    a_conts, a_dest, b_conts, b_dest = [], [], [], []
+    for p, (a, b) in enumerate(pairs):
+        ku, base = key_sets[p], heads[p]
+        a_conts.extend(a.containers)
+        a_dest.extend(base + np.searchsorted(ku, a.keys))
+        b_conts.extend(b.containers)
+        b_dest.extend(base + np.searchsorted(ku, b.keys))
+    keys = (np.concatenate(key_sets) if key_sets
+            else np.empty(0, np.uint16))
+    return PackedPairwise(
+        keys=keys,
+        a_words=densify_containers(a_conts, a_dest, m),
+        b_words=densify_containers(b_conts, b_dest, m),
+        heads=heads)
 
 
 def unpack_result(keys: np.ndarray, words: np.ndarray,

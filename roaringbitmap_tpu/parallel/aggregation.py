@@ -87,9 +87,45 @@ def xor(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
     return _aggregate_ragged("xor", _flatten(bitmaps), engine)
 
 
+def _intersect_keys(bitmaps: list[RoaringBitmap]) -> np.ndarray:
+    """Surviving key set of a wide AND — workShyAnd's 65,536-bit key bitset
+    (FastAggregation.java:359-371), vectorized: AND-reduce the [N, 2048]
+    key presence masks, then extract set bits.  Runs on host: the masks are
+    host-built and 8 KiB each, so a device round trip would cost dispatch
+    latency to offload microseconds of work (the device twin,
+    ops.dense.key_mask_intersection, serves the sharded path where masks
+    are already device-resident).  The 64-bit tier (u64 high-48 keys) has
+    no fixed-size mask, so it keeps an intersect1d chain.
+    """
+    if bitmaps[0].keys.dtype != np.uint16:
+        keys = bitmaps[0].keys
+        for b in bitmaps[1:]:
+            keys = np.intersect1d(keys, b.keys, assume_unique=True)
+            if keys.size == 0:
+                break
+        return keys
+    masks = packing.key_presence_masks(bitmaps)
+    inter = np.bitwise_and.reduce(masks, axis=0)
+    bits = np.unpackbits(inter.view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.uint16)
+
+
+def _and_device_words(bitmaps: list[RoaringBitmap]):
+    """Shared wide-AND pipeline: key intersection -> regular [K, N, 2048]
+    pack -> device AND-reduce.  Returns (keys, words, cards) or None when
+    the intersection is provably empty."""
+    keys = _intersect_keys(bitmaps)
+    if keys.size == 0:
+        return None
+    packed = packing.pack_for_intersection(bitmaps, keys=keys)
+    words, cards = dense.regular_reduce_and(jnp.asarray(packed.words))
+    return packed.keys, words, cards
+
+
 def and_(*bitmaps: RoaringBitmap, engine: str = "auto",
          out_cls=None) -> RoaringBitmap:
-    """Wide intersection (FastAggregation.and workShyAnd :356)."""
+    """Wide intersection (FastAggregation.and workShyAnd :356): key-mask
+    intersection, then one regular [K, N, 2048] AND-reduce."""
     cls = out_cls or RoaringBitmap
     bitmaps = _flatten(bitmaps)
     if not bitmaps:
@@ -98,11 +134,11 @@ def and_(*bitmaps: RoaringBitmap, engine: str = "auto",
         return cls()
     if len(bitmaps) == 1:
         return _materialize(bitmaps[0])
-    packed = packing.pack_for_intersection(bitmaps)
-    if packed.keys.size == 0:
+    res = _and_device_words(bitmaps)
+    if res is None:
         return cls()
-    words, cards = dense.regular_reduce_and(jnp.asarray(packed.words))
-    return packing.unpack_result(packed.keys, np.asarray(words),
+    keys, words, cards = res
+    return packing.unpack_result(keys, np.asarray(words),
                                  np.asarray(cards), out_cls=cls)
 
 
@@ -120,11 +156,12 @@ def and_cardinality(*bitmaps: RoaringBitmap) -> int:
     bitmaps = _flatten(bitmaps)
     if not bitmaps or any(b.is_empty() for b in bitmaps):
         return 0
-    packed = packing.pack_for_intersection(bitmaps)
-    if packed.keys.size == 0:
+    if len(bitmaps) == 1:
+        return bitmaps[0].cardinality
+    res = _and_device_words(bitmaps)
+    if res is None:
         return 0
-    _, cards = dense.regular_reduce_and(jnp.asarray(packed.words))
-    return int(np.asarray(jnp.sum(cards)))
+    return int(np.asarray(jnp.sum(res[2])))
 
 
 def xor_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto") -> int:
@@ -146,6 +183,49 @@ def _flatten(bitmaps) -> list[RoaringBitmap]:
     if len(bitmaps) == 1 and not hasattr(bitmaps[0], "keys"):
         return list(bitmaps[0])
     return list(bitmaps)
+
+
+# ---------------------------------------------------------- batched pairwise
+
+def pairwise_device(op: str, pairs, engine: str = "auto"):
+    """Batched pairwise op on P bitmap pairs -> device (words, cards, packed).
+
+    One fused kernel over every pair's key-aligned containers — the
+    reference's per-pair container dispatch (Container.java:63-181,
+    BitmapContainer.or's branchless fused cardinality :1064-1085) done wide:
+    pallas engine = ops.kernels.pairwise_popcount_pallas (single HBM pass),
+    xla engine = ops.dense.pairwise.
+    """
+    packed = packing.pack_pairwise(list(pairs))
+    a = jnp.asarray(packed.a_words)
+    b = jnp.asarray(packed.b_words)
+    if packed.keys.size and _engine(engine) == "pallas":
+        words, cards = kernels.pairwise_popcount_pallas(op, a, b)
+    else:
+        words, cards = dense.pairwise(op, a, b)
+    return words, cards, packed
+
+
+def pairwise(op: str, pairs, engine: str = "auto",
+             out_cls=None) -> list[RoaringBitmap]:
+    """[a_i op b_i for each pair] with op in or/and/xor/andnot."""
+    words, cards, packed = pairwise_device(op, pairs, engine)
+    words = np.asarray(words)
+    cards = np.asarray(cards)
+    out = []
+    for p in range(packed.heads.size - 1):
+        lo, hi = int(packed.heads[p]), int(packed.heads[p + 1])
+        out.append(packing.unpack_result(
+            packed.keys[lo:hi], words[lo:hi], cards[lo:hi], out_cls=out_cls))
+    return out
+
+
+def pairwise_cardinality(op: str, pairs, engine: str = "auto") -> np.ndarray:
+    """i64[P] result cardinalities only (the andCardinality/orCardinality
+    fast path, batched — nothing but P scalars leaves the device path)."""
+    _, cards, packed = pairwise_device(op, pairs, engine)
+    csum = np.concatenate(([0], np.cumsum(np.asarray(cards, dtype=np.int64))))
+    return csum[packed.heads[1:]] - csum[packed.heads[:-1]]
 
 
 # ------------------------------------------------------------- 64-bit tier
@@ -210,18 +290,50 @@ class DeviceBitmapSet:
     def aggregate_device(self, op: str, engine: str = "auto"):
         """Run the wide op; returns device (words u32[K,2048], cards i32[K]).
 
-        op is "or" or "xor".  AND is rejected: the segment layout has no
-        rows for keys a bitmap lacks, so a segmented "and" would silently
-        ignore missing containers; use aggregation.and_ (workShy path).
+        or/xor: segmented reduce over the blocked layout.  and: only keys
+        present in EVERY bitmap can survive (workShyAnd's key intersection,
+        FastAggregation.java:356-380) — equivalently segments with exactly n
+        rows — so the payload is gathered from the resident blocked tensor
+        (no re-pack, no transfer) and AND-reduced as a regular block; other
+        keys get zero rows (a missing container annihilates the AND).
         """
+        if op == "and":
+            return self._and_device()
         if op not in ("or", "xor"):
-            raise ValueError(f"DeviceBitmapSet supports or/xor, not {op!r}; "
-                             "use aggregation.and_ for wide intersections")
+            raise ValueError(f"unsupported wide op {op!r}")
         if self._select_engine(engine) == "pallas":
             return kernels.segmented_reduce_pallas_blocked(
                 op, self.words, self.blk_seg, self.keys.size, BLOCK)
         return dense.segmented_reduce(
             op, self.words, self.seg_ids, self.head_idx, self.n_steps)
+
+    def _and_device(self):
+        k = self.keys.size
+        full = np.flatnonzero(self._packed.seg_sizes == self.n)
+        words = jnp.zeros((k, packing.WORDS32), jnp.uint32)
+        if full.size == 0:
+            return words, jnp.zeros((k,), jnp.int32)
+        rows = (self._packed.seg_offsets[full][:, None]
+                + np.arange(self.n)).ravel()
+        block = self.words[jnp.asarray(rows)].reshape(
+            full.size, self.n, packing.WORDS32)
+        sub_words, sub_cards = dense.regular_reduce_and(block)
+        idx = jnp.asarray(full)
+        return (words.at[idx].set(sub_words),
+                jnp.zeros((k,), jnp.int32).at[idx].set(sub_cards))
+
+    def aggregate_range_cardinality(self, op: str, start: int, stop: int,
+                                    engine: str = "auto") -> int:
+        """Cardinality of the wide aggregate within value range [start, stop)
+        — RoaringBitmap.rangeCardinality (RoaringBitmap.java:2668) applied to
+        the aggregate, fused on device via ops.dense.range_cardinality; only
+        one scalar returns to host."""
+        heads, _ = self.aggregate_device(op, engine)
+        key_base = self.keys.astype(np.int64) << 16
+        lo = jnp.asarray(np.clip(start - key_base, 0, 1 << 16)[:, None])
+        hi = jnp.asarray(np.clip(stop - key_base, 0, 1 << 16)[:, None])
+        return int(np.asarray(jnp.sum(
+            dense.range_cardinality(heads, lo, hi))))
 
     def aggregate(self, op: str, engine: str = "auto") -> RoaringBitmap:
         words, cards = self.aggregate_device(op, engine)

@@ -117,11 +117,50 @@ def test_xor_empty_container_dropped():
     assert got.container_count() == 1
 
 
-def test_device_set_rejects_and():
-    # regression: ragged segmented AND would silently ignore missing keys
-    ds = DeviceBitmapSet([RoaringBitmap.bitmap_of(1), RoaringBitmap.bitmap_of(0x10002)])
+def test_device_set_and(workload, oracles):
+    """Resident AND: gathered full segments, missing keys annihilate."""
+    ds = DeviceBitmapSet(workload)
+    assert ds.aggregate("and") == oracles["and"]
+    # disjoint key sets: segmented AND must NOT ignore missing containers
+    ds2 = DeviceBitmapSet(
+        [RoaringBitmap.bitmap_of(1), RoaringBitmap.bitmap_of(0x10002)])
+    assert ds2.aggregate("and").is_empty()
     with pytest.raises(ValueError):
-        ds.aggregate("and")
+        ds2.aggregate("andnot")
+
+
+def test_device_set_range_cardinality(workload, oracles):
+    ds = DeviceBitmapSet(workload)
+    union = oracles["or"]
+    for start, stop in [(0, 1 << 21), (1000, 250000), (65536, 65536 * 3 + 17)]:
+        want = int(np.count_nonzero(
+            (union.to_array() >= start) & (union.to_array() < stop)))
+        assert ds.aggregate_range_cardinality("or", start, stop) == want
+
+
+@pytest.mark.parametrize("engine", ["xla", "pallas"])
+@pytest.mark.parametrize("op", ["or", "and", "xor", "andnot"])
+def test_batched_pairwise(workload, op, engine):
+    from roaringbitmap_tpu.core.bitmap import and_ as h_and, andnot as h_andnot
+    from roaringbitmap_tpu.core.bitmap import or_ as h_or, xor as h_xor
+
+    host = {"or": h_or, "and": h_and, "xor": h_xor, "andnot": h_andnot}[op]
+    pairs = list(zip(workload[0::2], workload[1::2]))
+    got = aggregation.pairwise(op, pairs, engine=engine)
+    want = [host(a, b) for a, b in pairs]
+    assert got == want
+    cards = aggregation.pairwise_cardinality(op, pairs, engine=engine)
+    assert cards.tolist() == [w.cardinality for w in want]
+
+
+def test_batched_pairwise_empty_and_disjoint():
+    e = RoaringBitmap()
+    a = RoaringBitmap.bitmap_of(1, 2, 3)
+    b = RoaringBitmap.bitmap_of(0x20001)
+    got = aggregation.pairwise("or", [(e, e), (a, b)])
+    assert got[0].is_empty() and got[1] == (a | b)
+    cards = aggregation.pairwise_cardinality("and", [(e, e), (a, b)])
+    assert cards.tolist() == [0, 0]
 
 
 def test_chained_wide_or_parity(workload, oracles):

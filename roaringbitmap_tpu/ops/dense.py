@@ -99,17 +99,6 @@ def regular_reduce_and(words: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 
 @jax.jit
-def key_mask_intersection(masks: jnp.ndarray) -> jnp.ndarray:
-    """AND-reduce of u32[N, 2048] key-presence masks -> u32[2048].
-
-    Device form of Util.intersectArrayIntoBitmap over container keys
-    (Util.java:531, used by FastAggregation.java:364-371).
-    """
-    return jax.lax.reduce(masks, jnp.uint32(0xFFFFFFFF),
-                          jax.lax.bitwise_and, (0,))
-
-
-@jax.jit
 def range_cardinality(words: jnp.ndarray, start: jnp.ndarray,
                       stop: jnp.ndarray) -> jnp.ndarray:
     """Popcount of bits [start, stop) inside a u32[2048] container image.

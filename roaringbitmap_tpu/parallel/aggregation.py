@@ -90,12 +90,11 @@ def xor(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
 def _intersect_keys(bitmaps: list[RoaringBitmap]) -> np.ndarray:
     """Surviving key set of a wide AND — workShyAnd's 65,536-bit key bitset
     (FastAggregation.java:359-371), vectorized: AND-reduce the [N, 2048]
-    key presence masks, then extract set bits.  Runs on host: the masks are
-    host-built and 8 KiB each, so a device round trip would cost dispatch
-    latency to offload microseconds of work (the device twin,
-    ops.dense.key_mask_intersection, serves the sharded path where masks
-    are already device-resident).  The 64-bit tier (u64 high-48 keys) has
-    no fixed-size mask, so it keeps an intersect1d chain.
+    key presence masks, then extract set bits.  Runs on host by design: the
+    masks are host-built and 8 KiB each, so a device round trip would cost
+    dispatch latency to offload microseconds of work.  The 64-bit tier
+    (u64 high-48 keys) has no fixed-size mask, so it keeps an intersect1d
+    chain.
     """
     if bitmaps[0].keys.dtype != np.uint16:
         keys = bitmaps[0].keys

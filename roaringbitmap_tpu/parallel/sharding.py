@@ -111,9 +111,74 @@ def shard_packed(mesh: Mesh, packed: packing.PackedAggregation,
 def wide_aggregate_sharded(mesh: Mesh, op: str,
                            bitmaps) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """End to end: pack, shard, reduce across the mesh. Returns (keys, words, cards)."""
+    if op == "and":
+        return wide_and_sharded(mesh, bitmaps)
     packed = packing.pack_for_aggregation(bitmaps)
     step = make_sharded_aggregator(mesh, op, packed.num_keys,
                                    dense.n_steps_for(packed.max_group))
     words_d, segs_d = shard_packed(mesh, packed)
     heads, cards = step(words_d, segs_d)
     return packed.keys, np.asarray(heads), np.asarray(cards)
+
+
+def _pad_to_multiple(arr: np.ndarray, multiple: int, fill,
+                     axis: int = 0) -> np.ndarray:
+    pad = -(-arr.shape[axis] // multiple) * multiple - arr.shape[axis]
+    if pad == 0:
+        return arr
+    shape = list(arr.shape)
+    shape[axis] = pad
+    return np.concatenate([arr, np.full(shape, fill, arr.dtype)], axis=axis)
+
+
+def make_sharded_and(mesh: Mesh,
+                     row_axis: str = "rows", lane_axis: str = "lanes"):
+    """Jitted SPMD wide-AND over a regular block u32[K, N_pad, 2048] with the
+    bitmap axis sharded over `row_axis` (padding bitmaps are all-ones, the
+    AND identity).  Local AND-reduce, then a ppermute AND butterfly — the
+    cross-chip form of workShyAnd's iand chain (FastAggregation.java:393-411)."""
+    axis_size = mesh.shape[row_axis]
+
+    def step(words):
+        local = jax.lax.reduce(words, jnp.uint32(0xFFFFFFFF),
+                               jax.lax.bitwise_and, (1,))
+        acc = _butterfly_combine("and", local, row_axis, axis_size)
+        cards = jnp.sum(jax.lax.population_count(acc).astype(jnp.int32),
+                        axis=-1)
+        cards = jax.lax.psum(cards, lane_axis)
+        return acc, cards
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(None, row_axis, lane_axis),),
+        out_specs=(P(None, lane_axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def wide_and_sharded(mesh: Mesh, bitmaps,
+                     row_axis: str = "rows", lane_axis: str = "lanes"
+                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sharded workShyAnd: host key-mask intersection (see
+    aggregation._intersect_keys — an 8 KiB AND-reduce never justifies a
+    device dispatch), then the bitmap-axis sharded AND butterfly.
+    Returns (keys, words, cards)."""
+    from .aggregation import _intersect_keys
+
+    if not bitmaps or any(b.is_empty() for b in bitmaps):
+        return (np.empty(0, np.uint16), np.zeros((0, WORDS32), np.uint32),
+                np.zeros((0,), np.int32))
+    keys = _intersect_keys(bitmaps)
+    if keys.size == 0:
+        return (keys, np.zeros((0, WORDS32), np.uint32),
+                np.zeros((0,), np.int32))
+    packed = packing.pack_for_intersection(bitmaps, keys=keys)
+    # padding bitmaps are all-ones, the AND identity
+    words = _pad_to_multiple(packed.words, mesh.shape[row_axis],
+                             np.uint32(0xFFFFFFFF), axis=1)
+    words_d = jax.device_put(
+        words, NamedSharding(mesh, P(None, row_axis, lane_axis)))
+    step = make_sharded_and(mesh, row_axis, lane_axis)
+    acc, cards = step(words_d)
+    return packed.keys, np.asarray(acc), np.asarray(cards)

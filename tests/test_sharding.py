@@ -46,8 +46,53 @@ def test_sharded_xor_matches_host(workload):
     assert got == acc
 
 
-def test_sharded_rejects_and():
+def test_ragged_aggregator_rejects_and():
+    # the ragged segmented path cannot AND (missing rows would be ignored);
+    # wide_aggregate_sharded routes "and" to the workShy two-stage path
     devs = np.array(jax.devices()).reshape(8, 1)
     mesh = Mesh(devs, ("rows", "lanes"))
     with pytest.raises(ValueError):
         sharding.make_sharded_aggregator(mesh, "and", 4, 2)
+
+
+@pytest.mark.parametrize("rows,lanes", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_and_matches_host(workload, rows, lanes):
+    acc = workload[0].clone()
+    for b in workload[1:]:
+        acc.iand(b)
+    devs = np.array(jax.devices()).reshape(rows, lanes)
+    mesh = Mesh(devs, ("rows", "lanes"))
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "and", workload)
+    got = packing.unpack_result(keys, words, cards)
+    assert got == acc
+
+
+def test_sharded_and_nonempty(workload):
+    base = RoaringBitmap.from_values(np.arange(0, 300000, 7, dtype=np.uint32))
+    bms = [base | b for b in workload[:6]]
+    acc = bms[0].clone()
+    for b in bms[1:]:
+        acc.iand(b)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("rows", "lanes"))
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "and", bms)
+    assert packing.unpack_result(keys, words, cards) == acc
+    assert acc.cardinality >= base.cardinality
+
+
+@pytest.mark.parametrize("op", ["or", "xor", "and"])
+def test_sharded_census1881_parity(op):
+    """Dataset-scale mesh parity (VERDICT r1 item 6)."""
+    if not datasets.has_dataset("census1881"):
+        pytest.skip("census1881 unavailable")
+    bms = datasets.load_bitmaps("census1881")
+    if op == "and":
+        oracle = bms[0].clone()
+        for b in bms[1:]:
+            oracle.iand(b)
+    else:
+        oracle = RoaringBitmap()
+        for b in bms:
+            (oracle.ior if op == "or" else oracle.ixor)(b)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("rows", "lanes"))
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, op, bms)
+    assert packing.unpack_result(keys, words, cards) == oracle

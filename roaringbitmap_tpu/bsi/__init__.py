@@ -8,5 +8,7 @@ algebra over the slices — the ideal fused TPU workload (BASELINE config #5).
 
 from .slice_index import Operation, RoaringBitmapSliceIndex
 from .device import DeviceBSI
+from .immutable import ImmutableBitSliceIndex
 
-__all__ = ["Operation", "RoaringBitmapSliceIndex", "DeviceBSI"]
+__all__ = ["Operation", "RoaringBitmapSliceIndex", "DeviceBSI",
+           "ImmutableBitSliceIndex"]

@@ -84,7 +84,9 @@ class DeviceBSI:
         self.max_value = bsi.max_value
         # the ebM's key set covers every slice (slices are subsets of ebM)
         self.depth = bsi.bit_count()
-        self._ebm_host = bsi.ebm.clone()  # for the pruning fast path
+        # pruning fast path; immutable-tier ebms have no clone()
+        self._ebm_host = (bsi.ebm.clone() if hasattr(bsi.ebm, "clone")
+                          else bsi.ebm.to_bitmap())
         self.keys, self.ebm, self.slices = _pack_index(bsi.ebm, bsi.slices)
 
     def hbm_bytes(self) -> int:

@@ -1,0 +1,106 @@
+"""Immutable (memory-mappable) BSI tier — the bsi/buffer analog.
+
+Capability parity with the reference's buffer BSI
+(bsi/src/main/java/org/roaringbitmap/bsi/buffer/BitSliceIndexBase.java and
+ImmutableBitSliceIndex.java:181): attach to a serialized bit-sliced index
+without materializing it — the header is parsed once, the existence bitmap
+and every slice stay as zero-copy `buffer.ImmutableRoaringBitmap` views
+whose containers decode lazily — and run the full read-only query surface
+(compare / sum / topK / get_value / transpose / in_values).
+
+Design note: the reference re-implements the whole query engine a second
+time against ByteBuffers (BitSliceIndexBase, 641 LoC).  Here the host query
+engine is already duck-typed over `.keys`/`.containers`, so the immutable
+tier IS `RoaringBitmapSliceIndex` with buffer-backed bitmap storage and
+mutation disabled — one engine, two storage tiers, like the core bitmap's
+buffer package (roaringbitmap_tpu.buffer).
+
+The byte format is `serialize_buffer`'s fixed-width layout
+(RoaringBitmapSliceIndex.serialize(ByteBuffer), bsi/.../RoaringBitmapSliceIndex.java:239-252):
+i32-BE minValue, i32-BE maxValue, u8 runOptimized, ebM portable stream,
+i32-BE bitDepth, slice portable streams.
+"""
+
+from __future__ import annotations
+
+import mmap
+import struct
+
+from ..buffer.immutable import ImmutableRoaringBitmap
+from ..format import spec
+from .slice_index import RoaringBitmapSliceIndex
+
+
+class ImmutableBitSliceIndex(RoaringBitmapSliceIndex):
+    """Read-only BSI over a serialized buffer (ImmutableBitSliceIndex.java)."""
+
+    def __init__(self, buf: bytes | memoryview):
+        mv = memoryview(buf)
+        if len(mv) < 9:
+            raise spec.InvalidRoaringFormat("truncated BSI header")
+        mn, mx = struct.unpack_from(">ii", mv, 0)
+        # do NOT call super().__init__ (it allocates mutable slices);
+        # initialize the same attributes with buffer-backed views instead
+        self.min_value, self.max_value = mn, mx
+        self.run_optimized = mv[8] == 1
+        pos = 9
+        self.ebm, pos = _wrap_bitmap(mv, pos)
+        if pos + 4 > len(mv):
+            raise spec.InvalidRoaringFormat("truncated BSI bit depth")
+        (depth,) = struct.unpack_from(">i", mv, pos)
+        pos += 4
+        if depth < 0 or depth > 64:
+            raise spec.InvalidRoaringFormat(f"bad BSI bit depth {depth}")
+        self.slices = []
+        for _ in range(depth):
+            s, pos = _wrap_bitmap(mv, pos)
+            self.slices.append(s)
+        self._mv = mv  # keep the backing buffer alive
+
+    @staticmethod
+    def mapped(path: str) -> "ImmutableBitSliceIndex":
+        """mmap a file produced by serialize_buffer (the MemoryMapping
+        example's usage, examples/.../ImmutableRoaringBitmapExample)."""
+        with open(path, "rb") as f:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        return ImmutableBitSliceIndex(memoryview(mm))
+
+    def to_mutable(self) -> RoaringBitmapSliceIndex:
+        """Materialize a heap-mutable copy (MutableBitSliceIndex pairing)."""
+        out = RoaringBitmapSliceIndex(self.min_value, self.max_value)
+        out.run_optimized = self.run_optimized
+        out.ebm = self.ebm.to_bitmap()
+        out.slices = [s.to_bitmap() for s in self.slices]
+        return out
+
+    def clone(self) -> RoaringBitmapSliceIndex:
+        return self.to_mutable()
+
+    # ------------------------------------------------------- mutation guards
+    def _immutable(self, name: str):
+        raise TypeError(f"ImmutableBitSliceIndex is read-only ({name}); "
+                        "use to_mutable() first")
+
+    def set_value(self, column_id: int, value: int) -> None:
+        self._immutable("set_value")
+
+    def set_values(self, pairs) -> None:
+        self._immutable("set_values")
+
+    def add(self, other) -> None:
+        self._immutable("add")
+
+    def merge(self, other) -> None:
+        self._immutable("merge")
+
+    def merge_overwrite(self, other) -> None:
+        self._immutable("merge_overwrite")
+
+    def run_optimize(self) -> None:
+        self._immutable("run_optimize")
+
+
+def _wrap_bitmap(mv: memoryview, pos: int) -> tuple[ImmutableRoaringBitmap, int]:
+    """Zero-copy wrap of one embedded portable bitmap stream."""
+    imm = ImmutableRoaringBitmap(mv[pos:])
+    return imm, pos + imm.serialized_size_in_bytes()

@@ -385,8 +385,10 @@ class RoaringBitmapSliceIndex:
         decision = minmax_decision(op, start, end, self.min_value,
                                    self.max_value)
         if decision == "all":
-            return (self.ebm.clone() if found_set is None
-                    else rb_and(self.ebm, found_set))
+            if found_set is not None:
+                return rb_and(self.ebm, found_set)
+            return (self.ebm.clone() if hasattr(self.ebm, "clone")
+                    else self.ebm.to_bitmap())  # immutable tier has no clone
         if decision == "empty":
             return RoaringBitmap()
         return None

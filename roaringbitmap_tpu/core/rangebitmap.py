@@ -14,12 +14,21 @@ slice scan (shared with the bsi module) on host, or fused on device via
 ``DeviceRangeBitmap`` (bsi.device) where thresholds are passed as bit arrays
 so full u64 ranges stay exact.
 
-The byte layout differs from the reference's (theirs interleaves its
-internal container stream; it is a Java-implementation detail, not part of
-RoaringFormatSpec).  Ours keeps the 0xF00D cookie and the mappable property:
-slice payloads are standard 32-bit RoaringFormatSpec streams located by an
-offset table, so `map()` only parses headers and wraps payload slices
-zero-copy (SerializedView).
+Serialization is byte-compatible with the reference layout
+(RangeBitmap.java:65-85 `map` and Appender.serialize :1483-1510):
+
+  u16 cookie 0xF00D | u8 base=2 | u8 sliceCount | u16 maxKey | u32 maxRid
+  maxKey * ceil(sliceCount/8) bytes of per-chunk slice-presence masks (LE)
+  container records, per chunk in key order, per present slice ascending:
+    u8 type (0=BITMAP,1=RUN,2=ARRAY)
+    BITMAP: u16 cardinality (mod 2^16) + 1024 u64 words
+    RUN:    u16 nbrRuns + (start u16, length-1 u16) pairs
+    ARRAY:  u16 cardinality + cardinality u16 values
+
+The appender stores the COMPLEMENT encoding (`~value & rangeMask`,
+Appender.add :1514): slice i's container holds rows whose value has bit i
+CLEAR.  Internally we keep direct slices (bit set), so serialize/map
+complement within each 2^16-row chunk on the way through.
 """
 
 from __future__ import annotations
@@ -34,6 +43,82 @@ from .bitmap import RoaringBitmap, and_ as rb_and, andnot as rb_andnot, \
 from ..format import spec
 
 COOKIE = 0xF00D  # RangeBitmap.java:25
+_T_BITMAP, _T_RUN, _T_ARRAY = 0, 1, 2  # RangeBitmap.java:26-28
+
+
+def _record_kind(slice_i: int, card: int, n_runs: int) -> int:
+    """The container type the Java appender would emit.
+
+    Slices < 5 live as BitmapContainers in the appender (containerForSlice
+    :1608-1613) whose runOptimize only converts to RUN when the run form
+    beats 8192 bytes (BitmapContainer.java:1218-1225) — it NEVER downgrades
+    to array.  Slices >= 5 live as RunContainers whose toEfficientContainer
+    (RunContainer.java:2326-2335) picks run on <= ties, else array/bitmap by
+    cardinality.
+    """
+    run_sz = 2 + 4 * n_runs
+    if slice_i < 5:
+        return _T_RUN if run_sz < 8192 else _T_BITMAP
+    if run_sz <= min(8192, 2 * card + 2):
+        return _T_RUN
+    return _T_ARRAY if card <= C.ARRAY_MAX_SIZE else _T_BITMAP
+
+
+def _emit_record(out: bytearray, c: C.Container, slice_i: int) -> None:
+    """One typed container record (Appender.append :1545-1580)."""
+    if isinstance(c, C.RunContainer):
+        card, n_runs = c.cardinality, c.n_runs
+    else:
+        card, n_runs = c.cardinality, C.number_of_runs(c.values())
+    kind = _record_kind(slice_i, card, n_runs)
+    if kind == _T_RUN:
+        runs = c.runs if isinstance(c, C.RunContainer) \
+            else C.values_to_runs(c.values())
+        out.append(_T_RUN)
+        out += struct.pack("<H", runs.size // 2)
+        out += runs.astype("<u2").tobytes()
+    elif kind == _T_BITMAP:
+        out.append(_T_BITMAP)
+        out += struct.pack("<H", card & 0xFFFF)  # char cast, :1565
+        out += c.words().astype("<u8").tobytes()
+    else:
+        out.append(_T_ARRAY)
+        out += struct.pack("<H", card)
+        out += c.values().astype("<u2").tobytes()
+
+
+def _rows_container(chunk_rows: int) -> C.Container:
+    """All appended rows of a chunk as one run — the constant the full/empty
+    fast paths need without an 8 KiB word round trip."""
+    if chunk_rows == 1 << 16:
+        return C.full_container()
+    return C.RunContainer(np.array([0, chunk_rows - 1], dtype=np.uint16))
+
+
+def _read_record(mv: memoryview, pos: int) -> tuple[C.Container, int]:
+    ctype = mv[pos]
+    pos += 1
+    if ctype == _T_BITMAP:
+        if len(mv) < pos + 2 + 8192:
+            raise spec.InvalidRoaringFormat("truncated bitmap record")
+        words = np.frombuffer(mv[pos + 2:pos + 2 + 8192],
+                              dtype="<u8").astype(np.uint64)
+        return C.BitmapContainer(words), pos + 2 + 8192
+    if ctype == _T_RUN:
+        (n_runs,) = struct.unpack_from("<H", mv, pos)
+        end = pos + 2 + 4 * n_runs
+        if len(mv) < end:
+            raise spec.InvalidRoaringFormat("truncated run record")
+        runs = np.frombuffer(mv[pos + 2:end], dtype="<u2").astype(np.uint16)
+        return C.RunContainer(runs), end
+    if ctype == _T_ARRAY:
+        (card,) = struct.unpack_from("<H", mv, pos)
+        end = pos + 2 + 2 * card
+        if len(mv) < end:
+            raise spec.InvalidRoaringFormat("truncated array record")
+        vals = np.frombuffer(mv[pos + 2:end], dtype="<u2").astype(np.uint16)
+        return C.ArrayContainer(vals), end
+    raise spec.InvalidRoaringFormat(f"unknown container type {ctype}")
 
 
 def _range_mask_bits(max_value: int) -> int:
@@ -51,6 +136,7 @@ class RangeBitmap:
         self._slices = slices
         self._rows = row_count
         self._max = max_value
+        self._serialized_cache: bytes | None = None
 
     # ----------------------------------------------------------------- build
     @staticmethod
@@ -165,48 +251,112 @@ class RangeBitmap:
         return self.between(min_value, max_value, context).cardinality
 
     # ------------------------------------------------------------------- I/O
+    def _chunk_container(self, slice_i: int, key: int) -> C.Container | None:
+        """Direct-encoding container of slice i at chunk `key`, or None."""
+        s = self._slices[slice_i]
+        idx = int(np.searchsorted(s.keys, np.uint16(key)))
+        if idx < s.keys.size and s.keys[idx] == key:
+            return s.containers[idx]
+        return None
+
     def serialize(self) -> bytes:
-        """Mappable layout: header (cookie 0xF00D, slice count, row count,
-        max value), u32-LE slice-payload offset table, then each slice as a
-        standard 32-bit RoaringFormatSpec stream."""
-        payloads = [s.serialize() for s in self._slices]
-        n = len(payloads)
-        out = bytearray(struct.pack("<IHHQQ", COOKIE, 1, n, self._rows,
-                                    self._max))
-        base = len(out) + 4 * n
-        off = 0
-        for p in payloads:
-            out += struct.pack("<I", base + off)
-            off += len(p)
-        for p in payloads:
-            out += p
-        return bytes(out)
+        """Reference-compatible stream (Appender.serialize :1483-1510).
+        Cached: the index is immutable, and the reference's documented
+        size-then-serialize calling pattern must not pay the encoding pass
+        twice."""
+        if self._serialized_cache is None:
+            self._serialized_cache = self._serialize_impl()
+        return self._serialized_cache
+
+    def _serialize_impl(self) -> bytes:
+        depth = len(self._slices)
+        bytes_per_mask = (depth + 7) >> 3
+        n_keys = -(-self._rows // (1 << 16))
+        if self._rows >= 1 << 32 or n_keys > 0xFFFF:
+            raise ValueError("RangeBitmap supports at most 2^32-1 rows")
+        out = bytearray(struct.pack("<HBBHI", COOKIE, 2, depth, n_keys,
+                                    self._rows))
+        masks = bytearray()
+        records = bytearray()
+        for key in range(n_keys):
+            chunk_rows = min(self._rows - (key << 16), 1 << 16)
+            keep = (C.values_to_words(np.arange(chunk_rows, dtype=np.uint16))
+                    if chunk_rows < 1 << 16 else None)
+            mask_bits = 0
+            for i in range(depth):
+                direct = self._chunk_container(i, key)
+                # complement within the appended rows of this chunk
+                # (Appender.add stores ~value bits, :1514)
+                if direct is None:
+                    comp = _rows_container(chunk_rows)  # all rows, one run
+                else:
+                    comp_words = ~direct.words()
+                    if keep is not None:
+                        comp_words = comp_words & keep
+                    comp = C.from_words(comp_words)
+                    if comp.cardinality == 0:
+                        continue
+                mask_bits |= 1 << i
+                _emit_record(records, comp, i)
+            masks += mask_bits.to_bytes(bytes_per_mask, "little")
+        return bytes(out + masks + records)
 
     def serialized_size_in_bytes(self) -> int:
-        return (24 + 4 * len(self._slices)
-                + sum(s.serialized_size_in_bytes() for s in self._slices))
+        if self._serialized_cache is None:
+            self._serialized_cache = self.serialize()
+        return len(self._serialized_cache)
 
     @staticmethod
     def map(buf: bytes | memoryview) -> "RangeBitmap":
-        """Zero-copy attach to a serialized RangeBitmap (map :65-85)."""
+        """Attach to a serialized RangeBitmap (map :65-85).  Accepts any
+        stream the reference's Appender produces and answers queries
+        bit-exactly; complement containers are decoded back into direct
+        slices."""
         mv = memoryview(buf)
-        if len(mv) < 24:
+        if len(mv) < 10:
             raise spec.InvalidRoaringFormat("truncated RangeBitmap header")
-        cookie, version, n, rows, max_value = struct.unpack_from("<IHHQQ", mv, 0)
+        cookie, base, depth, n_keys, rows = struct.unpack_from("<HBBHI", mv, 0)
         if cookie != COOKIE:
             raise spec.InvalidRoaringFormat(
                 f"invalid RangeBitmap cookie {cookie:#x}")
-        if version != 1:
-            raise spec.InvalidRoaringFormat(f"unknown RangeBitmap version {version}")
-        if len(mv) < 24 + 4 * n:
-            raise spec.InvalidRoaringFormat("truncated RangeBitmap offsets")
-        offsets = np.frombuffer(mv[24:24 + 4 * n], dtype="<u4")
-        slices = []
-        for i in range(n):
-            view = spec.SerializedView(mv[int(offsets[i]):])
-            conts = [view.container(j) for j in range(view.size)]
-            slices.append(RoaringBitmap(view.keys.copy(), conts))
-        return RangeBitmap(slices, rows, max_value)
+        if base != 2:
+            raise spec.InvalidRoaringFormat(
+                f"unsupported RangeBitmap base {base}")
+        bytes_per_mask = (depth + 7) >> 3
+        pos = 10
+        if len(mv) < pos + n_keys * bytes_per_mask:
+            raise spec.InvalidRoaringFormat("truncated RangeBitmap masks")
+        chunk_masks = [
+            int.from_bytes(mv[pos + k * bytes_per_mask:
+                              pos + (k + 1) * bytes_per_mask], "little")
+            for k in range(n_keys)]
+        pos += n_keys * bytes_per_mask
+        slice_keys: list[list[int]] = [[] for _ in range(depth)]
+        slice_conts: list[list[C.Container]] = [[] for _ in range(depth)]
+        for key in range(n_keys):
+            chunk_rows = min(rows - (key << 16), 1 << 16)
+            keep = None
+            if chunk_rows < 1 << 16:
+                keep = C.values_to_words(np.arange(chunk_rows, dtype=np.uint16))
+            for i in range(depth):
+                if (chunk_masks[key] >> i) & 1:
+                    comp, pos = _read_record(mv, pos)
+                    direct_words = ~comp.words()
+                    if keep is not None:
+                        direct_words = direct_words & keep
+                    direct = C.from_words(direct_words)
+                    if direct.cardinality == 0:
+                        continue
+                else:
+                    # empty complement: every appended row has bit i set
+                    direct = _rows_container(chunk_rows)
+                slice_keys[i].append(key)
+                slice_conts[i].append(direct)
+        slices = [
+            RoaringBitmap(np.array(slice_keys[i], dtype=np.uint16),
+                          slice_conts[i])
+            for i in range(depth)]
+        return RangeBitmap(slices, rows, (1 << depth) - 1)
 
     # ------------------------------------------------------------- internals
     @property
@@ -273,8 +423,8 @@ class Appender:
 
     def serialized_size_in_bytes(self) -> int:
         self._flush()
-        return (24 + 4 * len(self._slices)
-                + sum(s.serialized_size_in_bytes() for s in self._slices))
+        return RangeBitmap(self._slices, self._rows,
+                           self.max_value).serialized_size_in_bytes()
 
     def serialize(self) -> bytes:
         """Serialize without materializing a RangeBitmap first (:1483)."""

@@ -201,6 +201,83 @@ class TestHostBSI:
         assert back == bsi
 
 
+class TestImmutableBSI:
+    """bsi/buffer tier (ImmutableBitSliceIndex.java:181, BitSliceIndexBase):
+    attach to serialized bytes, full read-only query surface, no full parse."""
+
+    @pytest.fixture(scope="class")
+    def imm(self, bsi):
+        from roaringbitmap_tpu.bsi import ImmutableBitSliceIndex
+
+        return ImmutableBitSliceIndex(bsi.serialize_buffer())
+
+    @pytest.mark.parametrize("op", ALL_OPS)
+    def test_compare_parity(self, data, bsi, imm, op):
+        cols, vals = data
+        for q in (0.25, 0.75):
+            pred = int(np.quantile(vals, q))
+            assert imm.compare(op, pred, pred + 50) == \
+                bsi.compare(op, pred, pred + 50), (op, pred)
+
+    def test_sum_topk_getvalue(self, data, bsi, imm):
+        cols, vals = data
+        assert imm.sum() == bsi.sum()
+        assert imm.top_k(137) == bsi.top_k(137)
+        for c in cols[:20]:
+            assert imm.get_value(int(c)) == bsi.get_value(int(c))
+        fs = RoaringBitmap.from_values(cols[::7])
+        assert imm.sum(fs) == bsi.sum(fs)
+        assert imm.compare(Operation.LE, int(np.median(vals)), 0, fs) == \
+            bsi.compare(Operation.LE, int(np.median(vals)), 0, fs)
+
+    def test_minmax_pruned_paths(self, bsi, imm):
+        assert imm.compare(Operation.LT, bsi.max_value + 10) == \
+            bsi.compare(Operation.LT, bsi.max_value + 10)
+        assert imm.compare(Operation.GT, -5).cardinality == \
+            bsi.get_existence_bitmap().cardinality
+
+    def test_mutation_rejected(self, imm):
+        with pytest.raises(TypeError):
+            imm.set_value(1, 2)
+        with pytest.raises(TypeError):
+            imm.merge(imm)
+        with pytest.raises(TypeError):
+            imm.run_optimize()
+
+    def test_to_mutable_roundtrip(self, bsi, imm):
+        mut = imm.to_mutable()
+        assert mut == bsi
+        mut.set_value(0xFFFFFF, 7)  # mutable copy mutates fine
+        assert mut.get_value(0xFFFFFF) == (7, True)
+
+    def test_mmap_file(self, bsi, tmp_path_factory):
+        from roaringbitmap_tpu.bsi import ImmutableBitSliceIndex
+
+        path = tmp_path_factory.mktemp("bsi") / "index.bsi"
+        path.write_bytes(bsi.serialize_buffer())
+        imm = ImmutableBitSliceIndex.mapped(str(path))
+        pred = (bsi.min_value + bsi.max_value) // 2
+        assert imm.compare(Operation.GE, pred) == \
+            bsi.compare(Operation.GE, pred)
+        assert imm.sum() == bsi.sum()
+
+    def test_device_from_immutable(self, data, bsi, imm):
+        """mmap -> HBM: DeviceBSI accepts the immutable tier directly."""
+        dev = DeviceBSI(imm)
+        pred = int(np.median(data[1]))
+        assert dev.compare(Operation.LT, pred) == \
+            bsi.compare(Operation.LT, pred)
+
+    def test_truncated_rejected(self, bsi):
+        from roaringbitmap_tpu.bsi import ImmutableBitSliceIndex
+        from roaringbitmap_tpu.format.spec import InvalidRoaringFormat
+
+        data = bsi.serialize_buffer()
+        for cut in (4, 12, len(data) // 2):
+            with pytest.raises(InvalidRoaringFormat):
+                ImmutableBitSliceIndex(data[:cut])
+
+
 class TestDeviceBSI:
     @pytest.fixture(scope="class")
     def dev(self, bsi):

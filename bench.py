@@ -1,28 +1,32 @@
-"""Benchmark: wide-OR aggregation throughput on census1881 (driver metric).
+"""Benchmark: wide-OR aggregation throughput on the driver-metric datasets.
 
 Measures the north-star workload from BASELINE.json: FastAggregation/
-ParallelAggregation-style wide OR over the census1881 real-roaring-dataset
-(200 bitmaps), executed on device from HBM-resident packed containers, with
-exact cardinality asserted every run.
+ParallelAggregation-style wide OR over BOTH named real-roaring datasets
+(census1881 AND wikileaks-noquotes, 200 bitmaps each), executed on device
+from HBM-resident packed containers, with exact cardinality asserted every
+run.  The headline metric stays census1881 (driver continuity); the
+wikileaks numbers ride in detail so one artifact evidences the full target.
 
 Methodology
 - CPU baseline: baselines/cpu_baseline.json — the C++ -O3 translation of the
   JVM ParallelAggregation.or algorithm (no JVM exists in this image; see
   baselines/wide_or_cpu.cpp).  Falls back to this host's Python fold only if
   the file is missing, and labels the result accordingly.
-- Device steady state: the TPU here sits behind a network tunnel, so a
-  single dispatch costs ~90 ms RTT.  We therefore run two chained-rep
-  programs (R1 and R2 dependent wide-ORs inside one jit) and report the
-  *marginal* cost (t2 - t1) / (R2 - R1): pure on-device per-op time with
-  dispatch/sync amortized out — the same quantity the CPU ns/op measures.
-  Every chained program's summed cardinality is asserted == reps * expected,
-  proving each iteration really ran bit-exact.
-- Cold path: pack (host rotation+densify) + transfer + first dispatch are
-  timed and reported separately; steady state assumes HBM residency (the
+- Device steady state: a single dispatch to the tunneled TPU carries ~ms RTT,
+  so we run two chained-rep programs (R1 and R2 dependent wide-ORs inside
+  one jit) and report the *marginal* cost (t2 - t1) / (R2 - R1): pure
+  on-device per-op time with dispatch/sync amortized out — the same quantity
+  the CPU ns/op measures.  Every chained program's summed cardinality is
+  asserted == (reps * expected) mod 2^32, proving each iteration ran
+  bit-exact.
+- Cold path: pack (host stream build + transfer + device densify) and the
+  first dispatch are timed separately AFTER a device warm-up, so pack_ms is
+  the steady-state ingest cost, not the one-time runtime handshake (which is
+  reported as warmup_ms).  Steady state assumes HBM residency (the
   ImmutableRoaringBitmap stays-mmap'd usage, README.md:198-274).
 
 --profile writes a jax.profiler trace (the JMH -prof analog) to
-  /tmp/rb_tpu_trace and reports per-engine device ms from it.
+  /tmp/rb_tpu_trace and reports per-kernel device-time totals parsed from it.
 
 Prints ONE JSON line with metric/value/unit/vs_baseline + detail.
 """
@@ -37,18 +41,18 @@ import time
 
 import numpy as np
 
-
 R1, R2 = 100, 1100  # chained rep counts; marginal = (t2-t1)/(R2-R1)
+BENCH_DATASETS = ("census1881", "wikileaks-noquotes")
 
 
-def load_cpu_baseline() -> tuple[float | None, dict]:
+def load_cpu_baseline(dataset: str) -> tuple[float | None, dict]:
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "baselines", "cpu_baseline.json")
     if not os.path.exists(path):
         return None, {}
     with open(path) as f:
         data = json.load(f)
-    row = data.get("datasets", {}).get("census1881", {}).get("wide_or")
+    row = data.get("datasets", {}).get(dataset, {}).get("wide_or")
     if not row:
         return None, {}
     return row["ns_per_op_avg"] / 1e9, {
@@ -59,21 +63,16 @@ def load_cpu_baseline() -> tuple[float | None, dict]:
     }
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--profile", action="store_true",
-                    help="capture a jax.profiler trace of the measured runs")
-    args = ap.parse_args()
-
+def bench_dataset(name: str, profile: bool) -> dict:
     import jax
 
     from roaringbitmap_tpu import RoaringBitmap
     from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
     from roaringbitmap_tpu.utils import datasets
 
-    if datasets.has_dataset("census1881"):
-        arrs = datasets.load_value_arrays("census1881")
-        dataset = "census1881"
+    if datasets.has_dataset(name):
+        arrs = datasets.load_value_arrays(name)
+        dataset = name
     else:
         dataset = "synthetic"
         rng = np.random.default_rng(0)
@@ -82,11 +81,10 @@ def main() -> None:
 
     bitmaps = [RoaringBitmap.from_values(a) for a in arrs]
     oracle_card = int(np.unique(np.concatenate(arrs)).size)
-    backend = jax.default_backend()
 
-    # ---- CPU baseline (census-specific; never applied to the synthetic
+    # ---- CPU baseline (dataset-specific; never applied to the synthetic
     # fallback workload)
-    cpu_s, cpu_info = (load_cpu_baseline() if dataset == "census1881"
+    cpu_s, cpu_info = (load_cpu_baseline(dataset) if dataset != "synthetic"
                        else (None, {}))
     if cpu_s is None:
         t0 = time.perf_counter()
@@ -101,22 +99,45 @@ def main() -> None:
         assert cpu_info.pop("cpu_result_cardinality") == oracle_card, \
             "C++ baseline cardinality drift"
 
-    # ---- cold path: pack + transfer + first aggregation, end to end
+    # ---- cold path: first build compiles the densify program (one-time per
+    # shape — reported apart), then pack_ms is the steady-state ingest cost
     t0 = time.perf_counter()
     ds = DeviceBitmapSet(bitmaps)
-    t_pack = time.perf_counter() - t0
+    if ds.words is not None:
+        ds.words.block_until_ready()
+    t_compile = time.perf_counter() - t0
     words0, cards0 = ds.aggregate_device("or", engine="xla")
     total0 = int(np.asarray(cards0.sum()))
     t_cold = time.perf_counter() - t0
     assert total0 == oracle_card, "device parity failure (single shot)"
 
+    def timed_pack(inputs) -> float:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            d = DeviceBitmapSet(inputs)
+            d.words.block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_pack = timed_pack(bitmaps)
+
+    # byte-path ingest throughput (serialized blobs -> HBM, no Container
+    # objects): the stream->HBM capability VERDICT r2 item 3 names
+    blobs = [b.serialize() for b in bitmaps]
+    ser_bytes = sum(len(x) for x in blobs)
+    t_pack_bytes = timed_pack(blobs)
+    ds_bytes = DeviceBitmapSet(blobs)
+    _, c_b = ds_bytes.aggregate_device("or", engine="xla")
+    assert int(np.asarray(c_b.sum())) == oracle_card, "byte-path parity"
+    del ds_bytes
+
     # ---- steady state per engine: marginal chained cost
     r1, r2 = R1, R2
 
     def chained_seconds(engine: str, reps: int) -> float:
-        """Best-of-3 timed runs of one compiled chained program (the RTT to
-        the tunneled TPU adds ~10 ms of per-dispatch noise; min is the
-        noise-robust estimator)."""
+        """Best-of-3 timed runs of one compiled chained program (tunnel RTT
+        adds per-dispatch noise; min is the noise-robust estimator)."""
         expected = (reps * oracle_card) % 2**32  # uint32 accumulator
         fn = ds.chained_wide_or(reps, engine=engine)
         best = float("inf")
@@ -140,36 +161,99 @@ def main() -> None:
         raise RuntimeError(
             f"unstable timing for engine {engine}: t({r2}) <= t({r1})")
 
-    with (jax.profiler.trace("/tmp/rb_tpu_trace") if args.profile
+    with (jax.profiler.trace("/tmp/rb_tpu_trace") if profile
           else contextlib.nullcontext()):
         per_engine = {eng: marginal(eng) for eng in ("xla", "pallas")}
 
     engine = min(per_engine, key=lambda e: per_engine[e][0])
     dev_s, e2e_s = per_engine[engine]
 
-    ops_per_sec = 1.0 / dev_s
-    out = {
-        "metric": f"wide_or_{dataset}_aggregations_per_sec",
-        "value": round(ops_per_sec, 3),
-        "unit": "wide-OR/s (200 bitmaps, card-exact, steady-state marginal)",
+    return {
+        "dataset": dataset,
+        "ops_per_sec": round(1.0 / dev_s, 3),
         "vs_baseline": round(cpu_s / dev_s, 3),
+        "engine": engine,
+        "block": ds.block,
+        "marginal_us_per_wide_or": {
+            k: round(v[0] * 1e6, 2) for k, v in per_engine.items()},
+        "e2e_us_per_wide_or_with_dispatch": {
+            k: round(v[1] * 1e6, 2) for k, v in per_engine.items()},
+        "n_bitmaps": len(bitmaps), "result_cardinality": oracle_card,
+        "pack_ms": round(t_pack * 1e3, 2),
+        "pack_from_serialized_bytes_ms": round(t_pack_bytes * 1e3, 2),
+        "serialized_mb": round(ser_bytes / 1e6, 2),
+        "ingest_compile_ms_one_time": round(t_compile * 1e3, 2),
+        "cold_pack_transfer_first_query_ms": round(t_cold * 1e3, 2),
+        "cpu_wide_or_ms": round(cpu_s * 1e3, 4),
+        "cpu_baseline": cpu_info,
+        "hbm_resident_mb": round(ds.hbm_bytes() / 1e6, 1),
+        "chained_reps": [r1, r2],
+    }
+
+
+def parse_profile_trace(trace_dir: str) -> dict:
+    """Per-kernel device-time totals (us) from the latest trace.xplane.pb —
+    the jmh -prof analog promised by --profile."""
+    try:
+        import glob
+        import gzip
+
+        paths = sorted(glob.glob(
+            os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+        if not paths:
+            return {"error": "no trace.json.gz found"}
+        with gzip.open(paths[-1], "rt") as f:
+            events = json.load(f).get("traceEvents", [])
+        totals: dict[str, float] = {}
+        for ev in events:
+            if ev.get("ph") == "X" and "dur" in ev:
+                name = ev.get("name", "?")
+                totals[name] = totals.get(name, 0.0) + ev["dur"]
+        top = sorted(totals.items(), key=lambda kv: -kv[1])[:12]
+        return {k: round(v, 1) for k, v in top}
+    except Exception as e:  # pragma: no cover
+        return {"error": f"trace parse failed: {e}"}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", action="store_true",
+                    help="capture a jax.profiler trace of the measured runs")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    # runtime warm-up: first transfer/compile carries the axon handshake
+    # (~600 ms) — real, but one-time per process, so report it apart
+    t0 = time.perf_counter()
+    jnp.square(jax.device_put(np.ones(8, np.float32))).block_until_ready()
+    warmup_ms = (time.perf_counter() - t0) * 1e3
+
+    results = {name: bench_dataset(name, args.profile)
+               for name in BENCH_DATASETS}
+
+    head = results[BENCH_DATASETS[0]]
+    out = {
+        "metric": f"wide_or_{head['dataset']}_aggregations_per_sec",
+        "value": head["ops_per_sec"],
+        "unit": "wide-OR/s (200 bitmaps, card-exact, steady-state marginal)",
+        "vs_baseline": head["vs_baseline"],
         "detail": {
-            "backend": backend, "engine": engine,
-            "marginal_us_per_wide_or": {
-                k: round(v[0] * 1e6, 2) for k, v in per_engine.items()},
-            "e2e_us_per_wide_or_with_dispatch": {
-                k: round(v[1] * 1e6, 2) for k, v in per_engine.items()},
-            "n_bitmaps": len(bitmaps), "result_cardinality": oracle_card,
-            "pack_ms": round(t_pack * 1e3, 2),
-            "cold_pack_transfer_first_query_ms": round(t_cold * 1e3, 2),
-            "cpu_wide_or_ms": round(cpu_s * 1e3, 4),
-            "cpu_baseline": cpu_info,
-            "hbm_resident_mb": round(ds.hbm_bytes() / 1e6, 1),
-            "chained_reps": [r1, r2],
+            "backend": jax.default_backend(),
+            "warmup_ms": round(warmup_ms, 1),
+            **{k: v for k, v in head.items() if k != "dataset"},
+            "wikileaks-noquotes": results.get("wikileaks-noquotes"),
+            "north_star": {
+                name: {"vs_baseline": r["vs_baseline"],
+                       "target": 10.0, "met": r["vs_baseline"] >= 10.0}
+                for name, r in results.items()},
         },
     }
     if args.profile:
         out["detail"]["profile_trace_dir"] = "/tmp/rb_tpu_trace"
+        out["detail"]["profile_kernel_us"] = parse_profile_trace(
+            "/tmp/rb_tpu_trace")
     print(json.dumps(out))
 
 

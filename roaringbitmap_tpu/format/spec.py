@@ -18,8 +18,10 @@ Container kind on read is derived, not stored: run bit wins; otherwise
 cardinality > 4096 means bitmap (RoaringArray.java:305-312).
 
 This stream is both the checkpoint format and the host<->device wire format:
-deserialize_meta() exposes zero-copy views into the byte buffer so device
-packing never materializes per-container Python objects.
+deserialize_meta() / SerializedView expose zero-copy views into the byte
+buffer, and ops.packing.pack_blocked_compact ingests those views straight
+into device transfer streams — device packing never materializes
+per-container Python objects.
 """
 
 from __future__ import annotations
@@ -199,6 +201,13 @@ class SerializedView:
         if self.size == 0:
             return 8
         return int(self.payload_offsets[-1] + self.payload_sizes[-1])
+
+
+def deserialize_meta(buf: bytes | memoryview) -> SerializedView:
+    """Zero-copy metadata parse: header arrays decoded, payload left in
+    place.  The ingest seam for device packing (and the ctor the buffer
+    package's ImmutableRoaringBitmap wraps)."""
+    return SerializedView(buf)
 
 
 def deserialize(buf: bytes | memoryview) -> tuple[np.ndarray, list[Container]]:

@@ -118,3 +118,41 @@ def range_cardinality(words: jnp.ndarray, start: jnp.ndarray,
 
 def n_steps_for(max_group: int) -> int:
     return max(1, int(max(1, max_group - 1)).bit_length())
+
+
+def densify_streams_impl(dense_words, dense_dest, values, val_counts, val_dest,
+                         n_rows: int, total_values: int) -> jnp.ndarray:
+    """Build the dense u32[n_rows, 2048] container image from compact streams
+    (ops.packing.CompactStreams) on device.
+
+    Sparse containers arrive as raw u16 member values; each value contributes
+    one bit at flat position row*2048 + (v>>5).  A scatter-ADD is exact here:
+    (row, word, bit) triples are unique (values are unique within a container
+    and containers own distinct rows), so sums never carry across bits.  This
+    replaces the host-side packbits scatter of densify_containers for device
+    ingest — the host ships ~serialized-size bytes instead of 8 KB per
+    container (the ImmutableRoaringArray zero-copy ingest seam,
+    buffer/ImmutableRoaringArray.java:166-194, rebuilt device-side).
+
+    One scratch row (index n_rows) absorbs sentinel-padded stream entries.
+    Traceable (no jit here) so callers can inline it inside larger programs.
+    """
+    flat = jnp.zeros(((n_rows + 1) * WORDS32,), jnp.uint32)
+    if total_values:
+        rows = jnp.repeat(val_dest.astype(jnp.int32), val_counts,
+                          total_repeat_length=total_values)
+        v = values.astype(jnp.int32)
+        g = rows * WORDS32 + (v >> 5)
+        bits = jnp.uint32(1) << (v & 31).astype(jnp.uint32)
+        flat = flat.at[g].add(bits, unique_indices=False)
+    out = flat.reshape(n_rows + 1, WORDS32)
+    if dense_words.shape[0]:
+        out = out.at[dense_dest.astype(jnp.int32)].set(dense_words)
+    return out[:n_rows]
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows", "total_values"))
+def densify_streams(dense_words, dense_dest, values, val_counts, val_dest,
+                    n_rows: int, total_values: int) -> jnp.ndarray:
+    return densify_streams_impl(dense_words, dense_dest, values, val_counts,
+                                val_dest, n_rows, total_values)

@@ -116,7 +116,7 @@ def _seg_reduce_blocked_kernel(op, block):
 def segmented_reduce_pallas_blocked(
         op: str, words: jnp.ndarray, blk_seg: jnp.ndarray,
         num_segments: int, block: int) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Blocked ragged reduce over segment-padded rows (ops.packing.pack_blocked).
+    """Blocked ragged reduce over segment-padded rows (ops.packing.pack_blocked_compact).
 
     Each grid step reduces `block` same-segment rows in VMEM before touching
     the accumulator — cutting grid steps (and their fixed overhead) by

@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..core.bitmap import RoaringBitmap
-from ..core.containers import WORDS_PER_CONTAINER
+from ..core.containers import ARRAY_MAX_SIZE, WORDS_PER_CONTAINER
 
 WORDS32 = 2 * WORDS_PER_CONTAINER  # 2048 u32 words per container
 
@@ -164,53 +164,229 @@ def pack_for_aggregation(bitmaps: list[RoaringBitmap],
         max_group=int(seg_sizes.max()) if keys.size else 0)
 
 
-@dataclass
-class PackedBlocked:
-    """Segment-padded layout for the blocked Pallas reduce: every segment's
-    rows are padded with zero rows (the OR/XOR identity) to a multiple of
-    `block`, so each grid step reduces `block` same-segment rows in VMEM."""
-
-    keys: np.ndarray      # [K] distinct keys, sorted
-    words: np.ndarray     # u32[Mb_pad, 2048]
-    blk_seg: np.ndarray   # i32[Mb_pad/block]; padding blocks get segment K
-    block: int
-    n_blocks: int         # true block count
-    seg_sizes: np.ndarray    # i64[K] true rows per segment
-    seg_offsets: np.ndarray  # i64[K] first (padded) row of each segment
-
-
-def blocked_block_count(bitmaps: list[RoaringBitmap], block: int = 8) -> int:
-    """Block count pack_blocked would produce — cheap (key counts only), so
-    engine selection can test the SMEM ceiling before densifying anything."""
-    flat_keys = np.concatenate([b.keys for b in bitmaps])
+def blocked_block_count(bitmaps: list, block: int = 8) -> int:
+    """Block count pack_blocked_compact would produce — cheap (key counts
+    only), so engine selection can test the SMEM ceiling before building
+    any stream."""
+    flat_keys = np.concatenate([_keys_of(b) for b in bitmaps])
     _, counts = np.unique(flat_keys, return_counts=True)
     return int((-(-counts // block)).sum())
 
 
-def pack_blocked(bitmaps: list[RoaringBitmap], block: int = 8) -> PackedBlocked:
-    """Group-by-key rotation with per-segment zero padding (OR/XOR only)."""
-    flat_keys = np.concatenate([b.keys for b in bitmaps])
+# ------------------------------------------------------- stream (byte) ingest
+#
+# The buffer package's real capability (SURVEY §2.2): aggregate straight off
+# the serialized layout without materializing per-container heap objects
+# (buffer/ImmutableRoaringArray.java:166-194, BufferFastAggregation.java:187).
+# Here the serialized stream splits into two transfer-minimal device streams:
+#   - dense containers (bitmap + large-run) ship their 8 KB wire image as-is,
+#   - sparse containers (array + small-run) ship raw u16 member values.
+# The dense [rows, 2048] image is then built ON DEVICE by ops.dense.
+# densify_streams (scatter-add of per-value bit contributions — collision-free
+# because (row, word, bit) triples are unique), so host packing never touches
+# an 8 KB row for sparse data and the host->HBM transfer is ~serialized size.
+
+#: Run containers above this cardinality ship as dense wire images instead of
+#: expanded value streams (break-even: 4096 u16 values = one 8 KB dense row).
+RUN_DENSIFY_THRESHOLD = ARRAY_MAX_SIZE
+
+
+@dataclass
+class CompactStreams:
+    """Transfer-minimal ingest form of a rotated container batch."""
+
+    n_rows: int               # dense image row count (excluding scratch row)
+    dense_words: np.ndarray   # u32[Md, 2048] wire images (bitmap / big-run)
+    dense_dest: np.ndarray    # i32[Md] destination rows
+    values: np.ndarray        # u16[V] concat member values (array / small-run)
+    val_counts: np.ndarray    # i32[Mv] values per sparse container
+    val_dest: np.ndarray      # i32[Mv] destination row per sparse container
+
+    @property
+    def total_values(self) -> int:
+        return int(self.values.size)
+
+    def transfer_bytes(self) -> int:
+        return (self.dense_words.nbytes + self.dense_dest.nbytes
+                + self.values.nbytes + self.val_counts.nbytes
+                + self.val_dest.nbytes)
+
+
+def _keys_of(b) -> np.ndarray:
+    """Container key array of any bitmap-like input (object, immutable view,
+    or raw serialized bytes) without materializing containers."""
+    v = _as_view(b)
+    return b.keys if v is None else v.keys
+
+
+def _as_view(b):
+    """SerializedView of ``b`` when it is byte-backed, else None."""
+    from ..format import spec
+
+    if isinstance(b, (bytes, bytearray, memoryview)):
+        return spec.SerializedView(b)
+    if isinstance(b, spec.SerializedView):
+        return b
+    view = getattr(b, "_view", None)
+    if isinstance(view, spec.SerializedView):
+        return view
+    return None
+
+
+def _emit_container_streams(sources: list, order: np.ndarray, dest: np.ndarray,
+                            n_rows: int) -> CompactStreams:
+    """Classify every container of the rotated batch into the dense / sparse
+    stream, in ``order`` (rows sorted by segment), destinations ``dest``."""
+    from ..core import containers as C
+
+    # flat (source index, container index) in input order
+    sizes = [ _keys_of(s).size for s in sources ]
+    src_of = np.repeat(np.arange(len(sources)), sizes)
+    idx_in_src = np.concatenate([np.arange(k) for k in sizes]) if sizes \
+        else np.empty(0, np.int64)
+
+    dense_rows: list[int] = []
+    dense_words: list[np.ndarray] = []
+    pieces: list[np.ndarray] = []       # sparse per-container value arrays
+    val_dest: list[int] = []
+    views = [_as_view(s) for s in sources]
+    for pos, row in zip(order, np.asarray(dest, dtype=np.int64)):
+        s, i = int(src_of[pos]), int(idx_in_src[pos])
+        view = views[s]
+        if view is not None:
+            payload = view.container_payload(i)
+            if view.is_bitmap[i]:
+                dense_rows.append(row)
+                dense_words.append(np.frombuffer(payload, dtype="<u4"))
+                continue
+            if view.is_run[i]:
+                nruns = int(np.frombuffer(payload[:2], dtype="<u2")[0])
+                runs = np.frombuffer(payload[2:2 + 4 * nruns], dtype="<u2")
+                vals = C.runs_to_values(runs.astype(np.uint16))
+            else:
+                vals = np.frombuffer(payload, dtype="<u2")
+        else:
+            c = sources[s].containers[i]
+            if isinstance(c, C.BitmapContainer):
+                dense_rows.append(row)
+                dense_words.append(c.words().view(np.uint32))
+                continue
+            vals = c.values() if not isinstance(c, C.RunContainer) \
+                else C.runs_to_values(c.runs)
+        if vals.size > RUN_DENSIFY_THRESHOLD:
+            # dense is the smaller wire form past 4096 values
+            dense_rows.append(row)
+            dense_words.append(C.values_to_words(vals).view(np.uint32))
+        elif vals.size:
+            pieces.append(vals)
+            val_dest.append(row)
+    values = (np.ascontiguousarray(np.concatenate(pieces)).astype(np.uint16)
+              if pieces else np.empty(0, np.uint16))
+    return CompactStreams(
+        n_rows=n_rows,
+        dense_words=(np.stack(dense_words).astype(np.uint32) if dense_words
+                     else np.empty((0, WORDS32), np.uint32)),
+        dense_dest=np.asarray(dense_rows, dtype=np.int32),
+        values=values,
+        val_counts=np.array([p.size for p in pieces], dtype=np.int32),
+        val_dest=np.asarray(val_dest, dtype=np.int32))
+
+
+def pad_streams_pow2(s: CompactStreams) -> CompactStreams:
+    """Pad stream array lengths to powers of two so ad-hoc call sites stop
+    recompiling once the workload shape stabilizes (same role as pack_for_
+    aggregation's pow2 row padding).  Padding is absorbed by the densify
+    scratch row (index n_rows): padded values carry value 0 under a sentinel
+    count entry destined for the scratch row; padded dense rows are zero rows
+    also destined there."""
+    v, mv, md = s.values.size, s.val_counts.size, s.dense_words.shape[0]
+    vpad, mvpad, mdpad = next_pow2(v), next_pow2(mv + 1), next_pow2(md)
+    values = np.zeros(vpad, np.uint16)
+    values[:v] = s.values
+    val_counts = np.zeros(mvpad, np.int32)
+    val_counts[:mv] = s.val_counts
+    val_counts[mv] = vpad - v  # sentinel soaks up the value padding
+    val_dest = np.full(mvpad, s.n_rows, np.int32)
+    val_dest[:mv] = s.val_dest
+    dense_words = np.zeros((mdpad, WORDS32), np.uint32)
+    dense_words[:md] = s.dense_words
+    dense_dest = np.full(mdpad, s.n_rows, np.int32)
+    dense_dest[:md] = s.dense_dest
+    return CompactStreams(n_rows=s.n_rows, dense_words=dense_words,
+                          dense_dest=dense_dest, values=values,
+                          val_counts=val_counts, val_dest=val_dest)
+
+
+@dataclass
+class PackedBlockedCompact:
+    """Blocked-layout metadata + compact transfer streams (no host densify)."""
+
+    keys: np.ndarray         # [K] distinct keys, sorted
+    blk_seg: np.ndarray      # i32[n_rows/block]; padding blocks get segment K
+    block: int
+    n_blocks: int            # true block count
+    seg_sizes: np.ndarray    # i64[K] true rows per segment
+    seg_offsets: np.ndarray  # i64[K] first (padded) row of each segment
+    streams: CompactStreams
+    carry_row: int           # a padding row of segment 0 (loop-carry slot)
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.blk_seg.size) * self.block
+
+
+def choose_block(seg_sizes: np.ndarray) -> int:
+    """Per-set Pallas block size: larger blocks amortize grid-step overhead
+    (measured ~3x faster at 16-32 vs 8 on census1881) but pad every segment
+    to a block multiple, so small segments stay at 8."""
+    if seg_sizes.size == 0:
+        return 8
+    return 16 if float(np.median(seg_sizes)) >= 16 else 8
+
+
+def pack_blocked_compact(sources: list, block: int | None = None,
+                         round_blocks: int = 8,
+                         carry_slot: bool = True) -> PackedBlockedCompact:
+    """Group-by-key rotation emitting compact streams instead of a host-built
+    dense tensor.  ``sources`` may mix RoaringBitmaps, ImmutableRoaringBitmaps,
+    SerializedViews, and raw serialized bytes.
+
+    carry_slot guarantees segment 0 has at least one zero padding row, used by
+    DeviceBitmapSet.chained_wide_or as the loop-carried write-back slot.
+    round_blocks pads the block count to a multiple (NOT pow2 — a resident set
+    compiles for one shape, so tight padding wins back HBM).
+    """
+    # parse byte-backed sources ONCE; _as_view is idempotent on views
+    sources = [v if (v := _as_view(s)) is not None else s for s in sources]
+    all_keys = [_keys_of(s) for s in sources]
+    flat_keys = (np.concatenate(all_keys) if all_keys
+                 else np.empty(0, np.uint16))
     order = np.argsort(flat_keys, kind="stable")
     keys, seg_of_row = np.unique(flat_keys, return_inverse=True)
     m, k = flat_keys.size, keys.size
     seg_sorted = seg_of_row[order]
     head = np.searchsorted(seg_sorted, np.arange(k)).astype(np.int64)
     g = np.diff(np.append(head, m))
+    if block is None:
+        block = choose_block(g)
     gp = -(-g // block) * block
+    if carry_slot and k and gp[0] == g[0]:
+        gp[0] += block  # ensure a spare zero row in segment 0
     offs = np.concatenate(([0], np.cumsum(gp)))
     n_blocks = int(offs[-1]) // block
-    nb_pad = next_pow2(n_blocks)
+    nb_pad = -(-n_blocks // round_blocks) * round_blocks
     within = np.arange(m) - head[seg_sorted]
     dest = offs[seg_sorted] + within
-    conts = [c for b in bitmaps for c in b.containers]
-    words = densify_containers([conts[s] for s in order], dest,
-                               nb_pad * block)
+    streams = _emit_container_streams(sources, order, dest, nb_pad * block)
     blk_seg = np.full(nb_pad, k, dtype=np.int32)
     blk_seg[:n_blocks] = np.repeat(np.arange(k, dtype=np.int32),
                                    (gp // block).astype(np.int64))
-    return PackedBlocked(keys=keys, words=words, blk_seg=blk_seg,
-                         block=block, n_blocks=n_blocks,
-                         seg_sizes=g, seg_offsets=offs[:-1])
+    return PackedBlockedCompact(
+        keys=keys, blk_seg=blk_seg, block=block, n_blocks=n_blocks,
+        seg_sizes=g, seg_offsets=offs[:-1], streams=streams,
+        # without a reserved slot, g[0] may be a live row of segment 1 —
+        # poison the field instead of pointing consumers at foreign data
+        carry_row=int(g[0]) if (carry_slot and k) else -1)
 
 
 @dataclass

@@ -34,7 +34,9 @@ def _engine(engine: str) -> str:
     return engine
 
 
-#: Blocked-layout rows reduced per Pallas grid step (ops.packing.pack_blocked).
+#: Blocked-layout rows per Pallas grid step for ad-hoc (non-resident) calls
+#: (ops.packing.pack_blocked_compact); resident sets pick adaptively via
+#: packing.choose_block.
 BLOCK = 8
 
 
@@ -52,9 +54,20 @@ def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
         and packing.blocked_block_count(bitmaps, BLOCK)
         <= kernels.SMEM_PREFETCH_MAX)
     if use_blocked:
-        blocked = packing.pack_blocked(bitmaps, BLOCK)
+        # compact byte-stream ingest + on-device densify: the host ships
+        # ~serialized-size bytes, never 8 KB per sparse container.  Rounding
+        # the block count to a multiple of 64 (with pow2-padded streams)
+        # coarsens shapes so ad-hoc call sites recompile every 64 blocks at
+        # most — linear but coarse; resident sets avoid the issue entirely.
+        blocked = packing.pack_blocked_compact(
+            bitmaps, block=BLOCK, round_blocks=64, carry_slot=False)
+        s = packing.pad_streams_pow2(blocked.streams)
+        words = dense.densify_streams(
+            jnp.asarray(s.dense_words), jnp.asarray(s.dense_dest),
+            jnp.asarray(s.values), jnp.asarray(s.val_counts),
+            jnp.asarray(s.val_dest), blocked.n_rows, s.total_values)
         heads, cards = kernels.segmented_reduce_pallas_blocked(
-            op, jnp.asarray(blocked.words), jnp.asarray(blocked.blk_seg),
+            op, words, jnp.asarray(blocked.blk_seg),
             blocked.keys.size, BLOCK)
         keys = blocked.keys
     else:
@@ -257,25 +270,61 @@ class DeviceBitmapSet:
 
     The ImmutableRoaringBitmap-stays-mmap'd usage pattern (README.md:198-274)
     translated to HBM: pack once, aggregate many times without re-transfer.
+
+    Inputs may mix RoaringBitmaps, ImmutableRoaringBitmaps, SerializedViews,
+    and raw serialized bytes — byte-backed inputs are ingested straight off
+    the wire layout (ops.packing compact streams) without materializing
+    Container objects, and the dense image is built on device.
+
+    layout:
+      - "dense" (default): HBM holds the dense u32[rows, 2048] image —
+        fastest repeated queries (one kernel pass, no per-query densify).
+      - "compact": HBM holds only the compact streams (~serialized size);
+        every query densifies transiently on device before reducing.  Pays
+        roughly one extra zeros+scatter+read pass per query for a 5-30x
+        smaller resident footprint on sparse datasets (SURVEY datasets
+        average 6-600x dense blowup; see insights HBM accounting).
     """
 
-    def __init__(self, bitmaps: list[RoaringBitmap]):
+    def __init__(self, bitmaps: list, block: int | None = None,
+                 layout: str = "dense"):
+        if layout not in ("dense", "compact"):
+            raise ValueError(f"unknown layout {layout!r}")
         self.n = len(bitmaps)
+        self.layout = layout
         # Blocked layout serves BOTH engines: segment-padded zero rows are
         # the OR/XOR identity, so the layout is simultaneously a valid
         # ragged input for the XLA doubling pass and the Pallas blocked
         # kernel's native shape (and its per-block scalar array stays far
         # under the SMEM prefetch ceiling at any realistic scale).
-        self._packed = packing.pack_blocked(bitmaps, BLOCK)
+        self._packed = packing.pack_blocked_compact(bitmaps, block=block)
+        self.block = self._packed.block
         self.keys = self._packed.keys
-        self.words = jax.device_put(self._packed.words)
+        s = self._packed.streams
+        self._streams = tuple(jax.device_put(a) for a in (
+            s.dense_words, s.dense_dest, s.values, s.val_counts, s.val_dest))
+        self._n_rows, self._total_values = s.n_rows, s.total_values
+        if layout == "dense":
+            self.words = dense.densify_streams(
+                *self._streams, self._n_rows, self._total_values)
+            self._streams = None  # free the stream copies
+        else:
+            self.words = None
         self.blk_seg = jax.device_put(self._packed.blk_seg)
-        seg_rows = np.repeat(self._packed.blk_seg, BLOCK).astype(np.int32)
+        seg_rows = np.repeat(self._packed.blk_seg, self.block).astype(np.int32)
         self.seg_ids = jax.device_put(seg_rows)
         head = np.searchsorted(seg_rows, np.arange(self.keys.size))
         self.head_idx = jax.device_put(head.astype(np.int32))
-        seg_sizes = np.diff(np.append(head, self._packed.n_blocks * BLOCK))
+        seg_sizes = np.diff(np.append(head, self._packed.n_blocks * self.block))
         self.n_steps = dense.n_steps_for(int(seg_sizes.max()) if seg_sizes.size else 0)
+
+    def _resident_words(self):
+        """Dense image: resident (dense layout) or transient device densify
+        (compact layout)."""
+        if self.words is not None:
+            return self.words
+        return dense.densify_streams(
+            *self._streams, self._n_rows, self._total_values)
 
     def _select_engine(self, engine: str) -> str:
         """Engine choice with the SMEM guard: the per-block scalar prefetch
@@ -300,11 +349,12 @@ class DeviceBitmapSet:
             return self._and_device()
         if op not in ("or", "xor"):
             raise ValueError(f"unsupported wide op {op!r}")
+        words = self._resident_words()
         if self._select_engine(engine) == "pallas":
             return kernels.segmented_reduce_pallas_blocked(
-                op, self.words, self.blk_seg, self.keys.size, BLOCK)
+                op, words, self.blk_seg, self.keys.size, self.block)
         return dense.segmented_reduce(
-            op, self.words, self.seg_ids, self.head_idx, self.n_steps)
+            op, words, self.seg_ids, self.head_idx, self.n_steps)
 
     def _and_device(self):
         k = self.keys.size
@@ -314,7 +364,7 @@ class DeviceBitmapSet:
             return words, jnp.zeros((k,), jnp.int32)
         rows = (self._packed.seg_offsets[full][:, None]
                 + np.arange(self.n)).ravel()
-        block = self.words[jnp.asarray(rows)].reshape(
+        block = self._resident_words()[jnp.asarray(rows)].reshape(
             full.size, self.n, packing.WORDS32)
         sub_words, sub_cards = dense.regular_reduce_and(block)
         idx = jnp.asarray(full)
@@ -339,39 +389,74 @@ class DeviceBitmapSet:
         return packing.unpack_result(self.keys, np.asarray(words), np.asarray(cards))
 
     def hbm_bytes(self) -> int:
-        return int(self.words.nbytes + self.blk_seg.nbytes
-                   + self.seg_ids.nbytes + self.head_idx.nbytes)
+        meta = int(self.blk_seg.nbytes + self.seg_ids.nbytes
+                   + self.head_idx.nbytes)
+        if self.words is not None:
+            return int(self.words.nbytes) + meta
+        return sum(int(a.nbytes) for a in self._streams) + meta
 
     def chained_wide_or(self, reps: int, engine: str = "auto"):
         """Steady-state throughput probe: `reps` dependent wide-ORs in ONE jit.
 
-        Each iteration writes the union's first per-key row back into input
-        row 0 — idempotent for OR (row 0 belongs to segment 0, and OR-ing a
-        segment's own union back in changes nothing), but a true data
-        dependency, so neither XLA nor the runtime can elide or cache
-        repeated executions.  Returns the summed cardinality over all reps
-        **modulo 2^32** (uint32 accumulator — overflow-free for any reps x
-        cardinality); callers assert it equals (reps * expected) % 2^32 to
-        prove every iteration really ran bit-exact.  This is the measurement
-        loop bench.py uses (single dispatch, JMH-style steady state).
+        Each iteration writes the union's first per-key row back into a
+        segment-0 input row — idempotent for OR (OR-ing a segment's own union
+        back in changes nothing), but a true data dependency, so neither XLA
+        nor the runtime can elide, cache, or hoist repeated executions.  In
+        the compact layout the write-back targets the reserved zero padding
+        row of segment 0 (packing carry_row) via a loop-carried extra dense
+        stream entry, making the per-iteration densify itself loop-variant.
+        Returns the summed cardinality over all reps **modulo 2^32** (uint32
+        accumulator — overflow-free for any reps x cardinality); callers
+        assert it equals (reps * expected) % 2^32 to prove every iteration
+        really ran bit-exact.  This is the measurement loop bench.py uses
+        (single dispatch, JMH-style steady state).
         """
         eng = self._select_engine(engine)
-        blk_seg, seg_ids, head_idx, n_keys, n_steps = (
+        blk_seg, seg_ids, head_idx, n_keys, n_steps, block = (
             self.blk_seg, self.seg_ids, self.head_idx, self.keys.size,
-            self.n_steps)
+            self.n_steps, self.block)
 
-        def body(i, state):
-            words, total = state
+        def reduce_step(words):
             if eng == "pallas":
-                heads, cards = kernels.segmented_reduce_pallas_blocked(
-                    "or", words, blk_seg, n_keys, BLOCK)
-            else:
-                heads, cards = dense.segmented_reduce(
-                    "or", words, seg_ids, head_idx, n_steps)
-            words = words.at[0].set(heads[0])
-            return words, total + jnp.sum(cards.astype(jnp.uint32))
+                return kernels.segmented_reduce_pallas_blocked(
+                    "or", words, blk_seg, n_keys, block)
+            return dense.segmented_reduce(
+                "or", words, seg_ids, head_idx, n_steps)
 
-        def run(words):
-            return jax.lax.fori_loop(0, reps, body, (words, jnp.uint32(0)))[1]
+        if self.layout == "dense":
+            def body(i, state):
+                words, total = state
+                heads, cards = reduce_step(words)
+                words = words.at[0].set(heads[0])
+                return words, total + jnp.sum(cards.astype(jnp.uint32))
 
-        return jax.jit(run)
+            def run(words):
+                return jax.lax.fori_loop(
+                    0, reps, body, (words, jnp.uint32(0)))[1]
+
+            return jax.jit(run)
+
+        # compact layout: densify EVERY iteration (that IS the query cost),
+        # with the carry row threaded through the dense stream
+        streams = self._streams
+        n_rows, total_values = self._n_rows, self._total_values
+        carry_row = self._packed.carry_row
+
+        def body_compact(i, state):
+            carry, total = state
+            dw = jnp.concatenate([streams[0], carry[None]], axis=0)
+            dd = jnp.concatenate(
+                [streams[1].astype(jnp.int32),
+                 jnp.full((1,), carry_row, jnp.int32)])
+            words = dense.densify_streams_impl(
+                dw, dd, streams[2], streams[3], streams[4],
+                n_rows, total_values)
+            heads, cards = reduce_step(words)
+            return heads[0], total + jnp.sum(cards.astype(jnp.uint32))
+
+        def run_compact(_words_unused):
+            carry0 = jnp.zeros((packing.WORDS32,), jnp.uint32)
+            return jax.lax.fori_loop(
+                0, reps, body_compact, (carry0, jnp.uint32(0)))[1]
+
+        return jax.jit(run_compact)

@@ -552,6 +552,11 @@ class RoaringBitmapSliceIndex:
             raise spec.InvalidRoaringFormat("truncated BSI bit depth")
         (depth,) = struct.unpack_from(">i", mv, pos)
         pos += 4
+        if depth < 0 or depth > 64:
+            # same bound ImmutableBitSliceIndex enforces: reject before the
+            # per-slice read loop so hostile buffers fail fast (negative depth
+            # must not silently yield an empty index)
+            raise spec.InvalidRoaringFormat(f"BSI bit depth {depth} out of [0, 64]")
         bsi.slices = []
         for _ in range(depth):
             s, pos = _read_bitmap(mv, pos)

@@ -379,6 +379,7 @@ class Appender:
         self._pending: list[np.ndarray] = []
         self._slices = [RoaringBitmap() for _ in range(self.depth)]
         self._rows = 0
+        self._ser_cache: bytes | None = None
 
     def add(self, value: int) -> None:
         """add (:1511): append one value at the next row id."""
@@ -394,6 +395,7 @@ class Appender:
         if v.size and int(v.max()) > self.max_value:
             raise ValueError("value exceeds appender maxValue")
         self._pending.append(v)
+        self._ser_cache = None
 
     def _flush(self) -> None:
         if not self._pending:
@@ -420,13 +422,21 @@ class Appender:
         self._pending = []
         self._slices = [RoaringBitmap() for _ in range(self.depth)]
         self._rows = 0
+        self._ser_cache = None
+
+    def _serialized(self) -> bytes:
+        """Encoded byte image, cached so the documented size-then-serialize
+        calling pattern (serializedSizeInBytes + serialize, :1468-1483) runs
+        the encoding pass once; add()/clear() invalidate."""
+        if self._ser_cache is None:
+            self._flush()
+            self._ser_cache = RangeBitmap(
+                self._slices, self._rows, self.max_value).serialize()
+        return self._ser_cache
 
     def serialized_size_in_bytes(self) -> int:
-        self._flush()
-        return RangeBitmap(self._slices, self._rows,
-                           self.max_value).serialized_size_in_bytes()
+        return len(self._serialized())
 
     def serialize(self) -> bytes:
         """Serialize without materializing a RangeBitmap first (:1483)."""
-        self._flush()
-        return RangeBitmap(self._slices, self._rows, self.max_value).serialize()
+        return self._serialized()

@@ -245,6 +245,8 @@ def _emit_container_streams(sources: list, order: np.ndarray, dest: np.ndarray,
     idx_in_src = np.concatenate([np.arange(k) for k in sizes]) if sizes \
         else np.empty(0, np.int64)
 
+    from ..format.spec import InvalidRoaringFormat
+
     dense_rows: list[int] = []
     dense_words: list[np.ndarray] = []
     pieces: list[np.ndarray] = []       # sparse per-container value arrays
@@ -254,22 +256,49 @@ def _emit_container_streams(sources: list, order: np.ndarray, dest: np.ndarray,
         s, i = int(src_of[pos]), int(idx_in_src[pos])
         view = views[s]
         if view is not None:
+            # byte path: same corruption guards the eager SerializedView.
+            # container() applies, minus the bitmap popcount (O(8 KB)/row on
+            # the ingest hot path; a wrong declared bitmap cardinality cannot
+            # shift the stream — payloads are fixed 8 KB — and every device
+            # aggregate recomputes cardinalities exactly anyway)
             payload = view.container_payload(i)
             if view.is_bitmap[i]:
+                if len(payload) != 8192:
+                    raise InvalidRoaringFormat(
+                        f"container {i}: truncated bitmap payload")
                 dense_rows.append(row)
                 dense_words.append(np.frombuffer(payload, dtype="<u4"))
                 continue
             if view.is_run[i]:
                 nruns = int(np.frombuffer(payload[:2], dtype="<u2")[0])
                 runs = np.frombuffer(payload[2:2 + 4 * nruns], dtype="<u2")
+                if runs.size != 2 * nruns:
+                    raise InvalidRoaringFormat(
+                        f"container {i}: truncated run payload")
+                starts = runs[0::2].astype(np.int64)
+                ends = starts + runs[1::2]
+                if nruns and int(ends.max()) > 0xFFFF:
+                    # start + length-1 must stay within the 2^16 chunk, or
+                    # runs_to_values' uint16 wrap corrupts low values
+                    raise InvalidRoaringFormat(
+                        f"container {i}: run extends past 65535")
+                if nruns > 1 and bool(np.any(starts[1:] <= ends[:-1])):
+                    raise InvalidRoaringFormat(
+                        f"container {i}: overlapping/unsorted runs")
+                if int((ends - starts + 1).sum()) != int(view.cardinalities[i]):
+                    raise InvalidRoaringFormat(
+                        f"container {i}: run cardinality mismatch")
                 vals = C.runs_to_values(runs.astype(np.uint16))
             else:
                 vals = np.frombuffer(payload, dtype="<u2")
+                if vals.size > 1 and bool(np.any(vals[1:] <= vals[:-1])):
+                    raise InvalidRoaringFormat(
+                        f"container {i}: array values not strictly increasing")
         else:
             c = sources[s].containers[i]
             if isinstance(c, C.BitmapContainer):
                 dense_rows.append(row)
-                dense_words.append(c.words().view(np.uint32))
+                dense_words.append(container_words_u32(c))
                 continue
             vals = c.values() if not isinstance(c, C.RunContainer) \
                 else C.runs_to_values(c.runs)

@@ -157,7 +157,18 @@ def _java_appender_stream(values: np.ndarray, max_value: int) -> bytes:
     (RangeBitmap.java Appender.add :1514 / append :1545 / serialize :1483):
     complement bit slices per 2^16-row chunk, typed container records,
     per-chunk presence masks.  Deliberately NOT built on our RangeBitmap
-    classes — this is the documented-layout fixture generator."""
+    classes — this is the documented-layout fixture generator.
+
+    Known limitation (ADVICE r2): both sides of this parity check come from
+    the same reading of RangeBitmap.java — a shared misinterpretation would
+    pass.  Java-produced fixture bytes cannot be generated in this image (no
+    JVM, zero egress; the reference ships no serialized RangeBitmap fixtures
+    under src/test/resources — only roaring-format .bin files, which
+    tests/test_format.py already replays).  Mitigations here: the emulator is
+    generated from the *spec text* (header <HBBHI, complement encoding, typed
+    records, bit-length slice count per RangeBitmap.java:1491-1500,1622-1625)
+    rather than from our encoder, and structural fields (cookie, slice count,
+    record types) are asserted field-by-field, not only byte-equal."""
     import struct
 
     depth = max(int(max_value).bit_length(), 1)

@@ -125,3 +125,76 @@ def test_one_shot_blocked_path_uses_streams():
     want = aggregation.or_(*bitmaps, engine="xla")
     got = aggregation.or_(*bitmaps, engine="pallas")
     assert got == want
+
+
+class TestHostileBytes:
+    """Corrupt serialized input must raise InvalidRoaringFormat at ingest,
+    never produce a silently wrong aggregate (the guard SerializedView.
+    container() applies on the eager path, mirrored on the stream path)."""
+
+    def _blob(self):
+        rb = RoaringBitmap.from_values(
+            np.concatenate([np.arange(0, 200, 2),            # array
+                            np.arange(1 << 16, (1 << 16) + 300)]))  # run-able
+        rb.run_optimize()
+        return bytearray(rb.serialize())
+
+    def test_unsorted_array_values_rejected(self):
+        blob = self._blob()
+        view = spec.SerializedView(bytes(blob))
+        arr_i = int(np.flatnonzero(~view.is_run & ~view.is_bitmap)[0])
+        off = int(view.payload_offsets[arr_i])
+        blob[off:off + 2], blob[off + 2:off + 4] = \
+            blob[off + 2:off + 4], blob[off:off + 2]  # swap first two values
+        with pytest.raises(spec.InvalidRoaringFormat):
+            packing.pack_blocked_compact([bytes(blob)])
+
+    def test_run_cardinality_mismatch_rejected(self):
+        blob = self._blob()
+        view = spec.SerializedView(bytes(blob))
+        run_i = int(np.flatnonzero(view.is_run)[0])
+        off = int(view.payload_offsets[run_i])
+        corrupted = bytearray(blob)
+        # inflate the run length: expanded size != declared cardinality
+        corrupted[off + 4:off + 6] = (500).to_bytes(2, "little")
+        with pytest.raises(spec.InvalidRoaringFormat):
+            packing.pack_blocked_compact([bytes(corrupted)])
+
+    @staticmethod
+    def _run_blob(runs: list[tuple[int, int]]) -> bytes:
+        """Hand-built single-container run blob with declared cardinality
+        consistent with `runs` [(start, len-1), ...] — so only the
+        structural run guards can fire, not the cardinality check."""
+        card = sum(l + 1 for _, l in runs)
+        out = bytearray()
+        out += (spec.SERIAL_COOKIE | (0 << 16)).to_bytes(4, "little")
+        out += bytes([1])                                # run marker: c0 is run
+        out += (0).to_bytes(2, "little")                 # key 0
+        out += (card - 1).to_bytes(2, "little")          # cardinality-1
+        out += len(runs).to_bytes(2, "little")
+        for s, l in runs:
+            out += s.to_bytes(2, "little") + l.to_bytes(2, "little")
+        return bytes(out)
+
+    def test_overlapping_runs_rejected(self):
+        # two runs, second starts inside the first; total expanded size
+        # matches the declared cardinality so ONLY the overlap guard fires
+        blob = self._run_blob([(10, 99), (50, 99)])
+        with pytest.raises(spec.InvalidRoaringFormat, match="overlap"):
+            packing.pack_blocked_compact([blob])
+
+    def test_run_past_chunk_end_rejected(self):
+        # start + len-1 crosses 65535: uint16 expansion would wrap to low
+        # values and silently corrupt the aggregate
+        blob = self._run_blob([(65000, 999)])
+        with pytest.raises(spec.InvalidRoaringFormat, match="past 65535"):
+            packing.pack_blocked_compact([blob])
+
+    def test_wellformed_two_run_blob_accepted(self):
+        blob = self._run_blob([(10, 9), (100, 9)])
+        packed = packing.pack_blocked_compact([blob])
+        assert packed.keys.size == 1
+
+    def test_good_blob_accepted(self):
+        packed = packing.pack_blocked_compact([bytes(self._blob())])
+        assert packed.keys.size == 2

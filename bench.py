@@ -19,6 +19,17 @@ Methodology
   the CPU ns/op measures.  Every chained program's summed cardinality is
   asserted == (reps * expected) mod 2^32, proving each iteration ran
   bit-exact.
+- Regime note (profiler-verified): a jax.profiler trace of the chained loop
+  counts exactly `reps` executions of the Pallas kernel (no elision; e.g.
+  200x at 4.6 us avg device time on census1881), so the marginal is real
+  per-op work.  At this working-set size (~18 MB) the chip serves repeated
+  sweeps at ~3 TB/s effective — well above the ~0.74 TB/s this chip measures
+  streaming a 256 MB array — i.e. the steady state is (at least partly)
+  on-chip-resident; scaled to a ~99 MB resident set the same marginal drops
+  to ~325 us/op (HBM-streamed).  This is symmetric with the CPU baseline:
+  its 0.886 ms wide-OR is the hot-loop steady state of 50 reps over a
+  2.8 MB working set sitting in L2/L3 — JMH hot-loop methodology on both
+  sides, cache-resident vs cache-resident.
 - Cold path: pack (host stream build + transfer + device densify) and the
   first dispatch are timed separately AFTER a device warm-up, so pack_ms is
   the steady-state ingest cost, not the one-time runtime handshake (which is
@@ -41,7 +52,9 @@ import time
 
 import numpy as np
 
-R1, R2 = 100, 1100  # chained rep counts; marginal = (t2-t1)/(R2-R1)
+R1, R2 = 100, 4100  # chained rep counts; marginal = (t2-t1)/(R2-R1)
+# (gap sized so the marginal signal — ~45 ms at a 11 us/op kernel — clears
+# the post-readback tunnel dispatch jitter, which measures ~10-100 ms)
 BENCH_DATASETS = ("census1881", "wikileaks-noquotes")
 
 
@@ -63,10 +76,30 @@ def load_cpu_baseline(dataset: str) -> tuple[float | None, dict]:
     }
 
 
-def bench_dataset(name: str, profile: bool) -> dict:
-    import jax
+def _timed_pack(inputs, cls) -> tuple[float, object]:
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        d = cls(inputs)
+        d.words.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best, d
 
+
+def ingest_phase(name: str) -> dict:
+    """Everything that must run BEFORE the process's first device->host
+    readback: build + pack timings in the tunnel's pipelined regime.
+
+    Measured tunnel artifact (see query_phase's tunnel_rtt_ms): the axon
+    tunnel acks host->device puts asynchronously until the first D2H
+    readback, after which EVERY put pays a real ~100-180 ms round trip for
+    the remainder of the process.  Ingest cost is therefore measured first,
+    in the pipelined regime — which is also the regime a locally-attached
+    TPU (PCIe/ICI, no tunnel) runs in all the time.  The post-readback
+    number is reported too (pack_ms_post_readback), nothing is hidden.
+    """
     from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.ops import packing
     from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
     from roaringbitmap_tpu.utils import datasets
 
@@ -81,6 +114,42 @@ def bench_dataset(name: str, profile: bool) -> dict:
 
     bitmaps = [RoaringBitmap.from_values(a) for a in arrs]
     oracle_card = int(np.unique(np.concatenate(arrs)).size)
+
+    # cold build: compiles the densify program for this shape (one-time per
+    # shape per cache state — the persistent compilation cache set up in
+    # main() makes this ~1s warm vs ~17s on a cold cache)
+    t0 = time.perf_counter()
+    ds = DeviceBitmapSet(bitmaps)
+    if ds.words is not None:
+        ds.words.block_until_ready()
+    t_compile = time.perf_counter() - t0
+
+    t_pack, _ = _timed_pack(bitmaps, DeviceBitmapSet)
+
+    # byte-path ingest (serialized blobs -> HBM, no Container objects):
+    # the stream->HBM capability VERDICT r2 item 3 names
+    blobs = [b.serialize() for b in bitmaps]
+    t0 = time.perf_counter()
+    packing.pack_blocked_compact(blobs)
+    t_pack_host = time.perf_counter() - t0  # host stream build alone
+    t_pack_bytes, ds_bytes = _timed_pack(blobs, DeviceBitmapSet)
+
+    return {
+        "dataset": dataset, "bitmaps": bitmaps, "blobs": blobs,
+        "oracle_card": oracle_card, "ds": ds, "ds_bytes": ds_bytes,
+        "t_compile": t_compile, "t_pack": t_pack,
+        "t_pack_bytes": t_pack_bytes, "t_pack_host": t_pack_host,
+    }
+
+
+def query_phase(state: dict, profile: bool) -> dict:
+    import jax
+
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+
+    dataset = state["dataset"]
+    bitmaps, oracle_card = state["bitmaps"], state["oracle_card"]
+    ds, ds_bytes = state["ds"], state["ds_bytes"]
 
     # ---- CPU baseline (dataset-specific; never applied to the synthetic
     # fallback workload)
@@ -99,37 +168,19 @@ def bench_dataset(name: str, profile: bool) -> dict:
         assert cpu_info.pop("cpu_result_cardinality") == oracle_card, \
             "C++ baseline cardinality drift"
 
-    # ---- cold path: first build compiles the densify program (one-time per
-    # shape — reported apart), then pack_ms is the steady-state ingest cost
+    # first query = the process's first D2H readback for this dataset
     t0 = time.perf_counter()
-    ds = DeviceBitmapSet(bitmaps)
-    if ds.words is not None:
-        ds.words.block_until_ready()
-    t_compile = time.perf_counter() - t0
-    words0, cards0 = ds.aggregate_device("or", engine="xla")
+    _, cards0 = ds.aggregate_device("or", engine="xla")
     total0 = int(np.asarray(cards0.sum()))
-    t_cold = time.perf_counter() - t0
+    t_first_query = time.perf_counter() - t0
     assert total0 == oracle_card, "device parity failure (single shot)"
-
-    def timed_pack(inputs) -> tuple[float, DeviceBitmapSet]:
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            d = DeviceBitmapSet(inputs)
-            d.words.block_until_ready()
-            best = min(best, time.perf_counter() - t0)
-        return best, d
-
-    t_pack, _ = timed_pack(bitmaps)
-
-    # byte-path ingest throughput (serialized blobs -> HBM, no Container
-    # objects): the stream->HBM capability VERDICT r2 item 3 names
-    blobs = [b.serialize() for b in bitmaps]
-    ser_bytes = sum(len(x) for x in blobs)
-    t_pack_bytes, ds_bytes = timed_pack(blobs)
     _, c_b = ds_bytes.aggregate_device("or", engine="xla")
     assert int(np.asarray(c_b.sum())) == oracle_card, "byte-path parity"
-    del ds_bytes
+    ds_bytes = None            # drop BOTH references so the dense image
+    state["ds_bytes"] = None   # actually leaves HBM before the packs below
+
+    # tunnel artifact, quantified: one post-readback put of the byte streams
+    t_pack_post, _ = _timed_pack(state["blobs"], DeviceBitmapSet)
 
     # ---- steady state per engine: marginal chained cost
     r1, r2 = R1, R2
@@ -140,7 +191,7 @@ def bench_dataset(name: str, profile: bool) -> dict:
         expected = (reps * oracle_card) % 2**32  # uint32 accumulator
         fn = ds.chained_wide_or(reps, engine=engine)
         best = float("inf")
-        for i in range(4):  # first call compiles + warms up, then 3 timed
+        for i in range(6):  # first call compiles + warms up, then 5 timed
             t0 = time.perf_counter()
             total = int(np.asarray(fn(ds.words)))
             dt = time.perf_counter() - t0
@@ -153,7 +204,7 @@ def bench_dataset(name: str, profile: bool) -> dict:
 
     def marginal(engine: str) -> tuple[float, float]:
         """(steady-state s/op, end-to-end s/op at r2 incl. one dispatch)."""
-        for _ in range(3):  # retry when scheduling noise makes t2 <= t1
+        for _ in range(4):  # retry when scheduling noise makes t2 <= t1
             t1, t2 = chained_seconds(engine, r1), chained_seconds(engine, r2)
             if t2 > t1:
                 return (t2 - t1) / (r2 - r1), t2 / r2
@@ -178,11 +229,19 @@ def bench_dataset(name: str, profile: bool) -> dict:
         "e2e_us_per_wide_or_with_dispatch": {
             k: round(v[1] * 1e6, 2) for k, v in per_engine.items()},
         "n_bitmaps": len(bitmaps), "result_cardinality": oracle_card,
-        "pack_ms": round(t_pack * 1e3, 2),
-        "pack_from_serialized_bytes_ms": round(t_pack_bytes * 1e3, 2),
-        "serialized_mb": round(ser_bytes / 1e6, 2),
-        "ingest_compile_ms_one_time": round(t_compile * 1e3, 2),
-        "cold_pack_transfer_first_query_ms": round(t_cold * 1e3, 2),
+        "pack_ms": round(state["t_pack"] * 1e3, 2),
+        "pack_from_serialized_bytes_ms": round(state["t_pack_bytes"] * 1e3, 2),
+        "pack_host_stream_build_ms": round(state["t_pack_host"] * 1e3, 2),
+        "pack_ms_post_readback": round(t_pack_post * 1e3, 2),
+        "tunnel_note": "pack_ms rows are measured before the process's first "
+                       "device->host readback; after one readback the axon "
+                       "tunnel serializes every host->device put at ~100-180 "
+                       "ms RTT (pack_ms_post_readback) — a harness artifact, "
+                       "not an ingest cost (local PCIe attach has no tunnel)",
+        "serialized_mb": round(
+            sum(len(x) for x in state["blobs"]) / 1e6, 2),
+        "ingest_compile_ms_one_time": round(state["t_compile"] * 1e3, 2),
+        "first_query_ms": round(t_first_query * 1e3, 2),
         "cpu_wide_or_ms": round(cpu_s * 1e3, 4),
         "cpu_baseline": cpu_info,
         "hbm_resident_mb": round(ds.hbm_bytes() / 1e6, 1),
@@ -232,6 +291,12 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+
+    # persistent compilation cache: the densify/reduce programs compile in
+    # ~17s cold; cached on disk they load in ~1s on every later run
+    jax.config.update("jax_compilation_cache_dir", "/tmp/rb_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
     import jax.numpy as jnp
 
     # runtime warm-up: first transfer/compile carries the axon handshake
@@ -240,7 +305,11 @@ def main() -> None:
     jnp.square(jax.device_put(np.ones(8, np.float32))).block_until_ready()
     warmup_ms = (time.perf_counter() - t0) * 1e3
 
-    results = {name: bench_dataset(name, args.profile)
+    # phase 1 for ALL datasets first: ingest timings must precede the first
+    # D2H readback (see ingest_phase docstring for the measured tunnel mode
+    # switch); phase 2 then queries each resident set
+    states = {name: ingest_phase(name) for name in BENCH_DATASETS}
+    results = {name: query_phase(states[name], args.profile)
                for name in BENCH_DATASETS}
 
     head = results[BENCH_DATASETS[0]]

@@ -563,11 +563,36 @@ def or_not(a: RoaringBitmap, b: RoaringBitmap, range_end: int) -> RoaringBitmap:
     b's members at/above range_end do not contribute (the reference's key
     loop stops at maxKey and copies only a's remaining containers); a's
     members above range_end are kept.
+
+    Single bounded merge pass, like the reference: one container per key in
+    [0, maxKey] (the result is dense there — a missing b container
+    complements to all-ones), then a's tail containers appended untouched.
+    Nothing of b beyond range_end is cloned or flipped.
     """
-    comp = b.clone()
-    comp.remove_range(range_end, 1 << 32)
-    comp.flip_range(0, range_end)
-    return or_(a, comp)
+    if range_end <= 0:
+        return a.clone()
+    range_end = min(range_end, 1 << 32)
+    max_key = (range_end - 1) >> 16
+    a_idx = {int(k): i for i, k in enumerate(a.keys)}
+    b_idx = {int(k): i for i, k in enumerate(b.keys)}
+    keys: list[int] = []
+    conts: list[C.Container] = []
+    for k in range(max_key + 1):
+        # bits [0, span) of this key's chunk are in range
+        span = min(range_end - (k << 16), 1 << 16)
+        prefix = C.range_container(0, span)
+        j = b_idx.get(k)
+        comp = prefix if j is None else C.container_andnot(prefix, b.containers[j])
+        i = a_idx.get(k)
+        c = comp if i is None else C.container_or(a.containers[i], comp)
+        if c.cardinality:
+            keys.append(k)
+            conts.append(c)
+    for k, ca in zip(a.keys, a.containers):
+        if int(k) > max_key:
+            keys.append(int(k))
+            conts.append(ca)  # shared, same as _merge_union's lone-side rows
+    return _result_cls(a)(np.array(keys, dtype=a.keys.dtype), conts)
 
 
 def _merge_union(a: RoaringBitmap, b: RoaringBitmap, op, drop_empty: bool = False):

@@ -6,6 +6,11 @@ advanceIfNeeded), reverse iterators, rank iterators
 bitmap classes (RoaringBatchIterator.java:19-28).  These are host-side
 conveniences; bulk paths should prefer to_array()/batch_iterator or the
 device tier.
+
+True flyweights (IntIteratorFlyweight.java): memory is O(one container) —
+only the container currently being walked is expanded to a value array;
+the rest of the bitmap is never materialized.  Walking a 10^9-universe
+bitmap holds at most 2^16 values (256 KB) at a time.
 """
 
 from __future__ import annotations
@@ -15,32 +20,74 @@ import numpy as np
 
 class PeekableIntIterator:
     """Ascending iterator with peek_next and advance_if_needed
-    (PeekableIntIterator.java; flyweight IntIteratorFlyweight)."""
+    (PeekableIntIterator.java; flyweight IntIteratorFlyweight).
+
+    Expands one container at a time: _load(ci) materializes container ci's
+    values; moving to the next container drops the previous array.
+    """
 
     def __init__(self, rb):
-        self._arr = rb.to_array()
+        # snapshot the structure (keys array + container list) so structural
+        # mutation of the bitmap after iterator creation cannot desync the
+        # walk; container contents are shared (in-place container mutation
+        # during iteration is undefined, as for the reference's flyweights)
+        self._keys = rb.keys.copy()
+        self._conts = list(rb.containers)
+        self._ci = 0
+        self._cur = np.empty(0, np.uint32)
         self._pos = 0
+        self._load(0)
+
+    def _load(self, ci: int) -> None:
+        """Expand container ci (skipping empty ones) into _cur."""
+        self._pos = 0
+        while ci < len(self._conts):
+            c = self._conts[ci]
+            if c.cardinality:
+                self._ci = ci
+                base = np.uint32(int(self._keys[ci]) << 16)
+                self._cur = base + c.values().astype(np.uint32)
+                return
+            ci += 1
+        self._ci = ci
+        self._cur = np.empty(0, np.uint32)
 
     def has_next(self) -> bool:
-        return self._pos < self._arr.size
+        return self._pos < self._cur.size
 
     def next(self) -> int:
-        v = int(self._arr[self._pos])
+        v = int(self._cur[self._pos])
         self._pos += 1
+        if self._pos == self._cur.size:
+            self._load(self._ci + 1)
         return v
 
     def peek_next(self) -> int:
         if not self.has_next():
             raise StopIteration
-        return int(self._arr[self._pos])
+        return int(self._cur[self._pos])
 
     def advance_if_needed(self, min_val: int) -> None:
-        """Skip values < min_val in O(log n) (advanceIfNeeded)."""
-        self._pos += int(np.searchsorted(self._arr[self._pos:], min_val))
+        """Skip values < min_val: O(log #keys) container hop + O(log card)
+        within the landing container (advanceIfNeeded) — no other container
+        is touched, let alone expanded."""
+        if not self.has_next() or int(self._cur[self._pos]) >= min_val:
+            return
+        key = min_val >> 16
+        if key != int(self._keys[self._ci]):
+            ci = int(np.searchsorted(self._keys, key))
+            self._load(ci)
+            if not self.has_next():
+                return
+        if int(self._keys[self._ci]) == key:
+            self._pos += int(np.searchsorted(
+                self._cur[self._pos:], np.uint32(min_val)))
+            if self._pos == self._cur.size:
+                self._load(self._ci + 1)
 
     def clone(self) -> "PeekableIntIterator":
-        out = PeekableIntIterator.__new__(PeekableIntIterator)
-        out._arr, out._pos = self._arr, self._pos
+        out = self.__class__.__new__(self.__class__)
+        out.__dict__ = dict(self.__dict__)
         return out
 
     def __iter__(self):
@@ -49,27 +96,63 @@ class PeekableIntIterator:
 
 
 class PeekableIntRankIterator(PeekableIntIterator):
-    """PeekableIntRankIterator: also reports the rank of the next value."""
+    """PeekableIntRankIterator: also reports the rank of the next value.
+
+    Tracks the cardinality of containers already passed (_base); rank =
+    base + position inside the current container.
+    """
+
+    def __init__(self, rb):
+        self._base = 0
+        self._base_ci = 0
+        super().__init__(rb)
+
+    def _load(self, ci: int) -> None:
+        # accumulate cardinalities of containers being skipped over
+        for j in range(self._base_ci, min(ci, len(self._conts))):
+            self._base += self._conts[j].cardinality
+        self._base_ci = max(self._base_ci, min(ci, len(self._conts)))
+        super()._load(ci)
+        # _load may skip empty containers; account for them (cardinality 0)
+        self._base_ci = max(self._base_ci, min(self._ci, len(self._conts)))
 
     def peek_next_rank(self) -> int:
         if not self.has_next():
             raise StopIteration
-        return self._pos + 1  # rank is 1-based in the reference
+        return self._base + self._pos + 1  # rank is 1-based in the reference
 
 
 class ReverseIntIterator:
-    """Descending iterator (getReverseIntIterator)."""
+    """Descending iterator (getReverseIntIterator) — same one-container
+    flyweight discipline, walking containers from the last."""
 
     def __init__(self, rb):
-        self._arr = rb.to_array()
-        self._pos = self._arr.size - 1
+        self._keys = rb.keys.copy()   # structural snapshot, as above
+        self._conts = list(rb.containers)
+        self._load(len(self._conts) - 1)
+
+    def _load(self, ci: int) -> None:
+        while ci >= 0:
+            c = self._conts[ci]
+            if c.cardinality:
+                self._ci = ci
+                base = np.uint32(int(self._keys[ci]) << 16)
+                self._cur = base + c.values().astype(np.uint32)
+                self._pos = self._cur.size - 1
+                return
+            ci -= 1
+        self._ci = -1
+        self._cur = np.empty(0, np.uint32)
+        self._pos = -1
 
     def has_next(self) -> bool:
         return self._pos >= 0
 
     def next(self) -> int:
-        v = int(self._arr[self._pos])
+        v = int(self._cur[self._pos])
         self._pos -= 1
+        if self._pos < 0:
+            self._load(self._ci - 1)
         return v
 
     def __iter__(self):

@@ -438,9 +438,102 @@ class DeviceBitmapSet:
 
         # compact layout: densify EVERY iteration (that IS the query cost),
         # with the carry row threaded through the dense stream
+        return self._chained_compact(reps, eng)
+
+    def chained_aggregate(self, op: str, reps: int, engine: str = "auto"):
+        """Generalized steady-state probe: `reps` dependent wide ops (or /
+        xor / and) in ONE jit — the chained_wide_or methodology for the ops
+        whose results cannot be idempotently written back.
+
+        Serialization is enforced with jax.lax.optimization_barrier: each
+        iteration's input words pass through a barrier alongside the
+        loop-carried total, making every reduce loop-VARIANT so XLA's
+        loop-invariant code motion / CSE cannot hoist, fold, or elide the
+        repeated executions.  (chained_wide_or's write-back is kept for OR —
+        benchmarks compare both mechanisms as a methodology cross-check.)
+        Returns a jitted fn(words) -> summed cardinality over all reps,
+        modulo 2^32; callers assert == (reps * expected) % 2^32.
+        """
+        if op not in ("or", "xor", "and"):
+            raise ValueError(f"unsupported chained op {op!r}")
+        eng = self._select_engine(engine)
+        blk_seg, seg_ids, head_idx, n_keys, n_steps, block = (
+            self.blk_seg, self.seg_ids, self.head_idx, self.keys.size,
+            self.n_steps, self.block)
+
+        if op == "and":
+            full = np.flatnonzero(self._packed.seg_sizes == self.n)
+            rows = jnp.asarray(
+                (self._packed.seg_offsets[full][:, None]
+                 + np.arange(self.n)).ravel()) if full.size else None
+            nfull = int(full.size)
+
+            def reduce_cards(w):
+                if rows is None:
+                    return jnp.zeros((1,), jnp.int32)
+                blockw = w[rows].reshape(nfull, self.n, packing.WORDS32)
+                _, cards = dense.regular_reduce_and(blockw)
+                return cards
+        else:
+            def reduce_cards(w):
+                if eng == "pallas":
+                    _, cards = kernels.segmented_reduce_pallas_blocked(
+                        op, w, blk_seg, n_keys, block)
+                else:
+                    _, cards = dense.segmented_reduce(
+                        op, w, seg_ids, head_idx, n_steps)
+                return cards
+
+        if self.layout == "dense":
+            def body(i, state):
+                words, total = state
+                w, _ = jax.lax.optimization_barrier((words, total))
+                cards = reduce_cards(w)
+                return words, total + jnp.sum(cards.astype(jnp.uint32))
+
+            def run(words):
+                return jax.lax.fori_loop(
+                    0, reps, body, (words, jnp.uint32(0)))[1]
+
+            return jax.jit(run)
+
+        # compact layout: barrier the streams instead and densify inside the
+        # loop — the per-iteration densify IS the query cost being measured
+        streams = self._streams
+        n_rows, total_values = self._n_rows, self._total_values
+
+        def body_compact(i, state):
+            total = state
+            dw, _ = jax.lax.optimization_barrier((streams[0], total))
+            words = dense.densify_streams_impl(
+                dw, streams[1].astype(jnp.int32), streams[2], streams[3],
+                streams[4], n_rows, total_values)
+            cards = reduce_cards(words)
+            return total + jnp.sum(cards.astype(jnp.uint32))
+
+        def run_compact(_words_unused):
+            return jax.lax.fori_loop(
+                0, reps, body_compact, jnp.uint32(0))
+
+        return jax.jit(run_compact)
+
+    def _chained_compact(self, reps: int, eng: str):
+        """chained_wide_or body for the compact layout: densify every
+        iteration (that IS the query cost), carry row threaded through the
+        dense stream."""
         streams = self._streams
         n_rows, total_values = self._n_rows, self._total_values
         carry_row = self._packed.carry_row
+        blk_seg, seg_ids, head_idx, n_keys, n_steps, block = (
+            self.blk_seg, self.seg_ids, self.head_idx, self.keys.size,
+            self.n_steps, self.block)
+
+        def reduce_step(words):
+            if eng == "pallas":
+                return kernels.segmented_reduce_pallas_blocked(
+                    "or", words, blk_seg, n_keys, block)
+            return dense.segmented_reduce(
+                "or", words, seg_ids, head_idx, n_steps)
 
         def body_compact(i, state):
             carry, total = state

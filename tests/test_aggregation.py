@@ -168,3 +168,33 @@ def test_chained_wide_or_parity(workload, oracles):
     for eng in ("xla", "pallas"):
         total = int(np.asarray(ds.chained_wide_or(4, engine=eng)(ds.words)))
         assert total == 4 * oracles["or"].cardinality
+
+
+def test_chained_aggregate_parity_all_ops_layouts(rng):
+    """chained_aggregate (optimization_barrier methodology) must agree with
+    the host tier for every op x engine x layout — and with chained_wide_or
+    (write-back methodology) for OR: two independent anti-elision mechanisms
+    cross-checking each other."""
+    from roaringbitmap_tpu.parallel import fast_aggregation
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+
+    bms = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 19, 4000).astype(np.uint32)) for _ in range(12)]
+    # guarantee a non-empty wide-AND: give every bitmap a shared run
+    common = np.arange(100, 600, dtype=np.uint32)
+    bms = [b | RoaringBitmap.from_values(common) for b in bms]
+    want = {"or": fast_aggregation.or_(*bms).cardinality,
+            "xor": fast_aggregation.xor(*bms).cardinality,
+            "and": fast_aggregation.and_(*bms).cardinality}
+    assert want["and"] >= 500
+    reps = 5
+    for layout in ("dense", "compact"):
+        ds = DeviceBitmapSet(bms, layout=layout)
+        for op in ("or", "xor", "and"):
+            for eng in ("xla", "pallas"):
+                got = int(np.asarray(
+                    ds.chained_aggregate(op, reps, engine=eng)(ds.words)))
+                assert got == (reps * want[op]) % 2**32, (layout, op, eng)
+        got_wb = int(np.asarray(
+            ds.chained_wide_or(reps, engine="xla")(ds.words)))
+        assert got_wb == (reps * want["or"]) % 2**32, layout

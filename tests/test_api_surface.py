@@ -249,3 +249,70 @@ class TestIterators:
         c = it.clone()
         it.next()
         assert c.peek_next() == 2 and it.peek_next() == 3
+
+
+class TestIteratorFlyweight:
+    """The flyweight guarantee (IntIteratorFlyweight.java): walking never
+    materializes more than the current container's values."""
+
+    def _rb(self):
+        vals = np.concatenate([
+            np.arange(0, 8000, 2, dtype=np.uint32),         # array chunk
+            np.arange(1 << 16, (1 << 16) + 70000),          # bitmap+run chunks
+            np.array([5 << 16, (5 << 16) + 9], dtype=np.uint32)])
+        return RoaringBitmap.from_values(vals.astype(np.uint32))
+
+    def test_full_walk_parity(self):
+        rb = self._rb()
+        assert np.array_equal(np.fromiter(PeekableIntIterator(rb), np.uint32),
+                              rb.to_array())
+        assert np.array_equal(
+            np.fromiter(ReverseIntIterator(rb), np.uint32),
+            rb.to_array()[::-1])
+
+    def test_memory_is_one_container(self):
+        rb = self._rb()
+        it = PeekableIntIterator(rb)
+        # current buffer is bounded by one container, not the cardinality
+        assert it._cur.size <= 1 << 16 < rb.cardinality
+
+    def test_advance_skips_containers_without_expanding(self):
+        rb = self._rb()
+        it = PeekableIntIterator(rb)
+        it.advance_if_needed((5 << 16) + 1)
+        assert it.peek_next() == (5 << 16) + 9
+        # advance into a gap key: lands on next present container
+        it2 = PeekableIntIterator(rb)
+        it2.advance_if_needed(4 << 16)
+        assert it2.peek_next() == 5 << 16
+
+    def test_rank_across_containers(self):
+        rb = self._rb()
+        it = PeekableIntRankIterator(rb)
+        it.advance_if_needed(1 << 16)  # first value of the second chunk
+        assert it.peek_next() == 1 << 16
+        assert it.peek_next_rank() == 4001  # 4000 values in chunk 0
+        it.advance_if_needed(5 << 16)
+        assert it.peek_next_rank() == 4001 + 70000
+
+    def test_advance_past_everything(self):
+        it = PeekableIntIterator(self._rb())
+        it.advance_if_needed(0xFFFFFFFF)
+        assert not it.has_next()
+
+    def test_empty_bitmap(self):
+        it = PeekableIntIterator(RoaringBitmap())
+        assert not it.has_next()
+        assert not ReverseIntIterator(RoaringBitmap()).has_next()
+
+    def test_structural_mutation_does_not_desync(self):
+        # snapshot semantics: adding to the bitmap after iterator creation
+        # must not crash or corrupt an in-flight walk (regression: aliased
+        # keys/containers desynced when _insert rebound them)
+        rb = RoaringBitmap.bitmap_of(1 << 16, (1 << 16) + 5)
+        it = PeekableIntIterator(rb)
+        rb.add(3)   # structural insert BEFORE the iterated key
+        assert list(it) == [1 << 16, (1 << 16) + 5]
+        rit = ReverseIntIterator(rb)
+        rb.add(9 << 16)
+        assert list(rit) == [(1 << 16) + 5, 1 << 16, 3]

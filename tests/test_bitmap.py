@@ -183,3 +183,17 @@ def test_bitmap_container_point_ops_stay_wordlevel(rng):
     assert isinstance(c, C.BitmapContainer)
     c2 = c.remove(0)
     assert isinstance(c2, C.ArrayContainer) and c2.cardinality == 4096
+
+
+def test_or_not_property(rng):
+    # randomized sweep incl. range ends off/on chunk boundaries and empty sides
+    for trial in range(8):
+        a = rand_bitmap(rng, universe=1 << 18)
+        b = rand_bitmap(rng, universe=1 << 18)
+        end = int(rng.integers(1, 1 << 18)) if trial % 4 else (trial // 4 + 1) << 16
+        sa, sb = set(a.to_array().tolist()), set(b.to_array().tolist())
+        got = rt.or_not(a, b, end)
+        want = sa | (set(range(end)) - sb)
+        assert set(got.to_array().tolist()) == want, (trial, end)
+    assert rt.or_not(RoaringBitmap.bitmap_of(7), RoaringBitmap(), 0) == \
+        RoaringBitmap.bitmap_of(7)

@@ -1,0 +1,74 @@
+"""Reference-depth fuzz run (Fuzzer.java's 10k-iteration regime) with a
+committed JSON artifact.
+
+Runs the tests/test_fuzz.py property catalog at RB_FUZZ_ITERATIONS depth
+via pytest, then records configuration, per-class pass counts, and wall
+time to benchmarks/fuzz_r{N}.json.  The artifact is the proof VERDICT r2
+item 7 asked for: host algebra properties at 10,000 iterations each,
+device-parity properties (both engines, byte-path ingest, pairwise) at
+depth/25 — every failure would have raised with a base64 repro artifact
+(utils/fuzz.report_failure, the Reporter.java analog).
+
+Usage: python benchmarks/fuzz_run.py [--iterations 10000] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import re
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iterations", type=int, default=10_000)
+    ap.add_argument("--out", default=os.path.join(HERE, "fuzz_r03.json"))
+    args = ap.parse_args()
+
+    env = dict(os.environ)
+    env["RB_FUZZ_ITERATIONS"] = str(args.iterations)
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_fuzz.py", "-q",
+         "--tb=short"],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    wall = time.perf_counter() - t0
+
+    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    m = re.search(r"(\d+) passed", tail)
+    doc = {
+        "harness": "benchmarks/fuzz_run.py -> pytest tests/test_fuzz.py",
+        "reference_analog": "fuzz-tests Fuzzer.java verifyInvariance, "
+                            "ITERATIONS sysprop (Fuzzer.java:12,40-49)",
+        "iterations_per_host_property": args.iterations,
+        "iterations_per_device_property": max(6, args.iterations // 25),
+        "region_mix": "rle/dense/sparse per 2^16 chunk "
+                      "(RandomisedTestData.java:17-53 analog)",
+        "engines_fuzzed": ["xla", "pallas (interpret)",
+                           "byte-path ingest", "pairwise"],
+        "passed": int(m.group(1)) if m else None,
+        "exit_code": proc.returncode,
+        "wall_seconds": round(wall, 1),
+        "pytest_tail": tail,
+        "host": platform.platform(),
+        "note": "compiled-Mosaic parity is covered separately by the "
+                "RB_TPU_TESTS=1 on-chip lane (tests/test_on_tpu.py)",
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc, indent=2))
+    sys.exit(proc.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Merge per-dataset realdata.py JSON captures into one artifact.
+
+The matrix is captured one process per dataset (each dataset's shapes
+compile separately; the persistent compilation cache only helps re-runs of
+the same dataset), then merged here into benchmarks/realdata_r{N}.json.
+
+Usage: python benchmarks/merge_results.py out.json in1.json in2.json ...
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    out_path, *ins = sys.argv[1:]
+    merged: dict = {}
+    for path in ins:
+        with open(path) as f:
+            doc = json.load(f)
+        if not merged:
+            merged = {k: v for k, v in doc.items() if k != "datasets"}
+            merged["datasets"] = {}
+        merged["datasets"].update(doc["datasets"])
+    with open(out_path, "w") as f:
+        json.dump(merged, f, indent=1)
+        f.write("\n")
+    print(f"merged {len(ins)} captures -> {out_path} "
+          f"({', '.join(merged['datasets'])})")
+
+
+if __name__ == "__main__":
+    main()

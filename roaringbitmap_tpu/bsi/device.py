@@ -65,6 +65,29 @@ def oneil_scan(slices, ebm, bits):
     return gt, lt, eq
 
 
+def _compare_res(op: str, slices, ebm, bits, bits2, found):
+    """Traceable core of the fused comparator: one O'Neil scan + the op's
+    word combine (shared by the one-shot jit and the chained probe)."""
+    gt, lt, eq = oneil_scan(slices, ebm, bits)
+    eq = found & eq
+    if op == "EQ":
+        return eq
+    if op == "NEQ":
+        return found & ~eq
+    if op == "GT":
+        return gt & found
+    if op == "LT":
+        return lt & found
+    if op == "LE":
+        return (lt & found) | eq
+    if op == "GE":
+        return (gt & found) | eq
+    if op == "RANGE":
+        gt2, lt2, eq2 = oneil_scan(slices, ebm, bits2)
+        return ((gt & found) | eq) & ((lt2 & found) | (found & eq2))
+    raise ValueError(f"unsupported operation {op}")
+
+
 def _pack_index(ebm_bitmap: RoaringBitmap, slice_bitmaps):
     """Densify an existence bitmap + its slices over the ebm's key set and
     push both HBM-resident.  Returns (keys, ebm_dev, slices_dev)."""
@@ -104,26 +127,28 @@ class DeviceBSI:
 
     @partial(jax.jit, static_argnums=(0, 1))
     def _compare_words(self, op: str, bits, bits2, found):
-        gt, lt, eq = oneil_scan(self.slices, self.ebm, bits)
-        eq = found & eq
-        if op == "EQ":
-            res = eq
-        elif op == "NEQ":
-            res = found & ~eq
-        elif op == "GT":
-            res = gt & found
-        elif op == "LT":
-            res = lt & found
-        elif op == "LE":
-            res = (lt & found) | eq
-        elif op == "GE":
-            res = (gt & found) | eq
-        elif op == "RANGE":
-            gt2, lt2, eq2 = oneil_scan(self.slices, self.ebm, bits2)
-            res = ((gt & found) | eq) & ((lt2 & found) | (found & eq2))
-        else:
-            raise ValueError(f"unsupported operation {op}")
+        res = _compare_res(op, self.slices, self.ebm, bits, bits2, found)
         return res, popcount(res, axis=-1)
+
+    def chained_compare_cardinality(self, op: Operation, value: int,
+                                    reps: int, end: int = 0):
+        """Steady-state probe: `reps` dependent compares in ONE jit (the
+        chained-marginal methodology of parallel.aggregation), serialized by
+        an optimization_barrier on the predicate bits so the O'Neil scan is
+        loop-variant and cannot be hoisted.  Returns a jitted nullary fn ->
+        summed cardinality over all reps mod 2^32."""
+        bits, bits2 = self._bits(value), self._bits(end)
+        slices, ebm, found, op_s = self.slices, self.ebm, self.ebm, op.value
+
+        def body(i, total):
+            # BOTH predicates ride the barrier: RANGE's second scan must be
+            # loop-variant too, or LICM hoists half the per-op work
+            b, b2, _ = jax.lax.optimization_barrier((bits, bits2, total))
+            res = _compare_res(op_s, slices, ebm, b, b2, found)
+            return total + jnp.sum(popcount(res).astype(jnp.uint32))
+
+        return jax.jit(
+            lambda: jax.lax.fori_loop(0, reps, body, jnp.uint32(0)))
 
     # --------------------------------------------------------------- queries
     def _found_words(self, found_set: RoaringBitmap | None):
@@ -244,6 +269,24 @@ class DeviceBSI:
         return f
 
 
+def _range_res(op: str, slices, ebm, bits, bits2, found):
+    """Traceable core of the range-threshold query (shared by the one-shot
+    jit and the chained probe)."""
+    gt, lt, eq = oneil_scan(slices, ebm, bits)
+    if op == "lte":
+        return (lt | eq) & found
+    if op == "gte":
+        return (gt | eq) & found
+    if op == "eq":
+        return eq & found
+    if op == "neq":
+        return found & ~eq
+    if op == "between":
+        gt2, lt2, eq2 = oneil_scan(slices, ebm, bits2)
+        return (gt | eq) & (lt2 | eq2) & found
+    raise ValueError(f"unsupported op {op}")
+
+
 class DeviceRangeBitmap:
     """A core.rangebitmap.RangeBitmap packed HBM-resident.
 
@@ -272,21 +315,24 @@ class DeviceRangeBitmap:
 
     @partial(jax.jit, static_argnums=(0, 1))
     def _query_words(self, op: str, bits, bits2, found):
-        gt, lt, eq = oneil_scan(self.slices, self.ebm, bits)
-        if op == "lte":
-            res = (lt | eq) & found
-        elif op == "gte":
-            res = (gt | eq) & found
-        elif op == "eq":
-            res = eq & found
-        elif op == "neq":
-            res = found & ~eq
-        elif op == "between":
-            gt2, lt2, eq2 = oneil_scan(self.slices, self.ebm, bits2)
-            res = (gt | eq) & (lt2 | eq2) & found
-        else:
-            raise ValueError(f"unsupported op {op}")
+        res = _range_res(op, self.slices, self.ebm, bits, bits2, found)
         return res, popcount(res, axis=-1)
+
+    def chained_cardinality(self, op: str, a: int, b: int, reps: int):
+        """Chained-marginal probe, mirroring DeviceBSI.
+        chained_compare_cardinality: reps dependent threshold queries in one
+        jit, barrier-serialized.  fn() -> summed cardinality mod 2^32."""
+        bits, bits2 = self._bits(a), self._bits(b)
+        slices, ebm = self.slices, self.ebm
+
+        def body(i, total):
+            # both thresholds barriered — see chained_compare_cardinality
+            bb, bb2, _ = jax.lax.optimization_barrier((bits, bits2, total))
+            res = _range_res(op, slices, ebm, bb, bb2, ebm)
+            return total + jnp.sum(popcount(res).astype(jnp.uint32))
+
+        return jax.jit(
+            lambda: jax.lax.fori_loop(0, reps, body, jnp.uint32(0)))
 
     def _found_words(self, context: RoaringBitmap | None):
         if context is None:

@@ -428,6 +428,16 @@ class PackedIntersection:
     words: np.ndarray   # u32[K, N, 2048]
 
 
+def _container_at(b, i: int):
+    """One container of a bitmap-like source.  Byte-backed sources
+    (ImmutableRoaringBitmap) wrap just this payload slice — a wide AND must
+    not materialize the keys its intersection already eliminated
+    (BufferFastAggregation's workShyAnd touches only surviving containers,
+    buffer/BufferFastAggregation.java:699)."""
+    get = getattr(b, "_container", None)
+    return get(i) if get is not None else b.containers[i]
+
+
 def pack_for_intersection(bitmaps: list[RoaringBitmap],
                           keys: np.ndarray) -> PackedIntersection:
     """keys is the precomputed surviving key set (every bitmap must hold a
@@ -436,7 +446,7 @@ def pack_for_intersection(bitmaps: list[RoaringBitmap],
     conts, dest = [], []
     for j, b in enumerate(bitmaps):
         for i, bi in enumerate(np.searchsorted(b.keys, keys)):
-            conts.append(b.containers[bi])
+            conts.append(_container_at(b, int(bi)))
             dest.append(i * n + j)
     words = densify_containers(conts, dest, keys.size * n)
     return PackedIntersection(keys=keys,

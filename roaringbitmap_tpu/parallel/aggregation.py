@@ -47,18 +47,20 @@ def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
         return (out_cls or RoaringBitmap)()
     if len(bitmaps) == 1:
         return _materialize(bitmaps[0])
-    use_blocked = (
-        _engine(engine) == "pallas"
-        # block count is computable from key counts alone — check the SMEM
-        # ceiling BEFORE densifying the blocked tensor
-        and packing.blocked_block_count(bitmaps, BLOCK)
-        <= kernels.SMEM_PREFETCH_MAX)
+    # block count is computable from key counts alone — check the SMEM
+    # ceiling BEFORE densifying the blocked tensor
+    use_blocked = (packing.blocked_block_count(bitmaps, BLOCK)
+                   <= kernels.SMEM_PREFETCH_MAX)
     if use_blocked:
-        # compact byte-stream ingest + on-device densify: the host ships
-        # ~serialized-size bytes, never 8 KB per sparse container.  Rounding
-        # the block count to a multiple of 64 (with pow2-padded streams)
-        # coarsens shapes so ad-hoc call sites recompile every 64 blocks at
-        # most — linear but coarse; resident sets avoid the issue entirely.
+        # compact byte-stream ingest + on-device densify FOR BOTH ENGINES:
+        # the host ships ~serialized-size bytes, never 8 KB per sparse
+        # container, and byte-backed inputs (serialized blobs, mmap'd
+        # ImmutableRoaringBitmaps) never materialize Container objects —
+        # the BufferFastAggregation capability (BufferFastAggregation.java:187).
+        # Rounding the block count to a multiple of 64 (with pow2-padded
+        # streams) coarsens shapes so ad-hoc call sites recompile every 64
+        # blocks at most — linear but coarse; resident sets avoid the issue
+        # entirely.
         blocked = packing.pack_blocked_compact(
             bitmaps, block=BLOCK, round_blocks=64, carry_slot=False)
         s = packing.pad_streams_pow2(blocked.streams)
@@ -66,10 +68,20 @@ def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
             jnp.asarray(s.dense_words), jnp.asarray(s.dense_dest),
             jnp.asarray(s.values), jnp.asarray(s.val_counts),
             jnp.asarray(s.val_dest), blocked.n_rows, s.total_values)
-        heads, cards = kernels.segmented_reduce_pallas_blocked(
-            op, words, jnp.asarray(blocked.blk_seg),
-            blocked.keys.size, BLOCK)
         keys = blocked.keys
+        if _engine(engine) == "pallas":
+            heads, cards = kernels.segmented_reduce_pallas_blocked(
+                op, words, jnp.asarray(blocked.blk_seg), keys.size, BLOCK)
+        else:
+            seg_rows = np.repeat(blocked.blk_seg, BLOCK).astype(np.int32)
+            head_idx = np.searchsorted(
+                seg_rows, np.arange(keys.size)).astype(np.int32)
+            # group sizes terminate at the TRUE row count — the round_blocks
+            # padding rows (segment id K) must not inflate n_steps
+            seg_sizes = np.diff(np.append(head_idx, blocked.n_blocks * BLOCK))
+            heads, cards = dense.segmented_reduce(
+                op, words, jnp.asarray(seg_rows), jnp.asarray(head_idx),
+                dense.n_steps_for(int(seg_sizes.max()) if keys.size else 0))
     else:
         packed = packing.pack_for_aggregation(bitmaps)
         heads, cards = _run_ragged(op, packed, engine)
@@ -230,6 +242,30 @@ def pairwise(op: str, pairs, engine: str = "auto",
         out.append(packing.unpack_result(
             packed.keys[lo:hi], words[lo:hi], cards[lo:hi], out_cls=out_cls))
     return out
+
+
+def chained_pairwise_cardinality(op: str, pairs, reps: int,
+                                 engine: str = "auto"):
+    """Steady-state probe for the batched pairwise kernel: reps dependent
+    executions over the resident pair tensors in ONE jit, serialized by an
+    optimization_barrier (the chained-marginal methodology).  Returns
+    (jitted fn() -> total cardinality over all reps mod 2^32, packed) —
+    callers assert fn() == (reps * sum(host pair cards)) % 2^32."""
+    packed = packing.pack_pairwise(list(pairs))
+    a = jax.device_put(packed.a_words)
+    b = jax.device_put(packed.b_words)
+    eng = _engine(engine)
+
+    def body(i, total):
+        ab, _ = jax.lax.optimization_barrier((a, total))
+        if eng == "pallas":
+            _, cards = kernels.pairwise_popcount_pallas(op, ab, b)
+        else:
+            _, cards = dense.pairwise(op, ab, b)
+        return total + jnp.sum(cards.astype(jnp.uint32))
+
+    fn = jax.jit(lambda: jax.lax.fori_loop(0, reps, body, jnp.uint32(0)))
+    return fn, packed
 
 
 def pairwise_cardinality(op: str, pairs, engine: str = "auto") -> np.ndarray:

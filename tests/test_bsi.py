@@ -359,3 +359,43 @@ class TestDeviceBSI:
         bsi = RoaringBitmapSliceIndex()
         with pytest.raises(ValueError):
             bsi.set_value(1, 1 << 31)
+
+
+def test_chained_device_probes_parity(rng):
+    """The chained-marginal probes (barrier methodology) must agree with the
+    one-shot host results: BSI compare, RangeBitmap threshold, pairwise."""
+    import jax.numpy as jnp  # noqa: F401
+    from roaringbitmap_tpu.bsi.device import DeviceBSI, DeviceRangeBitmap
+    from roaringbitmap_tpu.bsi.slice_index import (
+        Operation, RoaringBitmapSliceIndex)
+    from roaringbitmap_tpu.core.rangebitmap import RangeBitmap
+    from roaringbitmap_tpu.parallel import aggregation
+
+    vals = rng.integers(0, 1 << 18, 5000).astype(np.uint64)
+    rows = np.arange(vals.size, dtype=np.uint32)
+    bsi = RoaringBitmapSliceIndex.from_pairs(rows, vals)
+    dev = DeviceBSI(bsi)
+    thr = int(np.median(vals))
+    want = bsi.compare(Operation.LT, thr, 0, None).cardinality
+    got = int(np.asarray(dev.chained_compare_cardinality(
+        Operation.LT, thr, 4)()))
+    assert got == (4 * want) % 2**32
+
+    app = RangeBitmap.appender(1 << 18)
+    app.add_many(vals)
+    rb = app.build()
+    drb = DeviceRangeBitmap(rb)
+    want_r = rb.lte(thr).cardinality
+    got_r = int(np.asarray(drb.chained_cardinality("lte", thr, 0, 4)()))
+    assert got_r == (4 * want_r) % 2**32
+
+    from roaringbitmap_tpu import RoaringBitmap
+    bms = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 18, 3000).astype(np.uint32)) for _ in range(6)]
+    pairs = list(zip(bms[:-1], bms[1:]))
+    for op, host in (("and", lambda a, b: a & b), ("or", lambda a, b: a | b)):
+        want_p = sum(host(a, b).cardinality for a, b in pairs)
+        for eng in ("xla", "pallas"):
+            fn, _ = aggregation.chained_pairwise_cardinality(
+                op, pairs, 3, engine=eng)
+            assert int(np.asarray(fn())) == (3 * want_p) % 2**32, (op, eng)

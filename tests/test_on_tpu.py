@@ -1,0 +1,134 @@
+"""On-TPU parity lane: the engine-parity suite on the REAL backend.
+
+Everything here runs compiled Mosaic kernels (no interpret mode) against a
+real dataset slice + synthetic mixed-container inputs, asserting
+bit-equality with the host tier — the lane VERDICT r2 item 5 asked for
+(the CPU-pinned main suite never compiles a Mosaic kernel; reference
+analog: the jmh correctness tests, jmh/src/test/.../realdata/*Test.java).
+
+Run (one command, ~2 min incl. first compiles; the persistent compilation
+cache in this module makes reruns fast):
+
+    RB_TPU_TESTS=1 python -m pytest tests/test_on_tpu.py -q
+
+Skipped entirely unless RB_TPU_TESTS=1 and the backend is a TPU.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("RB_TPU_TESTS") != "1",
+    reason="on-TPU lane: set RB_TPU_TESTS=1 and run only this file")
+
+jax = pytest.importorskip("jax")
+
+if os.environ.get("RB_TPU_TESTS") == "1":
+    jax.config.update("jax_compilation_cache_dir", "/tmp/rb_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if jax.default_backend() != "tpu":  # pragma: no cover
+        pytestmark = pytest.mark.skip(reason="no TPU backend available")
+
+from roaringbitmap_tpu import RoaringBitmap  # noqa: E402
+from roaringbitmap_tpu.parallel import aggregation, fast_aggregation  # noqa: E402
+from roaringbitmap_tpu.utils import datasets  # noqa: E402
+
+
+def _mixed(rng, n=10):
+    out = []
+    for i in range(n):
+        vals = [rng.integers(0, 1 << 20, 800),
+                (2 << 16) + rng.integers(0, 9000, 6000)]
+        start = (3 << 16) + int(rng.integers(0, 500))
+        vals.append(np.arange(start, start + 4000 + 50 * i))
+        out.append(RoaringBitmap.from_values(
+            np.concatenate(vals).astype(np.uint32)))
+    return out
+
+
+@pytest.fixture(scope="module")
+def census():
+    if not datasets.has_dataset("census1881"):
+        pytest.skip("dataset not in mirror")
+    return datasets.load_bitmaps("census1881")[:60]
+
+
+@pytest.fixture(scope="module")
+def mixed(rng):
+    return _mixed(rng)
+
+
+class TestWideOpsOnChip:
+    @pytest.mark.parametrize("engine", ["xla", "pallas"])
+    @pytest.mark.parametrize("op", ["or", "xor", "and"])
+    def test_wide_parity_census(self, census, engine, op):
+        host = {"or": fast_aggregation.or_, "xor": fast_aggregation.xor,
+                "and": fast_aggregation.and_}[op](*census)
+        ds = aggregation.DeviceBitmapSet(census)
+        assert ds.aggregate(op, engine=engine) == host
+
+    @pytest.mark.parametrize("engine", ["xla", "pallas"])
+    def test_wide_parity_mixed_containers(self, mixed, engine):
+        for op, fn in (("or", fast_aggregation.or_),
+                       ("xor", fast_aggregation.xor)):
+            got = {"or": aggregation.or_, "xor": aggregation.xor}[op](
+                *mixed, engine=engine)
+            assert got == fn(*mixed), op
+
+    @pytest.mark.parametrize("layout", ["dense", "compact"])
+    def test_chained_loop_compiled(self, census, layout):
+        """The bench measurement loop itself, compiled on the chip."""
+        want = fast_aggregation.or_(*census).cardinality
+        ds = aggregation.DeviceBitmapSet(census, layout=layout)
+        fn = ds.chained_wide_or(5, engine="pallas")
+        assert int(np.asarray(fn(ds.words))) == (5 * want) % 2**32
+
+    def test_byte_path_ingest(self, census):
+        blobs = [b.serialize() for b in census]
+        ds = aggregation.DeviceBitmapSet(blobs)
+        assert ds.aggregate("or", engine="pallas") == \
+            fast_aggregation.or_(*census)
+
+
+class TestPairwiseOnChip:
+    @pytest.mark.parametrize("engine", ["xla", "pallas"])
+    def test_pairwise_parity(self, census, engine):
+        pairs = list(zip(census[:-1], census[1:]))[:20]
+        got = aggregation.pairwise("and", pairs, engine=engine)
+        want = [a & b for a, b in pairs]
+        assert got == want
+
+
+class TestIndexTiersOnChip:
+    def test_bsi_device_parity(self, census, rng):
+        from roaringbitmap_tpu.bsi.device import DeviceBSI
+        from roaringbitmap_tpu.bsi.slice_index import (
+            Operation, RoaringBitmapSliceIndex)
+
+        union = fast_aggregation.or_(*census)
+        vals = union.to_array()[:50000].astype(np.uint64)
+        bsi = RoaringBitmapSliceIndex.from_pairs(
+            np.arange(vals.size, dtype=np.uint32), vals)
+        dev = DeviceBSI(bsi)
+        thr = int(np.median(vals))
+        for op in (Operation.LT, Operation.GE, Operation.EQ):
+            assert dev.compare(op, thr) == bsi.compare(op, thr, 0, None), op
+        assert dev.sum() == bsi.sum()
+        assert dev.top_k(500) == bsi.top_k(500)
+
+    def test_rangebitmap_device_parity(self, census):
+        from roaringbitmap_tpu.bsi.device import DeviceRangeBitmap
+        from roaringbitmap_tpu.core.rangebitmap import RangeBitmap
+
+        union = fast_aggregation.or_(*census)
+        vals = union.to_array()[:50000].astype(np.uint64)
+        app = RangeBitmap.appender(int(vals.max()))
+        app.add_many(vals)
+        rbm = app.build()
+        dev = DeviceRangeBitmap(rbm)
+        thr = int(np.median(vals))
+        assert dev.lte(thr) == rbm.lte(thr)
+        assert dev.between(thr // 2, thr * 2) == rbm.between(thr // 2, thr * 2)
+        assert dev.lte_cardinality(thr) == rbm.lte_cardinality(thr)

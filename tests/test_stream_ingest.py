@@ -198,3 +198,24 @@ class TestHostileBytes:
     def test_good_blob_accepted(self):
         packed = packing.pack_blocked_compact([bytes(self._blob())])
         assert packed.keys.size == 2
+
+
+def test_wide_and_immutable_materializes_only_survivors():
+    """Wide AND over immutables: keys eliminated by the intersection must
+    never be materialized (the workShyAnd discipline, BufferFastAggregation
+    .java:699) — and the full container list must never be built."""
+    rng = np.random.default_rng(11)
+    bms = []
+    for i in range(5):
+        vals = [np.arange(10, 500),                       # shared key 0
+                ((i + 1) << 16) + rng.integers(0, 9000, 200)]  # private key
+        bms.append(RoaringBitmap.from_values(
+            np.concatenate(vals).astype(np.uint32)))
+    want = bms[0] & bms[1] & bms[2] & bms[3] & bms[4]
+    assert want.cardinality
+    imms = [ImmutableRoaringBitmap(b.serialize()) for b in bms]
+    got = aggregation.and_(*imms)
+    assert got == want
+    for im in imms:
+        assert im._all is None          # full list never built
+        assert set(im._cache) == {0}    # only the surviving key's container

@@ -1,0 +1,139 @@
+"""Native runtime tier: C++ ingest engine, loaded via ctypes.
+
+Builds roaringbitmap_tpu/native/stream_ingest.cpp on demand (g++ -O3,
+cached by mtime like baselines/run_cpu_baseline.py) and exposes
+``pack_blocked_compact_native`` with semantics identical to
+ops.packing.pack_blocked_compact for byte-backed 32-bit sources.  The
+NumPy implementation remains the oracle and the fallback: set RB_NATIVE=0
+to disable, and any load/build failure degrades silently to Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "stream_ingest.cpp")
+LIB = os.path.join(HERE, "_stream_ingest.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _build() -> str | None:
+    try:
+        if (not os.path.exists(LIB)
+                or os.path.getmtime(LIB) < os.path.getmtime(SRC)):
+            # compile to a process-unique temp and atomically rename: two
+            # processes racing on a fresh checkout must never dlopen a
+            # half-written .so (one-process-per-dataset captures, pytest -n)
+            tmp = f"{LIB}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+                 "-fPIC", "-o", tmp, SRC],
+                check=True, capture_output=True)
+            os.replace(tmp, LIB)
+        return LIB
+    except Exception:
+        return None
+
+
+def load() -> ctypes.CDLL | None:
+    """The ingest library, built/loaded once per process (None if
+    unavailable or disabled)."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if os.environ.get("RB_NATIVE", "1") == "0" or _build() is None:
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(LIB)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.rb_ingest.restype = ctypes.c_void_p
+        lib.rb_ingest.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.rb_error.restype = ctypes.c_char_p
+        lib.rb_error.argtypes = [ctypes.c_void_p]
+        for name in ("rb_num_keys", "rb_n_blocks", "rb_nb_pad",
+                     "rb_carry_row", "rb_md", "rb_total_values", "rb_mv"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.rb_block.restype = ctypes.c_int
+        lib.rb_block.argtypes = [ctypes.c_void_p]
+        lib.rb_export.restype = None
+        lib.rb_export.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 9
+        lib.rb_free.restype = None
+        lib.rb_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+def pack_blocked_compact_native(blobs: list[bytes], block: int | None,
+                                round_blocks: int, carry_slot: bool):
+    """Native rotation+classification of serialized blobs; returns a
+    PackedBlockedCompact, or None when the native path is unavailable.
+    Raises InvalidRoaringFormat on hostile input (same guards as the
+    NumPy path)."""
+    from ..format.spec import InvalidRoaringFormat
+    from ..ops import packing
+
+    lib = load()
+    if lib is None:
+        return None
+    # per-blob pointers — no concatenation copy on the ingest hot path
+    ptrs = (ctypes.c_char_p * len(blobs))(*blobs)
+    lens = np.array([len(b) for b in blobs], dtype=np.int64)
+    handle = lib.rb_ingest(
+        ptrs, lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(blobs), 0 if block is None else block, round_blocks,
+        1 if carry_slot else 0)
+    try:
+        err = lib.rb_error(handle)
+        if err:
+            raise InvalidRoaringFormat(err.decode())
+        k = lib.rb_num_keys(handle)
+        nb_pad = lib.rb_nb_pad(handle)
+        md = lib.rb_md(handle)
+        v = lib.rb_total_values(handle)
+        mv = lib.rb_mv(handle)
+        keys = np.empty(k, np.uint16)
+        blk_seg = np.empty(nb_pad, np.int32)
+        seg_sizes = np.empty(k, np.int64)
+        seg_offsets = np.empty(k, np.int64)
+        dense_words = np.empty((md, packing.WORDS32), np.uint32)
+        dense_dest = np.empty(md, np.int32)
+        values = np.empty(v, np.uint16)
+        val_counts = np.empty(mv, np.int32)
+        val_dest = np.empty(mv, np.int32)
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        lib.rb_export(handle, ptr(keys), ptr(blk_seg), ptr(seg_sizes),
+                      ptr(seg_offsets), ptr(dense_words), ptr(dense_dest),
+                      ptr(values), ptr(val_counts), ptr(val_dest))
+        out_block = lib.rb_block(handle)
+        n_blocks = lib.rb_n_blocks(handle)
+        carry_row = lib.rb_carry_row(handle)
+    finally:
+        lib.rb_free(handle)
+    streams = packing.CompactStreams(
+        n_rows=int(nb_pad) * out_block, dense_words=dense_words,
+        dense_dest=dense_dest, values=values, val_counts=val_counts,
+        val_dest=val_dest)
+    return packing.PackedBlockedCompact(
+        keys=keys, blk_seg=blk_seg, block=int(out_block),
+        n_blocks=int(n_blocks), seg_sizes=seg_sizes,
+        seg_offsets=seg_offsets, streams=streams,
+        carry_row=int(carry_row))

@@ -385,6 +385,18 @@ def pack_blocked_compact(sources: list, block: int | None = None,
     round_blocks pads the block count to a multiple (NOT pow2 — a resident set
     compiles for one shape, so tight padding wins back HBM).
     """
+    # native fast path: pure-bytes 32-bit inputs go through the C++ ingest
+    # engine (roaringbitmap_tpu.native) — same semantics, same hostile-input
+    # guards, one pass over the wire bytes; falls back to this NumPy
+    # implementation (the oracle) whenever unavailable
+    if sources and all(isinstance(s, (bytes, bytearray)) for s in sources):
+        from .. import native
+
+        packed = native.pack_blocked_compact_native(
+            [bytes(s) for s in sources], block, round_blocks, carry_slot)
+        if packed is not None:
+            return packed
+
     # parse byte-backed sources ONCE; _as_view is idempotent on views
     sources = [v if (v := _as_view(s)) is not None else s for s in sources]
     all_keys = [_keys_of(s) for s in sources]
@@ -416,6 +428,21 @@ def pack_blocked_compact(sources: list, block: int | None = None,
         # without a reserved slot, g[0] may be a live row of segment 1 —
         # poison the field instead of pointing consumers at foreign data
         carry_row=int(g[0]) if (carry_slot and k) else -1)
+
+
+def blocked_ragged_meta(blk_seg: np.ndarray, block: int, n_blocks: int,
+                        num_keys: int):
+    """Row-level ragged metadata of a blocked layout, for the XLA doubling
+    engine: (seg_rows i32[rows], head_idx i32[K], n_steps).  Group sizes
+    terminate at the TRUE row count so round_blocks padding rows (segment
+    id K) never inflate the doubling-pass depth."""
+    seg_rows = np.repeat(blk_seg, block).astype(np.int32)
+    head_idx = np.searchsorted(seg_rows, np.arange(num_keys)).astype(np.int32)
+    seg_sizes = np.diff(np.append(head_idx, n_blocks * block))
+    from . import dense
+
+    n_steps = dense.n_steps_for(int(seg_sizes.max()) if num_keys else 0)
+    return seg_rows, head_idx, n_steps
 
 
 @dataclass

@@ -73,15 +73,11 @@ def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
             heads, cards = kernels.segmented_reduce_pallas_blocked(
                 op, words, jnp.asarray(blocked.blk_seg), keys.size, BLOCK)
         else:
-            seg_rows = np.repeat(blocked.blk_seg, BLOCK).astype(np.int32)
-            head_idx = np.searchsorted(
-                seg_rows, np.arange(keys.size)).astype(np.int32)
-            # group sizes terminate at the TRUE row count — the round_blocks
-            # padding rows (segment id K) must not inflate n_steps
-            seg_sizes = np.diff(np.append(head_idx, blocked.n_blocks * BLOCK))
+            seg_rows, head_idx, n_steps = packing.blocked_ragged_meta(
+                blocked.blk_seg, BLOCK, blocked.n_blocks, keys.size)
             heads, cards = dense.segmented_reduce(
                 op, words, jnp.asarray(seg_rows), jnp.asarray(head_idx),
-                dense.n_steps_for(int(seg_sizes.max()) if keys.size else 0))
+                n_steps)
     else:
         packed = packing.pack_for_aggregation(bitmaps)
         heads, cards = _run_ragged(op, packed, engine)
@@ -254,7 +250,9 @@ def chained_pairwise_cardinality(op: str, pairs, reps: int,
     packed = packing.pack_pairwise(list(pairs))
     a = jax.device_put(packed.a_words)
     b = jax.device_put(packed.b_words)
-    eng = _engine(engine)
+    # zero-row pack (all pairs empty): the pallas kernel cannot tile an
+    # empty operand — route to the dense path, same guard as pairwise_device
+    eng = _engine(engine) if packed.keys.size else "xla"
 
     def body(i, total):
         ab, _ = jax.lax.optimization_barrier((a, total))
@@ -347,12 +345,11 @@ class DeviceBitmapSet:
         else:
             self.words = None
         self.blk_seg = jax.device_put(self._packed.blk_seg)
-        seg_rows = np.repeat(self._packed.blk_seg, self.block).astype(np.int32)
+        seg_rows, head_idx, self.n_steps = packing.blocked_ragged_meta(
+            self._packed.blk_seg, self.block, self._packed.n_blocks,
+            self.keys.size)
         self.seg_ids = jax.device_put(seg_rows)
-        head = np.searchsorted(seg_rows, np.arange(self.keys.size))
-        self.head_idx = jax.device_put(head.astype(np.int32))
-        seg_sizes = np.diff(np.append(head, self._packed.n_blocks * self.block))
-        self.n_steps = dense.n_steps_for(int(seg_sizes.max()) if seg_sizes.size else 0)
+        self.head_idx = jax.device_put(head_idx)
 
     def _resident_words(self):
         """Dense image: resident (dense layout) or transient device densify

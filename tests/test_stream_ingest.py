@@ -219,3 +219,64 @@ def test_wide_and_immutable_materializes_only_survivors():
     for im in imms:
         assert im._all is None          # full list never built
         assert set(im._cache) == {0}    # only the surviving key's container
+
+
+class TestNativeIngest:
+    """C++ ingest engine (roaringbitmap_tpu.native) vs the NumPy oracle:
+    identical metadata and densified image, identical hostile-input
+    behavior.  Skips when the toolchain can't build the library."""
+
+    @pytest.fixture(scope="class")
+    def lib(self):
+        from roaringbitmap_tpu import native
+        if native.load() is None:
+            pytest.skip("native ingest unavailable")
+        return native
+
+    def test_metadata_and_image_parity(self, lib):
+        bitmaps = _mixed_bitmaps(seed=21, n=10)
+        blobs = [b.serialize() for b in bitmaps]
+        nat = packing.pack_blocked_compact(blobs)               # native path
+        py = packing.pack_blocked_compact(
+            [spec.SerializedView(x) for x in blobs])            # oracle path
+        assert np.array_equal(nat.keys, py.keys)
+        assert np.array_equal(nat.blk_seg, py.blk_seg)
+        assert (nat.block, nat.n_blocks, nat.carry_row) == \
+            (py.block, py.n_blocks, py.carry_row)
+        assert np.array_equal(nat.seg_sizes, py.seg_sizes)
+        assert np.array_equal(nat.seg_offsets, py.seg_offsets)
+
+        def image(p):
+            out = np.zeros((p.streams.n_rows, packing.WORDS32), np.uint32)
+            s = p.streams
+            if s.dense_dest.size:
+                out[s.dense_dest] = s.dense_words
+            heads = np.concatenate(([0], np.cumsum(s.val_counts)))
+            for i in range(s.val_counts.size):
+                vals = s.values[heads[i]:heads[i + 1]].astype(np.int64)
+                np.bitwise_or.at(out[s.val_dest[i]], vals >> 5,
+                                 np.uint32(1) << (vals & 31).astype(np.uint32))
+            return out
+        # emission order differs by design (input-major vs key-major);
+        # the scattered image is the semantic content
+        np.testing.assert_array_equal(image(nat), image(py))
+
+    def test_device_aggregate_through_native(self, lib):
+        bitmaps = _mixed_bitmaps(seed=22, n=8)
+        want = bitmaps[0]
+        for b in bitmaps[1:]:
+            want = want | b
+        ds = aggregation.DeviceBitmapSet([b.serialize() for b in bitmaps])
+        assert ds.aggregate("or") == want
+
+    def test_native_disabled_env(self, lib, monkeypatch):
+        # RB_NATIVE=0 must silently use the NumPy path
+        from roaringbitmap_tpu import native as nat_mod
+        monkeypatch.setattr(nat_mod, "_lib", None)
+        monkeypatch.setattr(nat_mod, "_lib_failed", False)
+        monkeypatch.setenv("RB_NATIVE", "0")
+        bitmaps = _mixed_bitmaps(seed=23, n=4)
+        blobs = [b.serialize() for b in bitmaps]
+        p = packing.pack_blocked_compact(blobs)
+        assert p.keys.size
+        monkeypatch.setattr(nat_mod, "_lib_failed", False)

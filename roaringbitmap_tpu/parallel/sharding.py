@@ -108,11 +108,122 @@ def shard_packed(mesh: Mesh, packed: packing.PackedAggregation,
     return words_d, segs_d
 
 
-def wide_aggregate_sharded(mesh: Mesh, op: str,
-                           bitmaps) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """End to end: pack, shard, reduce across the mesh. Returns (keys, words, cards)."""
+def _split_streams_by_shard(s: packing.CompactStreams, rows_per_shard: int,
+                            d: int):
+    """Partition compact streams by destination shard, padding each shard's
+    sub-stream to the cross-shard maximum (padding rows/values target the
+    per-shard scratch row, index rows_per_shard, exactly like
+    pad_streams_pow2's sentinel scheme)."""
+    # dense sub-streams
+    sh = s.dense_dest // rows_per_shard
+    md = int(np.bincount(sh, minlength=d).max()) if sh.size else 0
+    dense_words = np.zeros((d, max(md, 1), packing.WORDS32), np.uint32)
+    dense_dest = np.full((d, max(md, 1)), rows_per_shard, np.int32)
+    for k in range(d):
+        rows = np.flatnonzero(sh == k)
+        dense_words[k, :rows.size] = s.dense_words[rows]
+        dense_dest[k, :rows.size] = s.dense_dest[rows] - k * rows_per_shard
+    # sparse sub-streams: split the value stream at container boundaries
+    heads = np.concatenate(([0], np.cumsum(s.val_counts)))
+    shv = s.val_dest // rows_per_shard
+    mv = int(np.bincount(shv, minlength=d).max()) if shv.size else 0
+    vmax = 0
+    per_shard: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    for k in range(d):
+        idx = np.flatnonzero(shv == k)
+        vals = (np.concatenate([s.values[heads[i]:heads[i + 1]]
+                                for i in idx])
+                if idx.size else np.empty(0, np.uint16))
+        per_shard.append((vals, s.val_counts[idx],
+                          s.val_dest[idx] - k * rows_per_shard))
+        vmax = max(vmax, vals.size)
+    values = np.zeros((d, max(vmax, 1)), np.uint16)
+    val_counts = np.zeros((d, max(mv, 1) + 1), np.int32)
+    val_dest = np.full((d, max(mv, 1) + 1), rows_per_shard, np.int32)
+    for k, (vals, counts, dests) in enumerate(per_shard):
+        values[k, :vals.size] = vals
+        val_counts[k, :counts.size] = counts
+        val_counts[k, -1] = values.shape[1] - vals.size  # sentinel soak
+        val_dest[k, :dests.size] = dests
+    return dense_words, dense_dest, values, val_counts, val_dest
+
+
+def shard_streams(mesh: Mesh, blocked: packing.PackedBlockedCompact,
+                  row_axis: str = "rows"):
+    """Compact multi-chip ingest: ship ~serialized-size streams to the mesh
+    and densify per shard ON DEVICE — the host never materializes the dense
+    [M, 2048] image (which is 6-1300x the serialized bytes on the SURVEY
+    datasets).  Returns (words u32[rows, 2048] sharded over row_axis,
+    seg_ids i32[rows] sharded, n_blocks_padded).
+    """
+    d = mesh.shape[row_axis]
+    block, k = blocked.block, blocked.keys.size
+    nb = int(blocked.blk_seg.size)
+    nb_pad = -(-nb // d) * d  # block count divisible across shards
+    blk_seg = np.full(nb_pad, k, np.int32)
+    blk_seg[:nb] = blocked.blk_seg
+    rows = nb_pad * block
+    rows_per_shard = rows // d
+    parts = _split_streams_by_shard(blocked.streams, rows_per_shard, d)
+    total_values = int(parts[2].shape[1])
+
+    mapped = _sharded_densify(mesh, row_axis, rows_per_shard, total_values)
+    sharding = NamedSharding(mesh, P(row_axis))
+    dev = [jax.device_put(a, sharding) for a in parts]
+    words = mapped(*dev)
+    seg_ids = jax.device_put(
+        np.repeat(blk_seg, block).astype(np.int32), sharding)
+    return words, seg_ids, blk_seg
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_densify(mesh: Mesh, row_axis: str, rows_per_shard: int,
+                     total_values: int):
+    """Cached jitted per-shard densify program — keyed on (mesh, axis,
+    shard rows, value-stream length) so repeated compact ingests with a
+    stable workload shape reuse one executable instead of re-tracing a
+    fresh closure every call."""
+
+    def densify_local(dw, dd, v, vc, vdst):
+        # leading shard axis is size 1 inside the shard; drop it
+        return dense.densify_streams_impl(
+            dw[0], dd[0], v[0], vc[0], vdst[0],
+            rows_per_shard, total_values)
+
+    return jax.jit(jax.shard_map(
+        densify_local, mesh=mesh,
+        in_specs=(P(row_axis), P(row_axis), P(row_axis), P(row_axis),
+                  P(row_axis)),
+        out_specs=P(row_axis),
+    ))
+
+
+def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,
+                           ingest: str = "dense"
+                           ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """End to end: pack, shard, reduce across the mesh. Returns (keys, words, cards).
+
+    ingest="dense" host-densifies then scatters (8 KB/container on the
+    wire); ingest="compact" ships compact streams (~serialized size) and
+    densifies per shard on device — same reduction, same results.  AND
+    routes through the workShy key-intersection path for either ingest
+    (byte-backed sources are wrapped zero-copy; only surviving containers
+    materialize).
+    """
+    if ingest not in ("dense", "compact"):
+        raise ValueError(f"unknown ingest {ingest!r}")
     if op == "and":
-        return wide_and_sharded(mesh, bitmaps)
+        return wide_and_sharded(mesh, _wrap_bytes(bitmaps))
+    if ingest == "compact":
+        blocked = packing.pack_blocked_compact(bitmaps, carry_slot=False)
+        words_d, segs_d, blk_seg = shard_streams(mesh, blocked)
+        # max padded group size in O(K): groups are block-multiple-padded
+        gp_max = int((-(-blocked.seg_sizes // blocked.block)
+                      * blocked.block).max()) if blocked.keys.size else 0
+        step = make_sharded_aggregator(mesh, op, blocked.keys.size,
+                                       dense.n_steps_for(gp_max))
+        heads, cards = step(words_d, segs_d)
+        return blocked.keys, np.asarray(heads), np.asarray(cards)
     packed = packing.pack_for_aggregation(bitmaps)
     step = make_sharded_aggregator(mesh, op, packed.num_keys,
                                    dense.n_steps_for(packed.max_group))
@@ -155,6 +266,24 @@ def make_sharded_and(mesh: Mesh,
         check_vma=False,
     )
     return jax.jit(mapped)
+
+
+def _wrap_bytes(bitmaps):
+    """Byte-backed sources -> zero-copy ImmutableRoaringBitmaps (headers
+    parsed, payloads untouched) so the workShy AND path can run key
+    intersection and materialize only surviving containers."""
+    from ..buffer import ImmutableRoaringBitmap
+    from ..format import spec
+
+    out = []
+    for b in bitmaps:
+        if isinstance(b, (bytes, bytearray, memoryview)):
+            out.append(ImmutableRoaringBitmap(b))
+        elif isinstance(b, spec.SerializedView):
+            out.append(ImmutableRoaringBitmap(b.buf))
+        else:
+            out.append(b)
+    return out
 
 
 def wide_and_sharded(mesh: Mesh, bitmaps,

@@ -19,6 +19,11 @@ def workload():
 
 
 @pytest.fixture(scope="module")
+def mesh8():
+    return Mesh(np.array(jax.devices()).reshape(4, 2), ("rows", "lanes"))
+
+
+@pytest.fixture(scope="module")
 def oracle_or(workload):
     acc = RoaringBitmap()
     for b in workload:
@@ -96,3 +101,41 @@ def test_sharded_census1881_parity(op):
     mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("rows", "lanes"))
     keys, words, cards = sharding.wide_aggregate_sharded(mesh, op, bms)
     assert packing.unpack_result(keys, words, cards) == oracle
+
+
+def test_compact_ingest_sharded_parity(mesh8, rng):
+    """ingest="compact" (streams sharded, per-shard device densify) must be
+    bit-identical to the host-densified dense ingest — incl. byte-backed
+    sources, which ship ~serialized-size to the mesh."""
+    bms = []
+    for i in range(12):
+        vals = [rng.integers(0, 1 << 20, 600),
+                (2 << 16) + rng.integers(0, 9000, 6000)]
+        start = (3 << 16) + int(rng.integers(0, 900))
+        vals.append(np.arange(start, start + 5000 + 97 * i))
+        b = RoaringBitmap.from_values(np.concatenate(vals).astype(np.uint32))
+        b.run_optimize()
+        bms.append(b)
+    for op in ("or", "xor"):
+        kd, wd, cd = sharding.wide_aggregate_sharded(mesh8, op, bms, ingest="dense")
+        for src in (bms, [b.serialize() for b in bms]):
+            kc, wc, cc = sharding.wide_aggregate_sharded(mesh8, op, src,
+                                                   ingest="compact")
+            got = packing.unpack_result(kc, wc, cc)
+            want = packing.unpack_result(kd, wd, cd)
+            assert got == want, (op, type(src[0]).__name__)
+
+
+def test_sharded_ingest_validation_and_bytes_and(mesh8, rng):
+    bms = [RoaringBitmap.from_values(
+        np.concatenate([np.arange(5, 400),
+                        ((i + 1) << 16) + rng.integers(0, 5000, 100)])
+        .astype(np.uint32)) for i in range(4)]
+    with pytest.raises(ValueError, match="unknown ingest"):
+        sharding.wide_aggregate_sharded(mesh8, "or", bms, ingest="streams")
+    # AND over raw bytes: zero-copy wrap, workShy path, exact result
+    want = bms[0] & bms[1] & bms[2] & bms[3]
+    assert want.cardinality
+    keys, words, cards = sharding.wide_aggregate_sharded(
+        mesh8, "and", [b.serialize() for b in bms], ingest="compact")
+    assert packing.unpack_result(keys, words, cards) == want

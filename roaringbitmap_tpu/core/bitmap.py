@@ -144,6 +144,14 @@ class RoaringBitmap:
             total += self.containers[i].rank(x & 0xFFFF)
         return total
 
+    def range_cardinality(self, start: int, stop: int) -> int:
+        """Number of members in [start, stop)
+        (RoaringBitmap.rangeCardinality:2668)."""
+        if stop <= start:
+            return 0
+        hi = self.rank(stop - 1)
+        return hi - (self.rank(start - 1) if start > 0 else 0)
+
     def select(self, j: int) -> int:
         """j-th smallest member, 0-based (RoaringBitmap.select:2820)."""
         for k, c in zip(self.keys, self.containers):

@@ -130,3 +130,37 @@ def hbm_footprint_bytes(rb: RoaringBitmap) -> int:
     (u32[K, 2048] rows) — the HBM-accounting analog of the reference's JOL
     memory tests (SURVEY §5)."""
     return rb.container_count() * C.WORDS_PER_CONTAINER * 8
+
+
+def recommend_device_layout(bitmaps, hbm_budget_bytes: int = 512 << 20) -> dict:
+    """Advise DeviceBitmapSet layout from dense blowup AND absolute HBM.
+
+    The dense HBM image costs 8 KB/container; the compact layout costs
+    ~serialized size plus a per-query on-device densify (measured ~1.2-1.4x
+    the dense query marginal, benchmarks/realdata_r03.json
+    wide_or/device-pallas-marginal-compact).  Dense stays the default while
+    it is affordable — a census-like 6x blowup over 2 MB serialized is 12 MB
+    of HBM, trivially worth the fastest query path.  Compact wins when the
+    blowup is extreme (uscensus2000: ~1300x — paying 39 MB to hold 30 KB of
+    data) or the dense image would crowd the budget shared with other
+    resident sets.
+    """
+    dense_b = 0
+    ser_b = 0
+    for b in bitmaps:
+        dense_b += hbm_footprint_bytes(b)
+        ser_b += b.serialized_size_in_bytes()
+    ratio = dense_b / ser_b if ser_b else 1.0
+    layout = ("compact" if ratio >= 32.0 or dense_b > hbm_budget_bytes
+              else "dense")
+    return {
+        "layout": layout,
+        "dense_hbm_bytes": dense_b,
+        "serialized_bytes": ser_b,
+        "dense_blowup": round(ratio, 2),
+        "why": ("dense image affordable (blowup < 32x, within budget) — "
+                "fastest repeated queries" if layout == "dense" else
+                "extreme blowup or budget pressure: compact streams cost "
+                "~serialized size in HBM for a ~1.2-1.4x query-marginal "
+                "penalty"),
+    }

@@ -411,11 +411,7 @@ class DeviceBitmapSet:
         the aggregate, fused on device via ops.dense.range_cardinality; only
         one scalar returns to host."""
         heads, _ = self.aggregate_device(op, engine)
-        key_base = self.keys.astype(np.int64) << 16
-        lo = jnp.asarray(np.clip(start - key_base, 0, 1 << 16)[:, None])
-        hi = jnp.asarray(np.clip(stop - key_base, 0, 1 << 16)[:, None])
-        return int(np.asarray(jnp.sum(
-            dense.range_cardinality(heads, lo, hi))))
+        return _device_range_cardinality(self.keys, heads, start, stop)
 
     def aggregate(self, op: str, engine: str = "auto") -> RoaringBitmap:
         words, cards = self.aggregate_device(op, engine)
@@ -586,3 +582,112 @@ class DeviceBitmapSet:
                 0, reps, body_compact, (carry0, jnp.uint32(0)))[1]
 
         return jax.jit(run_compact)
+
+
+def _device_range_cardinality(keys: np.ndarray, words, start: int,
+                              stop: int) -> int:
+    """Bits of a device [K, 2048] image within global value range
+    [start, stop): per-key bounds clamped host-side, fused popcount on
+    device, one scalar back (RoaringBitmap.rangeCardinality:2668)."""
+    key_base = keys.astype(np.int64) << 16
+    lo = jnp.asarray(np.clip(start - key_base, 0, 1 << 16)[:, None])
+    hi = jnp.asarray(np.clip(stop - key_base, 0, 1 << 16)[:, None])
+    return int(np.asarray(jnp.sum(dense.range_cardinality(words, lo, hi))))
+
+
+# ----------------------------------------------------- device query plans
+
+class DeviceBitmap:
+    """A bitmap living in HBM: host key index + device u32[K, 2048] image.
+
+    The composition tier SURVEY §7 hard part (d) calls for: results of
+    wide aggregates stay device-resident and compose (AND/OR/XOR/ANDNOT)
+    without a host round trip, the way the reference chains ops over
+    mmap'd ImmutableRoaringBitmaps without heap materialization.  Only
+    `materialize()` / `cardinality()` move data host-ward (and
+    cardinality moves one scalar).
+
+    Key alignment between two operands happens on the host (keys are a
+    few hundred u16s), the word algebra on device: operands are scattered
+    into the union key space — zero rows are the identity for or/xor/
+    andnot and annihilate correctly for and — then one fused pairwise op
+    + popcount runs over the aligned images.
+    """
+
+    def __init__(self, keys: np.ndarray, words, cards=None):
+        self.keys = np.asarray(keys)
+        self.words = words              # u32[K, 2048] device array
+        self._cards = cards             # i32[K] device array or None
+
+    @staticmethod
+    def aggregate(ds: "DeviceBitmapSet", op: str,
+                  engine: str = "auto") -> "DeviceBitmap":
+        """Wide op over a resident set -> device-resident result."""
+        words, cards = ds.aggregate_device(op, engine=engine)
+        return DeviceBitmap(ds.keys, words, cards)
+
+    @staticmethod
+    def from_host(rb: RoaringBitmap) -> "DeviceBitmap":
+        packed = packing.pack_for_aggregation([rb], pad_rows=False)
+        return DeviceBitmap(packed.keys, jnp.asarray(packed.words))
+
+    def _aligned(self, other: "DeviceBitmap"):
+        """Scatter both operands into the union key space (device gather,
+        host-computed index maps)."""
+        union = np.union1d(self.keys, other.keys)
+        k = union.size
+
+        def expand(db):
+            idx = np.searchsorted(union, db.keys)
+            out = jnp.zeros((k, packing.WORDS32), jnp.uint32)
+            if db.keys.size:
+                out = out.at[jnp.asarray(idx)].set(db.words)
+            return out
+
+        return union, expand(self), expand(other)
+
+    def _binary(self, other: "DeviceBitmap", op: str) -> "DeviceBitmap":
+        union, a, b = self._aligned(other)
+        words, cards = dense.pairwise(op, a, b)
+        return DeviceBitmap(union, words, cards)
+
+    def __and__(self, o):
+        return self._binary(o, "and")
+
+    def __or__(self, o):
+        return self._binary(o, "or")
+
+    def __xor__(self, o):
+        return self._binary(o, "xor")
+
+    def __sub__(self, o):
+        return self._binary(o, "andnot")
+
+    def and_not(self, o):
+        return self._binary(o, "andnot")
+
+    def cards(self):
+        if self._cards is None:
+            self._cards = dense.popcount(self.words)
+        return self._cards
+
+    def cardinality(self) -> int:
+        """One scalar to host."""
+        return int(np.asarray(jnp.sum(self.cards())))
+
+    def range_cardinality(self, start: int, stop: int) -> int:
+        """Bits in [start, stop) — fused on device, one scalar back."""
+        return _device_range_cardinality(self.keys, self.words, start, stop)
+
+    def materialize(self, out_cls=None) -> RoaringBitmap:
+        """Move to host as a normalized RoaringBitmap (the single
+        host-ward edge of a query plan)."""
+        return packing.unpack_result(
+            self.keys, np.asarray(self.words), np.asarray(self.cards()),
+            out_cls=out_cls)
+
+    def hbm_bytes(self) -> int:
+        return int(self.words.nbytes)
+
+    def __repr__(self) -> str:
+        return f"DeviceBitmap(keys={self.keys.size}, hbm={self.hbm_bytes()}B)"

@@ -198,3 +198,55 @@ def test_chained_aggregate_parity_all_ops_layouts(rng):
         got_wb = int(np.asarray(
             ds.chained_wide_or(reps, engine="xla")(ds.words)))
         assert got_wb == (reps * want["or"]) % 2**32, layout
+
+
+class TestDeviceQueryPlans:
+    """DeviceBitmap: aggregate results compose on device (SURVEY §7 hard
+    part (d) — no host round trip inside a query plan)."""
+
+    def _sets(self, rng):
+        mk = lambda seed: [RoaringBitmap.from_values(
+            np.random.default_rng(seed + i).integers(
+                0, 1 << 19, 4000).astype(np.uint32)) for i in range(8)]
+        return mk(100), mk(200)
+
+    def test_compose_two_aggregates(self, rng):
+        from roaringbitmap_tpu.parallel import fast_aggregation
+        from roaringbitmap_tpu.parallel.aggregation import (
+            DeviceBitmap, DeviceBitmapSet)
+
+        a_bms, b_bms = self._sets(rng)
+        ua = DeviceBitmap.aggregate(DeviceBitmapSet(a_bms), "or")
+        ub = DeviceBitmap.aggregate(DeviceBitmapSet(b_bms), "or")
+        host_a = fast_aggregation.or_(*a_bms)
+        host_b = fast_aggregation.or_(*b_bms)
+        for op, host in (
+                ("__and__", host_a & host_b), ("__or__", host_a | host_b),
+                ("__xor__", host_a ^ host_b), ("__sub__", host_a - host_b)):
+            got = getattr(ua, op)(ub)
+            assert got.materialize() == host, op
+            assert got.cardinality() == host.cardinality, op
+
+    def test_plan_chains_without_host(self, rng):
+        from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
+
+        a = RoaringBitmap.from_values(np.arange(0, 100000, 3, dtype=np.uint32))
+        b = RoaringBitmap.from_values(np.arange(0, 100000, 5, dtype=np.uint32))
+        c = RoaringBitmap.from_values(np.arange(0, 100000, 7, dtype=np.uint32))
+        da, db, dc = (DeviceBitmap.from_host(x) for x in (a, b, c))
+        plan = (da | db) & dc - (da & db)     # composes in HBM
+        want = ((a | b) & c) - (a & b)
+        assert plan.materialize() == want
+        assert plan.range_cardinality(1000, 50000) == \
+            want.range_cardinality(1000, 50000)
+
+    def test_disjoint_key_spaces(self):
+        from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
+
+        a = RoaringBitmap.bitmap_of(1, 2, 3)
+        b = RoaringBitmap.bitmap_of((5 << 16) + 1)
+        got = DeviceBitmap.from_host(a) | DeviceBitmap.from_host(b)
+        assert got.materialize() == (a | b)
+        empty = DeviceBitmap.from_host(a) & DeviceBitmap.from_host(b)
+        assert empty.cardinality() == 0
+        assert empty.materialize() == RoaringBitmap()

@@ -1,0 +1,20 @@
+"""Device-layout recommendation (insights HBM accounting tier)."""
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+
+
+def test_recommend_device_layout():
+    from roaringbitmap_tpu.insights.analysis import recommend_device_layout
+
+    dense_set = [RoaringBitmap.from_values(
+        np.arange(0, 60000, 2, dtype=np.uint32)) for _ in range(4)]
+    rec = recommend_device_layout(dense_set)
+    assert rec["layout"] == "dense" and rec["dense_blowup"] < 4
+    sparse_set = [RoaringBitmap.bitmap_of(i << 16) for i in range(30)]  # 8 KB rows for 1-bit containers
+    rec2 = recommend_device_layout(sparse_set)
+    assert rec2["layout"] == "compact" and rec2["dense_blowup"] >= 32
+    # budget pressure flips dense sets to compact too
+    rec3 = recommend_device_layout(dense_set, hbm_budget_bytes=16 << 10)
+    assert rec3["layout"] == "compact"

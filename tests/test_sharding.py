@@ -139,3 +139,14 @@ def test_sharded_ingest_validation_and_bytes_and(mesh8, rng):
     keys, words, cards = sharding.wide_aggregate_sharded(
         mesh8, "and", [b.serialize() for b in bms], ingest="compact")
     assert packing.unpack_result(keys, words, cards) == want
+
+
+def test_dense_ingest_accepts_bytes(mesh8, rng):
+    bms = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 18, 2000).astype(np.uint32)) for _ in range(6)]
+    want = RoaringBitmap()
+    for b in bms:
+        want.ior(b)
+    keys, words, cards = sharding.wide_aggregate_sharded(
+        mesh8, "or", [b.serialize() for b in bms], ingest="dense")
+    assert packing.unpack_result(keys, words, cards) == want

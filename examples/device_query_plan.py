@@ -1,0 +1,65 @@
+"""Device query plans: compose wide-aggregate results without leaving HBM.
+
+The TPU-native analog of chaining ops over mmap'd ImmutableRoaringBitmaps
+(MemoryMappingExample + BufferFastAggregation usage): two bitmap
+collections are packed once, each reduced on device, and the results
+combined with set algebra entirely in HBM — the host sees one scalar per
+cardinality probe and one materialized bitmap at the end.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.insights.analysis import recommend_device_layout
+from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap, DeviceBitmapSet
+
+
+def main() -> None:
+    rng = np.random.default_rng(42)
+    posts = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 20, 40_000).astype(np.uint32))
+        for _ in range(64)]                     # e.g. docs matching tag i
+    views = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 20, 25_000).astype(np.uint32))
+        for _ in range(64)]
+
+    advice = recommend_device_layout(posts + views)
+    print(f"layout advice: {advice['layout']} "
+          f"(dense blowup {advice['dense_blowup']}x)")
+
+    tagged = DeviceBitmap.aggregate(DeviceBitmapSet(posts), "or")
+    viewed = DeviceBitmap.aggregate(DeviceBitmapSet(views), "or")
+
+    # the whole plan runs on device; only scalars come back
+    both = tagged & viewed
+    either_only = (tagged | viewed) - both
+    print(f"tagged:        {tagged.cardinality():>9,}")
+    print(f"viewed:        {viewed.cardinality():>9,}")
+    print(f"both:          {both.cardinality():>9,}")
+    print(f"exactly one:   {either_only.cardinality():>9,}")
+    print(f"both in [0, 2^19): {both.range_cardinality(0, 1 << 19):,}")
+
+    probes = np.arange(0, 1 << 20, 9973, dtype=np.uint32)
+    hits = both.contains_batch(probes)
+    print(f"probe hits: {int(hits.sum())}/{probes.size}")
+
+    result = both.materialize()                 # single host-ward edge
+    print(f"materialized: {result!r}")
+
+    # parity against the host tier
+    host_t, host_v = RoaringBitmap(), RoaringBitmap()
+    for b in posts:
+        host_t.ior(b)
+    for b in views:
+        host_v.ior(b)
+    assert result == (host_t & host_v)
+    print("bit-exact with host tier")
+
+
+if __name__ == "__main__":
+    main()

@@ -546,7 +546,13 @@ def unpack_result(keys: np.ndarray, words: np.ndarray,
     from ..core import containers as C
 
     if out_cls is None:
-        out_cls = RoaringBitmap
+        if keys.dtype != np.uint16:
+            # u64 high-48 keys: the 64-bit tier rides the same engines
+            from ..core.bitmap64 import Roaring64Bitmap
+
+            out_cls = Roaring64Bitmap
+        else:
+            out_cls = RoaringBitmap
     words = np.asarray(words, dtype=np.uint32)
     cards = np.asarray(cards)
     out_keys, out_conts = [], []

@@ -413,9 +413,13 @@ class DeviceBitmapSet:
         heads, _ = self.aggregate_device(op, engine)
         return _device_range_cardinality(self.keys, heads, start, stop)
 
-    def aggregate(self, op: str, engine: str = "auto") -> RoaringBitmap:
+    def aggregate(self, op: str, engine: str = "auto",
+                  out_cls=None) -> RoaringBitmap:
         words, cards = self.aggregate_device(op, engine)
-        return packing.unpack_result(self.keys, np.asarray(words), np.asarray(cards))
+        # out_cls defaults by key dtype inside unpack_result (u64 keys ->
+        # Roaring64Bitmap), so every consumer gets the right tier
+        return packing.unpack_result(self.keys, np.asarray(words),
+                                     np.asarray(cards), out_cls=out_cls)
 
     def hbm_bytes(self) -> int:
         meta = int(self.blk_seg.nbytes + self.seg_ids.nbytes
@@ -678,6 +682,40 @@ class DeviceBitmap:
     def range_cardinality(self, start: int, stop: int) -> int:
         """Bits in [start, stop) — fused on device, one scalar back."""
         return _device_range_cardinality(self.keys, self.words, start, stop)
+
+    def contains_batch(self, values) -> np.ndarray:
+        """bool[N] membership of `values`, probed ON DEVICE — the batched
+        device form of RoaringBitmap.contains (the realdata contains
+        benchmark's host-only probe, done wide: key binary search + word
+        bit test are one fused gather program, no per-value host work)."""
+        if self.keys.dtype == np.uint16:
+            values = np.asarray(values, dtype=np.uint32)
+            if self.keys.size == 0:
+                return np.zeros(values.shape, bool)
+            keys_d = jnp.asarray(self.keys.astype(np.uint32))
+            v = jnp.asarray(values)
+            hb = v >> 16
+            idx = jnp.searchsorted(keys_d, hb)
+            safe = jnp.minimum(idx, self.keys.size - 1)
+            valid_d = (idx < self.keys.size) & (keys_d[safe] == hb)
+            lo = v & 0xFFFF
+            word = self.words[safe, (lo >> 5).astype(jnp.int32)]
+            bit = (word >> (lo & 31).astype(jnp.uint32)) & 1
+            return np.asarray(valid_d & (bit == 1))
+        # u64 high-48 keys: device integers default to 32 bits under JAX, so
+        # the key binary search runs host-side (K is small); the word/bit
+        # probe still rides the device image
+        values = np.asarray(values, dtype=np.uint64)
+        if self.keys.size == 0:
+            return np.zeros(values.shape, bool)
+        hb = values >> np.uint64(16)
+        idx = np.searchsorted(self.keys, hb)
+        safe = np.minimum(idx, self.keys.size - 1)
+        valid = (idx < self.keys.size) & (self.keys[safe] == hb)
+        lo = (values & np.uint64(0xFFFF)).astype(np.uint32)
+        word = self.words[jnp.asarray(safe), jnp.asarray((lo >> 5).astype(np.int32))]
+        bit = (word >> jnp.asarray(lo & 31)) & 1
+        return valid & (np.asarray(bit) == 1)
 
     def materialize(self, out_cls=None) -> RoaringBitmap:
         """Move to host as a normalized RoaringBitmap (the single

@@ -250,3 +250,37 @@ class TestDeviceQueryPlans:
         empty = DeviceBitmap.from_host(a) & DeviceBitmap.from_host(b)
         assert empty.cardinality() == 0
         assert empty.materialize() == RoaringBitmap()
+
+    def test_contains_batch_on_device(self, rng):
+        from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
+
+        rb = RoaringBitmap.from_values(
+            rng.integers(0, 1 << 20, 30000).astype(np.uint32))
+        db = DeviceBitmap.from_host(rb)
+        probes = np.concatenate([
+            rb.to_array()[::37],                       # present
+            rng.integers(0, 1 << 21, 500).astype(np.uint32),  # mixed
+            np.array([0, 0xFFFFFFFF], dtype=np.uint32)])
+        got = db.contains_batch(probes)
+        want = np.array([rb.contains(int(v)) for v in probes])
+        assert np.array_equal(got, want)
+        empty = DeviceBitmap.from_host(RoaringBitmap())
+        assert not empty.contains_batch(np.array([1, 2], np.uint32)).any()
+
+    def test_u64_plan_materialize_and_contains(self, rng):
+        from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+        from roaringbitmap_tpu.parallel.aggregation import (
+            DeviceBitmap, DeviceBitmapSet)
+
+        bms = [Roaring64Bitmap.from_values(
+            (np.uint64(1) << np.uint64(48)) * np.uint64(i % 2)
+            + np.arange(i * 100, 4000, dtype=np.uint64)) for i in range(4)]
+        from roaringbitmap_tpu.parallel import aggregation as agg
+        want = agg.or64(*bms)
+        db = DeviceBitmap.aggregate(DeviceBitmapSet(bms), "or")
+        got = db.materialize()
+        assert isinstance(got, Roaring64Bitmap) and got == want
+        probes = np.array([0, 50, 1 << 48, (1 << 48) + 399,
+                           (1 << 48) + 999999, 1 << 52], dtype=np.uint64)
+        res = db.contains_batch(probes)
+        assert res.tolist() == [want.contains(int(v)) for v in probes]

@@ -315,3 +315,22 @@ class TestWideAggregation64:
         for a in arrs[1:]:
             oracle = np.intersect1d(oracle, a)
         assert np.array_equal(got.to_array(), oracle)
+
+
+def test_device_set_with_u64_keys(rng):
+    """DeviceBitmapSet over the 64-bit tier: u64 high-48 keys ride the same
+    blocked engine (SURVEY §2.3 — same packed container pools)."""
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+    from roaringbitmap_tpu.parallel import aggregation
+
+    bms = []
+    for i in range(6):
+        vals = (np.uint64(1) << np.uint64(33)) * np.uint64(i % 3) \
+            + rng.integers(0, 1 << 18, 3000).astype(np.uint64)
+        bms.append(Roaring64Bitmap.from_values(vals))
+    want = aggregation.or64(*bms)
+    ds = DeviceBitmapSet(bms)
+    got = ds.aggregate("or", engine="xla")
+    assert got == want
+    assert np.array_equal(got.to_array(), want.to_array())

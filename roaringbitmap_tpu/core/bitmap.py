@@ -262,6 +262,113 @@ class RoaringBitmap:
         if n:
             yield np.concatenate(buf)
 
+    def for_each(self, fn) -> None:
+        """Visit every member ascending (RoaringBitmap.forEach:2082)."""
+        for v in self:
+            fn(v)
+
+    def for_each_in_range(self, start: int, stop: int, fn) -> None:
+        """Visit members in [start, stop) ascending (forEachInRange)."""
+        for v in self.to_array():
+            v = int(v)
+            if v >= stop:
+                return
+            if v >= start:
+                fn(v)
+
+    def for_all_in_range(self, start: int, stop: int, fn) -> None:
+        """Visit EVERY position in [start, stop) with its membership bit
+        (forAllInRange's RelativeRangeConsumer contract)."""
+        arr = self.to_array()
+        members = set(arr[(arr >= start) & (arr < stop)].tolist())
+        for v in range(start, stop):
+            fn(v - start, v in members)
+
+    def get_int_iterator(self):
+        """PeekableIntIterator flyweight (getIntIterator:2147)."""
+        from .iterators import PeekableIntIterator
+
+        return PeekableIntIterator(self)
+
+    def get_reverse_int_iterator(self):
+        """Descending flyweight (getReverseIntIterator:2160)."""
+        from .iterators import ReverseIntIterator
+
+        return ReverseIntIterator(self)
+
+    def get_signed_int_iterator(self):
+        """Ascending in SIGNED 32-bit order: negatives (values >= 2^31)
+        come first (getSignedIntIterator)."""
+        arr = self.to_array()
+        for v in arr[arr >= (1 << 31)]:
+            yield int(v) - (1 << 32)
+        for v in arr[arr < (1 << 31)]:
+            yield int(v)
+
+    def first_signed(self) -> int:
+        """Smallest member in signed-int order (firstSigned)."""
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        arr = self.to_array()
+        neg = arr[arr >= (1 << 31)]
+        return int(neg[0]) - (1 << 32) if neg.size else int(arr[0])
+
+    def last_signed(self) -> int:
+        """Largest member in signed-int order (lastSigned)."""
+        if self.is_empty():
+            raise ValueError("empty bitmap")
+        arr = self.to_array()
+        pos = arr[arr < (1 << 31)]
+        return int(pos[-1]) if pos.size else int(arr[-1]) - (1 << 32)
+
+    def cardinality_exceeds(self, threshold: int) -> bool:
+        """True iff cardinality > threshold, short-circuiting per container
+        (cardinalityExceeds)."""
+        total = 0
+        for c in self.containers:
+            total += c.cardinality
+            if total > threshold:
+                return True
+        return False
+
+    def select_range(self, start: int, end: int) -> "RoaringBitmap":
+        """Members with rank in [start, end), as a bitmap (selectRange)."""
+        if start < 0 or end <= start:
+            raise ValueError("invalid rank range")
+        arr = self.to_array()
+        if start >= arr.size:
+            raise ValueError("select_range: start beyond cardinality")
+        return RoaringBitmap.from_values(arr[start:min(end, arr.size)])
+
+    def rank_long(self, x: int) -> int:
+        """rankLong: Python ints never overflow; alias of rank."""
+        return self.rank(x)
+
+    @property
+    def long_cardinality(self) -> int:
+        """getLongCardinality alias (Python ints are unbounded)."""
+        return self.cardinality
+
+    def get_long_size_in_bytes(self) -> int:
+        return self.get_size_in_bytes()
+
+    def trim(self) -> None:
+        """trim(): NumPy container arrays are exact-sized already; kept for
+        API parity (the reference shrinks overallocated arrays)."""
+
+    @staticmethod
+    def bitmap_of_unordered(values) -> "RoaringBitmap":
+        """bitmapOfUnordered: from_values sorts internally."""
+        return RoaringBitmap.from_values(
+            np.asarray(values, dtype=np.uint32))
+
+    @staticmethod
+    def maximum_serialized_size(cardinality: int, universe_size: int) -> int:
+        """Analytic bound (RoaringBitmap.maximumSerializedSize:3030)."""
+        from ..format import spec
+
+        return spec.maximum_serialized_size(cardinality, universe_size)
+
     # -------------------------------------------------------------- mutation
     def add(self, x: int) -> None:
         """Point insert (RoaringBitmap.add:1162)."""

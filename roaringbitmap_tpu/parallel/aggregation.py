@@ -592,10 +592,15 @@ def _device_range_cardinality(keys: np.ndarray, words, start: int,
                               stop: int) -> int:
     """Bits of a device [K, 2048] image within global value range
     [start, stop): per-key bounds clamped host-side, fused popcount on
-    device, one scalar back (RoaringBitmap.rangeCardinality:2668)."""
-    key_base = keys.astype(np.int64) << 16
-    lo = jnp.asarray(np.clip(start - key_base, 0, 1 << 16)[:, None])
-    hi = jnp.asarray(np.clip(stop - key_base, 0, 1 << 16)[:, None])
+    device, one scalar back (RoaringBitmap.rangeCardinality:2668).
+
+    Clamping runs in Python ints: u64-tier key bases reach 2^64-2^16,
+    past int64, so NumPy signed arithmetic would overflow."""
+    bases = [int(k) << 16 for k in keys]
+    lo = jnp.asarray(np.array(
+        [[min(max(start - kb, 0), 1 << 16)] for kb in bases], np.int32))
+    hi = jnp.asarray(np.array(
+        [[min(max(stop - kb, 0), 1 << 16)] for kb in bases], np.int32))
     return int(np.asarray(jnp.sum(dense.range_cardinality(words, lo, hi))))
 
 
@@ -689,7 +694,14 @@ class DeviceBitmap:
         benchmark's host-only probe, done wide: key binary search + word
         bit test are one fused gather program, no per-value host work)."""
         if self.keys.dtype == np.uint16:
-            values = np.asarray(values, dtype=np.uint32)
+            raw = np.asarray(values)
+            # probes outside [0, 2^32) are definitionally absent — mask them
+            # instead of letting a uint32 cast wrap into false positives
+            in_range = ((raw >= 0) & (raw < (1 << 32))
+                        if raw.dtype.kind in "iu" and raw.itemsize > 4
+                        or raw.dtype.kind == "i"
+                        else np.ones(raw.shape, bool))
+            values = raw.astype(np.uint32)
             if self.keys.size == 0:
                 return np.zeros(values.shape, bool)
             keys_d = jnp.asarray(self.keys.astype(np.uint32))
@@ -701,7 +713,7 @@ class DeviceBitmap:
             lo = v & 0xFFFF
             word = self.words[safe, (lo >> 5).astype(jnp.int32)]
             bit = (word >> (lo & 31).astype(jnp.uint32)) & 1
-            return np.asarray(valid_d & (bit == 1))
+            return np.asarray(valid_d & (bit == 1)) & in_range
         # u64 high-48 keys: device integers default to 32 bits under JAX, so
         # the key binary search runs host-side (K is small); the word/bit
         # probe still rides the device image

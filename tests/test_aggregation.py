@@ -284,3 +284,24 @@ class TestDeviceQueryPlans:
                            (1 << 48) + 999999, 1 << 52], dtype=np.uint64)
         res = db.contains_batch(probes)
         assert res.tolist() == [want.contains(int(v)) for v in probes]
+
+    def test_contains_batch_out_of_range_probes(self):
+        from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
+
+        db = DeviceBitmap.from_host(RoaringBitmap.bitmap_of(5))
+        probes = np.array([5, 5 + (1 << 32), (1 << 63) + 5], dtype=np.uint64)
+        assert db.contains_batch(probes).tolist() == [True, False, False]
+        assert db.contains_batch(
+            np.array([-1, 5], dtype=np.int64)).tolist() == [False, True]
+
+    def test_u64_range_cardinality_top_half(self):
+        from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+        from roaringbitmap_tpu.parallel.aggregation import (
+            DeviceBitmap, DeviceBitmapSet)
+
+        vals = (np.uint64(1) << np.uint64(63)) + np.arange(100, dtype=np.uint64)
+        db = DeviceBitmap.aggregate(
+            DeviceBitmapSet([Roaring64Bitmap.from_values(vals)]), "or")
+        assert db.range_cardinality(0, 1 << 64) == 100
+        assert db.range_cardinality((1 << 63) + 50, 1 << 64) == 50
+        assert db.range_cardinality(0, 1 << 63) == 0

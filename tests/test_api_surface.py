@@ -316,3 +316,63 @@ class TestIteratorFlyweight:
         rit = ReverseIntIterator(rb)
         rb.add(9 << 16)
         assert list(rit) == [(1 << 16) + 5, 1 << 16, 3]
+
+
+class TestReferenceParityMethods:
+    """The long tail of RoaringBitmap.java public surface."""
+
+    def _rb(self):
+        return RoaringBitmap.from_values(np.array(
+            [3, 7, 100, 65536, 0x80000000, 0xFFFFFFFF], dtype=np.uint32))
+
+    def test_for_each_family(self):
+        rb = self._rb()
+        seen = []
+        rb.for_each(seen.append)
+        assert seen == rb.to_array().tolist()
+        seen2 = []
+        rb.for_each_in_range(5, 70000, seen2.append)
+        assert seen2 == [7, 100, 65536]
+        bits = []
+        rb.for_all_in_range(6, 9, lambda rel, present: bits.append((rel, present)))
+        assert bits == [(0, False), (1, True), (2, False)]
+
+    def test_iterator_getters(self):
+        rb = self._rb()
+        assert list(rb.get_int_iterator()) == rb.to_array().tolist()
+        assert list(rb.get_reverse_int_iterator()) == rb.to_array()[::-1].tolist()
+        signed = list(rb.get_signed_int_iterator())
+        assert signed == [-(1 << 31), -1, 3, 7, 100, 65536]
+
+    def test_signed_bounds(self):
+        rb = self._rb()
+        assert rb.first_signed() == -(1 << 31)
+        assert rb.last_signed() == 65536
+        pos_only = RoaringBitmap.bitmap_of(5, 9)
+        assert pos_only.first_signed() == 5 and pos_only.last_signed() == 9
+        neg_only = RoaringBitmap.bitmap_of(0xFFFFFFF0)
+        assert neg_only.first_signed() == -16 and neg_only.last_signed() == -16
+
+    def test_cardinality_exceeds_and_select_range(self):
+        rb = self._rb()
+        assert rb.cardinality_exceeds(5) and not rb.cardinality_exceeds(6)
+        sel = rb.select_range(1, 4)
+        assert sel.to_array().tolist() == [7, 100, 65536]
+        with pytest.raises(ValueError):
+            rb.select_range(10, 12)
+
+    def test_aliases(self):
+        rb = self._rb()
+        assert rb.rank_long(100) == rb.rank(100) == 3
+        assert rb.long_cardinality == rb.cardinality
+        assert rb.get_long_size_in_bytes() == rb.get_size_in_bytes()
+        rb.trim()  # no-op, must exist
+        assert RoaringBitmap.bitmap_of_unordered([9, 1, 5]) == \
+            RoaringBitmap.bitmap_of(1, 5, 9)
+        assert RoaringBitmap.maximum_serialized_size(100, 1 << 20) > 200
+
+    def test_signed_bounds_empty_raises(self):
+        with pytest.raises(ValueError, match="empty"):
+            RoaringBitmap().first_signed()
+        with pytest.raises(ValueError, match="empty"):
+            RoaringBitmap().last_signed()

@@ -161,6 +161,99 @@ class ImmutableRoaringBitmap:
         return MutableRoaringBitmap(self._view.keys.copy(),
                                     list(self.containers))
 
+    # ------------------------------------------------- read-only long tail
+    # Delegations completing the ImmutableBitmapDataProvider surface; each
+    # materializes at most what the host method needs (to_bitmap for
+    # value-array walks — containers wrap lazily and cache).
+    def for_each(self, fn) -> None:
+        self.to_bitmap().for_each(fn)
+
+    def for_each_in_range(self, start: int, stop: int, fn) -> None:
+        self.to_bitmap().for_each_in_range(start, stop, fn)
+
+    def for_all_in_range(self, start: int, stop: int, fn) -> None:
+        self.to_bitmap().for_all_in_range(start, stop, fn)
+
+    def get_int_iterator(self):
+        return self.to_bitmap().get_int_iterator()
+
+    def get_reverse_int_iterator(self):
+        return self.to_bitmap().get_reverse_int_iterator()
+
+    def get_signed_int_iterator(self):
+        return self.to_bitmap().get_signed_int_iterator()
+
+    def first_signed(self) -> int:
+        return self.to_bitmap().first_signed()
+
+    def last_signed(self) -> int:
+        return self.to_bitmap().last_signed()
+
+    def cardinality_exceeds(self, threshold: int) -> bool:
+        # header-only: no payload touched at all
+        total = 0
+        for c in self._view.cardinalities:
+            total += int(c)
+            if total > threshold:
+                return True
+        return False
+
+    def range_cardinality(self, start: int, stop: int) -> int:
+        if stop <= start:
+            return 0
+        hi = self.rank(stop - 1)
+        return hi - (self.rank(start - 1) if start > 0 else 0)
+
+    def rank_long(self, x: int) -> int:
+        return self.rank(x)
+
+    @property
+    def long_cardinality(self) -> int:
+        return self.cardinality
+
+    def select_range(self, start: int, end: int) -> RoaringBitmap:
+        """Members with rank in [start, end): header cumsum locates the
+        container span; only those containers materialize."""
+        if start < 0 or end <= start:
+            raise ValueError("invalid rank range")
+        cum = np.concatenate(([0], np.cumsum(self._view.cardinalities)))
+        if start >= cum[-1]:
+            raise ValueError("select_range: start beyond cardinality")
+        end = min(end, int(cum[-1]))
+        first = int(np.searchsorted(cum, start, side="right")) - 1
+        last = int(np.searchsorted(cum, end, side="left"))
+        parts = []
+        for i in range(first, last):
+            vals = (np.uint32(int(self._view.keys[i]) << 16)
+                    | self._container(i).values().astype(np.uint32))
+            parts.append(vals[max(start - int(cum[i]), 0):end - int(cum[i])])
+        return RoaringBitmap.from_values(np.concatenate(parts))
+
+    def next_value(self, x: int) -> int:
+        """Smallest member >= x, -1 if none — rank/select over the header,
+        touching at most one container."""
+        r = self.rank(x - 1) if x > 0 else 0
+        if r >= self.cardinality:
+            return -1
+        return self.select(r)
+
+    def previous_value(self, x: int) -> int:
+        """Largest member <= x, -1 if none."""
+        r = self.rank(x)
+        return -1 if r == 0 else self.select(r - 1)
+
+    def next_absent_value(self, x: int) -> int:
+        return self.to_bitmap().next_absent_value(x)
+
+    def previous_absent_value(self, x: int) -> int:
+        return self.to_bitmap().previous_absent_value(x)
+
+    def limit(self, max_cardinality: int) -> RoaringBitmap:
+        """First max_cardinality members (limit) — same lazy span walk."""
+        if max_cardinality <= 0 or self.is_empty():
+            return RoaringBitmap()
+        return self.select_range(0, max_cardinality)
+
     # ----------------------------------------------------------- set algebra
     # In-RAM results, like the reference's static ops on immutable inputs.
     def __and__(self, o) -> RoaringBitmap:

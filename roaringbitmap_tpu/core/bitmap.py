@@ -269,12 +269,10 @@ class RoaringBitmap:
 
     def for_each_in_range(self, start: int, stop: int, fn) -> None:
         """Visit members in [start, stop) ascending (forEachInRange)."""
-        for v in self.to_array():
-            v = int(v)
-            if v >= stop:
-                return
-            if v >= start:
-                fn(v)
+        arr = self.to_array()
+        lo, hi = np.searchsorted(arr, [start, stop])
+        for v in arr[lo:hi]:
+            fn(int(v))
 
     def for_all_in_range(self, start: int, stop: int, fn) -> None:
         """Visit EVERY position in [start, stop) with its membership bit

@@ -717,7 +717,11 @@ class DeviceBitmap:
         # u64 high-48 keys: device integers default to 32 bits under JAX, so
         # the key binary search runs host-side (K is small); the word/bit
         # probe still rides the device image
-        values = np.asarray(values, dtype=np.uint64)
+        raw = np.asarray(values)
+        # negative probes are definitionally absent — mask, don't wrap
+        in_range64 = (raw >= 0 if raw.dtype.kind == "i"
+                      else np.ones(raw.shape, bool))
+        values = raw.astype(np.uint64)
         if self.keys.size == 0:
             return np.zeros(values.shape, bool)
         hb = values >> np.uint64(16)
@@ -727,7 +731,7 @@ class DeviceBitmap:
         lo = (values & np.uint64(0xFFFF)).astype(np.uint32)
         word = self.words[jnp.asarray(safe), jnp.asarray((lo >> 5).astype(np.int32))]
         bit = (word >> jnp.asarray(lo & 31)) & 1
-        return valid & (np.asarray(bit) == 1)
+        return valid & (np.asarray(bit) == 1) & in_range64
 
     def materialize(self, out_cls=None) -> RoaringBitmap:
         """Move to host as a normalized RoaringBitmap (the single

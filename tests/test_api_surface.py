@@ -376,3 +376,51 @@ class TestReferenceParityMethods:
             RoaringBitmap().first_signed()
         with pytest.raises(ValueError, match="empty"):
             RoaringBitmap().last_signed()
+
+
+class TestImmutableLongTail:
+    def _im(self):
+        from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+        rb = RoaringBitmap.from_values(np.array(
+            [3, 7, 100, 65536, 0x80000000], dtype=np.uint32))
+        return ImmutableRoaringBitmap(rb.serialize()), rb
+
+    def test_delegated_surface(self):
+        im, rb = self._im()
+        seen = []
+        im.for_each(seen.append)
+        assert seen == rb.to_array().tolist()
+        assert list(im.get_int_iterator()) == rb.to_array().tolist()
+        assert im.first_signed() == rb.first_signed()
+        assert im.last_signed() == rb.last_signed()
+        assert im.range_cardinality(5, 70000) == rb.range_cardinality(5, 70000)
+        assert im.rank_long(100) == rb.rank(100)
+        assert im.long_cardinality == rb.cardinality
+        assert im.select_range(1, 3) == rb.select_range(1, 3)
+        assert im.next_value(8) == rb.next_value(8)
+        assert im.previous_absent_value(100) == rb.previous_absent_value(100)
+        assert im.limit(2) == rb.limit(2)
+
+    def test_cardinality_exceeds_header_only(self):
+        im, rb = self._im()
+        assert im.cardinality_exceeds(4) and not im.cardinality_exceeds(5)
+        assert im._all is None  # header-only: nothing materialized
+
+    def test_lazy_navigation_touches_minimal_containers(self):
+        from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+        rb = RoaringBitmap.from_values(np.concatenate([
+            np.arange(0, 100, dtype=np.uint32),
+            (1 << 16) + np.arange(0, 100, dtype=np.uint32),
+            (5 << 16) + np.arange(0, 100, dtype=np.uint32)]))
+        im = ImmutableRoaringBitmap(rb.serialize())
+        assert im.next_value(50) == rb.next_value(50) == 50
+        assert im.previous_value((1 << 16) + 5000) == \
+            rb.previous_value((1 << 16) + 5000)
+        assert im.next_value((6 << 16)) == rb.next_value((6 << 16)) == -1
+        assert im.previous_value(0) == rb.previous_value(0) == 0
+        # the full list is never built; only query-touched containers cache
+        assert im._all is None and len(im._cache) <= 3
+        sel = im.select_range(150, 250)
+        assert sel == rb.select_range(150, 250)
+        assert im.limit(5) == rb.limit(5)
+        assert im._all is None

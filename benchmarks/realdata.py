@@ -45,7 +45,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ALL_DATASETS = ("census1881", "census1881_srt", "uscensus2000",
                 "wikileaks-noquotes", "wikileaks-noquotes_srt")
-ALL_GROUPS = ("wide", "pairwise", "micro", "bsi", "rangebitmap")
+ALL_GROUPS = ("wide", "pairwise", "micro", "containers", "bsi",
+              "rangebitmap")
 
 WIDE_R = (100, 4100)      # chained rep pair for wide marginals
 PAIR_R = (100, 2100)      # pairwise marginals
@@ -291,6 +292,36 @@ def bench_micro(st: dict, cells: dict, reps: int) -> None:
         "ms": round(t * 1e3, 3), "mvals_per_s": round(n / t / 1e6, 2)}
 
 
+def bench_containers(st: dict, cells: dict, reps: int) -> None:
+    """Container-kind micro ops — the jmh bitmapcontainer/arraycontainer/
+    runcontainer tier: pairwise AND/OR ns per container-kind pair, sampled
+    from the dataset's real containers."""
+    from roaringbitmap_tpu.core import containers as C
+
+    by_kind: dict[str, list] = {"array": [], "bitmap": [], "run": []}
+    for b in st["bms"]:
+        for c in b.containers:
+            kind = ("run" if isinstance(c, C.RunContainer) else
+                    "bitmap" if isinstance(c, C.BitmapContainer) else "array")
+            if len(by_kind[kind]) < 64:
+                by_kind[kind].append(c)
+    for ka in ("array", "bitmap", "run"):
+        for kb in ("array", "bitmap", "run"):
+            if ka > kb:
+                continue  # op is symmetric; keep the upper triangle
+            a_list, b_list = by_kind[ka], by_kind[kb]
+            if not a_list or not b_list:
+                continue
+            pairs = [(a_list[i % len(a_list)], b_list[(i + 1) % len(b_list)])
+                     for i in range(32)]
+            for opname, op in (("and", C.container_and),
+                               ("or", C.container_or)):
+                t = _timeit(lambda: [op(a, b) for a, b in pairs],
+                            reps) / len(pairs)
+                cells[f"container_{opname}/{ka}x{kb}"] = {
+                    "ns": round(t * 1e9)}
+
+
 def bench_bsi(st: dict, cells: dict, reps: int) -> None:
     from roaringbitmap_tpu.bsi.slice_index import Operation
 
@@ -404,8 +435,8 @@ def main() -> None:
         states[name] = ingest_dataset(name)
 
     group_fn = {"wide": bench_wide, "pairwise": bench_pairwise,
-                "micro": bench_micro, "bsi": bench_bsi,
-                "rangebitmap": bench_rangebitmap}
+                "micro": bench_micro, "containers": bench_containers,
+                "bsi": bench_bsi, "rangebitmap": bench_rangebitmap}
     for name in args.datasets:
         print(f"[realdata] query {name} ...", file=sys.stderr)
         st = states[name]
@@ -434,9 +465,11 @@ def main() -> None:
               f"{data['hbm_dense_mb']} MB dense / "
               f"{data['hbm_compact_mb']} MB compact HBM)", file=sys.stderr)
         for cell, v in sorted(data["cells"].items()):
-            val = v.get("ms", v.get("us", v.get("us_per_op", v.get("mb"))))
+            val = v.get("ms", v.get("us", v.get(
+                "us_per_op", v.get("ns", v.get("mb")))))
             unit = ("ms" if "ms" in v else "us" if "us" in v
-                    else "us/op" if "us_per_op" in v else "mb")
+                    else "us/op" if "us_per_op" in v
+                    else "ns" if "ns" in v else "mb")
             note = f"  ({v['note']})" if "note" in v else ""
             extra = "".join(f" {k}={v[k]}" for k in ("mb_per_s", "mvals_per_s")
                             if k in v)

@@ -176,6 +176,103 @@ class Roaring64Bitmap:
         r = self.rank(x)
         return self.select(r - 1) if r > 0 else -1
 
+    def rank_long(self, x: int) -> int:
+        """rankLong alias (Python ints are unbounded)."""
+        return self.rank(x)
+
+    @property
+    def int_cardinality(self) -> int:
+        """getIntCardinality: clamps to int range in the reference; Python
+        ints don't overflow, so this equals cardinality."""
+        return self.cardinality
+
+    def get_long_size_in_bytes(self) -> int:
+        return self.get_size_in_bytes()
+
+    def trim(self) -> None:
+        """trim(): NumPy-backed containers are exact-sized; API parity."""
+
+    def limit(self, max_cardinality: int) -> "Roaring64Bitmap":
+        """First max_cardinality members (limit) — walks containers only
+        until the budget is spent (never materializes the whole set)."""
+        if max_cardinality <= 0 or self.is_empty():
+            return Roaring64Bitmap()
+        parts: list[np.ndarray] = []
+        left = max_cardinality
+        for k, c in zip(self.keys, self.containers):
+            vals = c.values()[:left].astype(np.uint64)
+            parts.append(np.uint64(int(k) << 16) | vals)
+            left -= vals.size
+            if left == 0:
+                break
+        return Roaring64Bitmap.from_values(np.concatenate(parts))
+
+    def for_each(self, fn) -> None:
+        """Visit every member ascending (forEach)."""
+        for v in self:
+            fn(v)
+
+    def for_each_in_range(self, start: int, stop: int, fn) -> None:
+        """Visit members in [start, stop) ascending (forEachInRange).
+        stop=2^64 covers the top of the universe (same exclusive-stop
+        convention as add_range)."""
+        for v in self.long_iterator_from(start):
+            if v >= stop:
+                return
+            fn(v)
+
+    def for_all_in_range(self, start: int, stop: int, fn) -> None:
+        """Visit every position in [start, stop) with its membership bit
+        (forAllInRange)."""
+        members = set()
+        for v in self.long_iterator_from(start):
+            if v >= stop:
+                break
+            members.add(v)
+        for v in range(start, stop):
+            fn(v - start, v in members)
+
+    def long_iterator(self):
+        """Ascending iterator (getLongIterator)."""
+        return iter(self)
+
+    def long_iterator_from(self, minimum: int):
+        """Ascending from the first member >= minimum (getLongIteratorFrom)
+        — lazy per container, like __iter__."""
+        hb = high48(minimum)
+        i = int(np.searchsorted(self.keys, np.uint64(hb)))
+        for j in range(i, self.keys.size):
+            k = int(self.keys[j])
+            vals = self.containers[j].values()
+            if k == hb:
+                vals = vals[np.searchsorted(vals, low16(minimum)):]
+            base = k << 16
+            for v in vals:
+                yield base | int(v)
+
+    def reverse_long_iterator(self):
+        """Descending iterator (getReverseLongIterator) — lazy per
+        container."""
+        for j in range(self.keys.size - 1, -1, -1):
+            base = int(self.keys[j]) << 16
+            for v in self.containers[j].values()[::-1]:
+                yield base | int(v)
+
+    def reverse_long_iterator_from(self, maximum: int):
+        """Descending from the last member <= maximum
+        (getReverseLongIteratorFrom) — lazy per container."""
+        hb = high48(maximum)
+        i = int(np.searchsorted(self.keys, np.uint64(hb), side="right")) - 1
+        for j in range(i, -1, -1):
+            k = int(self.keys[j])
+            vals = self.containers[j].values()
+            if k == hb:
+                vals = vals[:np.searchsorted(vals, low16(maximum),
+                                             side="right")]
+            base = k << 16
+            for v in vals[::-1]:
+                yield base | int(v)
+
     # ------------------------------------------------------------- iteration
     def to_array(self) -> np.ndarray:
         if not self.containers:

@@ -334,3 +334,48 @@ def test_device_set_with_u64_keys(rng):
     got = ds.aggregate("or", engine="xla")
     assert got == want
     assert np.array_equal(got.to_array(), want.to_array())
+
+
+def test_long_tail_surface():
+    """Roaring64Bitmap's visitor/iterator long tail (forEach family,
+    getLongIterator(From), limit, aliases)."""
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    vals = np.array([5, 100, (1 << 40) + 3, (1 << 63) + 9], dtype=np.uint64)
+    rb = Roaring64Bitmap.from_values(vals)
+    seen = []
+    rb.for_each(seen.append)
+    assert seen == vals.tolist()
+    seen2 = []
+    rb.for_each_in_range(6, 1 << 41, seen2.append)
+    assert seen2 == [100, (1 << 40) + 3]
+    bits = []
+    rb.for_all_in_range(99, 102, lambda rel, p: bits.append((rel, p)))
+    assert bits == [(0, False), (1, True), (2, False)]
+    assert list(rb.long_iterator()) == vals.tolist()
+    assert list(rb.long_iterator_from(100)) == vals[1:].tolist()
+    assert list(rb.reverse_long_iterator()) == vals[::-1].tolist()
+    assert list(rb.reverse_long_iterator_from(1 << 40)) == [100, 5]
+    assert rb.limit(2).to_array().tolist() == [5, 100]
+    assert rb.rank_long((1 << 40) + 3) == 3
+    assert rb.int_cardinality == rb.cardinality == 4
+    assert rb.get_long_size_in_bytes() == rb.get_size_in_bytes()
+    rb.trim()
+
+
+def test_long_tail_u64_boundaries():
+    """stop=2^64 covers the top of the universe; iterators stay lazy."""
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    top = (1 << 64) - 1
+    rb = Roaring64Bitmap.from_values(np.array([5, top], dtype=np.uint64))
+    seen = []
+    rb.for_each_in_range(0, 1 << 64, seen.append)
+    assert seen == [5, top]
+    bits = []
+    rb.for_all_in_range(top - 1, 1 << 64, lambda r, p: bits.append((r, p)))
+    assert bits == [(0, False), (1, True)]
+    assert list(rb.long_iterator_from(6)) == [top]
+    assert list(rb.reverse_long_iterator_from(top)) == [top, 5]
+    assert list(rb.reverse_long_iterator_from(top - 1)) == [5]
+    assert rb.limit(1).to_array().tolist() == [5]

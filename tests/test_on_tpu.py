@@ -132,3 +132,44 @@ class TestIndexTiersOnChip:
         assert dev.lte(thr) == rbm.lte(thr)
         assert dev.between(thr // 2, thr * 2) == rbm.between(thr // 2, thr * 2)
         assert dev.lte_cardinality(thr) == rbm.lte_cardinality(thr)
+
+
+class TestPlansAndNativeOnChip:
+    """Round-3 additions on compiled Mosaic/XLA: device query plans,
+    native byte ingest, membership probes."""
+
+    def test_native_ingest_to_aggregate(self, census):
+        from roaringbitmap_tpu import native
+
+        if native.load() is None:
+            pytest.skip("native engine unavailable")
+        blobs = [b.serialize() for b in census]
+        ds = aggregation.DeviceBitmapSet(blobs)
+        assert ds.aggregate("or", engine="pallas") == \
+            fast_aggregation.or_(*census)
+
+    def test_query_plan_composes_on_chip(self, census):
+        from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
+
+        half = len(census) // 2
+        ua = DeviceBitmap.aggregate(
+            aggregation.DeviceBitmapSet(census[:half]), "or")
+        ub = DeviceBitmap.aggregate(
+            aggregation.DeviceBitmapSet(census[half:]), "or")
+        plan = (ua | ub) - (ua & ub)
+        want = fast_aggregation.or_(*census[:half]) ^ \
+            fast_aggregation.or_(*census[half:])
+        assert plan.materialize() == want
+        assert plan.cardinality() == want.cardinality
+
+    def test_contains_batch_on_chip(self, census):
+        from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
+
+        union = fast_aggregation.or_(*census)
+        db = DeviceBitmap.from_host(union)
+        arr = union.to_array()
+        probes = np.concatenate(
+            [arr[::997], np.arange(0, 1 << 22, 65521, dtype=np.uint32)])
+        got = db.contains_batch(probes)
+        want = np.array([union.contains(int(v)) for v in probes])
+        assert np.array_equal(got, want)

@@ -399,3 +399,24 @@ def test_chained_device_probes_parity(rng):
             fn, _ = aggregation.chained_pairwise_cardinality(
                 op, pairs, 3, engine=eng)
             assert int(np.asarray(fn())) == (3 * want_p) % 2**32, (op, eng)
+
+
+def test_device_bsi_accepts_immutable(rng):
+    """DeviceBSI packs an mmap-able ImmutableBitSliceIndex directly — the
+    buffer-tier -> HBM seam (ImmutableBitSliceIndex wraps slices zero-copy;
+    DeviceBSI densifies them once)."""
+    from roaringbitmap_tpu.bsi.device import DeviceBSI
+    from roaringbitmap_tpu.bsi.immutable import ImmutableBitSliceIndex
+    from roaringbitmap_tpu.bsi.slice_index import (
+        Operation, RoaringBitmapSliceIndex)
+
+    vals = rng.integers(0, 1 << 16, 3000).astype(np.uint64)
+    bsi = RoaringBitmapSliceIndex.from_pairs(
+        np.arange(vals.size, dtype=np.uint32), vals)
+    dev = DeviceBSI(ImmutableBitSliceIndex(bsi.serialize_buffer()))
+    thr = int(np.median(vals))
+    for op in (Operation.LT, Operation.GE):
+        assert dev.compare_cardinality(op, thr) == \
+            bsi.compare(op, thr, 0, None).cardinality, op
+    assert dev.sum() == bsi.sum()
+    assert dev.top_k(100) == bsi.top_k(100)

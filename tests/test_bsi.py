@@ -262,11 +262,18 @@ class TestImmutableBSI:
         assert imm.sum() == bsi.sum()
 
     def test_device_from_immutable(self, data, bsi, imm):
-        """mmap -> HBM: DeviceBSI accepts the immutable tier directly."""
+        """mmap -> HBM: DeviceBSI accepts the immutable tier directly —
+        full seam parity (compare/cardinality/sum/topK) so it cannot
+        silently regress."""
         dev = DeviceBSI(imm)
         pred = int(np.median(data[1]))
-        assert dev.compare(Operation.LT, pred) == \
-            bsi.compare(Operation.LT, pred)
+        for op in (Operation.LT, Operation.GE):
+            assert dev.compare(op, pred) == bsi.compare(op, pred), op
+            assert dev.compare_cardinality(op, pred) == \
+                bsi.compare(op, pred).cardinality, op
+        assert dev.sum() == bsi.sum()
+        k = min(100, bsi.ebm.cardinality)
+        assert dev.top_k(k) == bsi.top_k(k)
 
     def test_truncated_rejected(self, bsi):
         from roaringbitmap_tpu.bsi import ImmutableBitSliceIndex
@@ -400,23 +407,3 @@ def test_chained_device_probes_parity(rng):
                 op, pairs, 3, engine=eng)
             assert int(np.asarray(fn())) == (3 * want_p) % 2**32, (op, eng)
 
-
-def test_device_bsi_accepts_immutable(rng):
-    """DeviceBSI packs an mmap-able ImmutableBitSliceIndex directly — the
-    buffer-tier -> HBM seam (ImmutableBitSliceIndex wraps slices zero-copy;
-    DeviceBSI densifies them once)."""
-    from roaringbitmap_tpu.bsi.device import DeviceBSI
-    from roaringbitmap_tpu.bsi.immutable import ImmutableBitSliceIndex
-    from roaringbitmap_tpu.bsi.slice_index import (
-        Operation, RoaringBitmapSliceIndex)
-
-    vals = rng.integers(0, 1 << 16, 3000).astype(np.uint64)
-    bsi = RoaringBitmapSliceIndex.from_pairs(
-        np.arange(vals.size, dtype=np.uint32), vals)
-    dev = DeviceBSI(ImmutableBitSliceIndex(bsi.serialize_buffer()))
-    thr = int(np.median(vals))
-    for op in (Operation.LT, Operation.GE):
-        assert dev.compare_cardinality(op, thr) == \
-            bsi.compare(op, thr, 0, None).cardinality, op
-    assert dev.sum() == bsi.sum()
-    assert dev.top_k(100) == bsi.top_k(100)

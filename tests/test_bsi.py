@@ -268,11 +268,11 @@ class TestImmutableBSI:
         dev = DeviceBSI(imm)
         pred = int(np.median(data[1]))
         for op in (Operation.LT, Operation.GE):
-            assert dev.compare(op, pred) == bsi.compare(op, pred), op
-            assert dev.compare_cardinality(op, pred) == \
-                bsi.compare(op, pred).cardinality, op
+            want = bsi.compare(op, pred)
+            assert dev.compare(op, pred) == want, op
+            assert dev.compare_cardinality(op, pred) == want.cardinality, op
         assert dev.sum() == bsi.sum()
-        k = min(100, bsi.ebm.cardinality)
+        k = min(100, bsi.cardinality)
         assert dev.top_k(k) == bsi.top_k(k)
 
     def test_truncated_rejected(self, bsi):

@@ -217,10 +217,20 @@ def bench_wide(st: dict, cells: dict, reps: int) -> None:
 
 
 def bench_pairwise(st: dict, cells: dict, reps: int) -> None:
+    from roaringbitmap_tpu.ops import packing
     from roaringbitmap_tpu.parallel import aggregation
 
     bms = st["bms"]
     pairs = list(zip(bms[:-1], bms[1:]))
+    # pack cost (round-3 weak #1: host densify dominated e2e; now compact
+    # streams + device densify — compare against wide pack_ms)
+    cells["pairwise_pack/host-objects"] = {"ms": round(_timeit(
+        lambda: packing.pack_pairwise(pairs), reps) * 1e3, 3)}
+    bpairs = list(zip(st["blobs"][:-1], st["blobs"][1:]))
+    cells["pairwise_pack/native-bytes"] = {"ms": round(_timeit(
+        lambda: packing.pack_pairwise(bpairs), reps) * 1e3, 3)}
+    # resident pair batch, compact HBM layout (one set serves all op kinds)
+    ps_compact = aggregation.DevicePairSet(pairs, layout="compact")
     for kind, host_op in (("and", lambda a, b: a & b),
                           ("or", lambda a, b: a | b)):
         host_cards = [host_op(a, b).cardinality for a, b in pairs]
@@ -245,6 +255,14 @@ def bench_pairwise(st: dict, cells: dict, reps: int) -> None:
                 cells[f"pairwise_{kind}/{eng_name}-marginal"] = {
                     "us": round(per * 1e6, 2),
                     "note": f"{len(pairs)} pairs per op"}
+        # resident pair batch, compact HBM layout: densify-per-query cost
+        per = _marginal(
+            lambda r, kind=kind: ps_compact.chained_cardinality(kind, r),
+            total, PAIR_R)
+        if per is not None:
+            cells[f"pairwise_{kind}/device-resident-compact-marginal"] = {
+                "us": round(per * 1e6, 2),
+                "note": "compact HBM layout, densify per query"}
 
 
 def bench_micro(st: dict, cells: dict, reps: int) -> None:

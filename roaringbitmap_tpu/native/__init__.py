@@ -78,6 +78,22 @@ def load() -> ctypes.CDLL | None:
         lib.rb_export.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 9
         lib.rb_free.restype = None
         lib.rb_free.argtypes = [ctypes.c_void_p]
+        lib.rb_ingest_pairwise.restype = ctypes.c_void_p
+        lib.rb_ingest_pairwise.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64]
+        lib.rbp_error.restype = ctypes.c_char_p
+        lib.rbp_error.argtypes = [ctypes.c_void_p]
+        for name in ("rbp_m", "rbp_md_a", "rbp_v_a", "rbp_mv_a",
+                     "rbp_md_b", "rbp_v_b", "rbp_mv_b"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.rbp_export.restype = None
+        lib.rbp_export.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 12
+        lib.rbp_free.restype = None
+        lib.rbp_free.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -137,3 +153,60 @@ def pack_blocked_compact_native(blobs: list[bytes], block: int | None,
         n_blocks=int(n_blocks), seg_sizes=seg_sizes,
         seg_offsets=seg_offsets, streams=streams,
         carry_row=int(carry_row))
+
+
+def pack_pairwise_native(a_blobs: list[bytes], b_blobs: list[bytes],
+                         pad_rows: bool):
+    """Native per-pair union alignment of serialized pairs; returns a
+    PackedPairwiseCompact, or None when the native path is unavailable.
+    Raises InvalidRoaringFormat on hostile input (same guards as the
+    NumPy path)."""
+    from ..format.spec import InvalidRoaringFormat
+    from ..ops import packing
+
+    lib = load()
+    if lib is None:
+        return None
+    n = len(a_blobs)
+    a_ptrs = (ctypes.c_char_p * n)(*a_blobs)
+    b_ptrs = (ctypes.c_char_p * n)(*b_blobs)
+    a_lens = np.array([len(b) for b in a_blobs], dtype=np.int64)
+    b_lens = np.array([len(b) for b in b_blobs], dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    handle = lib.rb_ingest_pairwise(
+        a_ptrs, a_lens.ctypes.data_as(i64p),
+        b_ptrs, b_lens.ctypes.data_as(i64p), n)
+    try:
+        err = lib.rbp_error(handle)
+        if err:
+            raise InvalidRoaringFormat(err.decode())
+        m = lib.rbp_m(handle)
+        keys = np.empty(m, np.uint16)
+        heads = np.empty(n + 1, np.int64)
+        sides = {}
+        bufs = []
+        for side in ("a", "b"):
+            md = getattr(lib, f"rbp_md_{side}")(handle)
+            v = getattr(lib, f"rbp_v_{side}")(handle)
+            mv = getattr(lib, f"rbp_mv_{side}")(handle)
+            sides[side] = (np.empty((md, packing.WORDS32), np.uint32),
+                           np.empty(md, np.int32), np.empty(v, np.uint16),
+                           np.empty(mv, np.int32), np.empty(mv, np.int32))
+            bufs.extend(sides[side])
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        lib.rbp_export(handle, ptr(keys), ptr(heads),
+                       *[ptr(x) for x in bufs])
+    finally:
+        lib.rbp_free(handle)
+    m = int(m)
+    n_rows = packing.next_pow2(m) if pad_rows else m
+
+    def streams(side):
+        dw, dd, vals, vc, vd = sides[side]
+        return packing.CompactStreams(
+            n_rows=n_rows, dense_words=dw, dense_dest=dd, values=vals,
+            val_counts=vc, val_dest=vd)
+
+    return packing.PackedPairwiseCompact(
+        keys=keys, heads=heads, m=m, n_rows=n_rows,
+        a_streams=streams("a"), b_streams=streams("b"))

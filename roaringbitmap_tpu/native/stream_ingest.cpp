@@ -116,6 +116,101 @@ bool parse_source(const uint8_t* buf, int64_t len,
   return true;
 }
 
+// The two transfer streams of ops.packing.CompactStreams: dense wire
+// images (bitmap / big-run) and raw u16 member values (array / small-run).
+struct StreamSet {
+  std::vector<uint32_t> dense_words;   // [Md * 2048]
+  std::vector<int32_t> dense_dest;     // [Md]
+  std::vector<uint16_t> values;        // [V]
+  std::vector<int32_t> val_counts;     // [Mv]
+  std::vector<int32_t> val_dest;       // [Mv]
+};
+
+// Classify one container record into the stream set at destination `row`
+// (the emission half of ops.packing._emit_container_streams, validation
+// included).  Returns false with err set on hostile input.
+bool emit_container(const ContainerRec& r, int64_t row, int64_t pos,
+                    StreamSet& S, Err& err) {
+  if (r.kind == 1) {                       // bitmap: wire image as-is
+    if (r.payload_len != 8192) {
+      err.fail("container %ld: truncated bitmap payload", pos);
+      return false;
+    }
+    size_t at = S.dense_words.size();
+    S.dense_words.resize(at + WORDS32);
+    std::memcpy(S.dense_words.data() + at, r.payload, 8192);
+    S.dense_dest.push_back((int32_t)row);
+    return true;
+  }
+  if (r.kind == 2) {                       // run container
+    int64_t nruns = rd16(r.payload);
+    if (r.payload_len != 2 + 4 * nruns) {
+      err.fail("container %ld: truncated run payload", pos);
+      return false;
+    }
+    int64_t total = 0, prev_end = -1;
+    for (int64_t j = 0; j < nruns; j++) {
+      int64_t start = rd16(r.payload + 2 + 4 * j);
+      int64_t end = start + rd16(r.payload + 2 + 4 * j + 2);
+      if (end > 0xFFFF) {
+        err.fail("container %ld: run extends past 65535", pos);
+        return false;
+      }
+      if (start <= prev_end) {
+        err.fail("container %ld: overlapping/unsorted runs", pos);
+        return false;
+      }
+      prev_end = end;
+      total += end - start + 1;
+    }
+    if (total != r.card) {
+      err.fail("container %ld: run cardinality mismatch", pos);
+      return false;
+    }
+    if (total > ARRAY_MAX) {               // big run: densify to words
+      size_t at = S.dense_words.size();
+      S.dense_words.resize(at + WORDS32, 0);
+      uint32_t* w = S.dense_words.data() + at;
+      for (int64_t j = 0; j < nruns; j++) {
+        int64_t start = rd16(r.payload + 2 + 4 * j);
+        int64_t end = start + rd16(r.payload + 2 + 4 * j + 2);
+        for (int64_t v = start; v <= end; v++)
+          w[v >> 5] |= (uint32_t)1 << (v & 31);
+      }
+      S.dense_dest.push_back((int32_t)row);
+    } else if (total) {                    // small run: value stream
+      for (int64_t j = 0; j < nruns; j++) {
+        int64_t start = rd16(r.payload + 2 + 4 * j);
+        int64_t end = start + rd16(r.payload + 2 + 4 * j + 2);
+        for (int64_t v = start; v <= end; v++)
+          S.values.push_back((uint16_t)v);
+      }
+      S.val_counts.push_back((int32_t)total);
+      S.val_dest.push_back((int32_t)row);
+    }
+    return true;
+  }
+  // array container: sorted u16 values, shipped raw
+  int64_t n = r.payload_len / 2;
+  for (int64_t j = 1; j < n; j++) {
+    uint16_t a, b2;
+    std::memcpy(&a, r.payload + 2 * (j - 1), 2);
+    std::memcpy(&b2, r.payload + 2 * j, 2);
+    if (b2 <= a) {
+      err.fail("container %ld: array values not strictly increasing", pos);
+      return false;
+    }
+  }
+  if (n) {
+    size_t at = S.values.size();
+    S.values.resize(at + n);
+    std::memcpy(S.values.data() + at, r.payload, 2 * n);
+    S.val_counts.push_back((int32_t)n);
+    S.val_dest.push_back((int32_t)row);
+  }
+  return true;
+}
+
 }  // namespace
 
 struct IngestResult {
@@ -123,11 +218,7 @@ struct IngestResult {
   std::vector<int32_t> blk_seg;        // [nb_pad]
   std::vector<int64_t> seg_sizes;      // [K] true rows per segment
   std::vector<int64_t> seg_offsets;    // [K] first padded row
-  std::vector<uint32_t> dense_words;   // [Md * 2048]
-  std::vector<int32_t> dense_dest;     // [Md]
-  std::vector<uint16_t> values;        // [V]
-  std::vector<int32_t> val_counts;     // [Mv]
-  std::vector<int32_t> val_dest;       // [Mv]
+  StreamSet s;
   int64_t n_blocks = 0, nb_pad = 0, carry_row = -1;
   int block = 8;
   Err err;
@@ -200,90 +291,12 @@ IngestResult* rb_ingest(const uint8_t* const* bufs, const int64_t* lens,
   // emission in sorted-stable order: walk sources/containers in input
   // order per key bucket via a second counting pass
   std::vector<int64_t> next_in_seg(K, 0);
-  std::vector<uint16_t> run_vals;  // scratch for run expansion
   for (int64_t pos = 0; pos < m; pos++) {
     // rows arrive in input order; their slot is seg_offsets[seg] + seen
     const ContainerRec& r = recs[pos];
     int64_t seg = seg_of_key[r.key];
     int64_t row = R->seg_offsets[seg] + next_in_seg[seg]++;
-    if (r.kind == 1) {                       // bitmap: wire image as-is
-      if (r.payload_len != 8192) {
-        R->err.fail("container %ld: truncated bitmap payload", pos);
-        return R;
-      }
-      size_t at = R->dense_words.size();
-      R->dense_words.resize(at + WORDS32);
-      std::memcpy(R->dense_words.data() + at, r.payload, 8192);
-      R->dense_dest.push_back((int32_t)row);
-      continue;
-    }
-    if (r.kind == 2) {                       // run container
-      int64_t nruns = rd16(r.payload);
-      if (r.payload_len != 2 + 4 * nruns) {
-        R->err.fail("container %ld: truncated run payload", pos);
-        return R;
-      }
-      int64_t total = 0, prev_end = -1;
-      for (int64_t j = 0; j < nruns; j++) {
-        int64_t start = rd16(r.payload + 2 + 4 * j);
-        int64_t end = start + rd16(r.payload + 2 + 4 * j + 2);
-        if (end > 0xFFFF) {
-          R->err.fail("container %ld: run extends past 65535", pos);
-          return R;
-        }
-        if (start <= prev_end) {
-          R->err.fail("container %ld: overlapping/unsorted runs", pos);
-          return R;
-        }
-        prev_end = end;
-        total += end - start + 1;
-      }
-      if (total != r.card) {
-        R->err.fail("container %ld: run cardinality mismatch", pos);
-        return R;
-      }
-      if (total > ARRAY_MAX) {               // big run: densify to words
-        size_t at = R->dense_words.size();
-        R->dense_words.resize(at + WORDS32, 0);
-        uint32_t* w = R->dense_words.data() + at;
-        for (int64_t j = 0; j < nruns; j++) {
-          int64_t start = rd16(r.payload + 2 + 4 * j);
-          int64_t end = start + rd16(r.payload + 2 + 4 * j + 2);
-          for (int64_t v = start; v <= end; v++)
-            w[v >> 5] |= (uint32_t)1 << (v & 31);
-        }
-        R->dense_dest.push_back((int32_t)row);
-      } else if (total) {                    // small run: value stream
-        for (int64_t j = 0; j < nruns; j++) {
-          int64_t start = rd16(r.payload + 2 + 4 * j);
-          int64_t end = start + rd16(r.payload + 2 + 4 * j + 2);
-          for (int64_t v = start; v <= end; v++)
-            R->values.push_back((uint16_t)v);
-        }
-        R->val_counts.push_back((int32_t)total);
-        R->val_dest.push_back((int32_t)row);
-      }
-      continue;
-    }
-    // array container: sorted u16 values, shipped raw
-    const uint16_t* vals = (const uint16_t*)r.payload;
-    int64_t n = r.payload_len / 2;
-    for (int64_t j = 1; j < n; j++) {
-      uint16_t a, b2;
-      std::memcpy(&a, r.payload + 2 * (j - 1), 2);
-      std::memcpy(&b2, r.payload + 2 * j, 2);
-      if (b2 <= a) {
-        R->err.fail("container %ld: array values not strictly increasing", pos);
-        return R;
-      }
-    }
-    if (n) {
-      size_t at = R->values.size();
-      R->values.resize(at + n);
-      std::memcpy(R->values.data() + at, vals, 2 * n);
-      R->val_counts.push_back((int32_t)n);
-      R->val_dest.push_back((int32_t)row);
-    }
+    if (!emit_container(r, row, pos, R->s, R->err)) return R;
   }
   return R;
 }
@@ -294,9 +307,21 @@ int rb_block(IngestResult* R) { return R->block; }
 int64_t rb_n_blocks(IngestResult* R) { return R->n_blocks; }
 int64_t rb_nb_pad(IngestResult* R) { return R->nb_pad; }
 int64_t rb_carry_row(IngestResult* R) { return R->carry_row; }
-int64_t rb_md(IngestResult* R) { return (int64_t)R->dense_dest.size(); }
-int64_t rb_total_values(IngestResult* R) { return (int64_t)R->values.size(); }
-int64_t rb_mv(IngestResult* R) { return (int64_t)R->val_counts.size(); }
+int64_t rb_md(IngestResult* R) { return (int64_t)R->s.dense_dest.size(); }
+int64_t rb_total_values(IngestResult* R) { return (int64_t)R->s.values.size(); }
+int64_t rb_mv(IngestResult* R) { return (int64_t)R->s.val_counts.size(); }
+
+namespace {
+void export_streams(StreamSet& S, uint32_t* dense_words, int32_t* dense_dest,
+                    uint16_t* values, int32_t* val_counts, int32_t* val_dest) {
+  auto cp = [](auto& v, auto* dst) {
+    if (!v.empty()) std::memcpy(dst, v.data(), v.size() * sizeof(v[0]));
+  };
+  cp(S.dense_words, dense_words); cp(S.dense_dest, dense_dest);
+  cp(S.values, values); cp(S.val_counts, val_counts);
+  cp(S.val_dest, val_dest);
+}
+}  // namespace
 
 void rb_export(IngestResult* R, uint16_t* keys, int32_t* blk_seg,
                int64_t* seg_sizes, int64_t* seg_offsets,
@@ -307,11 +332,94 @@ void rb_export(IngestResult* R, uint16_t* keys, int32_t* blk_seg,
   };
   cp(R->keys, keys); cp(R->blk_seg, blk_seg);
   cp(R->seg_sizes, seg_sizes); cp(R->seg_offsets, seg_offsets);
-  cp(R->dense_words, dense_words); cp(R->dense_dest, dense_dest);
-  cp(R->values, values); cp(R->val_counts, val_counts);
-  cp(R->val_dest, val_dest);
+  export_streams(R->s, dense_words, dense_dest, values, val_counts, val_dest);
 }
 
 void rb_free(IngestResult* R) { delete R; }
+
+// ------------------------------------------------------------ pairwise mode
+//
+// P serialized pairs -> per-pair union-key alignment + two stream sets
+// (the native half of ops.packing.pack_pairwise: RoaringBitmap.or's
+// two-pointer key merge, RoaringBitmap.java:864-894, batched).  Each pair's
+// a/b containers land at row = pair base + index of their key in the pair's
+// key union; the caller densifies both sides on device.
+
+struct PairwiseResult {
+  std::vector<uint16_t> keys;   // [M] per-pair union keys, concatenated
+  std::vector<int64_t> heads;   // [P+1] row bounds per pair
+  StreamSet a, b;
+  Err err;
+};
+
+PairwiseResult* rb_ingest_pairwise(const uint8_t* const* a_bufs,
+                                   const int64_t* a_lens,
+                                   const uint8_t* const* b_bufs,
+                                   const int64_t* b_lens, int64_t n_pairs) {
+  auto* R = new PairwiseResult();
+  R->heads.push_back(0);
+  std::vector<ContainerRec> ra, rb;
+  for (int64_t p = 0; p < n_pairs; p++) {
+    ra.clear(); rb.clear();
+    if (!parse_source(a_bufs[p], a_lens[p], ra, R->err)) return R;
+    if (!parse_source(b_bufs[p], b_lens[p], rb, R->err)) return R;
+    // two-pointer merge of the (strictly increasing) key lists
+    size_t i = 0, j = 0;
+    while (i < ra.size() || j < rb.size()) {
+      int64_t row = (int64_t)R->keys.size();
+      bool take_a, take_b;
+      uint16_t key;
+      if (i < ra.size() && j < rb.size()) {
+        take_a = ra[i].key <= rb[j].key;
+        take_b = rb[j].key <= ra[i].key;
+        key = take_a ? ra[i].key : rb[j].key;
+      } else if (i < ra.size()) {
+        take_a = true; take_b = false; key = ra[i].key;
+      } else {
+        take_a = false; take_b = true; key = rb[j].key;
+      }
+      if (take_a) {
+        if (!emit_container(ra[i], row, (int64_t)i, R->a, R->err)) return R;
+        i++;
+      }
+      if (take_b) {
+        if (!emit_container(rb[j], row, (int64_t)j, R->b, R->err)) return R;
+        j++;
+      }
+      R->keys.push_back(key);
+    }
+    R->heads.push_back((int64_t)R->keys.size());
+  }
+  return R;
+}
+
+const char* rbp_error(PairwiseResult* R) {
+  return R->err.set ? R->err.msg : nullptr;
+}
+int64_t rbp_m(PairwiseResult* R) { return (int64_t)R->keys.size(); }
+int64_t rbp_md_a(PairwiseResult* R) { return (int64_t)R->a.dense_dest.size(); }
+int64_t rbp_v_a(PairwiseResult* R) { return (int64_t)R->a.values.size(); }
+int64_t rbp_mv_a(PairwiseResult* R) { return (int64_t)R->a.val_counts.size(); }
+int64_t rbp_md_b(PairwiseResult* R) { return (int64_t)R->b.dense_dest.size(); }
+int64_t rbp_v_b(PairwiseResult* R) { return (int64_t)R->b.values.size(); }
+int64_t rbp_mv_b(PairwiseResult* R) { return (int64_t)R->b.val_counts.size(); }
+
+void rbp_export(PairwiseResult* R, uint16_t* keys, int64_t* heads,
+                uint32_t* a_dense_words, int32_t* a_dense_dest,
+                uint16_t* a_values, int32_t* a_val_counts, int32_t* a_val_dest,
+                uint32_t* b_dense_words, int32_t* b_dense_dest,
+                uint16_t* b_values, int32_t* b_val_counts,
+                int32_t* b_val_dest) {
+  auto cp = [](auto& v, auto* dst) {
+    if (!v.empty()) std::memcpy(dst, v.data(), v.size() * sizeof(v[0]));
+  };
+  cp(R->keys, keys); cp(R->heads, heads);
+  export_streams(R->a, a_dense_words, a_dense_dest, a_values, a_val_counts,
+                 a_val_dest);
+  export_streams(R->b, b_dense_words, b_dense_dest, b_values, b_val_counts,
+                 b_val_dest);
+}
+
+void rbp_free(PairwiseResult* R) { delete R; }
 
 }  // extern "C"

@@ -219,22 +219,58 @@ def _pairwise_engine(engine: str) -> str:
     return "xla" if engine == "auto" else engine
 
 
+def _densify_side(streams: packing.CompactStreams, n_rows: int):
+    """Compact stream -> dense u32[n_rows, 2048] device image, with
+    pow2-padded streams so ad-hoc call sites stop recompiling once the
+    workload shape stabilizes."""
+    s = packing.pad_streams_pow2(streams)
+    return dense.densify_streams(
+        jnp.asarray(s.dense_words), jnp.asarray(s.dense_dest),
+        jnp.asarray(s.values), jnp.asarray(s.val_counts),
+        jnp.asarray(s.val_dest), n_rows, s.total_values)
+
+
+def _dispatch_pairwise(op: str, a, b, eng: str):
+    """The single engine-dispatch point for aligned pairwise images.
+    `eng` must be pre-resolved (callers apply _pairwise_engine and the
+    empty-operand guard once)."""
+    if eng == "pallas":
+        return kernels.pairwise_popcount_pallas(op, a, b)
+    return dense.pairwise(op, a, b)
+
+
+def _resolve_pairwise_engine(engine: str, num_rows: int) -> str:
+    """_pairwise_engine plus the empty-operand guard: the pallas kernel
+    cannot tile a zero-row operand — route empty packs to the dense path."""
+    return _pairwise_engine(engine) if num_rows else "xla"
+
+
+def _unpack_pairs(keys: np.ndarray, heads: np.ndarray, words, cards,
+                  out_cls=None) -> list[RoaringBitmap]:
+    """Device pairwise result -> per-pair host bitmaps via the heads bounds."""
+    words, cards = np.asarray(words), np.asarray(cards)
+    return [packing.unpack_result(keys[lo:hi], words[lo:hi], cards[lo:hi],
+                                  out_cls=out_cls)
+            for lo, hi in zip(heads[:-1], heads[1:])]
+
+
 def pairwise_device(op: str, pairs, engine: str = "auto"):
     """Batched pairwise op on P bitmap pairs -> device (words, cards, packed).
 
     One fused kernel over every pair's key-aligned containers — the
     reference's per-pair container dispatch (Container.java:63-181,
-    BitmapContainer.or's branchless fused cardinality :1064-1085) done wide:
-    pallas engine = ops.kernels.pairwise_popcount_pallas (single HBM pass),
-    xla engine = ops.dense.pairwise (the default, see _pairwise_engine).
+    BitmapContainer.or's branchless fused cardinality :1064-1085) done wide.
+    Both operand sides ingest as compact byte streams and densify ON DEVICE
+    (ops.dense.densify_streams), so host pack cost is ~serialized size like
+    the wide path: pallas engine = ops.kernels.pairwise_popcount_pallas
+    (single HBM pass), xla engine = ops.dense.pairwise (the default, see
+    _pairwise_engine).
     """
     packed = packing.pack_pairwise(list(pairs))
-    a = jnp.asarray(packed.a_words)
-    b = jnp.asarray(packed.b_words)
-    if packed.keys.size and _pairwise_engine(engine) == "pallas":
-        words, cards = kernels.pairwise_popcount_pallas(op, a, b)
-    else:
-        words, cards = dense.pairwise(op, a, b)
+    a = _densify_side(packed.a_streams, packed.n_rows)
+    b = _densify_side(packed.b_streams, packed.n_rows)
+    words, cards = _dispatch_pairwise(
+        op, a, b, _resolve_pairwise_engine(engine, packed.keys.size))
     return words, cards, packed
 
 
@@ -242,14 +278,7 @@ def pairwise(op: str, pairs, engine: str = "auto",
              out_cls=None) -> list[RoaringBitmap]:
     """[a_i op b_i for each pair] with op in or/and/xor/andnot."""
     words, cards, packed = pairwise_device(op, pairs, engine)
-    words = np.asarray(words)
-    cards = np.asarray(cards)
-    out = []
-    for p in range(packed.heads.size - 1):
-        lo, hi = int(packed.heads[p]), int(packed.heads[p + 1])
-        out.append(packing.unpack_result(
-            packed.keys[lo:hi], words[lo:hi], cards[lo:hi], out_cls=out_cls))
-    return out
+    return _unpack_pairs(packed.keys, packed.heads, words, cards, out_cls)
 
 
 def chained_pairwise_cardinality(op: str, pairs, reps: int,
@@ -259,31 +288,131 @@ def chained_pairwise_cardinality(op: str, pairs, reps: int,
     optimization_barrier (the chained-marginal methodology).  Returns
     (jitted fn() -> total cardinality over all reps mod 2^32, packed) —
     callers assert fn() == (reps * sum(host pair cards)) % 2^32."""
-    packed = packing.pack_pairwise(list(pairs))
-    a = jax.device_put(packed.a_words)
-    b = jax.device_put(packed.b_words)
-    # zero-row pack (all pairs empty): the pallas kernel cannot tile an
-    # empty operand — route to the dense path, same guard as pairwise_device
-    eng = _pairwise_engine(engine) if packed.keys.size else "xla"
+    ps = DevicePairSet(list(pairs), layout="dense")
+    return ps.chained_cardinality(op, reps, engine), ps._packed
 
-    def body(i, total):
-        ab, _ = jax.lax.optimization_barrier((a, total))
-        if eng == "pallas":
-            _, cards = kernels.pairwise_popcount_pallas(op, ab, b)
+
+class DevicePairSet:
+    """P bitmap pairs packed once and kept HBM-resident for repeated
+    pairwise queries — the resident-pairs analog of DeviceBitmapSet.
+
+    The usage pattern: align the pair batch on its per-pair key unions
+    ONCE (compact byte-stream ingest, device densify), then run any of
+    or/and/xor/andnot over the resident aligned images without re-pack or
+    re-transfer — the way the reference keeps mmap'd
+    ImmutableRoaringBitmaps resident across repeated pairwise calls
+    (buffer/ImmutableRoaringBitmap.java README usage).
+
+    layout:
+      - "dense" (default): both aligned u32[rows, 2048] images resident.
+      - "compact": only the compact streams resident (~serialized size);
+        every query densifies transiently on device.
+    """
+
+    def __init__(self, pairs: list, layout: str = "dense"):
+        if layout not in ("dense", "compact"):
+            raise ValueError(f"unknown layout {layout!r}")
+        self.layout = layout
+        p = packing.pack_pairwise(list(pairs), pad_rows=False)
+        self._packed = p
+        self.keys, self.heads = p.keys, p.heads
+        self.n_pairs = int(p.heads.size) - 1
+        self._n_rows = p.n_rows
+
+        def put(s: packing.CompactStreams):
+            return (tuple(jax.device_put(x) for x in (
+                s.dense_words, s.dense_dest, s.values, s.val_counts,
+                s.val_dest)), s.total_values)
+
+        self._a, self._av = put(p.a_streams)
+        self._b, self._bv = put(p.b_streams)
+        if layout == "dense":
+            self.a_words = dense.densify_streams(*self._a, self._n_rows,
+                                                 self._av)
+            self.b_words = dense.densify_streams(*self._b, self._n_rows,
+                                                 self._bv)
+            self._a = self._b = None  # free the stream copies
         else:
-            _, cards = dense.pairwise(op, ab, b)
-        return total + jnp.sum(cards.astype(jnp.uint32))
+            self.a_words = self.b_words = None
 
-    fn = jax.jit(lambda: jax.lax.fori_loop(0, reps, body, jnp.uint32(0)))
-    return fn, packed
+    def _sides(self):
+        if self.a_words is not None:
+            return self.a_words, self.b_words
+        return (dense.densify_streams(*self._a, self._n_rows, self._av),
+                dense.densify_streams(*self._b, self._n_rows, self._bv))
+
+    def pairwise_device(self, op: str, engine: str = "auto"):
+        """(u32[M, 2048] result words, i32[M] cards) on device."""
+        a, b = self._sides()
+        return _dispatch_pairwise(
+            op, a, b, _resolve_pairwise_engine(engine, self.keys.size))
+
+    def cardinalities(self, op: str, engine: str = "auto") -> np.ndarray:
+        """i64[P] per-pair result cardinalities (P scalars to host)."""
+        _, cards = self.pairwise_device(op, engine)
+        return _per_pair_cards(cards, self.heads)
+
+    def pairwise(self, op: str, engine: str = "auto",
+                 out_cls=None) -> list[RoaringBitmap]:
+        """[a_i op b_i] materialized to host bitmaps."""
+        words, cards = self.pairwise_device(op, engine)
+        return _unpack_pairs(self.keys, self.heads, words, cards, out_cls)
+
+    def chained_cardinality(self, op: str, reps: int, engine: str = "auto"):
+        """reps dependent pairwise executions in ONE jit, barrier-serialized
+        (the chained-marginal methodology).  Returns a jitted fn() -> total
+        cardinality over all reps mod 2^32; compact layout densifies every
+        iteration (that IS the per-query cost being measured)."""
+        eng = _resolve_pairwise_engine(engine, self.keys.size)
+
+        if self.layout == "dense":
+            a, b = self.a_words, self.b_words
+
+            def body(i, total):
+                ab, _ = jax.lax.optimization_barrier((a, total))
+                _, cards = _dispatch_pairwise(op, ab, b, eng)
+                return total + jnp.sum(cards.astype(jnp.uint32))
+
+            return jax.jit(
+                lambda: jax.lax.fori_loop(0, reps, body, jnp.uint32(0)))
+
+        sa, sb = self._a, self._b
+        n_rows, av, bv = self._n_rows, self._av, self._bv
+
+        def body_compact(i, total):
+            # barrier EVERY stream array: anything left outside would be
+            # loop-invariant and XLA's while-loop LICM would hoist its
+            # densify out of the loop, under-measuring the per-query cost
+            (ba, bb), _ = jax.lax.optimization_barrier(((sa, sb), total))
+            a = dense.densify_streams_impl(
+                ba[0], ba[1].astype(jnp.int32), ba[2], ba[3], ba[4],
+                n_rows, av)
+            b = dense.densify_streams_impl(
+                bb[0], bb[1].astype(jnp.int32), bb[2], bb[3], bb[4],
+                n_rows, bv)
+            _, cards = _dispatch_pairwise(op, a, b, eng)
+            return total + jnp.sum(cards.astype(jnp.uint32))
+
+        return jax.jit(
+            lambda: jax.lax.fori_loop(0, reps, body_compact, jnp.uint32(0)))
+
+    def hbm_bytes(self) -> int:
+        if self.a_words is not None:
+            return int(self.a_words.nbytes + self.b_words.nbytes)
+        return sum(int(x.nbytes) for x in self._a + self._b)
+
+
+def _per_pair_cards(cards, heads: np.ndarray) -> np.ndarray:
+    """Per-row device cards -> i64[P] per-pair sums via the heads bounds."""
+    csum = np.concatenate(([0], np.cumsum(np.asarray(cards, dtype=np.int64))))
+    return csum[heads[1:]] - csum[heads[:-1]]
 
 
 def pairwise_cardinality(op: str, pairs, engine: str = "auto") -> np.ndarray:
     """i64[P] result cardinalities only (the andCardinality/orCardinality
     fast path, batched — nothing but P scalars leaves the device path)."""
     _, cards, packed = pairwise_device(op, pairs, engine)
-    csum = np.concatenate(([0], np.cumsum(np.asarray(cards, dtype=np.int64))))
-    return csum[packed.heads[1:]] - csum[packed.heads[:-1]]
+    return _per_pair_cards(cards, packed.heads)
 
 
 # ------------------------------------------------------------- 64-bit tier
@@ -549,10 +678,12 @@ class DeviceBitmapSet:
 
         def body_compact(i, state):
             total = state
-            dw, _ = jax.lax.optimization_barrier((streams[0], total))
+            # barrier EVERY stream array so the whole densify (value
+            # scatter included) stays loop-variant — nothing hoistable
+            s, _ = jax.lax.optimization_barrier((streams, total))
             words = dense.densify_streams_impl(
-                dw, streams[1].astype(jnp.int32), streams[2], streams[3],
-                streams[4], n_rows, total_values)
+                s[0], s[1].astype(jnp.int32), s[2], s[3], s[4],
+                n_rows, total_values)
             cards = reduce_cards(words)
             return total + jnp.sum(cards.astype(jnp.uint32))
 
@@ -582,12 +713,16 @@ class DeviceBitmapSet:
 
         def body_compact(i, state):
             carry, total = state
-            dw = jnp.concatenate([streams[0], carry[None]], axis=0)
+            # the carry write-back makes the dense-stream set loop-variant;
+            # barrier the sparse streams too so the value scatter can't be
+            # hoisted either
+            s, _ = jax.lax.optimization_barrier((streams, total))
+            dw = jnp.concatenate([s[0], carry[None]], axis=0)
             dd = jnp.concatenate(
-                [streams[1].astype(jnp.int32),
+                [s[1].astype(jnp.int32),
                  jnp.full((1,), carry_row, jnp.int32)])
             words = dense.densify_streams_impl(
-                dw, dd, streams[2], streams[3], streams[4],
+                dw, dd, s[2], s[3], s[4],
                 n_rows, total_values)
             heads, cards = reduce_step(words)
             return heads[0], total + jnp.sum(cards.astype(jnp.uint32))

@@ -305,3 +305,61 @@ class TestDeviceQueryPlans:
         assert db.range_cardinality(0, 1 << 64) == 100
         assert db.range_cardinality((1 << 63) + 50, 1 << 64) == 50
         assert db.range_cardinality(0, 1 << 63) == 0
+
+
+class TestDevicePairSet:
+    """Resident pair batch: pack once (compact streams, device densify),
+    query many — the pairwise analog of DeviceBitmapSet."""
+
+    @pytest.fixture(scope="class")
+    def pairs(self, workload):
+        return list(zip(workload[0::2], workload[1::2]))
+
+    @pytest.fixture(scope="class")
+    def want(self, pairs):
+        from roaringbitmap_tpu.core.bitmap import and_ as h_and, andnot as h_andnot
+        from roaringbitmap_tpu.core.bitmap import or_ as h_or, xor as h_xor
+
+        return {op: [f(a, b) for a, b in pairs]
+                for op, f in (("or", h_or), ("and", h_and), ("xor", h_xor),
+                              ("andnot", h_andnot))}
+
+    @pytest.mark.parametrize("layout", ["dense", "compact"])
+    @pytest.mark.parametrize("op", ["or", "and", "xor", "andnot"])
+    def test_all_ops_both_layouts(self, pairs, want, op, layout):
+        ps = aggregation.DevicePairSet(pairs, layout=layout)
+        assert ps.pairwise(op) == want[op]
+        assert ps.cardinalities(op).tolist() == [
+            w.cardinality for w in want[op]]
+
+    @pytest.mark.parametrize("engine", ["xla", "pallas"])
+    def test_engines_match(self, pairs, want, engine):
+        ps = aggregation.DevicePairSet(pairs)
+        assert ps.pairwise("xor", engine=engine) == want["xor"]
+
+    @pytest.mark.parametrize("layout", ["dense", "compact"])
+    def test_chained_cardinality(self, pairs, want, layout):
+        ps = aggregation.DevicePairSet(pairs, layout=layout)
+        total = sum(w.cardinality for w in want["and"])
+        got = int(np.asarray(ps.chained_cardinality("and", 3)()))
+        assert got == (3 * total) % (1 << 32)
+
+    def test_byte_backed_operands(self, pairs, want):
+        """Serialized blobs and ImmutableRoaringBitmaps stream straight off
+        the wire layout — parity with the object path."""
+        from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+
+        mixed = [(a.serialize(), ImmutableRoaringBitmap(b.serialize()))
+                 for a, b in pairs]
+        ps = aggregation.DevicePairSet(mixed)
+        assert ps.pairwise("or") == want["or"]
+
+    def test_empty_and_disjoint(self):
+        e = RoaringBitmap()
+        a = RoaringBitmap.bitmap_of(1, 2, 3)
+        b = RoaringBitmap.bitmap_of(0x20001)
+        ps = aggregation.DevicePairSet([(e, e), (a, b)])
+        got = ps.pairwise("or")
+        assert got[0].is_empty() and got[1] == (a | b)
+        assert ps.cardinalities("and").tolist() == [0, 0]
+        assert ps.hbm_bytes() > 0

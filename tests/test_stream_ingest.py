@@ -280,3 +280,53 @@ class TestNativeIngest:
         p = packing.pack_blocked_compact(blobs)
         assert p.keys.size
         monkeypatch.setattr(nat_mod, "_lib_failed", False)
+
+
+class TestNativePairwise:
+    """Native pairwise ingest (rb_ingest_pairwise) vs the NumPy oracle:
+    identical alignment and stream content, identical hostile-input
+    behavior."""
+
+    @pytest.fixture(scope="class")
+    def lib(self):
+        from roaringbitmap_tpu import native
+        if native.load() is None:
+            pytest.skip("native ingest unavailable")
+        return native
+
+    def test_pack_parity(self, lib):
+        bms = _mixed_bitmaps(seed=31, n=10)
+        pairs = list(zip(bms[0::2], bms[1::2]))
+        bpairs = [(a.serialize(), b.serialize()) for a, b in pairs]
+        nat = packing.pack_pairwise(bpairs)                    # native path
+        py = packing.pack_pairwise(pairs)                      # oracle path
+        assert np.array_equal(nat.keys, py.keys)
+        assert np.array_equal(nat.heads, py.heads)
+        assert (nat.m, nat.n_rows) == (py.m, py.n_rows)
+        for side in ("a_streams", "b_streams"):
+            sn, sp = getattr(nat, side), getattr(py, side)
+            assert np.array_equal(sn.dense_words, sp.dense_words)
+            assert np.array_equal(sn.dense_dest, sp.dense_dest)
+            assert np.array_equal(sn.values, sp.values)
+            assert np.array_equal(sn.val_counts, sp.val_counts)
+            assert np.array_equal(sn.val_dest, sp.val_dest)
+
+    def test_device_pairwise_through_native(self, lib):
+        bms = _mixed_bitmaps(seed=32, n=8)
+        pairs = list(zip(bms[0::2], bms[1::2]))
+        bpairs = [(a.serialize(), b.serialize()) for a, b in pairs]
+        got = aggregation.pairwise("xor", bpairs)
+        assert got == [a ^ b for a, b in pairs]
+
+    def test_hostile_bytes_rejected(self, lib):
+        good = RoaringBitmap.bitmap_of(1, 2, 3).serialize()
+        bad = bytearray(RoaringBitmap.from_values(
+            np.arange(0, 200, 2, dtype=np.uint32)).serialize())
+        view = spec.SerializedView(bytes(bad))
+        off = int(view.payload_offsets[0])
+        bad[off:off + 2], bad[off + 2:off + 4] = \
+            bad[off + 2:off + 4], bad[off:off + 2]   # unsorted array values
+        with pytest.raises(spec.InvalidRoaringFormat):
+            packing.pack_pairwise([(good, bytes(bad))])
+        with pytest.raises(spec.InvalidRoaringFormat):
+            packing.pack_pairwise([(b"\x00\x01", good)])

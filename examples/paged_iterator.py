@@ -1,0 +1,41 @@
+"""Paged iteration (examples/PagedIterator.java): walk a large bitmap in
+fixed-size pages via the seekable batch iterator, jumping straight to an
+arbitrary page without expanding anything before it."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+
+rb = RoaringBitmap.from_values(
+    np.arange(0, 50_000_000, 7, dtype=np.uint32))
+PAGE = 100_000
+
+# sequential paging: each next_batch() is one page
+it = rb.get_batch_iterator(PAGE)
+first_pages = []
+for _ in range(3):
+    first_pages.append(it.next_batch())
+print("first 3 pages:", [p.size for p in first_pages],
+      "page0 head:", first_pages[0][:5].tolist())
+
+# seek: jump straight to the page containing value 30,000,000 — the ~450
+# containers below it are skipped, never expanded
+it = rb.get_batch_iterator(PAGE)
+it.advance_if_needed(30_000_000)
+page = it.next_batch()
+print("page after seek starts at:", int(page[0]))
+assert int(page[0]) == 30_000_005  # first multiple of 7 >= 30M
+
+# the same works on a byte-backed immutable, where skipped containers are
+# not even decoded from the serialized buffer
+im = ImmutableRoaringBitmap(rb.serialize())
+it = im.get_batch_iterator(PAGE)
+it.advance_if_needed(30_000_000)
+assert int(it.next_batch()[0]) == 30_000_005
+print("immutable seek decoded only", len(im._cache), "containers")

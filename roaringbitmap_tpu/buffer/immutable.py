@@ -34,6 +34,54 @@ from ..core.bitmap import (
 from ..format import spec
 
 
+class _LazyContainerSeq:
+    """Sequence view over an immutable's containers, decoding on touch.
+
+    This is the laziness seam: core.bitmap's pairwise algebra and the
+    iterator flyweights index containers element-wise, so handing them
+    this sequence instead of a materialized list makes every op decode
+    only the containers it actually touches (ImmutableRoaringArray.
+    getContainerAtIndex semantics, buffer/ImmutableRoaringArray.java:166).
+    Decoded containers are cached on the owning bitmap.
+    """
+
+    __slots__ = ("_im",)
+
+    #: structural mutation is impossible on the byte-backed class, so
+    #: iterator flyweights may hold this sequence directly instead of
+    #: snapshotting (= decoding) the whole container list
+    immutable = True
+
+    def __init__(self, im: "ImmutableRoaringBitmap"):
+        self._im = im
+
+    def __len__(self) -> int:
+        return self._im._view.size
+
+    def __bool__(self) -> bool:
+        return self._im._view.size > 0
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self._im._container(i)
+
+    def __getitem__(self, i):
+        n = len(self)
+        if isinstance(i, slice):
+            return [self._im._container(j) for j in range(*i.indices(n))]
+        i = int(i)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError("container index out of range")
+        return self._im._container(i)
+
+    def cardinality_at(self, i: int) -> int:
+        """Header-only cardinality — lets rank walks skip containers
+        without decoding them."""
+        return int(self._im._view.cardinalities[i])
+
+
 class ImmutableRoaringBitmap:
     """Read-only view over a serialized 32-bit roaring bitmap."""
 
@@ -42,7 +90,7 @@ class ImmutableRoaringBitmap:
     def __init__(self, buf: bytes | memoryview):
         self._view = spec.SerializedView(buf)
         self._cache: dict[int, C.Container] = {}
-        self._all: list[C.Container] | None = None
+        self._seq = _LazyContainerSeq(self)
 
     # ----------------------------------------------------------- constructors
     @staticmethod
@@ -63,13 +111,13 @@ class ImmutableRoaringBitmap:
         return self._view.keys
 
     @property
-    def containers(self) -> list[C.Container]:
-        """Materialized container list — the seam the device packers and
-        pairwise algebra consume.  Built once and cached; the per-key loops
-        in core.bitmap index this property repeatedly."""
-        if self._all is None:
-            self._all = [self._container(i) for i in range(self._view.size)]
-        return self._all
+    def containers(self) -> _LazyContainerSeq:
+        """Lazy container sequence — the seam the device packers and
+        pairwise algebra consume.  Indexing decodes (and caches) ONE
+        container; ops touch only the indices they need, so an AND against
+        a 100k-container mmap'd file decodes O(result) containers, not all
+        of them."""
+        return self._seq
 
     def _container(self, i: int) -> C.Container:
         c = self._cache.get(i)
@@ -140,14 +188,14 @@ class ImmutableRoaringBitmap:
         return bool(self._view.is_run.any())
 
     # ------------------------------------------------------------- iteration
-    def to_array(self) -> np.ndarray:
-        return self.to_bitmap().to_array()
-
-    def __iter__(self) -> Iterator[int]:
-        return iter(self.to_bitmap())
-
-    def batch_iterator(self, batch_size: int = 65536):
-        return self.to_bitmap().batch_iterator(batch_size)
+    # RoaringBitmap's walks are reused as plain functions: they only touch
+    # .keys / .containers / ._index, and the lazy container sequence makes
+    # each decode exactly the containers it visits — one at a time, never
+    # a full to_bitmap() materialization.
+    to_array = RoaringBitmap.to_array
+    __iter__ = RoaringBitmap.__iter__
+    batch_iterator = RoaringBitmap.batch_iterator
+    get_batch_iterator = RoaringBitmap.get_batch_iterator
 
     # ------------------------------------------------------------ conversion
     def to_bitmap(self) -> RoaringBitmap:
@@ -162,32 +210,19 @@ class ImmutableRoaringBitmap:
                                     list(self.containers))
 
     # ------------------------------------------------- read-only long tail
-    # Delegations completing the ImmutableBitmapDataProvider surface; each
-    # materializes at most what the host method needs (to_bitmap for
-    # value-array walks — containers wrap lazily and cache).
-    def for_each(self, fn) -> None:
-        self.to_bitmap().for_each(fn)
-
-    def for_each_in_range(self, start: int, stop: int, fn) -> None:
-        self.to_bitmap().for_each_in_range(start, stop, fn)
-
-    def for_all_in_range(self, start: int, stop: int, fn) -> None:
-        self.to_bitmap().for_all_in_range(start, stop, fn)
-
-    def get_int_iterator(self):
-        return self.to_bitmap().get_int_iterator()
-
-    def get_reverse_int_iterator(self):
-        return self.to_bitmap().get_reverse_int_iterator()
-
-    def get_signed_int_iterator(self):
-        return self.to_bitmap().get_signed_int_iterator()
-
-    def first_signed(self) -> int:
-        return self.to_bitmap().first_signed()
-
-    def last_signed(self) -> int:
-        return self.to_bitmap().last_signed()
+    # Same reuse discipline as the iteration block: RoaringBitmap's
+    # implementations run against the lazy sequence, decoding only the
+    # containers each walk visits (the range walks touch only the chunk
+    # span; the flyweight iterators hold the sequence and expand one
+    # container at a time).
+    for_each = RoaringBitmap.for_each
+    for_each_in_range = RoaringBitmap.for_each_in_range
+    for_all_in_range = RoaringBitmap.for_all_in_range
+    get_int_iterator = RoaringBitmap.get_int_iterator
+    get_reverse_int_iterator = RoaringBitmap.get_reverse_int_iterator
+    get_signed_int_iterator = RoaringBitmap.get_signed_int_iterator
+    first_signed = RoaringBitmap.first_signed
+    last_signed = RoaringBitmap.last_signed
 
     def cardinality_exceeds(self, threshold: int) -> bool:
         # header-only: no payload touched at all
@@ -242,11 +277,9 @@ class ImmutableRoaringBitmap:
         r = self.rank(x)
         return -1 if r == 0 else self.select(r - 1)
 
-    def next_absent_value(self, x: int) -> int:
-        return self.to_bitmap().next_absent_value(x)
-
-    def previous_absent_value(self, x: int) -> int:
-        return self.to_bitmap().previous_absent_value(x)
+    # absent-value walks touch one container per chunk step — lazy too
+    next_absent_value = RoaringBitmap.next_absent_value
+    previous_absent_value = RoaringBitmap.previous_absent_value
 
     def limit(self, max_cardinality: int) -> RoaringBitmap:
         """First max_cardinality members (limit) — same lazy span walk."""

@@ -268,19 +268,42 @@ class RoaringBitmap:
             fn(v)
 
     def for_each_in_range(self, start: int, stop: int, fn) -> None:
-        """Visit members in [start, stop) ascending (forEachInRange)."""
-        arr = self.to_array()
-        lo, hi = np.searchsorted(arr, [start, stop])
-        for v in arr[lo:hi]:
-            fn(int(v))
+        """Visit members in [start, stop) ascending (forEachInRange) —
+        touches only the containers the range spans (a byte-backed bitmap
+        decodes nothing else)."""
+        for lo, hi_excl, hb in _chunk_ranges(start, stop):
+            i = self._index(hb)
+            if i < 0:
+                continue
+            vals = self.containers[i].values()
+            a, b = np.searchsorted(vals, [lo, hi_excl])
+            base = hb << 16
+            for v in vals[int(a):int(b)]:
+                fn(base | int(v))
 
     def for_all_in_range(self, start: int, stop: int, fn) -> None:
         """Visit EVERY position in [start, stop) with its membership bit
-        (forAllInRange's RelativeRangeConsumer contract)."""
-        arr = self.to_array()
-        members = set(arr[(arr >= start) & (arr < stop)].tolist())
-        for v in range(start, stop):
-            fn(v - start, v in members)
+        (forAllInRange's RelativeRangeConsumer contract) — same per-chunk
+        walk as for_each_in_range."""
+        for lo, hi_excl, hb in _chunk_ranges(start, stop):
+            i = self._index(hb)
+            base = hb << 16
+            if i < 0:
+                for off in range(lo, hi_excl):
+                    fn(base + off - start, False)
+                continue
+            vals = self.containers[i].values()
+            a, b = np.searchsorted(vals, [lo, hi_excl])
+            members = set(vals[int(a):int(b)].tolist())
+            for off in range(lo, hi_excl):
+                fn(base + off - start, off in members)
+
+    def get_batch_iterator(self, batch_size: int = 65536):
+        """RoaringBatchIterator with seek — advance_if_needed skips whole
+        containers without expanding them (RoaringBatchIterator.java:53)."""
+        from .iterators import RoaringBatchIterator
+
+        return RoaringBatchIterator(self, batch_size)
 
     def get_int_iterator(self):
         """PeekableIntIterator flyweight (getIntIterator:2147)."""
@@ -686,11 +709,20 @@ def or_not(a: RoaringBitmap, b: RoaringBitmap, range_end: int) -> RoaringBitmap:
         return a.clone()
     range_end = min(range_end, 1 << 32)
     max_key = (range_end - 1) >> 16
-    a_idx = {int(k): i for i, k in enumerate(a.keys)}
-    b_idx = {int(k): i for i, k in enumerate(b.keys)}
-    keys: list[int] = []
-    conts: list[C.Container] = []
-    for k in range(max_key + 1):
+    a_idx = {int(k): i for i, k in enumerate(a.keys) if int(k) <= max_key}
+    b_idx = {int(k): i for i, k in enumerate(b.keys) if int(k) <= max_key}
+    # Keys untouched by either input complement to all-ones; they all share
+    # ONE immutable full-range container (containers are persistent, so
+    # sharing is safe — same as _merge_union's lone-side rows).  Container
+    # algebra therefore runs only over keys present in a or b: O(|a|+|b|)
+    # container ops instead of 65,536 at range_end=2^32 (the output is
+    # inherently dense, but its constant factor is now list fills).
+    full = C.full_container()
+    conts: list = [full] * (max_key + 1)
+    last_span = range_end - (max_key << 16)
+    if last_span < (1 << 16):
+        conts[max_key] = C.range_container(0, last_span)
+    for k in sorted(set(a_idx) | set(b_idx)):
         # bits [0, span) of this key's chunk are in range
         span = min(range_end - (k << 16), 1 << 16)
         prefix = C.range_container(0, span)
@@ -698,9 +730,9 @@ def or_not(a: RoaringBitmap, b: RoaringBitmap, range_end: int) -> RoaringBitmap:
         comp = prefix if j is None else C.container_andnot(prefix, b.containers[j])
         i = a_idx.get(k)
         c = comp if i is None else C.container_or(a.containers[i], comp)
-        if c.cardinality:
-            keys.append(k)
-            conts.append(c)
+        conts[k] = c if c.cardinality else None  # None = empty result, drop
+    keys = [k for k in range(max_key + 1) if conts[k] is not None]
+    conts = [c for c in conts if c is not None]
     for k, ca in zip(a.keys, a.containers):
         if int(k) > max_key:
             keys.append(int(k))

@@ -18,6 +18,23 @@ from __future__ import annotations
 import numpy as np
 
 
+def _snapshot_containers(rb):
+    """Container access for a flyweight walk.  Mutable bitmaps are
+    snapshotted (list copy) so structural mutation after iterator creation
+    cannot desync the walk; byte-backed immutables (whose lazy sequence
+    sets ``immutable = True``) are held directly — listifying one would
+    decode every container up front, defeating the flyweight discipline."""
+    conts = rb.containers
+    return conts if getattr(conts, "immutable", False) else list(conts)
+
+
+def _cardinality_at(conts, j: int) -> int:
+    """Container j's cardinality without forcing a decode when the backing
+    sequence can answer from its header."""
+    header = getattr(conts, "cardinality_at", None)
+    return header(j) if header is not None else conts[j].cardinality
+
+
 class PeekableIntIterator:
     """Ascending iterator with peek_next and advance_if_needed
     (PeekableIntIterator.java; flyweight IntIteratorFlyweight).
@@ -32,7 +49,7 @@ class PeekableIntIterator:
         # walk; container contents are shared (in-place container mutation
         # during iteration is undefined, as for the reference's flyweights)
         self._keys = rb.keys.copy()
-        self._conts = list(rb.containers)
+        self._conts = _snapshot_containers(rb)
         self._ci = 0
         self._cur = np.empty(0, np.uint32)
         self._pos = 0
@@ -109,8 +126,9 @@ class PeekableIntRankIterator(PeekableIntIterator):
 
     def _load(self, ci: int) -> None:
         # accumulate cardinalities of containers being skipped over
+        # (header-only on byte-backed bitmaps — skipping never decodes)
         for j in range(self._base_ci, min(ci, len(self._conts))):
-            self._base += self._conts[j].cardinality
+            self._base += _cardinality_at(self._conts, j)
         self._base_ci = max(self._base_ci, min(ci, len(self._conts)))
         super()._load(ci)
         # _load may skip empty containers; account for them (cardinality 0)
@@ -128,7 +146,7 @@ class ReverseIntIterator:
 
     def __init__(self, rb):
         self._keys = rb.keys.copy()   # structural snapshot, as above
-        self._conts = list(rb.containers)
+        self._conts = _snapshot_containers(rb)
         self._load(len(self._conts) - 1)
 
     def _load(self, ci: int) -> None:
@@ -158,3 +176,89 @@ class ReverseIntIterator:
     def __iter__(self):
         while self.has_next():
             yield self.next()
+
+
+class RoaringBatchIterator:
+    """Batch iterator with seek (RoaringBatchIterator.java:19-80).
+
+    next_batch() fills a u32 buffer of up to ``batch_size`` ascending
+    values, spanning containers; advance_if_needed(min_val) implements the
+    seek of RoaringBatchIterator.advanceIfNeeded (:53): whole containers
+    below min_val's chunk are skipped WITHOUT being expanded (a byte-backed
+    bitmap does not even decode them), and within the landing container the
+    position moves by binary search.  This is the natural host->device
+    streaming seam: page through value space and ship each batch.
+    """
+
+    def __init__(self, rb, batch_size: int = 65536):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self._keys = rb.keys.copy()
+        self._conts = _snapshot_containers(rb)
+        self._batch = batch_size
+        self._ci = 0
+        self._cur: np.ndarray | None = None  # expanded current container
+        self._pos = 0
+
+    def _skip_empty(self) -> None:
+        while (self._cur is None and self._ci < len(self._conts)
+               and _cardinality_at(self._conts, self._ci) == 0):
+            self._ci += 1
+
+    def has_next(self) -> bool:
+        self._skip_empty()
+        if self._cur is not None:
+            return True
+        return self._ci < len(self._conts)
+
+    def _expand(self) -> None:
+        base = np.uint32(int(self._keys[self._ci]) << 16)
+        self._cur = base + self._conts[self._ci].values().astype(np.uint32)
+        self._pos = 0
+
+    def next_batch(self) -> np.ndarray:
+        """Up to batch_size next values, ascending (empty when exhausted)."""
+        parts: list[np.ndarray] = []
+        n = 0
+        while n < self._batch:
+            self._skip_empty()
+            if self._ci >= len(self._conts):
+                break
+            if self._cur is None:
+                self._expand()
+            take = self._cur[self._pos:self._pos + (self._batch - n)]
+            parts.append(take)
+            n += take.size
+            self._pos += take.size
+            if self._pos >= self._cur.size:
+                self._cur = None
+                self._ci += 1
+        return np.concatenate(parts) if parts else np.empty(0, np.uint32)
+
+    def advance_if_needed(self, min_val: int) -> None:
+        """Skip values < min_val.  Containers in chunks below min_val's are
+        hopped over without expansion (or decode); inside the landing
+        container the cursor moves by one binary search."""
+        key = min_val >> 16
+        ci = int(np.searchsorted(self._keys, np.uint16(key)))
+        if ci > self._ci:
+            self._ci = ci
+            self._cur = None
+            self._pos = 0
+        if (self._ci < len(self._conts)
+                and int(self._keys[self._ci]) == key):
+            if self._cur is None:
+                self._skip_empty()
+                if (self._ci >= len(self._conts)
+                        or int(self._keys[self._ci]) != key):
+                    return
+                self._expand()
+            self._pos = max(self._pos, int(np.searchsorted(
+                self._cur, np.uint32(min_val))))
+            if self._pos >= self._cur.size:
+                self._cur = None
+                self._ci += 1
+
+    def __iter__(self):
+        while self.has_next():
+            yield self.next_batch()

@@ -26,7 +26,18 @@ per-container Python objects.
 
 from __future__ import annotations
 
+import sys
+
 import numpy as np
+
+#: On little-endian hosts the wire layout IS the in-memory layout, so
+#: container decode can return read-only zero-copy views into the buffer
+#: (the MappeableContainer capability, buffer/ImmutableRoaringArray.java:166:
+#: the reference wraps ByteBuffer slices without copying).  Containers are
+#: functional (add/remove copy before mutating), so a read-only backing
+#: array is safe — an accidental in-place write raises instead of
+#: corrupting the source buffer.
+_LITTLE_ENDIAN = sys.byteorder == "little"
 
 from ..core.containers import (
     ARRAY_MAX_SIZE,
@@ -180,16 +191,25 @@ class SerializedView:
         return self.buf[o:o + int(self.payload_sizes[i])]
 
     def container(self, i: int) -> Container:
+        """Decode container i — zero-copy on little-endian hosts: the
+        payload array is a read-only view into the backing buffer (a
+        big-endian host pays one astype copy)."""
         payload = self.container_payload(i)
         if self.is_run[i]:
             nruns = int(np.frombuffer(payload[:2], dtype="<u2")[0])
-            runs = np.frombuffer(payload[2:2 + 4 * nruns], dtype="<u2").astype(np.uint16)
+            runs = np.frombuffer(payload[2:2 + 4 * nruns], dtype="<u2")
+            if not _LITTLE_ENDIAN:
+                runs = runs.astype(np.uint16)
             c: Container = RunContainer(runs)
         elif self.is_bitmap[i]:
-            words = np.frombuffer(payload, dtype="<u8").astype(np.uint64)
+            words = np.frombuffer(payload, dtype="<u8")
+            if not _LITTLE_ENDIAN:
+                words = words.astype(np.uint64)
             c = BitmapContainer(words, int(self.cardinalities[i]))
         else:
-            vals = np.frombuffer(payload, dtype="<u2").astype(np.uint16)
+            vals = np.frombuffer(payload, dtype="<u2")
+            if not _LITTLE_ENDIAN:
+                vals = vals.astype(np.uint16)
             c = ArrayContainer(vals)
         if c.cardinality != int(self.cardinalities[i]):
             raise InvalidRoaringFormat(

@@ -19,7 +19,29 @@ import numpy as np
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(HERE, "stream_ingest.cpp")
-LIB = os.path.join(HERE, "_stream_ingest.so")
+
+
+def _cpu_tag() -> str:
+    """Short host-CPU fingerprint for the .so cache name: the library is
+    compiled -march=native, so a package directory shared across
+    heterogeneous hosts (NFS, moved container image) must not dlopen a
+    binary built for a different CPU — that dies with SIGILL at call time,
+    past the build/load fallback net (ADVICE r3)."""
+    import zlib  # non-crypto hash: safe on FIPS-enabled hosts at import time
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return f"{zlib.crc32(line.encode()):08x}"
+    except OSError:
+        pass
+    import platform
+
+    return f"{zlib.crc32(platform.machine().encode()):08x}"
+
+
+LIB = os.path.join(HERE, f"_stream_ingest_{_cpu_tag()}.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None

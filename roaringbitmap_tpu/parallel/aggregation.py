@@ -331,7 +331,11 @@ class DevicePairSet:
                                                  self._av)
             self.b_words = dense.densify_streams(*self._b, self._n_rows,
                                                  self._bv)
-            self._a = self._b = None  # free the stream copies
+            # free BOTH copies: the device stream arrays and the host-side
+            # stream payloads (the dense images are the resident form;
+            # keys/heads metadata is all later methods read)
+            self._a = self._b = None
+            p.a_streams = p.b_streams = None
         else:
             self.a_words = self.b_words = None
 
@@ -790,6 +794,13 @@ class DeviceBitmap:
     def _aligned(self, other: "DeviceBitmap"):
         """Scatter both operands into the union key space (device gather,
         host-computed index maps)."""
+        if self.keys.dtype != other.keys.dtype:
+            # u16 keys (32-bit tier) and u64 high-48 keys (64-bit tier)
+            # live in different key domains; a silent union1d promotion
+            # would merge them into a wrong bitmap
+            raise TypeError(
+                f"cannot combine bitmaps of different tiers: "
+                f"{self.keys.dtype} vs {other.keys.dtype} keys")
         union = np.union1d(self.keys, other.keys)
         k = union.size
 
@@ -840,8 +851,19 @@ class DeviceBitmap:
         device form of RoaringBitmap.contains (the realdata contains
         benchmark's host-only probe, done wide: key binary search + word
         bit test are one fused gather program, no per-value host work)."""
+        raw0 = np.asarray(values)
+        if raw0.size == 0:
+            # empty probe batches are a natural pipeline edge; np.asarray([])
+            # defaults to float64, which must not trip the dtype guard
+            return np.zeros(raw0.shape, bool)
+        if raw0.dtype.kind not in "iu":
+            # float/bool/object probes would be silently truncated by the
+            # uint casts below (4294967296.0 -> 0, -0.5 -> 0), turning a
+            # nonsense probe into a plausible membership answer (ADVICE r3)
+            raise TypeError(
+                f"contains_batch expects integer probes, got {raw0.dtype}")
         if self.keys.dtype == np.uint16:
-            raw = np.asarray(values)
+            raw = raw0
             # probes outside [0, 2^32) are definitionally absent — mask them
             # instead of letting a uint32 cast wrap into false positives
             in_range = ((raw >= 0) & (raw < (1 << 32))
@@ -864,7 +886,7 @@ class DeviceBitmap:
         # u64 high-48 keys: device integers default to 32 bits under JAX, so
         # the key binary search runs host-side (K is small); the word/bit
         # probe still rides the device image
-        raw = np.asarray(values)
+        raw = raw0
         # negative probes are definitionally absent — mask, don't wrap
         in_range64 = (raw >= 0 if raw.dtype.kind == "i"
                       else np.ones(raw.shape, bool))

@@ -58,9 +58,24 @@ def _butterfly_combine(op: str, acc, axis_name: str, axis_size: int):
     return acc
 
 
+_mesh_intern: dict = {}
+
+
+def _intern_mesh(mesh: Mesh) -> Mesh:
+    """Canonical instance per (device ids, shape, axis names).
+
+    Notebooks commonly recreate an equivalent Mesh every call; keying the
+    executable caches on the first such instance means they hit instead of
+    pinning a fresh compiled program (and its mesh) per call (ADVICE r3).
+    """
+    key = (tuple(d.id for d in mesh.devices.flat), mesh.devices.shape,
+           mesh.axis_names, getattr(mesh, "axis_types", None))
+    return _mesh_intern.setdefault(key, mesh)
+
+
 @functools.lru_cache(maxsize=128)
-def make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
-                            row_axis: str = "rows", lane_axis: str = "lanes"):
+def _make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
+                             row_axis: str, lane_axis: str):
     """Build a jitted SPMD wide-aggregation step for fixed (K, steps),
     cached per (mesh, op, K, steps, axes) so repeated calls with a stable
     workload shape reuse one executable.
@@ -92,6 +107,14 @@ def make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
         check_vma=False,
     )
     return jax.jit(mapped)
+
+
+def make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
+                            row_axis: str = "rows", lane_axis: str = "lanes"):
+    """Public entry: interns the mesh (see _intern_mesh) then returns the
+    cached jitted SPMD step."""
+    return _make_sharded_aggregator(_intern_mesh(mesh), op, num_keys,
+                                    n_steps, row_axis, lane_axis)
 
 
 def shard_packed(mesh: Mesh, packed: packing.PackedAggregation,
@@ -181,8 +204,8 @@ def shard_streams(mesh: Mesh, blocked: packing.PackedBlockedCompact,
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_densify(mesh: Mesh, row_axis: str, rows_per_shard: int,
-                     total_values: int):
+def _sharded_densify_cached(mesh: Mesh, row_axis: str, rows_per_shard: int,
+                            total_values: int):
     """Cached jitted per-shard densify program — keyed on (mesh, axis,
     shard rows, value-stream length) so repeated compact ingests with a
     stable workload shape reuse one executable instead of re-tracing a
@@ -200,6 +223,12 @@ def _sharded_densify(mesh: Mesh, row_axis: str, rows_per_shard: int,
                   P(row_axis)),
         out_specs=P(row_axis),
     ))
+
+
+def _sharded_densify(mesh: Mesh, row_axis: str, rows_per_shard: int,
+                     total_values: int):
+    return _sharded_densify_cached(_intern_mesh(mesh), row_axis,
+                                   rows_per_shard, total_values)
 
 
 def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,

@@ -363,3 +363,26 @@ class TestDevicePairSet:
         assert got[0].is_empty() and got[1] == (a | b)
         assert ps.cardinalities("and").tolist() == [0, 0]
         assert ps.hbm_bytes() > 0
+
+
+def test_contains_batch_rejects_non_integer_probes():
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
+
+    db = DeviceBitmap.from_host(RoaringBitmap.bitmap_of(5))
+    with pytest.raises(TypeError, match="integer probes"):
+        db.contains_batch(np.array([5.0, 4294967296.0]))
+    with pytest.raises(TypeError, match="integer probes"):
+        db.contains_batch(np.array([True, False]))
+
+
+def test_device_bitmap_tier_mismatch_rejected():
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+    from roaringbitmap_tpu.parallel.aggregation import (
+        DeviceBitmap, DeviceBitmapSet)
+
+    d32 = DeviceBitmap.from_host(RoaringBitmap.bitmap_of(1, 2))
+    d64 = DeviceBitmap.aggregate(DeviceBitmapSet(
+        [Roaring64Bitmap.from_values(
+            np.array([1 << 40], dtype=np.uint64))]), "or")
+    with pytest.raises(TypeError, match="tiers"):
+        _ = d32 | d64

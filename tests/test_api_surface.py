@@ -404,7 +404,7 @@ class TestImmutableLongTail:
     def test_cardinality_exceeds_header_only(self):
         im, rb = self._im()
         assert im.cardinality_exceeds(4) and not im.cardinality_exceeds(5)
-        assert im._all is None  # header-only: nothing materialized
+        assert not im._cache  # header-only: nothing decoded
 
     def test_lazy_navigation_touches_minimal_containers(self):
         from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
@@ -418,9 +418,8 @@ class TestImmutableLongTail:
             rb.previous_value((1 << 16) + 5000)
         assert im.next_value((6 << 16)) == rb.next_value((6 << 16)) == -1
         assert im.previous_value(0) == rb.previous_value(0) == 0
-        # the full list is never built; only query-touched containers cache
-        assert im._all is None and len(im._cache) <= 3
+        # only query-touched containers decode (lazy sequence, no full list)
+        assert len(im._cache) <= 3
         sel = im.select_range(150, 250)
         assert sel == rb.select_range(150, 250)
         assert im.limit(5) == rb.limit(5)
-        assert im._all is None

@@ -197,3 +197,69 @@ def test_or_not_property(rng):
         assert set(got.to_array().tolist()) == want, (trial, end)
     assert rt.or_not(RoaringBitmap.bitmap_of(7), RoaringBitmap(), 0) == \
         RoaringBitmap.bitmap_of(7)
+
+
+class TestRoaringBatchIterator:
+    """Seekable batch iterator (RoaringBatchIterator.java:19-80, seek :53)."""
+
+    @staticmethod
+    def _rb():
+        rng = np.random.default_rng(5)
+        vals = np.unique(np.concatenate([
+            rng.integers(0, 1 << 22, 30000),
+            np.arange(1 << 20, (1 << 20) + 5000),     # a dense run
+            [0, 0xFFFF, 0x10000, (1 << 22) - 1]]))
+        return RoaringBitmap.from_values(vals.astype(np.uint32))
+
+    def test_batches_cover_exactly(self):
+        rb = self._rb()
+        it = rb.get_batch_iterator(997)   # deliberately not a divisor
+        got = np.concatenate(list(it))
+        assert np.array_equal(got, rb.to_array())
+
+    def test_seek_parity_with_value_iterator(self):
+        rb = self._rb()
+        arr = rb.to_array()
+        for target in [0, 1, 70000, 1 << 20, (1 << 20) + 4999,
+                       int(arr[-1]), int(arr[-1]) + 1]:
+            it = rb.get_batch_iterator(256)
+            it.advance_if_needed(target)
+            rest = np.concatenate(list(it)) if it.has_next() \
+                else np.empty(0, np.uint32)
+            assert np.array_equal(rest, arr[arr >= target]), target
+
+    def test_seek_mid_stream_only_moves_forward(self):
+        rb = self._rb()
+        arr = rb.to_array()
+        it = rb.get_batch_iterator(1000)
+        first = it.next_batch()
+        # seeking BACKWARD must not rewind (reference contract: advance only)
+        it.advance_if_needed(0)
+        nxt = it.next_batch()
+        assert int(nxt[0]) == int(arr[1000])
+        # forward seek from mid-stream
+        it.advance_if_needed(int(arr[5000]))
+        assert int(it.next_batch()[0]) == int(arr[5000])
+        assert first.size == 1000
+
+    def test_empty_and_exhausted(self):
+        it = RoaringBitmap().get_batch_iterator(10)
+        assert not it.has_next() and it.next_batch().size == 0
+        rb = RoaringBitmap.bitmap_of(1, 2, 3)
+        it = rb.get_batch_iterator(10)
+        assert it.next_batch().tolist() == [1, 2, 3]
+        assert not it.has_next()
+        it.advance_if_needed(1 << 30)   # seek past the end: harmless
+        assert it.next_batch().size == 0
+
+    def test_immutable_seek_skips_decode(self):
+        from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+
+        parts = [np.arange(0, 4000, dtype=np.uint32) + (k << 16)
+                 for k in range(200)]
+        rb = RoaringBitmap.from_values(np.concatenate(parts))
+        im = ImmutableRoaringBitmap(rb.serialize())
+        it = im.get_batch_iterator(100)
+        it.advance_if_needed(150 << 16)
+        assert int(it.next_batch()[0]) == (150 << 16)
+        assert len(im._cache) <= 2     # skipped containers never decoded

@@ -90,7 +90,7 @@ def test_device_set_all_input_forms(form, layout):
     ds = aggregation.DeviceBitmapSet(inputs, layout=layout)
     if form == "immutable":
         # the whole point: ingest must not have materialized containers
-        assert all(b._all is None for b in inputs)
+        assert all(not b._cache for b in inputs)
     for op in ("or", "xor", "and"):
         got = ds.aggregate(op)
         want = bitmaps[0]
@@ -217,7 +217,6 @@ def test_wide_and_immutable_materializes_only_survivors():
     got = aggregation.and_(*imms)
     assert got == want
     for im in imms:
-        assert im._all is None          # full list never built
         assert set(im._cache) == {0}    # only the surviving key's container
 
 

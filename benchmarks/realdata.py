@@ -370,6 +370,11 @@ def bench_bsi(st: dict, cells: dict, reps: int) -> None:
     def dev_sum():
         assert dbsi.sum()[0] == want_sum
     cells["bsi_sum/device-e2e"] = {"ms": round(_timeit(dev_sum, reps) * 1e3, 3)}
+    per = _marginal(lambda r: dbsi.chained_sum_cardinality(r),
+                    want_sum, IDX_R)
+    if per is not None:
+        cells["bsi_sum/device-marginal"] = {
+            "us": round(per * 1e6, 2), "note": "steady-state per-op"}
 
     want_topk = bsi.top_k(k).cardinality
     cells["bsi_topk/host"] = {"ms": round(_timeit(
@@ -379,6 +384,13 @@ def bench_bsi(st: dict, cells: dict, reps: int) -> None:
         assert dbsi.top_k(k).cardinality == want_topk
     cells["bsi_topk/device-e2e"] = {"ms": round(_timeit(
         dev_topk, max(1, reps // 2)) * 1e3, 3), "k": k}
+    # pre-trim device cardinality (>= k with ties) is the chained oracle
+    pre_trim = int(np.asarray(dbsi._topk_words(k, dbsi.ebm)[1]).sum())
+    per = _marginal(lambda r: dbsi.chained_topk_cardinality(k, r),
+                    pre_trim, IDX_R)
+    if per is not None:
+        cells["bsi_topk/device-marginal"] = {
+            "us": round(per * 1e6, 2), "k": k, "note": "steady-state per-op"}
     cells["bsi_hbm_mb"] = {"mb": round(dbsi.hbm_bytes() / 1e6, 2)}
 
 
@@ -411,6 +423,12 @@ def bench_rangebitmap(st: dict, cells: dict, reps: int) -> None:
         assert drbm.between_cardinality(lo, hi) == want_btw
     cells["range_between/device-e2e"] = {
         "ms": round(_timeit(dev_btw, reps) * 1e3, 3)}
+    per = _marginal(lambda r: drbm.chained_cardinality("between", lo, hi, r),
+                    want_btw, IDX_R)
+    if per is not None:
+        cells["range_between/device-marginal"] = {
+            "us": round(per * 1e6, 2),
+            "note": "single-pass double-bound scan"}
     cells["range_hbm_mb"] = {"mb": round(drbm.hbm_bytes() / 1e6, 2)}
 
 

@@ -65,9 +65,36 @@ def oneil_scan(slices, ebm, bits):
     return gt, lt, eq
 
 
+def oneil_scan2(slices, ebm, bits_lo, bits_hi):
+    """One descending pass carrying BOTH bounds — the DoubleEvaluation
+    analog (RangeBitmap.java:903): each slice is read from HBM once and
+    updates the lower bound's (gt, eq) and the upper bound's (lt, eq)
+    together, halving the slice traffic of two independent scans.
+    """
+    def step(state, xs):
+        gt1, eq1, lt2, eq2 = state
+        w, b1, b2 = xs
+        gt1 = jnp.where(b1, gt1, gt1 | (eq1 & w))
+        eq1 = jnp.where(b1, eq1 & w, eq1 & ~w)
+        lt2 = jnp.where(b2, lt2 | (eq2 & ~w), lt2)
+        eq2 = jnp.where(b2, eq2 & w, eq2 & ~w)
+        return (gt1, eq1, lt2, eq2), None
+
+    zero = jnp.zeros_like(ebm)
+    (gt1, eq1, lt2, eq2), _ = jax.lax.scan(
+        step, (zero, ebm, zero, ebm),
+        (jnp.flip(slices, axis=0), bits_lo, bits_hi))
+    return gt1, eq1, lt2, eq2
+
+
 def _compare_res(op: str, slices, ebm, bits, bits2, found):
     """Traceable core of the fused comparator: one O'Neil scan + the op's
     word combine (shared by the one-shot jit and the chained probe)."""
+    if op == "RANGE":
+        # single-pass double evaluation: both bounds in one slice sweep
+        gt, eq, lt2, eq2 = oneil_scan2(slices, ebm, bits, bits2)
+        return ((gt & found) | (found & eq)) & (
+            (lt2 & found) | (found & eq2))
     gt, lt, eq = oneil_scan(slices, ebm, bits)
     eq = found & eq
     if op == "EQ":
@@ -82,10 +109,45 @@ def _compare_res(op: str, slices, ebm, bits, bits2, found):
         return (lt & found) | eq
     if op == "GE":
         return (gt & found) | eq
-    if op == "RANGE":
-        gt2, lt2, eq2 = oneil_scan(slices, ebm, bits2)
-        return ((gt & found) | eq) & ((lt2 & found) | (found & eq2))
     raise ValueError(f"unsupported operation {op}")
+
+
+def predicate_bits(predicate: int, depth: int) -> jnp.ndarray:
+    """Predicate -> top-bit-first bit array, decomposed with Python int
+    shifts so negative and >= 2^31 predicates keep the host comparator's
+    exact bit pattern (sign extension included) instead of wrapping
+    through a device int32 cast.  Shared by DeviceBSI, DeviceRangeBitmap,
+    and parallel.sharding.ShardedBSI."""
+    return jnp.asarray(
+        [(predicate >> i) & 1 for i in range(depth - 1, -1, -1)],
+        dtype=jnp.int32)
+
+
+def _topk_res(slices, found, k: int):
+    """Traceable Kaser top-K scan core (BitSliceIndexBase.topK :303-341),
+    shared by the one-shot jit and the chained probe.
+
+    The reference's branch structure collapses to branch-free selects:
+    n > k and n == k both keep (g, e & slice), so the only split is n < k —
+    jnp.where on the state tensors instead of nested lax.cond, keeping the
+    whole scan one straight-line fused program."""
+    def step(state, slice_words):
+        g, e = state
+        x = g | (e & slice_words)
+        take = jnp.sum(popcount(x)) < k   # else: restrict e to the slice
+        g = jnp.where(take, x, g)
+        e = jnp.where(take, e & ~slice_words, e & slice_words)
+        return (g, e), None
+
+    zero = jnp.zeros_like(found)
+    (g, e), _ = jax.lax.scan(step, (zero, found), jnp.flip(slices, axis=0))
+    return g | e
+
+
+def _slice_cards_res(slices, found):
+    """Per-slice popcount of slices ∩ found (the sum contraction's core,
+    shared by the one-shot jit, the chained probe, and the sharded step)."""
+    return jax.vmap(lambda s: jnp.sum(popcount(s & found)))(slices)
 
 
 def _pack_index(ebm_bitmap: RoaringBitmap, slice_bitmaps):
@@ -117,13 +179,7 @@ class DeviceBSI:
 
     # ------------------------------------------------------------ primitives
     def _bits(self, predicate: int) -> jnp.ndarray:
-        """Predicate -> top-bit-first bit array, decomposed with Python int
-        shifts so negative and >=2^31 predicates keep the host comparator's
-        exact bit pattern (sign extension included) instead of wrapping
-        through a device int32 cast."""
-        return jnp.asarray(
-            [(predicate >> i) & 1 for i in range(self.depth - 1, -1, -1)],
-            dtype=jnp.int32)
+        return predicate_bits(predicate, self.depth)
 
     @partial(jax.jit, static_argnums=(0, 1))
     def _compare_words(self, op: str, bits, bits2, found):
@@ -168,6 +224,15 @@ class DeviceBSI:
         return (self._ebm_host.clone() if found_set is None
                 else rb_and(self._ebm_host, found_set))
 
+    def _clamp_range(self, op: Operation, start: int,
+                     end: int) -> tuple[int, int]:
+        """RANGE bounds clamped to the stored domain (see slice_index.
+        compare): the scan reads only `depth` bits, so an out-of-band bound
+        would silently truncate."""
+        if op is Operation.RANGE:
+            return max(start, self.min_value), min(end, self.max_value)
+        return start, end
+
     def compare(self, op: Operation, start_or_value: int, end: int = 0,
                 found_set: RoaringBitmap | None = None) -> RoaringBitmap:
         """Fused device compare; bit-exact with the host comparator
@@ -176,6 +241,7 @@ class DeviceBSI:
                                    self.min_value, self.max_value)
         if decision is not None:
             return self._pruned(decision, found_set)
+        start_or_value, end = self._clamp_range(op, start_or_value, end)
         found = self._found_words(found_set)
         words, cards = self._compare_words(
             op.value, self._bits(start_or_value), self._bits(end), found)
@@ -211,6 +277,7 @@ class DeviceBSI:
         if op is Operation.NEQ and found_set is not None:
             # needs the host-side stray-key remainder; see compare()
             return self.compare(op, start_or_value, end, found_set).cardinality
+        start_or_value, end = self._clamp_range(op, start_or_value, end)
         found = self._found_words(found_set)
         _, cards = self._compare_words(
             op.value, self._bits(start_or_value), self._bits(end), found)
@@ -228,29 +295,13 @@ class DeviceBSI:
 
     @partial(jax.jit, static_argnums=0)
     def _slice_cards(self, found):
-        return jax.vmap(lambda s: jnp.sum(popcount(s & found)))(self.slices)
+        return _slice_cards_res(self.slices, found)
 
     @partial(jax.jit, static_argnums=(0, 1))
     def _topk_words(self, k: int, found):
-        """Kaser top-K scan on device (BitSliceIndexBase.topK :303-341),
-        minus the final tie trim (host-side, needs value order)."""
-        def step(state, slice_words):
-            g, e = state
-            x = g | (e & slice_words)
-            n = jnp.sum(popcount(x))
-            g, e = jax.lax.cond(
-                n > k,
-                lambda: (g, e & slice_words),
-                lambda: jax.lax.cond(
-                    n < k,
-                    lambda: (x, e & ~slice_words),
-                    lambda: (g, e & slice_words)))
-            return (g, e), None
-
-        zero = jnp.zeros_like(found)
-        (g, e), _ = jax.lax.scan(step, (zero, found),
-                                 jnp.flip(self.slices, axis=0))
-        f = g | e
+        """Kaser top-K scan on device (_topk_res), minus the final tie trim
+        (host-side, needs value order)."""
+        f = _topk_res(self.slices, found, k)
         return f, popcount(f, axis=-1)
 
     def top_k(self, k: int, found_set: RoaringBitmap | None = None
@@ -268,10 +319,49 @@ class DeviceBSI:
         assert f.cardinality == k, "bugs found when compute topK"
         return f
 
+    def chained_sum_cardinality(self, reps: int):
+        """Steady-state probe for the weighted-popcount sum: reps dependent
+        evaluations in ONE jit, barrier-serialized (found rides the
+        barrier).  fn() -> summed (sum mod 2^32) over all reps; callers
+        assert == (reps * host_sum) % 2^32."""
+        slices, found = self.slices, self.ebm
+        # per-slice weights mod 2^32, computed host-side (shifts past 31
+        # bits are out of range for a device u32 shift)
+        weights = jnp.asarray(np.array(
+            [(1 << i) & 0xFFFFFFFF for i in range(self.depth)], np.uint32))
+
+        def body(i, total):
+            f, _ = jax.lax.optimization_barrier((found, total))
+            cards = _slice_cards_res(slices, f)
+            part = jnp.sum(cards.astype(jnp.uint32) * weights)
+            return total + part
+
+        return jax.jit(
+            lambda: jax.lax.fori_loop(0, reps, body, jnp.uint32(0)))
+
+    def chained_topk_cardinality(self, k: int, reps: int):
+        """Steady-state probe for the Kaser scan: reps dependent top-K
+        evaluations in ONE jit.  fn() -> summed result cardinality mod
+        2^32 (the pre-trim device cardinality: >= k with ties)."""
+        slices, found = self.slices, self.ebm
+
+        def body(i, total):
+            f0, _ = jax.lax.optimization_barrier((found, total))
+            f = _topk_res(slices, f0, k)
+            return total + jnp.sum(popcount(f).astype(jnp.uint32))
+
+        return jax.jit(
+            lambda: jax.lax.fori_loop(0, reps, body, jnp.uint32(0)))
+
 
 def _range_res(op: str, slices, ebm, bits, bits2, found):
     """Traceable core of the range-threshold query (shared by the one-shot
     jit and the chained probe)."""
+    if op == "between":
+        # single-pass double evaluation (DoubleEvaluation,
+        # RangeBitmap.java:903): one slice sweep for both bounds
+        gt, eq, lt2, eq2 = oneil_scan2(slices, ebm, bits, bits2)
+        return (gt | eq) & (lt2 | eq2) & found
     gt, lt, eq = oneil_scan(slices, ebm, bits)
     if op == "lte":
         return (lt | eq) & found
@@ -281,9 +371,6 @@ def _range_res(op: str, slices, ebm, bits, bits2, found):
         return eq & found
     if op == "neq":
         return found & ~eq
-    if op == "between":
-        gt2, lt2, eq2 = oneil_scan(slices, ebm, bits2)
-        return (gt | eq) & (lt2 | eq2) & found
     raise ValueError(f"unsupported op {op}")
 
 
@@ -309,9 +396,7 @@ class DeviceRangeBitmap:
         return int(self.ebm.nbytes + self.slices.nbytes)
 
     def _bits(self, threshold: int) -> jnp.ndarray:
-        return jnp.asarray(
-            [(threshold >> i) & 1 for i in range(self.depth - 1, -1, -1)],
-            dtype=jnp.int32)
+        return predicate_bits(threshold, self.depth)
 
     @partial(jax.jit, static_argnums=(0, 1))
     def _query_words(self, op: str, bits, bits2, found):

@@ -393,16 +393,48 @@ class RoaringBitmapSliceIndex:
             return RoaringBitmap()
         return None
 
+    def _o_neil_range(self, lo: int, hi: int,
+                      found_set: RoaringBitmap | None) -> RoaringBitmap:
+        """RANGE in ONE descending slice pass carrying both bounds — the
+        DoubleEvaluation analog (RangeBitmap.java:903): each slice is walked
+        once, updating the lower bound's (gt, eq) and the upper bound's
+        (lt, eq), instead of two full o_neil_compare scans."""
+        fixed = self.ebm if found_set is None else found_set
+        gt1 = RoaringBitmap()
+        eq1 = self.ebm
+        lt2 = RoaringBitmap()
+        eq2 = self.ebm
+        for i in range(self.bit_count() - 1, -1, -1):
+            s = self.slices[i]
+            if (lo >> i) & 1:
+                eq1 = rb_and(eq1, s)
+            else:
+                gt1 = rb_or(gt1, rb_and(eq1, s))
+                eq1 = rb_andnot(eq1, s)
+            if (hi >> i) & 1:
+                lt2 = rb_or(lt2, rb_andnot(eq2, s))
+                eq2 = rb_and(eq2, s)
+            else:
+                eq2 = rb_andnot(eq2, s)
+        left = rb_or(rb_and(gt1, fixed), rb_and(fixed, eq1))
+        right = rb_or(rb_and(lt2, fixed), rb_and(fixed, eq2))
+        return rb_and(left, right)
+
     def compare(self, op: Operation, start_or_value: int, end: int = 0,
                 found_set: RoaringBitmap | None = None) -> RoaringBitmap:
-        """compare (:482-513): min/max pruning then O'Neil (RANGE = GE & LE)."""
+        """compare (:482-513): min/max pruning then O'Neil (RANGE runs the
+        single-pass double evaluation)."""
         pruned = self._compare_using_min_max(op, start_or_value, end, found_set)
         if pruned is not None:
             return pruned
         if op is Operation.RANGE:
-            left = self.o_neil_compare(Operation.GE, start_or_value, found_set)
-            right = self.o_neil_compare(Operation.LE, end, found_set)
-            return rb_and(left, right)
+            # clamp to the stored value domain: every row's value lies in
+            # [min_value, max_value], so the window is equivalent — and the
+            # scan reads only bit_count bits, which would silently truncate
+            # an out-of-band bound (e.g. end=200 at bit_count 7 reads 72)
+            start_or_value = max(start_or_value, self.min_value)
+            end = min(end, self.max_value)
+            return self._o_neil_range(start_or_value, end, found_set)
         return self.o_neil_compare(op, start_or_value, found_set)
 
     def sum(self, found_set: RoaringBitmap | None = None) -> tuple[int, int]:

@@ -216,10 +216,46 @@ class RangeBitmap:
         base = self._apply_context(self._all_rows(), context)
         return rb_andnot(base, self.eq(value))
 
+    def _scan2(self, lo: int, hi: int) -> tuple[RoaringBitmap, RoaringBitmap,
+                                                RoaringBitmap, RoaringBitmap]:
+        """Single descending pass carrying BOTH bounds — the DoubleEvaluation
+        analog (RangeBitmap.java:903): each slice is walked once and updates
+        the lower bound's (gt, eq) and the upper bound's (lt, eq) states,
+        halving the slice traffic of two independent _scan calls."""
+        gt1 = RoaringBitmap()
+        eq1 = self._all_rows()
+        lt2 = RoaringBitmap()
+        eq2 = self._all_rows()
+        for i in range(len(self._slices) - 1, -1, -1):
+            s = self._slices[i]
+            if (lo >> i) & 1:
+                eq1 = rb_and(eq1, s)
+            else:
+                gt1 = rb_or(gt1, rb_and(eq1, s))
+                eq1 = rb_andnot(eq1, s)
+            if (hi >> i) & 1:
+                lt2 = rb_or(lt2, rb_andnot(eq2, s))
+                eq2 = rb_and(eq2, s)
+            else:
+                eq2 = rb_andnot(eq2, s)
+        return gt1, eq1, lt2, eq2
+
     def between(self, min_value: int, max_value: int,
                 context: RoaringBitmap | None = None) -> RoaringBitmap:
-        """Rows with min <= value <= max (between :111-126)."""
-        return rb_and(self.gte(min_value, context), self.lte(max_value, context))
+        """Rows with min <= value <= max (between :111-126) — one
+        double-bound slice pass, not gte AND lte."""
+        lo, hi = max(min_value, 0), min(max_value, self._max)
+        if lo > self._max or max_value < 0 or lo > hi:
+            return RoaringBitmap()
+        if lo <= 0 and hi >= self._max:
+            return self._apply_context(self._all_rows(), context)
+        if lo <= 0:
+            return self.lte(hi, context)
+        if hi >= self._max:
+            return self.gte(lo, context)
+        gt1, eq1, lt2, eq2 = self._scan2(lo, hi)
+        res = rb_and(rb_or(gt1, eq1), rb_or(lt2, eq2))
+        return self._apply_context(res, context)
 
     # cardinality forms (:128-414)
     def lte_cardinality(self, threshold: int,

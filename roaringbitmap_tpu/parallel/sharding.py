@@ -349,3 +349,131 @@ def wide_and_sharded(mesh: Mesh, bitmaps,
     step = make_sharded_and(mesh, row_axis, lane_axis)
     acc, cards = step(words_d)
     return packed.keys, np.asarray(acc), np.asarray(cards)
+
+
+# --------------------------------------------------------------- sharded BSI
+#
+# The BSI/RangeBitmap slice axes shard naturally: slices u32[S, K, 2048]
+# puts the container-key axis on "rows" and the 2048-word axis on "lanes".
+# The fused O'Neil scan (bsi.device.oneil_scan) is elementwise over
+# [K, 2048], so the whole comparator runs with ZERO communication; the only
+# collective is the final cardinality psum (compare) / per-slice popcount
+# psum (sum).
+
+@functools.lru_cache(maxsize=64)
+def _make_sharded_bsi_compare(mesh: Mesh, op: str, row_axis: str,
+                              lane_axis: str):
+    from ..bsi import device as bsi_dev
+
+    def step(slices, ebm, bits, bits2):
+        res = bsi_dev._compare_res(op, slices, ebm, bits, bits2, ebm)
+        card = jnp.sum(jax.lax.population_count(res).astype(jnp.int32))
+        return jax.lax.psum(card, (row_axis, lane_axis))
+
+    return jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis),
+                  P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
+@functools.lru_cache(maxsize=64)
+def _make_sharded_bsi_slice_cards(mesh: Mesh, row_axis: str, lane_axis: str):
+    from ..bsi import device as bsi_dev
+
+    def step(slices, found):
+        cards = bsi_dev._slice_cards_res(slices, found)
+        count = jnp.sum(jax.lax.population_count(found).astype(jnp.int32))
+        return (jax.lax.psum(cards, (row_axis, lane_axis)),
+                jax.lax.psum(count, (row_axis, lane_axis)))
+
+    return jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))
+
+
+class ShardedBSI:
+    """A RoaringBitmapSliceIndex sharded over a device mesh.
+
+    The multi-device form of bsi.device.DeviceBSI (VERDICT r3 #9): compare
+    and sum scale across chips with the key axis data-parallel and the word
+    axis tensor-parallel; predicates stay replicated scalars so one
+    compiled executable serves every threshold.
+    """
+
+    def __init__(self, mesh: Mesh, bsi, row_axis: str = "rows",
+                 lane_axis: str = "lanes"):
+        from ..bsi import device as bsi_dev
+
+        self.mesh = _intern_mesh(mesh)
+        self.row_axis, self.lane_axis = row_axis, lane_axis
+        self.depth = bsi.bit_count()
+        self.min_value, self.max_value = bsi.min_value, bsi.max_value
+        self._ebm_card = bsi.ebm.cardinality
+        keys = bsi.ebm.keys.copy()
+        ebm_np = bsi_dev._densify(
+            bsi.ebm if hasattr(bsi.ebm, "clone") else bsi.ebm.to_bitmap(),
+            keys)
+        slices_np = (np.stack([bsi_dev._densify(s, keys) for s in bsi.slices])
+                     if bsi.slices else
+                     np.zeros((0,) + ebm_np.shape, np.uint32))
+        # pad the key axis to a row-shard multiple (zero rows: no members,
+        # contribute nothing to any query)
+        r = self.mesh.shape[row_axis]
+        k = ebm_np.shape[0]
+        kpad = max(-(-k // r) * r, r)
+        if kpad != k:
+            ebm_np = np.concatenate(
+                [ebm_np, np.zeros((kpad - k, WORDS32), np.uint32)])
+            slices_np = np.concatenate(
+                [slices_np,
+                 np.zeros((self.depth, kpad - k, WORDS32), np.uint32)],
+                axis=1) if self.depth else slices_np
+        self.keys = keys
+        self.ebm = jax.device_put(
+            ebm_np, NamedSharding(self.mesh, P(row_axis, lane_axis)))
+        self.slices = jax.device_put(
+            slices_np, NamedSharding(self.mesh, P(None, row_axis, lane_axis)))
+
+    def _bits(self, predicate: int) -> jnp.ndarray:
+        from ..bsi.device import predicate_bits
+
+        return predicate_bits(predicate, self.depth)
+
+    def compare_cardinality(self, op, start_or_value: int,
+                            end: int = 0) -> int:
+        """Cardinality of the fused compare over the whole mesh (found set
+        = ebm); min/max pruning + RANGE bound clamping match the host
+        comparator."""
+        from ..bsi.slice_index import Operation, minmax_decision
+
+        decision = minmax_decision(op, start_or_value, end,
+                                   self.min_value, self.max_value)
+        if decision == "empty":
+            return 0
+        if decision == "all":
+            return self._ebm_card
+        if op is Operation.RANGE:
+            # out-of-band bounds would silently truncate at `depth` bits
+            start_or_value = max(start_or_value, self.min_value)
+            end = min(end, self.max_value)
+        fn = _make_sharded_bsi_compare(self.mesh, op.value, self.row_axis,
+                                       self.lane_axis)
+        return int(np.asarray(fn(self.slices, self.ebm,
+                                 self._bits(start_or_value),
+                                 self._bits(end))))
+
+    def sum(self) -> tuple[int, int]:
+        """(sum of values, member count) — per-slice popcounts psum'd over
+        the mesh, 2^i weighting in Python ints (no device overflow)."""
+        fn = _make_sharded_bsi_slice_cards(self.mesh, self.row_axis,
+                                           self.lane_axis)
+        cards, count = fn(self.slices, self.ebm)
+        total = sum((1 << i) * int(c)
+                    for i, c in enumerate(np.asarray(cards)))
+        return total, int(np.asarray(count))

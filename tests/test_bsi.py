@@ -407,3 +407,86 @@ def test_chained_device_probes_parity(rng):
                 op, pairs, 3, engine=eng)
             assert int(np.asarray(fn())) == (3 * want_p) % 2**32, (op, eng)
 
+
+
+def test_chained_sum_topk_between_probes_parity(rng):
+    """Round-4 probes: chained sum / topK / single-pass between must agree
+    with host one-shots (bit-exact per rep, mod 2^32)."""
+    from roaringbitmap_tpu.bsi.device import DeviceBSI, DeviceRangeBitmap
+    from roaringbitmap_tpu.bsi.slice_index import RoaringBitmapSliceIndex
+    from roaringbitmap_tpu.core.rangebitmap import RangeBitmap
+
+    vals = rng.integers(0, 1 << 18, 4000).astype(np.uint64)
+    rows = np.arange(vals.size, dtype=np.uint32)
+    bsi = RoaringBitmapSliceIndex.from_pairs(rows, vals)
+    dev = DeviceBSI(bsi)
+
+    want_sum = bsi.sum()[0]
+    got = int(np.asarray(dev.chained_sum_cardinality(3)()))
+    assert got == (3 * want_sum) % 2**32
+
+    k = 777
+    pre_trim = int(np.asarray(dev._topk_words(k, dev.ebm)[1]).sum())
+    assert pre_trim >= k
+    got = int(np.asarray(dev.chained_topk_cardinality(k, 3)()))
+    assert got == (3 * pre_trim) % 2**32
+
+    app = RangeBitmap.appender(1 << 18)
+    app.add_many(vals)
+    rb = app.build()
+    drb = DeviceRangeBitmap(rb)
+    a, b = int(np.quantile(vals, 0.25)), int(np.quantile(vals, 0.75))
+    want_btw = int(((vals >= a) & (vals <= b)).sum())
+    assert rb.between(a, b).cardinality == want_btw   # host single-pass
+    assert drb.between_cardinality(a, b) == want_btw  # device single-pass
+    got = int(np.asarray(drb.chained_cardinality("between", a, b, 3)()))
+    assert got == (3 * want_btw) % 2**32
+
+
+def test_between_single_pass_edges(rng):
+    """Double-bound scan edge parity: bounds at/beyond extremes, empty
+    window, lo == hi, context given (vs the old gte-AND-lte composition)."""
+    from roaringbitmap_tpu.core.rangebitmap import RangeBitmap
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.core.bitmap import and_ as rb_and
+
+    vals = rng.integers(0, 5000, 3000).astype(np.uint64)
+    app = RangeBitmap.appender(5000)
+    app.add_many(vals)
+    rb = app.build()
+    ctx = RoaringBitmap.from_values(
+        np.arange(0, vals.size, 3, dtype=np.uint32))
+    mx = int(vals.max())
+    for lo, hi in [(0, mx), (-5, mx + 10), (17, 17), (200, 100),
+                   (0, 0), (mx, mx), (1, mx - 1), (mx + 1, mx + 5)]:
+        want = rb_and(rb.gte(lo), rb.lte(hi))
+        assert rb.between(lo, hi) == want, (lo, hi)
+        got_ctx = rb.between(lo, hi, ctx)
+        assert got_ctx == rb_and(want, ctx), (lo, hi)
+
+
+def test_range_bounds_beyond_bit_count_clamped(rng):
+    """RANGE with an end above max_value (beyond bit_count bits) must clamp,
+    not silently truncate: values 5..100 (7 bits), RANGE [10, 200] == GE 10."""
+    from roaringbitmap_tpu.bsi.device import DeviceBSI
+    from roaringbitmap_tpu.parallel.sharding import ShardedBSI
+    import jax
+    from jax.sharding import Mesh
+
+    vals = np.arange(5, 101, dtype=np.uint64)
+    cols = np.arange(vals.size, dtype=np.uint32)
+    bsi = RoaringBitmapSliceIndex.from_pairs(cols, vals)
+    want = int((vals >= 10).sum())    # == RANGE [10, 200] truth
+    got = bsi.compare(Operation.RANGE, 10, 200)
+    assert got.cardinality == want
+    dev = DeviceBSI(bsi)
+    assert dev.compare(Operation.RANGE, 10, 200) == got
+    assert dev.compare_cardinality(Operation.RANGE, 10, 200) == want
+    devs = jax.devices()
+    if len(devs) >= 8:
+        mesh = Mesh(np.array(devs[:8]).reshape(4, 2), ("rows", "lanes"))
+        sb = ShardedBSI(mesh, bsi)
+        assert sb.compare_cardinality(Operation.RANGE, 10, 200) == want
+    # low bound below min_value clamps too
+    assert bsi.compare(Operation.RANGE, -50, 40).cardinality == \
+        int((vals <= 40).sum())

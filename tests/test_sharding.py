@@ -150,3 +150,30 @@ def test_dense_ingest_accepts_bytes(mesh8, rng):
     keys, words, cards = sharding.wide_aggregate_sharded(
         mesh8, "or", [b.serialize() for b in bms], ingest="dense")
     assert packing.unpack_result(keys, words, cards) == want
+
+
+def test_sharded_bsi_parity(mesh8):
+    """ShardedBSI.compare/sum over the 8-device mesh == host BSI (VERDICT
+    r3 #9: slice axis replicated, key axis sharded)."""
+    from roaringbitmap_tpu.bsi.slice_index import (
+        Operation, RoaringBitmapSliceIndex)
+    from roaringbitmap_tpu.parallel.sharding import ShardedBSI
+
+    rng = np.random.default_rng(17)
+    # span several containers so the key axis actually shards
+    cols = np.unique(rng.integers(0, 1 << 20, 6000)).astype(np.uint32)
+    vals = rng.integers(0, 1 << 16, cols.size).astype(np.uint64)
+    bsi = RoaringBitmapSliceIndex.from_pairs(cols, vals)
+    sb = ShardedBSI(mesh8, bsi)
+    thr = int(np.median(vals))
+    for op in (Operation.LT, Operation.GE, Operation.EQ, Operation.NEQ):
+        want = bsi.compare(op, thr, 0, None).cardinality
+        assert sb.compare_cardinality(op, thr) == want, op
+    a, b = int(np.quantile(vals, 0.2)), int(np.quantile(vals, 0.8))
+    want = bsi.compare(Operation.RANGE, a, b, None).cardinality
+    assert sb.compare_cardinality(Operation.RANGE, a, b) == want
+    # out-of-range predicates ride the min/max pruning
+    assert sb.compare_cardinality(Operation.LT, -5) == 0
+    assert sb.compare_cardinality(
+        Operation.LE, 1 << 40) == bsi.ebm.cardinality
+    assert sb.sum() == bsi.sum()

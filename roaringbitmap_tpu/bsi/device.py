@@ -226,12 +226,10 @@ class DeviceBSI:
 
     def _clamp_range(self, op: Operation, start: int,
                      end: int) -> tuple[int, int]:
-        """RANGE bounds clamped to the stored domain (see slice_index.
-        compare): the scan reads only `depth` bits, so an out-of-band bound
-        would silently truncate."""
-        if op is Operation.RANGE:
-            return max(start, self.min_value), min(end, self.max_value)
-        return start, end
+        from .slice_index import clamp_range_bounds
+
+        return clamp_range_bounds(op, start, end,
+                                  self.min_value, self.max_value)
 
     def compare(self, op: Operation, start_or_value: int, end: int = 0,
                 found_set: RoaringBitmap | None = None) -> RoaringBitmap:

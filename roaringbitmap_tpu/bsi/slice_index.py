@@ -91,6 +91,19 @@ def minmax_decision(op: Operation, start: int, end: int,
     return None
 
 
+def clamp_range_bounds(op: Operation, start: int, end: int,
+                       mn: int, mx: int) -> tuple[int, int]:
+    """RANGE bounds clamped to the stored domain [mn, mx] — a parity
+    invariant shared by the host comparator, bsi.device.DeviceBSI, and
+    parallel.sharding.ShardedBSI: every row's value lies in [mn, mx], so
+    the window is equivalent, and the O'Neil scan reads only `bit_count`
+    bits, which would silently truncate an out-of-band bound (e.g. end=200
+    at bit_count 7 reads 72)."""
+    if op is Operation.RANGE:
+        return max(start, mn), min(end, mx)
+    return start, end
+
+
 # ------------------------------------------------------------- Hadoop vints
 def write_vlong(out: bytearray, v: int) -> None:
     """Hadoop WritableUtils.writeVLong zero-compressed encoding
@@ -428,12 +441,8 @@ class RoaringBitmapSliceIndex:
         if pruned is not None:
             return pruned
         if op is Operation.RANGE:
-            # clamp to the stored value domain: every row's value lies in
-            # [min_value, max_value], so the window is equivalent — and the
-            # scan reads only bit_count bits, which would silently truncate
-            # an out-of-band bound (e.g. end=200 at bit_count 7 reads 72)
-            start_or_value = max(start_or_value, self.min_value)
-            end = min(end, self.max_value)
+            start_or_value, end = clamp_range_bounds(
+                op, start_or_value, end, self.min_value, self.max_value)
             return self._o_neil_range(start_or_value, end, found_set)
         return self.o_neil_compare(op, start_or_value, found_set)
 

@@ -245,7 +245,7 @@ class RangeBitmap:
         """Rows with min <= value <= max (between :111-126) — one
         double-bound slice pass, not gte AND lte."""
         lo, hi = max(min_value, 0), min(max_value, self._max)
-        if lo > self._max or max_value < 0 or lo > hi:
+        if lo > hi:  # covers lo > self._max and max_value < 0 too
             return RoaringBitmap()
         if lo <= 0 and hi >= self._max:
             return self._apply_context(self._all_rows(), context)

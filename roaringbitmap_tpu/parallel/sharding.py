@@ -458,10 +458,10 @@ class ShardedBSI:
             return 0
         if decision == "all":
             return self._ebm_card
-        if op is Operation.RANGE:
-            # out-of-band bounds would silently truncate at `depth` bits
-            start_or_value = max(start_or_value, self.min_value)
-            end = min(end, self.max_value)
+        from ..bsi.slice_index import clamp_range_bounds
+
+        start_or_value, end = clamp_range_bounds(
+            op, start_or_value, end, self.min_value, self.max_value)
         fn = _make_sharded_bsi_compare(self.mesh, op.value, self.row_axis,
                                        self.lane_axis)
         return int(np.asarray(fn(self.slices, self.ebm,

@@ -1,0 +1,299 @@
+"""Nastiest reference edge cases, ported as explicit unit tests.
+
+Round-3 verdict item 10: the reference spends thousands of LoC on container
+boundary cases (TestRunContainer.java is 4,000 LoC alone); the fuzz catalog
+covers the bulk statistically, but the cases below are deterministic
+regressions the reference found worth pinning.  Each test cites its source.
+
+Ports are at the public-API level: this package's containers are value/SoA
+based by design (SURVEY §7), so container-internal assertions (getSizeInBytes,
+nbrruns) translate to observable behavior — membership, cardinality,
+container-kind selection, and serialized-form parity.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.core import containers as C
+from roaringbitmap_tpu.core.rangebitmap import RangeBitmap
+
+TESTDATA = "/root/reference/RoaringBitmap/src/test/resources/testdata"
+needs_corpus = pytest.mark.skipif(not os.path.isdir(TESTDATA),
+                                  reason="reference corpus not mounted")
+
+
+def _read_int_list(name: str) -> np.ndarray:
+    with open(os.path.join(TESTDATA, name)) as f:
+        return np.array([int(x) for x in f.read().replace("\n", ",").split(",")
+                         if x.strip()], dtype=np.int64)
+
+
+def _oracle_set(rb: RoaringBitmap) -> set[int]:
+    return set(rb.to_array().tolist())
+
+
+# ------------------------------------------------------------ offset corpus
+# TestConcatenation.java:33-66 (testElementwiseOffsetAppliedCorrectly /
+# testCardinalityPreserved): the offset_failure_case corpus captures addOffset
+# bugs where shifted containers straddle chunk boundaries.
+
+OFFSET_CASES = [("testIssue260.txt", 5950),
+                ("offset_failure_case_1.txt", 20),
+                ("offset_failure_case_2.txt", 20),
+                ("offset_failure_case_3.txt", 20)]
+
+
+@needs_corpus
+@pytest.mark.parametrize("name,offset", OFFSET_CASES)
+def test_offset_corpus_elementwise(name, offset):
+    # TestConcatenation.testElementwiseOffsetAppliedCorrectly:81-89
+    vals = _read_int_list(name)
+    rb = RoaringBitmap.from_values(vals.astype(np.uint32))
+    shifted = rb.add_offset(offset)
+    np.testing.assert_array_equal(
+        shifted.to_array().astype(np.int64), vals + offset)
+    # TestConcatenation.testCardinalityPreserved:100-105
+    assert shifted.cardinality == rb.cardinality
+
+
+@needs_corpus
+@pytest.mark.parametrize("name,offset", OFFSET_CASES)
+def test_offset_corpus_roundtrip(name, offset):
+    # negated offset must restore the original (no value exits [0, 2^32))
+    vals = _read_int_list(name)
+    rb = RoaringBitmap.from_values(vals.astype(np.uint32))
+    assert rb.add_offset(offset).add_offset(-offset) == rb
+
+
+def _mixed_container_bitmap(seed: int) -> RoaringBitmap:
+    """A bitmap with an array, a run, and a bitmap container at distinct
+    chunks — the testCase().withBitmapAt/withRunAt/withArrayAt construction
+    of TestConcatenation.java:40-45."""
+    rng = np.random.default_rng(seed)
+    rb = RoaringBitmap()
+    rb.add_many((rng.choice(1 << 16, size=100, replace=False)
+                 ).astype(np.uint32))                       # array chunk 0
+    rb.add_range((1 << 16) + 1000, (1 << 16) + 9000)        # run chunk 1
+    rb.add_many(((2 << 16)
+                 + rng.choice(1 << 16, size=9000, replace=False)
+                 ).astype(np.uint32))                       # bitmap chunk 2
+    return rb
+
+
+@pytest.mark.parametrize("offset", [20, 1 << 16, -20, 65516])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_offset_mixed_containers(offset, seed):
+    # TestConcatenation.java:40-63 — container-kind mixes under aligned
+    # (1 << 16) and awkward (20) offsets
+    rb = _mixed_container_bitmap(seed)
+    vals = rb.to_array().astype(np.int64) + offset
+    vals = vals[(vals >= 0) & (vals <= 0xFFFFFFFF)]
+    shifted = rb.add_offset(offset)
+    np.testing.assert_array_equal(shifted.to_array().astype(np.int64), vals)
+
+
+# ----------------------------------------------------- prevvalue regression
+def test_previous_value_regression():
+    # PreviousValueTest.java:15-24: previousValue beyond the last set bit
+    # must return last(), not miss the final container
+    if os.path.isdir(TESTDATA):
+        vals = _read_int_list("prevvalue-regression.txt")
+    else:
+        vals = np.array([5, 1 << 16, 1828800000], dtype=np.int64)
+    rb = RoaringBitmap.from_values(vals.astype(np.uint32))
+    assert rb.previous_value(1828834057) == rb.last()
+
+
+# ----------------------------------------------------- rangebitmap regression
+@needs_corpus
+def test_rangebitmap_between_regression():
+    # RangeBitmapTest.betweenRegressionTest:50-65: between(x, x+1) must equal
+    # eq(x) | eq(x+1) on the regression column
+    vals = _read_int_list("rangebitmap_regression.txt")
+    app = RangeBitmap.appender(2175288)
+    app.add_many(vals.astype(np.uint64))
+    rbm = app.build()
+    for i in range(4):
+        lo = 263501 + i
+        assert rbm.between(lo, lo + 1) == (rbm.eq(lo) | rbm.eq(lo + 1))
+
+
+# ------------------------------------------------- 0xFFFF-adjacent run cases
+def test_run_reaching_65535():
+    # TestRunContainer.testToString:3172-3176: run [32200,35000) plus the
+    # final value 65535 — the run codec's length field must not wrap
+    rb = RoaringBitmap()
+    rb.add_range(32200, 35000)
+    rb.add(65535)
+    assert rb.run_optimize()
+    c = rb.containers[0]
+    assert isinstance(c, C.RunContainer)
+    np.testing.assert_array_equal(
+        c.runs.astype(np.int64), [32200, 2799, 65535, 0])
+    assert rb.cardinality == 2801 and rb.last() == 65535
+    assert RoaringBitmap.deserialize(rb.serialize()) == rb
+
+
+def test_run_iadd_iremove_full_tail():
+    # TestRunContainer.iremove17:1608-1612: add [37543, 65536) then remove
+    # [9795, 65536) leaves nothing
+    rb = RoaringBitmap()
+    rb.add_range(37543, 65536)
+    rb.remove_range(9795, 65536)
+    assert rb.cardinality == 0 and rb.is_empty()
+
+
+def test_run_add_65534_65536():
+    # TestRunContainer.testRangeConsumer:3915-3929 entry set: runs fusing at
+    # the top of the chunk (65530 alone, then [65534, 65536))
+    rb = RoaringBitmap()
+    rb.add_range(3, 5)
+    rb.add_range(7, 9)
+    rb.add(10)
+    rb.add(65530)
+    rb.add_range(65534, 65536)
+    assert rb.to_array().tolist() == [3, 4, 7, 8, 10, 65530, 65534, 65535]
+    rb.run_optimize()
+    assert RoaringBitmap.deserialize(rb.serialize()) == rb
+
+
+def test_run_fuse_with_next_and_previous():
+    # TestRunContainer.addRangeAndFuseWithNextValueLength:234-249 and
+    # addRangeAndFuseWithPreviousValueLength:252-265: [10,20)+[21,30) add
+    # [15,21) -> ONE run [10,30) (serialized run form is 2 + 4*1 bytes...
+    # observable here as number_of_runs == 1)
+    rb = RoaringBitmap()
+    rb.add_range(10, 20)
+    rb.add_range(21, 30)
+    rb.add_range(15, 21)
+    assert rb.cardinality == 20
+    assert all(rb.contains(i) for i in range(10, 30))
+    assert C.number_of_runs(rb.containers[0].values()) == 1
+
+    rb2 = RoaringBitmap()
+    rb2.add_range(10, 20)
+    rb2.add_range(20, 30)
+    assert rb2.cardinality == 20
+    assert C.number_of_runs(rb2.containers[0].values()) == 1
+
+
+def test_full_chunk_run_constructor():
+    # TestRunContainer.testRangeConstructor:3563-3567: [0, 1<<16) is full
+    rb = RoaringBitmap.from_range(0, 1 << 16)
+    assert rb.cardinality == 65536
+    rb.run_optimize()
+    c = rb.containers[0]
+    assert isinstance(c, C.RunContainer) and c.cardinality == 65536
+    np.testing.assert_array_equal(c.runs.astype(np.int64), [0, 65535])
+    assert RoaringBitmap.deserialize(rb.serialize()) == rb
+
+
+def test_first_unsigned_top_half():
+    # TestRunContainer.testFirstUnsigned:3310-3314: [32768, 65536) — first()
+    # must treat the chunk values as unsigned
+    rb = RoaringBitmap()
+    rb.add_range(32768, 65536)
+    assert rb.first() == 32768
+    assert rb.last() == 65535
+
+
+# ------------------------------------------------- promotion / demotion chains
+def test_promotion_chain_at_4096():
+    # ArrayContainer.DEFAULT_MAX_SIZE = 4096 (ArrayContainer.java:27);
+    # TestArrayContainer promotion coverage: adding the 4097th value
+    # promotes, removing back demotes (BitmapContainer demote-on-remove)
+    rb = RoaringBitmap()
+    rb.add_many(np.arange(0, 2 * 4096, 2, dtype=np.uint32))  # 4096 values
+    assert isinstance(rb.containers[0], C.ArrayContainer)
+    rb.add(1)                                                # 4097th
+    assert isinstance(rb.containers[0], C.BitmapContainer)
+    rb.remove(1)
+    assert isinstance(rb.containers[0], C.ArrayContainer)
+    assert rb.cardinality == 4096
+
+
+def test_promotion_chain_full_then_punch():
+    # TestBitmapContainer-style full-chunk chain: fill the chunk, punch a
+    # hole, refill; kind selection and cardinality must track exactly
+    rb = RoaringBitmap.from_range(0, 1 << 16)
+    rb.remove(30000)
+    assert rb.cardinality == 65535
+    assert isinstance(rb.containers[0], C.BitmapContainer)
+    rb.add(30000)
+    assert rb.cardinality == 65536
+    rb.remove_range(0, 61440)  # leaves 4096 values -> array-size boundary
+    assert rb.cardinality == 4096
+    assert isinstance(rb.containers[0], C.ArrayContainer)
+
+
+def test_flip_range_full_chunk_boundaries():
+    # TestRunContainer inot14/inot15-style complements crossing the chunk
+    # top: flip [65000, 65536) twice is identity; flip across chunks matches
+    # the set oracle
+    rng = np.random.default_rng(7)
+    vals = rng.choice(1 << 17, size=5000, replace=False).astype(np.uint32)
+    rb = RoaringBitmap.from_values(vals)
+    before = _oracle_set(rb)
+    rb.flip_range(65000, 65536)
+    rb.flip_range(65000, 65536)
+    assert _oracle_set(rb) == before
+    rb.flip_range(60000, 70000)
+    expect = before ^ set(range(60000, 70000))
+    assert _oracle_set(rb) == expect
+
+
+def test_run_intersects_range_boundary():
+    # TestRunContainer.testIntersects:3161-3165: runs {41+15, 215+0, ...};
+    # intersects(57, 215) is FALSE (the 215 run starts exactly at the
+    # exclusive end)
+    rb = RoaringBitmap()
+    for start, length in ((41, 15), (215, 0), (217, 2790), (3065, 170),
+                          (3269, 422), (3733, 43), (3833, 16), (3852, 7),
+                          (3662, 3), (3901, 2)):
+        rb.add_range(start, start + length + 1)
+    assert not rb.intersects_range(57, 215)
+    assert rb.intersects_range(57, 216)
+
+
+# --------------------------------------------- next/previous value boundaries
+def test_next_value_word_boundaries():
+    # TestBitmapContainer.testNextValue2/testNextValueBetweenRuns:1036-1056 —
+    # [64,129) and [256,321) probe exactly at 64-bit word boundaries
+    rb = RoaringBitmap()
+    rb.add_range(64, 129)
+    rb.add_range(256, 321)
+    assert rb.next_value(0) == 64
+    assert rb.next_value(64) == 64
+    assert rb.next_value(65) == 65
+    assert rb.next_value(128) == 128
+    assert rb.next_value(129) == 256
+    assert rb.next_value(512) == -1
+
+
+def test_next_value_after_end_and_unsigned():
+    # TestBitmapContainer.testNextValueAfterEnd:1030-1033 and
+    # testNextValueUnsigned:1076-1083
+    rb = RoaringBitmap.from_values(np.array([10, 20, 30], np.uint32))
+    assert rb.next_value(31) == -1
+    hi = 1 << 15
+    rb2 = RoaringBitmap.from_values(np.array([hi | 5, hi | 7], np.uint32))
+    assert rb2.next_value(hi | 4) == (hi | 5)
+    assert rb2.next_value(hi | 5) == (hi | 5)
+    assert rb2.next_value(hi | 6) == (hi | 7)
+    assert rb2.next_value(hi | 8) == -1
+
+
+def test_previous_value_word_boundaries():
+    # TestBitmapContainer.testPreviousValue1:1086-1093
+    rb = RoaringBitmap()
+    rb.add_range(64, 129)
+    assert rb.previous_value(0) == -1
+    assert rb.previous_value(63) == -1
+    assert rb.previous_value(64) == 64
+    assert rb.previous_value(128) == 128
+    assert rb.previous_value(200) == 128

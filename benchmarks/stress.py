@@ -1,0 +1,267 @@
+"""Synthetic stress-shape benchmarks — the jmh aggregation suite analog.
+
+The reference stresses its aggregation engines with synthetic key-layout
+extremes (jmh/src/jmh/java/org/roaringbitmap/aggregation/{and,andnot,or,xor}/
+{bestcase,worstcase,identical}/RoaringBitmapBenchmark.java and
+FastAggregationRLEStressTest.java).  The realdata matrix never exercises
+these: segment skew is exactly the blocked layout's failure mode (padding
+waste at all-size-1 segments; one giant segment serializes the sequential
+Pallas grid), so each extreme gets its own cells here, both engines, with
+cardinality parity asserted against the host tier on every cell.
+
+Shapes (N bitmaps over K distinct container keys):
+  disjoint    every bitmap owns K/N private keys — segments of size 1, the
+              wide analog of jmh or/worstcase's interleaved-keys pair (and
+              the best case for AND: empty intersection, pruned host-side)
+  shared      all N bitmaps populate the SAME K keys — segments of size N
+              (jmh and/worstcase for the pairwise pair; the group-by-key
+              rotation's one-giant-segment-per-key regime)
+  giant       K=1: a single segment of N rows — maximum sequential depth
+              for the segmented kernels
+  identical   all N bitmaps are the same object graph (jmh */identical):
+              shared keys AND equal payloads
+Container-kind axis: sparse (array containers, ~200 values) and dense
+(bitmap containers, ~9000 values), matching the RLE stress test's density
+sweep (FastAggregationRLEStressTest.java probability 0.01/0.1/0.5).
+
+Pairwise cells replicate the two-bitmap jmh classes directly:
+  pair_bestcase   aggregation/and/bestcase (10k private keys each side,
+                  50 near-miss keys)
+  pair_worstcase  aggregation/and/worstcase (10k interleaved disjoint keys)
+  pair_identical  aggregation/and/identical (same 10k keys and values)
+
+Usage: python benchmarks/stress.py [--n N] [--keys K] [--reps R]
+Emits one JSON document on stdout (markdown table on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WIDE_R = (50, 1050)   # chained rep pair for marginals
+PAIR_R = (50, 1050)
+
+
+def _timeit(fn, reps: int) -> float:
+    fn()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _marginal(make_fn, expected: int, rep_pair, tries: int = 4) -> float | None:
+    r1, r2 = rep_pair
+    fns = {}
+
+    def timed(r):
+        fn = fns.setdefault(r, make_fn(r))
+        want = (r * expected) % 2**32
+        best = float("inf")
+        for i in range(6):
+            t0 = time.perf_counter()
+            got = int(np.asarray(fn()))
+            dt = time.perf_counter() - t0
+            assert got == want, f"chained parity: {got} != {want} (reps={r})"
+            if i:
+                best = min(best, dt)
+        return best
+
+    for _ in range(tries):
+        t1, t2 = timed(r1), timed(r2)
+        if t2 > t1:
+            return (t2 - t1) / (r2 - r1)
+    return None
+
+
+# -------------------------------------------------------------- generators
+
+def make_wide(shape: str, kind: str, n: int, keys: int,
+              seed: int = 99999):
+    """N bitmaps in the given key-layout extreme.  kind selects container
+    density: sparse -> array containers, dense -> bitmap containers."""
+    from roaringbitmap_tpu import RoaringBitmap
+
+    rng = np.random.default_rng(seed)
+    per = 200 if kind == "sparse" else 9000
+
+    def chunk_values(key: int) -> np.ndarray:
+        lo = rng.choice(1 << 16, size=per, replace=False).astype(np.uint32)
+        return (np.uint32(key) << np.uint32(16)) | lo
+
+    bms = []
+    if shape == "disjoint":
+        kper = max(1, keys // n)
+        for i in range(n):
+            vals = np.concatenate([chunk_values(i * kper + j)
+                                   for j in range(kper)])
+            bms.append(RoaringBitmap.from_values(np.sort(vals)))
+    elif shape == "shared":
+        for _ in range(n):
+            vals = np.concatenate([chunk_values(j) for j in range(keys)])
+            bms.append(RoaringBitmap.from_values(np.sort(vals)))
+    elif shape == "giant":
+        for _ in range(n):
+            bms.append(RoaringBitmap.from_values(np.sort(chunk_values(0))))
+    elif shape == "identical":
+        vals = np.sort(np.concatenate(
+            [chunk_values(j) for j in range(keys)]))
+        one = RoaringBitmap.from_values(vals)
+        bms = [one.clone() for _ in range(n)]
+    else:
+        raise ValueError(shape)
+    return bms
+
+
+def make_pair(shape: str):
+    """The two-bitmap jmh stress pairs, value-for-value."""
+    from roaringbitmap_tpu import RoaringBitmap
+
+    k = 1 << 16
+    if shape == "pair_bestcase":
+        # aggregation/and/bestcase/RoaringBitmapBenchmark.java:21-37
+        b1 = np.arange(10000, dtype=np.int64) * k
+        miss = np.arange(10000, 10050, dtype=np.int64)
+        b1 = np.concatenate([b1, miss * k + 13, [20000 * k]])
+        b2 = np.concatenate([miss * k,
+                             np.arange(10050, 20000, dtype=np.int64) * k])
+    elif shape == "pair_worstcase":
+        # aggregation/and/worstcase/RoaringBitmapBenchmark.java:20-29
+        i = np.arange(10000, dtype=np.int64)
+        b1, b2 = 2 * i * k, 2 * i * k + 1
+    elif shape == "pair_identical":
+        # aggregation/and/identical/RoaringBitmapBenchmark.java:20-29
+        i = np.arange(10000, dtype=np.int64)
+        b1 = b2 = i * k
+    else:
+        raise ValueError(shape)
+    return (RoaringBitmap.from_values(np.sort(b1).astype(np.uint32)),
+            RoaringBitmap.from_values(np.sort(b2).astype(np.uint32)))
+
+
+# ------------------------------------------------------------------- cells
+
+def bench_wide_shape(shape: str, kind: str, n: int, keys: int,
+                     cells: dict, reps: int) -> None:
+    from roaringbitmap_tpu.parallel import fast_aggregation
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+
+    bms = make_wide(shape, kind, n, keys)
+    ds = DeviceBitmapSet(bms)
+    tag = f"{shape}-{kind}"
+    cells[f"{tag}/meta"] = {
+        "n": n, "distinct_keys": int(ds.keys.size), "block": ds.block,
+        "rows_padded": int(ds.seg_ids.size),
+        "hbm_mb": round(ds.hbm_bytes() / 1e6, 2)}
+
+    host = {"or": lambda: fast_aggregation.or_(*bms),
+            "xor": lambda: fast_aggregation.xor(*bms),
+            "and": lambda: fast_aggregation.and_(*bms)}
+    oracle = {op: fn().cardinality for op, fn in host.items()}
+    for op in ("or", "xor", "and"):
+        cells[f"{tag}/wide_{op}/host"] = {
+            "ms": round(_timeit(host[op], reps) * 1e3, 3),
+            "note": "Python/NumPy tier"}
+        engines = (("xla",), ("pallas",)) if op != "and" else (("xla",),)
+        for (eng,) in engines:
+            import jax.numpy as jnp
+
+            def run(eng=eng, op=op):
+                _, cards = ds.aggregate_device(op, engine=eng)
+                total = int(np.asarray(jnp.sum(cards)))
+                assert total == oracle[op], (tag, op, eng, total, oracle[op])
+            name = "device-e2e" if op == "and" else f"device-{eng}-e2e"
+            cells[f"{tag}/wide_{op}/{name}"] = {
+                "ms": round(_timeit(run, reps) * 1e3, 3)}
+            per = _marginal(
+                lambda r, eng=eng, op=op: (
+                    lambda f: (lambda: f(ds.words)))(
+                        ds.chained_aggregate(op, r, engine=eng)),
+                oracle[op], WIDE_R)
+            if per is not None:
+                name = ("device-marginal" if op == "and"
+                        else f"device-{eng}-marginal")
+                cells[f"{tag}/wide_{op}/{name}"] = {
+                    "us": round(per * 1e6, 2)}
+
+
+def bench_pair_shape(shape: str, cells: dict, reps: int) -> None:
+    from roaringbitmap_tpu.parallel import aggregation
+
+    a, b = make_pair(shape)
+    pairs = [(a, b)]
+    for op, host_op in (("and", lambda x, y: x & y),
+                        ("or", lambda x, y: x | y),
+                        ("xor", lambda x, y: x ^ y),
+                        ("andnot", lambda x, y: x - y)):
+        want = host_op(a, b).cardinality
+        cells[f"{shape}/{op}/host"] = {
+            "us": round(_timeit(lambda: host_op(a, b), reps) * 1e6, 1),
+            "note": "Python/NumPy tier"}
+
+        def run(op=op, want=want):
+            cards = aggregation.pairwise_cardinality(op, pairs)
+            assert int(cards[0]) == want, (shape, op, cards, want)
+        cells[f"{shape}/{op}/device-e2e"] = {
+            "ms": round(_timeit(run, reps) * 1e3, 3),
+            "note": "incl. pack + dispatch"}
+        per = _marginal(
+            lambda r, op=op: aggregation.chained_pairwise_cardinality(
+                op, pairs, r)[0],
+            want, PAIR_R)
+        if per is not None:
+            cells[f"{shape}/{op}/device-marginal"] = {
+                "us": round(per * 1e6, 2)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100)
+    ap.add_argument("--keys", type=int, default=200)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--shapes", nargs="*",
+                    default=["disjoint", "shared", "giant", "identical"])
+    ap.add_argument("--pair-shapes", nargs="*",
+                    default=["pair_bestcase", "pair_worstcase",
+                             "pair_identical"])
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/rb_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    cells: dict = {}
+    for shape in args.shapes:
+        for kind in ("sparse", "dense"):
+            print(f"[stress] wide {shape}-{kind} ...", file=sys.stderr)
+            bench_wide_shape(shape, kind, args.n, args.keys, cells,
+                             args.reps)
+    for shape in args.pair_shapes:
+        print(f"[stress] {shape} ...", file=sys.stderr)
+        bench_pair_shape(shape, cells, args.reps)
+
+    result = {"backend": jax.default_backend(), "n": args.n,
+              "keys": args.keys, "cells": cells}
+    for cell, v in sorted(cells.items()):
+        val = v.get("ms", v.get("us", ""))
+        unit = "ms" if "ms" in v else "us" if "us" in v else ""
+        note = f"  ({v['note']})" if "note" in v else ""
+        meta = ("" if "ms" in v or "us" in v else
+                " ".join(f"{k}={v[k]}" for k in v))
+        print(f"  {cell:58s} {val:>10} {unit}{meta}{note}", file=sys.stderr)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -156,3 +156,63 @@ def densify_streams(dense_words, dense_dest, values, val_counts, val_dest,
                     n_rows: int, total_values: int) -> jnp.ndarray:
     return densify_streams_impl(dense_words, dense_dest, values, val_counts,
                                 val_dest, n_rows, total_values)
+
+
+# ----------------------------------------------- fused compact-layout reduce
+#
+# The round-3 compact layout paid a full materialize-then-reduce round trip
+# per query: scatter the M x 2048 dense image to HBM, then read it all back
+# in the segmented reduce (~3x the HBM traffic of the dense-resident path).
+# The fused form never materializes rows.  Sparse values scatter-add 4-bit
+# OCCURRENCE COUNTS per bit position, grouped by NIBBLE_GROUP-row windows:
+# within one container values are unique, and a group holds at most
+# NIBBLE_GROUP containers, so every nibble stays < 16 — the scatter-add is
+# carry-free and therefore exact.  Counts are half the size of the rows they
+# replace (4 bits/bit vs 8 KB/row over 8 rows), and the count -> bit
+# conversion (OR: nibble != 0, XOR: nibble parity) fuses into the Pallas
+# segmented accumulator (ops.kernels.fused_nibble_reduce), so the only HBM
+# traffic is one counts write + one counts read.
+
+#: Rows per nibble-count group.  Must divide the blocked layout's block size
+#: and stay below 16 so per-bit occurrence counts fit a nibble carry-free.
+NIBBLE_GROUP = 8
+#: u32 count words per group: 2^16 bit positions x 4 bits = 4 x 2048 words,
+#: laid out plane-major (plane j holds bits [8j, 8j+8) of every word) so the
+#: kernel's byte recombine is elementwise across planes.
+NIBBLE_WORDS = 4 * WORDS32
+
+
+def nibble_counts_impl(values, val_counts, val_dest, n_groups: int,
+                       total_values: int) -> jnp.ndarray:
+    """Sparse streams -> u32[n_groups + 1, NIBBLE_WORDS] occurrence counts.
+
+    Value v of destination row r contributes count 1 to group r >> 3, plane
+    (v >> 3) & 3, word v >> 5, nibble v & 7.  The trailing group absorbs
+    sentinel-padded entries (val_dest == n_rows, n_rows a NIBBLE_GROUP
+    multiple).  Traceable; callers inline it inside chained loops.
+    """
+    flat = jnp.zeros(((n_groups + 1) * NIBBLE_WORDS,), jnp.uint32)
+    if total_values:
+        rows = jnp.repeat(val_dest.astype(jnp.int32), val_counts,
+                          total_repeat_length=total_values)
+        v = values.astype(jnp.int32)
+        g = ((rows >> 3) * NIBBLE_WORDS + ((v >> 3) & 3) * WORDS32
+             + (v >> 5))
+        nib = jnp.uint32(1) << (4 * (v & 7)).astype(jnp.uint32)
+        flat = flat.at[g].add(nib, unique_indices=False)
+    return flat.reshape(n_groups + 1, NIBBLE_WORDS)
+
+
+def dense_partial_impl(op: str, dense_words, dseg, head_idx, head_valid,
+                       n_steps: int, num_segments: int) -> jnp.ndarray:
+    """Per-segment reduction of the dense-wire rows only:
+    u32[Md, 2048] (+ sorted i32[Md] segment ids) -> u32[K + 1, 2048].
+
+    Segments with no dense rows get zero rows (head_valid False); the
+    trailing row is the scratch segment's.  Traceable.
+    """
+    if dense_words.shape[0] == 0:
+        return jnp.zeros((num_segments + 1, WORDS32), jnp.uint32)
+    red = doubling_pass(OPS[op], dense_words, dseg, n_steps)
+    safe = jnp.minimum(head_idx, dense_words.shape[0] - 1)
+    return jnp.where(head_valid[:, None], red[safe], jnp.uint32(0))

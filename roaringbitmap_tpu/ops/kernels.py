@@ -146,6 +146,78 @@ def segmented_reduce_pallas_blocked(
     return heads, cards
 
 
+def _nibble_reduce_kernel(op_name: str, op):
+    def kernel(seg_ref, counts_ref, dp_ref, out_ref):
+        i = pl.program_id(0)
+        prev = seg_ref[jnp.maximum(i - 1, 0)]
+        is_head = jnp.logical_or(i == 0, seg_ref[i] != prev)
+        c = counts_ref[0]  # (4, 16, 128) u32: plane-major nibble counts
+        if op_name == "or":
+            # bit set iff its occurrence count nibble is non-zero
+            t = c | (c >> 1)
+            t = t | (t >> 2)
+            m = t & jnp.uint32(0x11111111)
+        else:  # xor: bit set iff its count is odd = the nibble's LSB
+            m = c & jnp.uint32(0x11111111)
+        # SWAR-compress the 8 nibble flags (bits 0,4,..,28) to the low byte
+        v = (m | (m >> 3)) & jnp.uint32(0x03030303)
+        w = (v | (v >> 6)) & jnp.uint32(0x000F000F)
+        r = (w | (w >> 12)) & jnp.uint32(0xFF)
+        # plane j holds bits [8j, 8j+8) of every output word — elementwise
+        word = r[0] | (r[1] << 8) | (r[2] << 16) | (r[3] << 24)
+
+        @pl.when(is_head)
+        def _init():
+            # fold the dense-wire rows' partial in exactly once per segment
+            out_ref[0] = op(word, dp_ref[0])
+
+        @pl.when(jnp.logical_not(is_head))
+        def _accum():
+            out_ref[0] = op(out_ref[0], word)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("op", "num_segments"))
+def fused_nibble_reduce(op: str, counts: jnp.ndarray,
+                        dense_partial: jnp.ndarray, grp_seg: jnp.ndarray,
+                        num_segments: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused compact-layout wide reduce: per-group nibble counts
+    (ops.dense.nibble_counts_impl) + per-segment dense-row partials ->
+    (u32[K, 2048] per-key words, i32[K] cardinalities), OR/XOR only.
+
+    One sequential grid pass over the count groups, converting counts to
+    bits in-register (VPU SWAR) and accumulating each segment in VMEM —
+    the round-3 verdict's missing fusion: the compact layout previously
+    materialized the full row image to HBM and read it back per query.
+    Count groups of a segment are consecutive (grp_seg sorted); the
+    trailing scratch group/partial row lands in out row K and is dropped.
+    """
+    ops = dense.OPS
+    n_blocks = counts.shape[0]
+    c4 = counts.reshape(n_blocks, 4, _SUB, _LANE)
+    dp3 = dense_partial.reshape(num_segments + 1, _SUB, _LANE)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((1, 4, _SUB, _LANE), lambda i, seg: (i, 0, 0, 0)),
+            pl.BlockSpec((1, _SUB, _LANE), lambda i, seg: (seg[i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _SUB, _LANE), lambda i, seg: (seg[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        _nibble_reduce_kernel(op, ops[op]),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_segments + 1, _SUB, _LANE),
+                                       jnp.uint32),
+        interpret=_use_interpret(),
+    )(grp_seg, c4, dp3)
+    heads = out[:num_segments].reshape(num_segments, WORDS32)
+    cards = jnp.sum(jax.lax.population_count(heads).astype(jnp.int32), axis=-1)
+    return heads, cards
+
+
 def _pairwise_popcount_kernel(op):
     def kernel(a_ref, b_ref, out_ref, card_ref):
         r = op(a_ref[...], b_ref[...])

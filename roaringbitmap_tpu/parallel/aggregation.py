@@ -23,6 +23,8 @@ tested for bit-equality on every path.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -480,6 +482,9 @@ class DeviceBitmapSet:
         self.block = self._packed.block
         self.keys = self._packed.keys
         s = self._packed.streams
+        if layout == "compact":
+            s = self._sort_dense_stream(s)
+            self._compact_meta(s)
         self._streams = tuple(jax.device_put(a) for a in (
             s.dense_words, s.dense_dest, s.values, s.val_counts, s.val_dest))
         self._n_rows, self._total_values = s.n_rows, s.total_values
@@ -496,6 +501,70 @@ class DeviceBitmapSet:
         self.seg_ids = jax.device_put(seg_rows)
         self.head_idx = jax.device_put(head_idx)
 
+    def _sort_dense_stream(self, s: packing.CompactStreams):
+        """Dense-wire rows reordered by destination row so their segment ids
+        are sorted ascending (the fused reduce's doubling pass needs sorted
+        segments; the NumPy pack already emits them sorted, the native
+        engine's interleaved walk may not)."""
+        if s.dense_dest.size and np.any(np.diff(s.dense_dest) < 0):
+            order = np.argsort(s.dense_dest, kind="stable")
+            s.dense_words = s.dense_words[order]
+            s.dense_dest = s.dense_dest[order]
+        return s
+
+    def _compact_meta(self, s: packing.CompactStreams) -> None:
+        """Host metadata for the fused compact reduce (ops.kernels.
+        fused_nibble_reduce): count-group segment ids and the dense-row
+        partial's gather maps, plus the carry-prepended variants used by the
+        write-back chained probe."""
+        k = self.keys.size
+        n_groups = s.n_rows // dense.NIBBLE_GROUP
+        grp_seg = np.full(n_groups + 1, k, dtype=np.int32)
+        grp_seg[:n_groups] = np.repeat(
+            self._packed.blk_seg, self.block // dense.NIBBLE_GROUP)
+        self._n_groups = n_groups
+        self._grp_seg = jax.device_put(grp_seg)
+
+        blk_seg = self._packed.blk_seg
+        dseg = (blk_seg[s.dense_dest // self.block].astype(np.int32)
+                if s.dense_dest.size else np.empty(0, np.int32))
+
+        def head_maps(seg_ids: np.ndarray):
+            """(head_idx i32[K+1], valid bool[K+1], n_steps) over sorted
+            per-dense-row segment ids; row K is the scratch segment."""
+            head = np.searchsorted(seg_ids, np.arange(k + 1)).astype(np.int32)
+            safe = np.minimum(head, max(seg_ids.size - 1, 0))
+            valid = ((head < seg_ids.size)
+                     & (seg_ids[safe] == np.arange(k + 1))
+                     if seg_ids.size else np.zeros(k + 1, bool))
+            sizes = np.diff(np.append(head, seg_ids.size))
+            n_steps = dense.n_steps_for(int(sizes.max()) if k else 0)
+            return (jax.device_put(head), jax.device_put(valid), n_steps)
+
+        self._dmeta = head_maps(dseg)
+        self._dseg = jax.device_put(dseg)
+        dseg_c = np.concatenate(([np.int32(0)], dseg))
+        self._dmeta_carry = head_maps(dseg_c)
+        self._dseg_carry = jax.device_put(dseg_c)
+
+    def _fused_compact(self, op: str, streams, carry=None):
+        """One fused compact-layout wide OR/XOR: nibble-count scatter +
+        dense-row partial + the Pallas segmented accumulator.  `streams` is
+        the (possibly barrier-passed) device stream tuple; `carry` is the
+        write-back chain's loop-carried row, prepended as a segment-0
+        dense row.  Dispatches through one jitted program (inlined when a
+        chained probe traces it inside its own loop)."""
+        if carry is None:
+            dw, dseg, (head, valid, steps) = (
+                streams[0], self._dseg, self._dmeta)
+        else:
+            dw = jnp.concatenate([carry[None], streams[0]], axis=0)
+            dseg, (head, valid, steps) = self._dseg_carry, self._dmeta_carry
+        return _fused_compact_run(
+            op, dw, streams[2], streams[3], streams[4], self._grp_seg,
+            dseg, head, valid, steps, self._n_groups, self._total_values,
+            self.keys.size)
+
     def _resident_words(self):
         """Dense image: resident (dense layout) or transient device densify
         (compact layout)."""
@@ -507,9 +576,14 @@ class DeviceBitmapSet:
     def _select_engine(self, engine: str) -> str:
         """Engine choice with the SMEM guard: the per-block scalar prefetch
         must fit SMEM (same bound as _run_ragged); beyond it every entry
-        point falls back to the doubling engine."""
+        point falls back to the doubling engine.  The compact layout's
+        fused kernel prefetches the per-group array instead (up to 2x the
+        per-block one)."""
         eng = _engine(engine)
         if eng == "pallas" and int(self.blk_seg.size) > kernels.SMEM_PREFETCH_MAX:
+            eng = "xla"
+        if (eng == "pallas" and self.words is None
+                and self._n_groups + 1 > kernels.SMEM_PREFETCH_MAX):
             eng = "xla"
         return eng
 
@@ -527,6 +601,10 @@ class DeviceBitmapSet:
             return self._and_device()
         if op not in ("or", "xor"):
             raise ValueError(f"unsupported wide op {op!r}")
+        if self.words is None and self._select_engine(engine) == "pallas":
+            # compact layout + pallas: the fused path never materializes
+            # the row image (half the scatter traffic, no reduce re-read)
+            return self._fused_compact(op, self._streams)
         words = self._resident_words()
         if self._select_engine(engine) == "pallas":
             return kernels.segmented_reduce_pallas_blocked(
@@ -571,6 +649,9 @@ class DeviceBitmapSet:
                    + self.head_idx.nbytes)
         if self.words is not None:
             return int(self.words.nbytes) + meta
+        meta += sum(int(a.nbytes) for a in (
+            self._grp_seg, self._dseg, self._dseg_carry,
+            *self._dmeta[:2], *self._dmeta_carry[:2]))
         return sum(int(a.nbytes) for a in self._streams) + meta
 
     def chained_wide_or(self, reps: int, engine: str = "auto"):
@@ -675,20 +756,24 @@ class DeviceBitmapSet:
 
             return jax.jit(run)
 
-        # compact layout: barrier the streams instead and densify inside the
-        # loop — the per-iteration densify IS the query cost being measured
+        # compact layout: barrier the streams instead and rebuild from them
+        # inside the loop — that per-iteration rebuild IS the query cost
         streams = self._streams
         n_rows, total_values = self._n_rows, self._total_values
+        use_fused = eng == "pallas" and op in ("or", "xor")
 
         def body_compact(i, state):
             total = state
-            # barrier EVERY stream array so the whole densify (value
+            # barrier EVERY stream array so the whole rebuild (value
             # scatter included) stays loop-variant — nothing hoistable
             s, _ = jax.lax.optimization_barrier((streams, total))
-            words = dense.densify_streams_impl(
-                s[0], s[1].astype(jnp.int32), s[2], s[3], s[4],
-                n_rows, total_values)
-            cards = reduce_cards(words)
+            if use_fused:
+                _, cards = self._fused_compact(op, s)
+            else:
+                words = dense.densify_streams_impl(
+                    s[0], s[1].astype(jnp.int32), s[2], s[3], s[4],
+                    n_rows, total_values)
+                cards = reduce_cards(words)
             return total + jnp.sum(cards.astype(jnp.uint32))
 
         def run_compact(_words_unused):
@@ -698,9 +783,9 @@ class DeviceBitmapSet:
         return jax.jit(run_compact)
 
     def _chained_compact(self, reps: int, eng: str):
-        """chained_wide_or body for the compact layout: densify every
-        iteration (that IS the query cost), carry row threaded through the
-        dense stream."""
+        """chained_wide_or body for the compact layout: rebuild from the
+        streams every iteration (that IS the query cost), carry row threaded
+        through the dense stream."""
         streams = self._streams
         n_rows, total_values = self._n_rows, self._total_values
         carry_row = self._packed.carry_row
@@ -721,14 +806,19 @@ class DeviceBitmapSet:
             # barrier the sparse streams too so the value scatter can't be
             # hoisted either
             s, _ = jax.lax.optimization_barrier((streams, total))
-            dw = jnp.concatenate([s[0], carry[None]], axis=0)
-            dd = jnp.concatenate(
-                [s[1].astype(jnp.int32),
-                 jnp.full((1,), carry_row, jnp.int32)])
-            words = dense.densify_streams_impl(
-                dw, dd, s[2], s[3], s[4],
-                n_rows, total_values)
-            heads, cards = reduce_step(words)
+            if eng == "pallas":
+                # fused path: the carry rides as a prepended segment-0
+                # dense row instead of a reserved destination row
+                heads, cards = self._fused_compact("or", s, carry=carry)
+            else:
+                dw = jnp.concatenate([s[0], carry[None]], axis=0)
+                dd = jnp.concatenate(
+                    [s[1].astype(jnp.int32),
+                     jnp.full((1,), carry_row, jnp.int32)])
+                words = dense.densify_streams_impl(
+                    dw, dd, s[2], s[3], s[4],
+                    n_rows, total_values)
+                heads, cards = reduce_step(words)
             return heads[0], total + jnp.sum(cards.astype(jnp.uint32))
 
         def run_compact(_words_unused):
@@ -737,6 +827,21 @@ class DeviceBitmapSet:
                 0, reps, body_compact, (carry0, jnp.uint32(0)))[1]
 
         return jax.jit(run_compact)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "steps", "n_groups",
+                                             "total_values", "k"))
+def _fused_compact_run(op: str, dense_words, values, val_counts, val_dest,
+                       grp_seg, dseg, head, valid, steps: int,
+                       n_groups: int, total_values: int, k: int):
+    """Jitted fused compact-layout reduce (DeviceBitmapSet._fused_compact's
+    body): one dispatch for nibble scatter + dense partial + Pallas
+    accumulator, so the one-shot API path fuses like the chained probes."""
+    counts = dense.nibble_counts_impl(values, val_counts, val_dest,
+                                      n_groups, total_values)
+    dp = dense.dense_partial_impl(op, dense_words, dseg, head, valid,
+                                  steps, k)
+    return kernels.fused_nibble_reduce(op, counts, dp, grp_seg, k)
 
 
 def _device_range_cardinality(keys: np.ndarray, words, start: int,

@@ -200,6 +200,34 @@ def test_chained_aggregate_parity_all_ops_layouts(rng):
         assert got_wb == (reps * want["or"]) % 2**32, layout
 
 
+def test_fused_compact_nibble_count_saturation():
+    """The fused compact reduce (ops.kernels.fused_nibble_reduce) encodes
+    per-bit occurrence COUNTS in nibbles, exact only while a count group
+    holds <= NIBBLE_GROUP containers.  Worst case: every container of a
+    full group sets the SAME bits — count 8, the nibble ceiling — mixed
+    with odd/even overlap so OR and XOR diverge, plus dense rows in the
+    same segments so the dense-partial head fold is exercised."""
+    from roaringbitmap_tpu.parallel import fast_aggregation
+
+    same = np.arange(0, 4000, 7, dtype=np.uint32)        # count == N bits
+    odd = np.arange(1, 3000, 9, dtype=np.uint32)
+    bms = []
+    for i in range(8):                                    # one full group
+        vals = [same]
+        if i < 3:                                         # count-3 bits
+            vals.append(odd)
+        if i == 0:                                        # dense row, same key
+            vals.append(np.arange(20000, 30000, dtype=np.uint32))
+        bms.append(RoaringBitmap.from_values(
+            np.unique(np.concatenate(vals))))
+    want_or = fast_aggregation.or_(*bms)
+    want_xor = fast_aggregation.xor(*bms)
+    assert want_xor.cardinality < want_or.cardinality    # overlap is real
+    ds = DeviceBitmapSet(bms, layout="compact")
+    assert ds.aggregate("or", engine="pallas") == want_or
+    assert ds.aggregate("xor", engine="pallas") == want_xor
+
+
 class TestDeviceQueryPlans:
     """DeviceBitmap: aggregate results compose on device (SURVEY §7 hard
     part (d) — no host round trip inside a query plan)."""

@@ -177,3 +177,52 @@ def test_sharded_bsi_parity(mesh8):
     assert sb.compare_cardinality(
         Operation.LE, 1 << 40) == bsi.ebm.cardinality
     assert sb.sum() == bsi.sum()
+
+
+def test_sharded_bsi_topk(mesh8):
+    """ShardedBSI.top_k_cardinality == DeviceBSI's pre-trim candidate
+    cardinality, and >= k whenever k rows exist."""
+    from roaringbitmap_tpu.bsi.device import DeviceBSI
+    from roaringbitmap_tpu.bsi.slice_index import RoaringBitmapSliceIndex
+    from roaringbitmap_tpu.parallel.sharding import ShardedBSI
+
+    rng = np.random.default_rng(23)
+    cols = np.unique(rng.integers(0, 1 << 19, 4000)).astype(np.uint32)
+    vals = rng.integers(0, 1 << 12, cols.size).astype(np.uint64)
+    bsi = RoaringBitmapSliceIndex.from_pairs(cols, vals)
+    sb = ShardedBSI(mesh8, bsi)
+    db = DeviceBSI(bsi)
+    for k in (1, 50, cols.size // 2, cols.size):
+        want = int(np.asarray(db._topk_words(k, db.ebm)[1]).sum())
+        got = sb.top_k_cardinality(k)
+        assert got == want, k
+        assert got >= k
+
+
+def test_sharded_rangebitmap_parity(mesh8):
+    """ShardedRangeBitmap threshold/between cardinalities == host
+    RangeBitmap over the 8-device mesh (VERDICT r3 missing #5)."""
+    from roaringbitmap_tpu.core.rangebitmap import RangeBitmap
+    from roaringbitmap_tpu.parallel.sharding import ShardedRangeBitmap
+
+    rng = np.random.default_rng(29)
+    vals = rng.integers(0, 100_000, 80_000).astype(np.uint64)
+    app = RangeBitmap.appender(int(vals.max()))
+    app.add_many(vals)
+    rbm = app.build()
+    srb = ShardedRangeBitmap(mesh8, rbm)
+    thr = int(np.median(vals))
+    lo, hi = int(np.percentile(vals, 25)), int(np.percentile(vals, 75))
+    assert srb.lte_cardinality(thr) == rbm.lte(thr).cardinality
+    assert srb.lt_cardinality(thr) == rbm.lt(thr).cardinality
+    assert srb.gte_cardinality(thr) == rbm.gte(thr).cardinality
+    assert srb.gt_cardinality(thr) == rbm.gt(thr).cardinality
+    assert srb.eq_cardinality(thr) == rbm.eq(thr).cardinality
+    assert srb.neq_cardinality(thr) == rbm.neq(thr).cardinality
+    assert (srb.between_cardinality(lo, hi)
+            == rbm.between(lo, hi).cardinality)
+    # boundary guards match the host semantics
+    assert srb.lte_cardinality(-1) == 0
+    assert srb.gte_cardinality(0) == srb.rows
+    assert srb.between_cardinality(hi, lo) == 0
+    assert srb.between_cardinality(-5, 1 << 40) == srb.rows

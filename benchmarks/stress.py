@@ -242,25 +242,37 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     cells: dict = {}
-    for shape in args.shapes:
-        for kind in ("sparse", "dense"):
-            print(f"[stress] wide {shape}-{kind} ...", file=sys.stderr)
-            bench_wide_shape(shape, kind, args.n, args.keys, cells,
-                             args.reps)
-    for shape in args.pair_shapes:
-        print(f"[stress] {shape} ...", file=sys.stderr)
-        bench_pair_shape(shape, cells, args.reps)
-
     result = {"backend": jax.default_backend(), "n": args.n,
               "keys": args.keys, "cells": cells}
-    for cell, v in sorted(cells.items()):
-        val = v.get("ms", v.get("us", ""))
-        unit = "ms" if "ms" in v else "us" if "us" in v else ""
-        note = f"  ({v['note']})" if "note" in v else ""
-        meta = ("" if "ms" in v or "us" in v else
-                " ".join(f"{k}={v[k]}" for k in v))
-        print(f"  {cell:58s} {val:>10} {unit}{meta}{note}", file=sys.stderr)
-    print(json.dumps(result))
+    # always emit the JSON document, even when a later shape fails — a
+    # partial matrix beats losing an hour of completed cells
+    try:
+        for shape in args.shapes:
+            for kind in ("sparse", "dense"):
+                print(f"[stress] wide {shape}-{kind} ...", file=sys.stderr,
+                      flush=True)
+                t0 = time.perf_counter()
+                bench_wide_shape(shape, kind, args.n, args.keys, cells,
+                                 args.reps)
+                print(f"[stress]   done in "
+                      f"{time.perf_counter() - t0:.0f}s", file=sys.stderr,
+                      flush=True)
+        for shape in args.pair_shapes:
+            print(f"[stress] {shape} ...", file=sys.stderr, flush=True)
+            bench_pair_shape(shape, cells, args.reps)
+    except BaseException as e:  # noqa: BLE001 — record then re-raise
+        result["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        for cell, v in sorted(cells.items()):
+            val = v.get("ms", v.get("us", ""))
+            unit = "ms" if "ms" in v else "us" if "us" in v else ""
+            note = f"  ({v['note']})" if "note" in v else ""
+            meta = ("" if "ms" in v or "us" in v else
+                    " ".join(f"{k}={v[k]}" for k in v))
+            print(f"  {cell:58s} {val:>10} {unit}{meta}{note}",
+                  file=sys.stderr)
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -241,6 +241,16 @@ def _dispatch_pairwise(op: str, a, b, eng: str):
     return dense.pairwise(op, a, b)
 
 
+def _dispatch_pairwise_cards(op: str, a, b, eng: str):
+    """Cardinality-only dispatch: neither engine stores the result words
+    (XLA dead-code-eliminates the unused output of its fusion; pallas runs
+    the dedicated cards kernel) — the andCardinality/orCardinality fast
+    path's no-materialization property, preserved per engine."""
+    if eng == "pallas":
+        return kernels.pairwise_cards_pallas(op, a, b)
+    return dense.pairwise(op, a, b)[1]
+
+
 def _resolve_pairwise_engine(engine: str, num_rows: int) -> str:
     """_pairwise_engine plus the empty-operand guard: the pallas kernel
     cannot tile a zero-row operand — route empty packs to the dense path."""
@@ -354,8 +364,11 @@ class DevicePairSet:
             op, a, b, _resolve_pairwise_engine(engine, self.keys.size))
 
     def cardinalities(self, op: str, engine: str = "auto") -> np.ndarray:
-        """i64[P] per-pair result cardinalities (P scalars to host)."""
-        _, cards = self.pairwise_device(op, engine)
+        """i64[P] per-pair result cardinalities (P scalars to host; no
+        result words stored on either engine)."""
+        a, b = self._sides()
+        cards = _dispatch_pairwise_cards(
+            op, a, b, _resolve_pairwise_engine(engine, self.keys.size))
         return _per_pair_cards(cards, self.heads)
 
     def pairwise(self, op: str, engine: str = "auto",
@@ -371,36 +384,43 @@ class DevicePairSet:
         iteration (that IS the per-query cost being measured)."""
         eng = _resolve_pairwise_engine(engine, self.keys.size)
 
+        # the resident tensors enter the jitted program as ARGUMENTS, not
+        # closed-over constants: jit bakes captured device arrays into the
+        # HLO, which bloats every compile with the full payload (and blows
+        # request limits when compilation rides a tunnel)
         if self.layout == "dense":
-            a, b = self.a_words, self.b_words
+            def run(a, b):
+                def body(i, total):
+                    ab, _ = jax.lax.optimization_barrier((a, total))
+                    cards = _dispatch_pairwise_cards(op, ab, b, eng)
+                    return total + jnp.sum(cards.astype(jnp.uint32))
 
-            def body(i, total):
-                ab, _ = jax.lax.optimization_barrier((a, total))
-                _, cards = _dispatch_pairwise(op, ab, b, eng)
-                return total + jnp.sum(cards.astype(jnp.uint32))
+                return jax.lax.fori_loop(0, reps, body, jnp.uint32(0))
 
-            return jax.jit(
-                lambda: jax.lax.fori_loop(0, reps, body, jnp.uint32(0)))
+            f = jax.jit(run)
+            return lambda: f(self.a_words, self.b_words)
 
-        sa, sb = self._a, self._b
         n_rows, av, bv = self._n_rows, self._av, self._bv
 
-        def body_compact(i, total):
-            # barrier EVERY stream array: anything left outside would be
-            # loop-invariant and XLA's while-loop LICM would hoist its
-            # densify out of the loop, under-measuring the per-query cost
-            (ba, bb), _ = jax.lax.optimization_barrier(((sa, sb), total))
-            a = dense.densify_streams_impl(
-                ba[0], ba[1].astype(jnp.int32), ba[2], ba[3], ba[4],
-                n_rows, av)
-            b = dense.densify_streams_impl(
-                bb[0], bb[1].astype(jnp.int32), bb[2], bb[3], bb[4],
-                n_rows, bv)
-            _, cards = _dispatch_pairwise(op, a, b, eng)
-            return total + jnp.sum(cards.astype(jnp.uint32))
+        def run_compact(sa, sb):
+            def body_compact(i, total):
+                # barrier EVERY stream array: anything left outside would be
+                # loop-invariant and XLA's while-loop LICM would hoist its
+                # densify out of the loop, under-measuring the per-query cost
+                (ba, bb), _ = jax.lax.optimization_barrier(((sa, sb), total))
+                a = dense.densify_streams_impl(
+                    ba[0], ba[1].astype(jnp.int32), ba[2], ba[3], ba[4],
+                    n_rows, av)
+                b = dense.densify_streams_impl(
+                    bb[0], bb[1].astype(jnp.int32), bb[2], bb[3], bb[4],
+                    n_rows, bv)
+                cards = _dispatch_pairwise_cards(op, a, b, eng)
+                return total + jnp.sum(cards.astype(jnp.uint32))
 
-        return jax.jit(
-            lambda: jax.lax.fori_loop(0, reps, body_compact, jnp.uint32(0)))
+            return jax.lax.fori_loop(0, reps, body_compact, jnp.uint32(0))
+
+        f = jax.jit(run_compact)
+        return lambda: f(self._a, self._b)
 
     def hbm_bytes(self) -> int:
         if self.a_words is not None:
@@ -416,8 +436,13 @@ def _per_pair_cards(cards, heads: np.ndarray) -> np.ndarray:
 
 def pairwise_cardinality(op: str, pairs, engine: str = "auto") -> np.ndarray:
     """i64[P] result cardinalities only (the andCardinality/orCardinality
-    fast path, batched — nothing but P scalars leaves the device path)."""
-    _, cards, packed = pairwise_device(op, pairs, engine)
+    fast path, batched — nothing but P scalars leaves the device path,
+    and neither engine stores the result words)."""
+    packed = packing.pack_pairwise(list(pairs))
+    a = _densify_side(packed.a_streams, packed.n_rows)
+    b = _densify_side(packed.b_streams, packed.n_rows)
+    cards = _dispatch_pairwise_cards(
+        op, a, b, _resolve_pairwise_engine(engine, packed.keys.size))
     return _per_pair_cards(cards, packed.heads)
 
 
@@ -471,6 +496,13 @@ class DeviceBitmapSet:
                  layout: str = "dense"):
         if layout not in ("dense", "compact"):
             raise ValueError(f"unknown layout {layout!r}")
+        if (layout == "compact" and block is not None
+                and (block < dense.NIBBLE_GROUP
+                     or block % dense.NIBBLE_GROUP)):
+            # the fused reduce's count groups (8 rows) must tile the block
+            raise ValueError(
+                f"compact layout requires block to be a multiple of "
+                f"{dense.NIBBLE_GROUP}, got {block}")
         self.n = len(bitmaps)
         self.layout = layout
         # Blocked layout serves BOTH engines: segment-padded zero rows are
@@ -757,36 +789,35 @@ class DeviceBitmapSet:
             return jax.jit(run)
 
         # compact layout: barrier the streams instead and rebuild from them
-        # inside the loop — that per-iteration rebuild IS the query cost
-        streams = self._streams
+        # inside the loop — that per-iteration rebuild IS the query cost.
+        # Streams enter as jit ARGUMENTS (closed-over device arrays would be
+        # baked into the HLO as constants — compile bloat, tunnel limits)
         n_rows, total_values = self._n_rows, self._total_values
         use_fused = eng == "pallas" and op in ("or", "xor")
 
-        def body_compact(i, state):
-            total = state
-            # barrier EVERY stream array so the whole rebuild (value
-            # scatter included) stays loop-variant — nothing hoistable
-            s, _ = jax.lax.optimization_barrier((streams, total))
-            if use_fused:
-                _, cards = self._fused_compact(op, s)
-            else:
-                words = dense.densify_streams_impl(
-                    s[0], s[1].astype(jnp.int32), s[2], s[3], s[4],
-                    n_rows, total_values)
-                cards = reduce_cards(words)
-            return total + jnp.sum(cards.astype(jnp.uint32))
+        def run_compact(streams):
+            def body_compact(i, total):
+                # barrier EVERY stream array so the whole rebuild (value
+                # scatter included) stays loop-variant — nothing hoistable
+                s, _ = jax.lax.optimization_barrier((streams, total))
+                if use_fused:
+                    _, cards = self._fused_compact(op, s)
+                else:
+                    words = dense.densify_streams_impl(
+                        s[0], s[1].astype(jnp.int32), s[2], s[3], s[4],
+                        n_rows, total_values)
+                    cards = reduce_cards(words)
+                return total + jnp.sum(cards.astype(jnp.uint32))
 
-        def run_compact(_words_unused):
-            return jax.lax.fori_loop(
-                0, reps, body_compact, jnp.uint32(0))
+            return jax.lax.fori_loop(0, reps, body_compact, jnp.uint32(0))
 
-        return jax.jit(run_compact)
+        f = jax.jit(run_compact)
+        return lambda _words_unused=None: f(self._streams)
 
     def _chained_compact(self, reps: int, eng: str):
         """chained_wide_or body for the compact layout: rebuild from the
         streams every iteration (that IS the query cost), carry row threaded
         through the dense stream."""
-        streams = self._streams
         n_rows, total_values = self._n_rows, self._total_values
         carry_row = self._packed.carry_row
         blk_seg, seg_ids, head_idx, n_keys, n_steps, block = (
@@ -800,33 +831,34 @@ class DeviceBitmapSet:
             return dense.segmented_reduce(
                 "or", words, seg_ids, head_idx, n_steps)
 
-        def body_compact(i, state):
-            carry, total = state
-            # the carry write-back makes the dense-stream set loop-variant;
-            # barrier the sparse streams too so the value scatter can't be
-            # hoisted either
-            s, _ = jax.lax.optimization_barrier((streams, total))
-            if eng == "pallas":
-                # fused path: the carry rides as a prepended segment-0
-                # dense row instead of a reserved destination row
-                heads, cards = self._fused_compact("or", s, carry=carry)
-            else:
-                dw = jnp.concatenate([s[0], carry[None]], axis=0)
-                dd = jnp.concatenate(
-                    [s[1].astype(jnp.int32),
-                     jnp.full((1,), carry_row, jnp.int32)])
-                words = dense.densify_streams_impl(
-                    dw, dd, s[2], s[3], s[4],
-                    n_rows, total_values)
-                heads, cards = reduce_step(words)
-            return heads[0], total + jnp.sum(cards.astype(jnp.uint32))
+        def run_compact(streams):
+            def body_compact(i, state):
+                carry, total = state
+                # the carry write-back makes the dense-stream set
+                # loop-variant; barrier the sparse streams too so the value
+                # scatter can't be hoisted either
+                s, _ = jax.lax.optimization_barrier((streams, total))
+                if eng == "pallas":
+                    # fused path: the carry rides as a prepended segment-0
+                    # dense row instead of a reserved destination row
+                    heads, cards = self._fused_compact("or", s, carry=carry)
+                else:
+                    dw = jnp.concatenate([s[0], carry[None]], axis=0)
+                    dd = jnp.concatenate(
+                        [s[1].astype(jnp.int32),
+                         jnp.full((1,), carry_row, jnp.int32)])
+                    words = dense.densify_streams_impl(
+                        dw, dd, s[2], s[3], s[4],
+                        n_rows, total_values)
+                    heads, cards = reduce_step(words)
+                return heads[0], total + jnp.sum(cards.astype(jnp.uint32))
 
-        def run_compact(_words_unused):
             carry0 = jnp.zeros((packing.WORDS32,), jnp.uint32)
             return jax.lax.fori_loop(
                 0, reps, body_compact, (carry0, jnp.uint32(0)))[1]
 
-        return jax.jit(run_compact)
+        f = jax.jit(run_compact)
+        return lambda _words_unused=None: f(self._streams)
 
 
 @functools.partial(jax.jit, static_argnames=("op", "steps", "n_groups",

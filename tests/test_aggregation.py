@@ -393,6 +393,27 @@ class TestDevicePairSet:
         assert ps.hbm_bytes() > 0
 
 
+def test_pairwise_cards_pallas_parity(rng):
+    """The cardinality-only pairwise kernel (no words store) must match the
+    fused XLA op+popcount bit-for-bit at every block size."""
+    from roaringbitmap_tpu.ops import dense as D
+    from roaringbitmap_tpu.ops import kernels
+
+    import jax.numpy as jnp
+
+    k = 21  # deliberately not a block multiple
+    a = jnp.asarray(rng.integers(0, 1 << 32, (k, D.WORDS32), dtype=np.uint64)
+                    .astype(np.uint32))
+    b = jnp.asarray(rng.integers(0, 1 << 32, (k, D.WORDS32), dtype=np.uint64)
+                    .astype(np.uint32))
+    for op in ("and", "or", "xor", "andnot"):
+        want = np.asarray(D.pairwise(op, a, b)[1])
+        for bk in (8, 16):
+            got = np.asarray(kernels.pairwise_cards_pallas(op, a, b,
+                                                           block_k=bk))
+            np.testing.assert_array_equal(got, want, err_msg=f"{op} bk={bk}")
+
+
 def test_contains_batch_rejects_non_integer_probes():
     from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
 

@@ -288,6 +288,26 @@ def test_next_value_after_end_and_unsigned():
     assert rb2.next_value(hi | 8) == -1
 
 
+@needs_corpus
+def test_ornot_fuzz_regression():
+    # TestRoaringBitmapOrNot.testBigOrNot/testBigOrNotStatic:382-425: the
+    # fuzz-caught orNot failure, replayed from the serialized repro pair
+    import base64
+    import json
+
+    from roaringbitmap_tpu.core.bitmap import or_not
+
+    with open(os.path.join(TESTDATA, "ornot-fuzz-failure.json")) as f:
+        info = json.load(f)
+    l_rb = RoaringBitmap.deserialize(base64.b64decode(info["bitmaps"][0]))
+    r_rb = RoaringBitmap.deserialize(base64.b64decode(info["bitmaps"][1]))
+    limit = l_rb.last() + 1
+    rng_bm = RoaringBitmap()
+    rng_bm.add_range(0, limit)
+    expected = l_rb | (rng_bm - r_rb)
+    assert or_not(l_rb, r_rb, limit) == expected
+
+
 def test_previous_value_word_boundaries():
     # TestBitmapContainer.testPreviousValue1:1086-1093
     rb = RoaringBitmap()

@@ -128,8 +128,11 @@ def ingest_dataset(name: str) -> dict:
 
     st["ds"] = ds
     st["ds_compact"] = DeviceBitmapSet(bms, layout="compact")
+    st["ds_counts"] = DeviceBitmapSet(bms, layout="counts")
+    st["ds_counts"].counts.block_until_ready()
     st["hbm_dense_mb"] = ds.hbm_bytes() / 1e6
     st["hbm_compact_mb"] = st["ds_compact"].hbm_bytes() / 1e6
+    st["hbm_counts_mb"] = st["ds_counts"].hbm_bytes() / 1e6
 
     # value column for the index tiers: row ids 0..N-1 valued by the union's
     # member values (a column-index workload over real data)
@@ -211,15 +214,30 @@ def bench_wide(st: dict, cells: dict, reps: int) -> None:
         cells["wide_or/device-pallas-marginal-writeback"] = {
             "us": round(per * 1e6, 2),
             "note": "independent anti-elision mechanism"}
-    # compact layout: per-query on-device densify + reduce
+    # counts layout: resident nibble counts (half of dense), no per-query
+    # scatter — the middle rung of the residency ladder
+    for eng in ("pallas", "xla"):
+        per = _marginal(
+            lambda r, e=eng: (lambda f: (lambda: f(None)))(
+                st["ds_counts"].chained_aggregate("or", r, engine=e)),
+            oracle["wide_or"], WIDE_R)
+        if per is not None:
+            cells[f"wide_or/device-{eng}-marginal-counts"] = {
+                "us": round(per * 1e6, 2),
+                "note": "counts-resident layout (see hbm_counts_mb)"}
+    # compact layout: per-query on-device rebuild.  Honest cost is
+    # scatter-bound (~13 ns/value serialized) — milliseconds at dataset
+    # scale; round 3's 31 us cell was a hoisting artifact.  Short rep pair:
+    # each rep costs ms.
     per = _marginal(
         lambda r: (lambda f: (lambda: f(None)))(
             st["ds_compact"].chained_wide_or(r, engine="pallas")),
-        oracle["wide_or"], WIDE_R)
+        oracle["wide_or"], (5, 105))
     if per is not None:
         cells["wide_or/device-pallas-marginal-compact"] = {
             "us": round(per * 1e6, 2),
-            "note": "compact HBM layout, densify per query"}
+            "note": "compact streams resident; per-query rebuild is "
+                    "scatter-bound (capacity tier)"}
 
 
 def bench_pairwise(st: dict, cells: dict, reps: int) -> None:
@@ -261,14 +279,16 @@ def bench_pairwise(st: dict, cells: dict, reps: int) -> None:
                 cells[f"pairwise_{kind}/{eng_name}-marginal"] = {
                     "us": round(per * 1e6, 2),
                     "note": f"{len(pairs)} pairs per op"}
-        # resident pair batch, compact HBM layout: densify-per-query cost
+        # resident pair batch, compact HBM layout: per-query rebuild is
+        # scatter-bound (ms at dataset scale) — short rep pair
         per = _marginal(
             lambda r, kind=kind: ps_compact.chained_cardinality(kind, r),
-            total, PAIR_R)
+            total, (5, 105))
         if per is not None:
             cells[f"pairwise_{kind}/device-resident-compact-marginal"] = {
                 "us": round(per * 1e6, 2),
-                "note": "compact HBM layout, densify per query"}
+                "note": "compact streams resident; rebuild per query "
+                        "(capacity tier)"}
 
 
 def bench_micro(st: dict, cells: dict, reps: int) -> None:
@@ -498,6 +518,7 @@ def main() -> None:
             "n_bitmaps": len(st["bms"]),
             "serialized_mb": round(st["serialized_mb"], 2),
             "hbm_dense_mb": round(st["hbm_dense_mb"], 2),
+            "hbm_counts_mb": round(st["hbm_counts_mb"], 2),
             "hbm_compact_mb": round(st["hbm_compact_mb"], 2),
             "hbm_compact_vs_serialized": round(
                 st["hbm_compact_mb"] / st["serialized_mb"], 2),

@@ -203,6 +203,73 @@ def nibble_counts_impl(values, val_counts, val_dest, n_groups: int,
     return flat.reshape(n_groups + 1, NIBBLE_WORDS)
 
 
+def spread_bits_to_nibbles(words: jnp.ndarray) -> jnp.ndarray:
+    """u32[..., 2048] bit image -> u32[..., 4, 2048] plane-major nibble
+    counts (each set bit becomes count 1; the exact inverse of the fused
+    kernel's SWAR compress).  Used to fold dense-wire rows into a resident
+    counts tensor at build time."""
+    planes = []
+    for j in range(4):
+        b = (words >> (8 * j)) & jnp.uint32(0xFF)
+        s = (b | (b << 12)) & jnp.uint32(0x000F000F)
+        s = (s | (s << 6)) & jnp.uint32(0x03030303)
+        s = (s | (s << 3)) & jnp.uint32(0x11111111)
+        planes.append(s)
+    return jnp.stack(planes, axis=-2)
+
+
+def counts_tile_to_word(c: jnp.ndarray, op: str) -> jnp.ndarray:
+    """Plane-axis-0 nibble counts u32[4, ...] -> bit words u32[...]
+    (OR: bit = count != 0; XOR: bit = count odd, i.e. the nibble's LSB).
+
+    THE single SWAR conversion, shared by the Pallas kernels (on (4, 16,
+    128) VMEM tiles) and the XLA reference path counts_to_words — one
+    definition so the engines cannot silently diverge.
+    """
+    if op == "or":
+        t = c | (c >> 1)
+        t = t | (t >> 2)
+        m = t & jnp.uint32(0x11111111)
+    else:  # xor
+        m = c & jnp.uint32(0x11111111)
+    # compress the 8 nibble flags (bits 0,4,..,28) into the low byte
+    v = (m | (m >> 3)) & jnp.uint32(0x03030303)
+    w = (v | (v >> 6)) & jnp.uint32(0x000F000F)
+    r = (w | (w >> 12)) & jnp.uint32(0xFF)
+    return r[0] | (r[1] << 8) | (r[2] << 16) | (r[3] << 24)
+
+
+def counts_to_words(counts: jnp.ndarray, op: str) -> jnp.ndarray:
+    """u32[..., 4, 2048] plane-major nibble counts -> u32[..., 2048] words
+    — the XLA-engine path over a counts-resident layout and the parity
+    oracle for the Pallas counts kernels."""
+    return counts_tile_to_word(jnp.moveaxis(counts, -2, 0), op)
+
+
+@functools.partial(jax.jit, static_argnames=("n_groups", "total_values"))
+def build_group_counts(dense_words, dense_dest, values, val_counts, val_dest,
+                       n_groups: int, total_values: int) -> jnp.ndarray:
+    """One-time build of a counts-resident layout: sparse values scatter
+    their nibble counts, dense-wire rows fold in via the bit->nibble
+    spread.  u32[n_groups + 1, NIBBLE_WORDS]; exact (each row contributes
+    at most one occurrence per bit, <= NIBBLE_GROUP rows per group).
+
+    This runs ONCE per set: the value scatter costs milliseconds at ~10^6
+    values (XLA lowers scatter-add to a serial update loop on TPU — the
+    same cost class as the dense layout's one-time densify), which is
+    precisely why the per-query layouts must not re-run it.
+    """
+    counts = nibble_counts_impl(values, val_counts, val_dest, n_groups,
+                                total_values)
+    if dense_words.shape[0]:
+        spread = spread_bits_to_nibbles(dense_words)
+        g = (dense_dest.astype(jnp.int32) >> 3)
+        counts = (counts.reshape(n_groups + 1, 4, WORDS32)
+                  .at[g].add(spread)
+                  .reshape(n_groups + 1, 4 * WORDS32))
+    return counts
+
+
 def dense_partial_impl(op: str, dense_words, dseg, head_idx, head_valid,
                        n_steps: int, num_segments: int) -> jnp.ndarray:
     """Per-segment reduction of the dense-wire rows only:

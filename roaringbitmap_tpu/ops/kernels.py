@@ -151,20 +151,8 @@ def _nibble_reduce_kernel(op_name: str, op):
         i = pl.program_id(0)
         prev = seg_ref[jnp.maximum(i - 1, 0)]
         is_head = jnp.logical_or(i == 0, seg_ref[i] != prev)
-        c = counts_ref[0]  # (4, 16, 128) u32: plane-major nibble counts
-        if op_name == "or":
-            # bit set iff its occurrence count nibble is non-zero
-            t = c | (c >> 1)
-            t = t | (t >> 2)
-            m = t & jnp.uint32(0x11111111)
-        else:  # xor: bit set iff its count is odd = the nibble's LSB
-            m = c & jnp.uint32(0x11111111)
-        # SWAR-compress the 8 nibble flags (bits 0,4,..,28) to the low byte
-        v = (m | (m >> 3)) & jnp.uint32(0x03030303)
-        w = (v | (v >> 6)) & jnp.uint32(0x000F000F)
-        r = (w | (w >> 12)) & jnp.uint32(0xFF)
-        # plane j holds bits [8j, 8j+8) of every output word — elementwise
-        word = r[0] | (r[1] << 8) | (r[2] << 16) | (r[3] << 24)
+        # (4, 16, 128) plane-major nibble counts -> bit words, in-register
+        word = dense.counts_tile_to_word(counts_ref[0], op_name)
 
         @pl.when(is_head)
         def _init():
@@ -213,6 +201,71 @@ def fused_nibble_reduce(op: str, counts: jnp.ndarray,
                                        jnp.uint32),
         interpret=_use_interpret(),
     )(grp_seg, c4, dp3)
+    heads = out[:num_segments].reshape(num_segments, WORDS32)
+    cards = jnp.sum(jax.lax.population_count(heads).astype(jnp.int32), axis=-1)
+    return heads, cards
+
+
+def _counts_reduce_kernel(op_name: str, op, groups: int):
+    def kernel(seg_ref, counts_ref, out_ref):
+        i = pl.program_id(0)
+        prev = seg_ref[jnp.maximum(i - 1, 0)]
+        is_head = jnp.logical_or(i == 0, seg_ref[i] != prev)
+        parts = [dense.counts_tile_to_word(counts_ref[0, gidx], op_name)
+                 for gidx in range(groups)]
+        # static tree-reduce; groups is a power of two (enforced by the
+        # counts-layout block validation)
+        while len(parts) > 1:
+            parts = [op(parts[j], parts[j + 1])
+                     for j in range(0, len(parts), 2)]
+        word = parts[0]
+
+        @pl.when(is_head)
+        def _init():
+            out_ref[0] = word
+
+        @pl.when(jnp.logical_not(is_head))
+        def _accum():
+            out_ref[0] = op(out_ref[0], word)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("op", "num_segments",
+                                             "groups_per_step"))
+def counts_segmented_reduce(op: str, counts: jnp.ndarray,
+                            grp_seg: jnp.ndarray, num_segments: int,
+                            groups_per_step: int = 1
+                            ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Wide OR/XOR straight off a counts-resident layout
+    (ops.dense.build_group_counts): one sequential pass converting nibble
+    counts to bits in-register and accumulating per segment in VMEM —
+    no scatter, no row image, half the HBM reads of the dense layout.
+
+    counts u32[G, NIBBLE_WORDS] with G a groups_per_step multiple (pad
+    groups carry segment id K); grp_seg i32[G] sorted, SMEM-prefetched at
+    super-step granularity.
+    """
+    ops = dense.OPS
+    g_all = counts.shape[0]
+    assert g_all % groups_per_step == 0
+    n_steps = g_all // groups_per_step
+    c4 = counts.reshape(n_steps, groups_per_step, 4, _SUB, _LANE)
+    step_seg = grp_seg.reshape(n_steps, groups_per_step)[:, 0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_steps,),
+        in_specs=[pl.BlockSpec((1, groups_per_step, 4, _SUB, _LANE),
+                               lambda i, seg: (i, 0, 0, 0, 0))],
+        out_specs=pl.BlockSpec((1, _SUB, _LANE), lambda i, seg: (seg[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        _counts_reduce_kernel(op, ops[op], groups_per_step),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_segments + 1, _SUB, _LANE),
+                                       jnp.uint32),
+        interpret=_use_interpret(),
+    )(step_seg, c4)
     heads = out[:num_segments].reshape(num_segments, WORDS32)
     cards = jnp.sum(jax.lax.population_count(heads).astype(jnp.int32), axis=-1)
     return heads, cards

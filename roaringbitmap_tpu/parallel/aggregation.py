@@ -482,27 +482,41 @@ class DeviceBitmapSet:
     the wire layout (ops.packing compact streams) without materializing
     Container objects, and the dense image is built on device.
 
-    layout:
+    layout (three rungs of an HBM-residency / query-cost ladder; measured
+    census1881 wide-OR steady-state marginals on v5e in parentheses):
       - "dense" (default): HBM holds the dense u32[rows, 2048] image —
-        fastest repeated queries (one kernel pass, no per-query densify).
-      - "compact": HBM holds only the compact streams (~serialized size);
-        every query densifies transiently on device before reducing.  Pays
-        roughly one extra zeros+scatter+read pass per query for a 5-30x
-        smaller resident footprint on sparse datasets (SURVEY datasets
-        average 6-600x dense blowup; see insights HBM accounting).
+        fastest repeated queries (~16 us), rows x 8 KB resident.
+      - "counts": HBM holds per-group 4-bit occurrence counts (rows x
+        4 KB, half the dense image) PLUS the compact streams (kept for the
+        AND fallback — so ~0.6x dense on sparse-dominated sets, but it can
+        exceed dense when bitmap containers dominate, since their 8 KB
+        wire rows stay resident alongside their folded counts); OR/XOR
+        queries run one Pallas pass straight off the counts (~2x dense
+        query cost, no scatter), AND falls back to a transient densify.
+      - "compact": HBM holds only the compact streams (~serialized size,
+        5-30x smaller than dense on the SURVEY datasets); every query
+        rebuilds on device.  The rebuild is scatter-bound (XLA lowers
+        scatter-add to a serial update loop on TPU, ~13 ns/value — ~13 ms
+        per query at 10^6 values), so this rung is for capacity-bound
+        sets queried rarely.  (Round 3 reported 31 us here; that was a
+        measurement artifact — the scatter was being hoisted out of the
+        chained loop.)
     """
 
     def __init__(self, bitmaps: list, block: int | None = None,
                  layout: str = "dense"):
-        if layout not in ("dense", "compact"):
+        if layout not in ("dense", "compact", "counts"):
             raise ValueError(f"unknown layout {layout!r}")
-        if (layout == "compact" and block is not None
+        if (layout in ("compact", "counts") and block is not None
                 and (block < dense.NIBBLE_GROUP
-                     or block % dense.NIBBLE_GROUP)):
-            # the fused reduce's count groups (8 rows) must tile the block
+                     or block % dense.NIBBLE_GROUP
+                     or (block // dense.NIBBLE_GROUP)
+                     & (block // dense.NIBBLE_GROUP - 1))):
+            # the nibble count groups (8 rows) must tile the block, and the
+            # kernels' static tree-reduce needs a power-of-two group count
             raise ValueError(
-                f"compact layout requires block to be a multiple of "
-                f"{dense.NIBBLE_GROUP}, got {block}")
+                f"{layout} layout requires block = {dense.NIBBLE_GROUP} * "
+                f"2^k, got {block}")
         self.n = len(bitmaps)
         self.layout = layout
         # Blocked layout serves BOTH engines: segment-padded zero rows are
@@ -514,18 +528,21 @@ class DeviceBitmapSet:
         self.block = self._packed.block
         self.keys = self._packed.keys
         s = self._packed.streams
-        if layout == "compact":
+        if layout in ("compact", "counts"):
             s = self._sort_dense_stream(s)
             self._compact_meta(s)
         self._streams = tuple(jax.device_put(a) for a in (
             s.dense_words, s.dense_dest, s.values, s.val_counts, s.val_dest))
         self._n_rows, self._total_values = s.n_rows, s.total_values
+        self.counts = None
         if layout == "dense":
             self.words = dense.densify_streams(
                 *self._streams, self._n_rows, self._total_values)
             self._streams = None  # free the stream copies
         else:
             self.words = None
+            if layout == "counts":
+                self._build_counts()
         self.blk_seg = jax.device_put(self._packed.blk_seg)
         seg_rows, head_idx, self.n_steps = packing.blocked_ragged_meta(
             self._packed.blk_seg, self.block, self._packed.n_blocks,
@@ -555,6 +572,7 @@ class DeviceBitmapSet:
         grp_seg[:n_groups] = np.repeat(
             self._packed.blk_seg, self.block // dense.NIBBLE_GROUP)
         self._n_groups = n_groups
+        self._grp_seg_np = grp_seg
         self._grp_seg = jax.device_put(grp_seg)
 
         blk_seg = self._packed.blk_seg
@@ -578,6 +596,46 @@ class DeviceBitmapSet:
         dseg_c = np.concatenate(([np.int32(0)], dseg))
         self._dmeta_carry = head_maps(dseg_c)
         self._dseg_carry = jax.device_put(dseg_c)
+
+    def _build_counts(self) -> None:
+        """One-time build of the counts-resident layout: scatter sparse
+        values + fold dense-wire rows (ops.dense.build_group_counts), then
+        pad the group axis so groups_per_step super-steps never split
+        (padding groups are zero counts under segment id K)."""
+        k = self.keys.size
+        gps = self.block // dense.NIBBLE_GROUP
+        self._gps = gps
+        counts = dense.build_group_counts(
+            *self._streams, self._n_groups, self._total_values)
+        g_all = self._n_groups + 1
+        pad = (-g_all) % gps
+        if pad:
+            counts = jnp.pad(counts, ((0, pad), (0, 0)))
+        self.counts = counts
+        grp_seg = np.full(g_all + pad, k, dtype=np.int32)
+        grp_seg[:self._n_groups] = self._grp_seg_np[:self._n_groups]
+        self._grp_seg_counts = jax.device_put(grp_seg)
+        # group-level ragged metadata for the XLA reference path
+        head_g = np.searchsorted(grp_seg[:self._n_groups],
+                                 np.arange(k)).astype(np.int32)
+        sizes_g = np.diff(np.append(head_g, self._n_groups))
+        self._counts_head = jax.device_put(head_g)
+        self._counts_steps = dense.n_steps_for(int(sizes_g.max()) if k else 0)
+
+    def _counts_reduce(self, op: str, counts, eng: str):
+        """Wide OR/XOR over a (possibly barrier-passed) counts tensor."""
+        k = self.keys.size
+        if eng == "pallas":
+            return kernels.counts_segmented_reduce(
+                op, counts, self._grp_seg_counts, k, self._gps)
+        # XLA reference: counts -> per-group words, then group-level
+        # segmented reduce (the parity cross-check engine)
+        g = counts.shape[0]
+        words_g = dense.counts_to_words(
+            counts.reshape(g, 4, packing.WORDS32), op)
+        return dense.segmented_reduce(
+            op, words_g, self._grp_seg_counts, self._counts_head,
+            self._counts_steps)
 
     def _fused_compact(self, op: str, streams, carry=None):
         """One fused compact-layout wide OR/XOR: nibble-count scatter +
@@ -633,6 +691,10 @@ class DeviceBitmapSet:
             return self._and_device()
         if op not in ("or", "xor"):
             raise ValueError(f"unsupported wide op {op!r}")
+        if self.counts is not None:
+            # counts layout: one pass off the resident counts, no scatter
+            return self._counts_reduce(op, self.counts,
+                                       self._select_engine(engine))
         if self.words is None and self._select_engine(engine) == "pallas":
             # compact layout + pallas: the fused path never materializes
             # the row image (half the scatter traffic, no reduce re-read)
@@ -684,7 +746,11 @@ class DeviceBitmapSet:
         meta += sum(int(a.nbytes) for a in (
             self._grp_seg, self._dseg, self._dseg_carry,
             *self._dmeta[:2], *self._dmeta_carry[:2]))
-        return sum(int(a.nbytes) for a in self._streams) + meta
+        total = sum(int(a.nbytes) for a in self._streams) + meta
+        if self.counts is not None:
+            total += int(self.counts.nbytes + self._grp_seg_counts.nbytes
+                         + self._counts_head.nbytes)
+        return total
 
     def chained_wide_or(self, reps: int, engine: str = "auto"):
         """Steady-state throughput probe: `reps` dependent wide-ORs in ONE jit.
@@ -726,6 +792,11 @@ class DeviceBitmapSet:
                     0, reps, body, (words, jnp.uint32(0)))[1]
 
             return jax.jit(run)
+
+        if self.counts is not None:
+            # counts layout: barrier-chained (the OR write-back would make
+            # counts grow across iterations — counts are not idempotent)
+            return self.chained_aggregate("or", reps, engine)
 
         # compact layout: densify EVERY iteration (that IS the query cost),
         # with the carry row threaded through the dense stream
@@ -787,6 +858,21 @@ class DeviceBitmapSet:
                     0, reps, body, (words, jnp.uint32(0)))[1]
 
             return jax.jit(run)
+
+        if self.counts is not None and op in ("or", "xor"):
+            # counts layout: one kernel pass off the barriered counts per
+            # iteration — no scatter in the loop
+            def run_counts(counts):
+                def body_counts(i, total):
+                    c, _ = jax.lax.optimization_barrier((counts, total))
+                    _, cards = self._counts_reduce(op, c, eng)
+                    return total + jnp.sum(cards.astype(jnp.uint32))
+
+                return jax.lax.fori_loop(0, reps, body_counts,
+                                         jnp.uint32(0))
+
+            f = jax.jit(run_counts)
+            return lambda _words_unused=None: f(self.counts)
 
         # compact layout: barrier the streams instead and rebuild from them
         # inside the loop — that per-iteration rebuild IS the query cost.

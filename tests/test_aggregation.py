@@ -200,6 +200,61 @@ def test_chained_aggregate_parity_all_ops_layouts(rng):
         assert got_wb == (reps * want["or"]) % 2**32, layout
 
 
+def test_counts_layout_parity():
+    """The counts-resident layout (nibble counts built once, queries run
+    straight off them) must match host and the other layouts for or/xor on
+    both engines, fall back correctly for and, and hold half the dense
+    image's HBM."""
+    from roaringbitmap_tpu.parallel import fast_aggregation
+
+    rng = np.random.default_rng(11)
+    bms = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 19, 5000).astype(np.uint32)) for _ in range(10)]
+    common = np.arange(50, 800, dtype=np.uint32)
+    bms = [b | RoaringBitmap.from_values(common) for b in bms]
+    # a dense chunk so build_group_counts' bit->nibble spread is exercised
+    bms[0] = bms[0] | RoaringBitmap.from_values(
+        np.arange(1 << 16, (1 << 16) + 30000, dtype=np.uint32))
+    want = {op: fn(*bms) for op, fn in
+            (("or", fast_aggregation.or_), ("xor", fast_aggregation.xor),
+             ("and", fast_aggregation.and_))}
+    ds = DeviceBitmapSet(bms, layout="counts")
+    dense_ds = DeviceBitmapSet(bms, layout="dense")
+    # sparse-dominated workload: counts + streams stays under the dense
+    # image (bitmap-heavy sets can exceed it — see the layout docstring)
+    assert ds.hbm_bytes() < dense_ds.hbm_bytes()
+    with pytest.raises(ValueError):
+        DeviceBitmapSet(bms, block=24, layout="counts")  # gps=3 not 2^k
+    for op in ("or", "xor"):
+        for eng in ("pallas", "xla"):
+            assert ds.aggregate(op, engine=eng) == want[op], (op, eng)
+    assert ds.aggregate("and") == want["and"]
+    reps = 3
+    for op in ("or", "xor"):
+        got = int(np.asarray(ds.chained_aggregate(op, reps,
+                                                  engine="pallas")(None)))
+        assert got == (reps * want[op].cardinality) % 2**32, op
+    got = int(np.asarray(ds.chained_wide_or(reps)(None)))
+    assert got == (reps * want["or"].cardinality) % 2**32
+
+
+def test_counts_layout_block16():
+    """block=16 -> two groups per kernel super-step; super-steps must not
+    split segments and parity must hold."""
+    from roaringbitmap_tpu.parallel import fast_aggregation
+
+    rng = np.random.default_rng(13)
+    # 24 bitmaps sharing every key -> median segment 24 -> block 16
+    bms = [RoaringBitmap.from_values(np.concatenate(
+        [c * (1 << 16) + rng.integers(0, 1 << 14, 800) for c in range(3)]
+        ).astype(np.uint32)) for _ in range(24)]
+    ds = DeviceBitmapSet(bms, layout="counts")
+    assert ds.block == 16 and ds._gps == 2
+    for op, fn in (("or", fast_aggregation.or_),
+                   ("xor", fast_aggregation.xor)):
+        assert ds.aggregate(op, engine="pallas") == fn(*bms), op
+
+
 def test_fused_compact_nibble_count_saturation():
     """The fused compact reduce (ops.kernels.fused_nibble_reduce) encodes
     per-bit occurrence COUNTS in nibbles, exact only while a count group

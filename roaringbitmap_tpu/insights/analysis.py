@@ -135,15 +135,21 @@ def hbm_footprint_bytes(rb: RoaringBitmap) -> int:
 def recommend_device_layout(bitmaps, hbm_budget_bytes: int = 512 << 20) -> dict:
     """Advise DeviceBitmapSet layout from dense blowup AND absolute HBM.
 
-    The dense HBM image costs 8 KB/container; the compact layout costs
-    ~serialized size plus a per-query on-device densify (measured ~1.2-1.4x
-    the dense query marginal, benchmarks/realdata_r03.json
-    wide_or/device-pallas-marginal-compact).  Dense stays the default while
-    it is affordable — a census-like 6x blowup over 2 MB serialized is 12 MB
-    of HBM, trivially worth the fastest query path.  Compact wins when the
-    blowup is extreme (uscensus2000: ~1300x — paying 39 MB to hold 30 KB of
-    data) or the dense image would crowd the budget shared with other
-    resident sets.
+    The residency ladder, with measured census1881 wide-OR marginals
+    (v5e, benchmarks/realdata_r04.json):
+      dense    8 KB/container — fastest queries (~16 us)
+      counts   ~4 KB/container of nibble counts + the compact streams —
+               ~1.7x the dense query cost, no per-query scatter
+      compact  ~serialized size only — but every query re-scatters the
+               value stream, which XLA serializes (~13 ns/value): ms-scale
+               queries at dataset size.  A capacity tier for sets queried
+               rarely, not a fast path (round 3's us-scale figure for this
+               rung was a measurement artifact).
+    The decision is a pure budget ladder — with compact queries at ms
+    scale, nothing short of a budget overflow justifies leaving the fast
+    rungs, and the dense blowup is reported as context, not used as a
+    trigger (the old >= 32x rule dated from when the compact rung was
+    believed to cost 1.2-1.4x per query).
     """
     dense_b = 0
     ser_b = 0
@@ -151,16 +157,24 @@ def recommend_device_layout(bitmaps, hbm_budget_bytes: int = 512 << 20) -> dict:
         dense_b += hbm_footprint_bytes(b)
         ser_b += b.serialized_size_in_bytes()
     ratio = dense_b / ser_b if ser_b else 1.0
-    layout = ("compact" if ratio >= 32.0 or dense_b > hbm_budget_bytes
-              else "dense")
+    counts_b = dense_b // 2 + ser_b  # counts tensor + resident streams
+    if dense_b <= hbm_budget_bytes:
+        layout = "dense"
+        why = "dense image fits the budget — fastest repeated queries"
+    elif counts_b < dense_b and counts_b <= hbm_budget_bytes:
+        layout = "counts"
+        why = ("dense image exceeds the budget; counts-resident layout "
+               "holds ~60% of it for ~1.7x the query marginal")
+    else:
+        layout = "compact"
+        why = ("neither dense nor counts fits the budget: keep only the "
+               "streams (~serialized size); queries rebuild on device at "
+               "ms scale — treat as a capacity tier")
     return {
         "layout": layout,
         "dense_hbm_bytes": dense_b,
+        "counts_hbm_bytes": counts_b,
         "serialized_bytes": ser_b,
         "dense_blowup": round(ratio, 2),
-        "why": ("dense image affordable (blowup < 32x, within budget) — "
-                "fastest repeated queries" if layout == "dense" else
-                "extreme blowup or budget pressure: compact streams cost "
-                "~serialized size in HBM for a ~1.2-1.4x query-marginal "
-                "penalty"),
+        "why": why,
     }

@@ -12,9 +12,25 @@ def test_recommend_device_layout():
         np.arange(0, 60000, 2, dtype=np.uint32)) for _ in range(4)]
     rec = recommend_device_layout(dense_set)
     assert rec["layout"] == "dense" and rec["dense_blowup"] < 4
+    # extreme blowup alone no longer forces compact — 245 KB of dense rows
+    # trivially fits the default budget and queries ~1000x faster
     sparse_set = [RoaringBitmap.bitmap_of(i << 16) for i in range(30)]  # 8 KB rows for 1-bit containers
     rec2 = recommend_device_layout(sparse_set)
-    assert rec2["layout"] == "compact" and rec2["dense_blowup"] >= 32
-    # budget pressure flips dense sets to compact too
+    assert rec2["layout"] == "dense" and rec2["dense_blowup"] >= 32
+    # budget overflow walks the ladder down to compact
     rec3 = recommend_device_layout(dense_set, hbm_budget_bytes=16 << 10)
     assert rec3["layout"] == "compact"
+    # bitmap-heavy set where counts cannot help (counts_b > dense_b): a
+    # budget between dense and counts must NOT skip to compact
+    rec3b = recommend_device_layout(
+        dense_set, hbm_budget_bytes=rec["dense_hbm_bytes"])
+    assert rec3b["layout"] == "dense"
+    # array-container set (serialized << dense, blowup < 32): a budget
+    # between the counts and dense footprints picks the middle rung
+    arr_set = [RoaringBitmap.from_values(
+        np.arange(0, 60000, 64, dtype=np.uint32)) for _ in range(4)]
+    rec4 = recommend_device_layout(arr_set)
+    assert rec4["counts_hbm_bytes"] < rec4["dense_hbm_bytes"]
+    budget = (rec4["counts_hbm_bytes"] + rec4["dense_hbm_bytes"]) // 2
+    rec5 = recommend_device_layout(arr_set, hbm_budget_bytes=budget)
+    assert rec5["layout"] == "counts"

@@ -317,3 +317,121 @@ def test_previous_value_word_boundaries():
     assert rb.previous_value(64) == 64
     assert rb.previous_value(128) == 128
     assert rb.previous_value(200) == 128
+
+
+# --------------------------------------------- numbered issue regressions
+# A targeted pass over TestRoaringBitmap.java's numbered-issue regressions.
+
+def test_ornot_regressions():
+    # TestRoaringBitmap.orNotRegressionTest:2376-2385 (must not throw) and
+    # orNotZeroRangeEndPreservesBitmap:2388-2398
+    from roaringbitmap_tpu.core.bitmap import or_not
+
+    one = RoaringBitmap()
+    other = RoaringBitmap()
+    other.add_range(0, 3)
+    or_not(one, other, 3)  # empty |~ [0,3) over [0,3) — no crash
+
+    one = RoaringBitmap.bitmap_of(32)
+    other = RoaringBitmap()
+    other.add_range(0, 100)
+    assert or_not(one, other, 0) == RoaringBitmap.bitmap_of(32)
+
+
+def test_issue418_offset_roundtrip_high():
+    # TestRoaringBitmap.issue418:5252-5271: offsets that push the single
+    # bit across the 0xFFFF0000 chunk boundary and back
+    rb = RoaringBitmap.bitmap_of(0)
+    for s in (100, 0xFFFF0000, 0xFFFF0001):
+        shifted = rb.add_offset(s)
+        assert shifted.contains(s) and shifted.cardinality == 1
+        back = shifted.add_offset(-s)
+        assert back.contains(0) and back.cardinality == 1
+
+
+def test_issue564_previous_value_before_first():
+    # TestRoaringBitmap.testPreviousValueRegression:5386-5390 (issue 564)
+    assert RoaringBitmap.bitmap_of(27399807).previous_value(403042) == -1
+    assert RoaringBitmap().previous_value(403042) == -1
+
+
+def test_previous_value_absent_target_container():
+    # TestRoaringBitmap.testPreviousValue_AbsentTargetContainer:5393-5401;
+    # Java's int -1 is unsigned 0xFFFFFFFF here
+    rb = RoaringBitmap.bitmap_of(0xFFFFFFFF, 2, 3, 131072)
+    assert rb.previous_value(65536) == 3
+    assert rb.previous_value(0x7FFFFFFF) == 131072
+    assert rb.previous_value((1 << 32) - 131072) == 131072
+    assert RoaringBitmap.bitmap_of(131072).previous_value(65536) == -1
+    # testPreviousValue_LastReturnedAsUnsignedLong:5404-5408
+    vals = [(1 << 32) - 650002, (1 << 32) - 650001, (1 << 32) - 650000]
+    rb2 = RoaringBitmap.bitmap_of(*vals)
+    assert rb2.previous_value(0xFFFFFFFF) == (1 << 32) - 650000
+
+
+def test_issue285_range_cardinality_at_boundary():
+    # TestRoaringBitmap.testRangeCardinalityAtBoundary:5410-5416
+    rb = RoaringBitmap.bitmap_of(66236)
+    assert rb.range_cardinality(60000, 70000) == 1
+    # testNextValueArray:5418-5423
+    rb2 = RoaringBitmap.bitmap_of(0, 1, 2, 4, 6)
+    assert rb2.next_value(7) == -1
+
+
+def test_issue370_equals_after_run_optimize():
+    # TestRoaringBitmap.regressionTestEquals370:5425-5439: equality must
+    # hold across container-kind differences, and run_optimize must not
+    # make two genuinely different bitmaps compare equal
+    a = [239, 240, 241, 242, 243, 244, 259, 260, 261, 262, 263, 264, 265,
+         266, 267, 268, 269, 270, 273, 274, 275, 276, 277, 278, 398, 399,
+         400, 401, 402, 403, 404, 405, 406, 408, 409, 410, 411, 412, 413,
+         420, 421, 422, 509, 510, 511, 512, 513, 514, 539, 540, 541, 542,
+         543, 544, 547, 548, 549, 550, 551, 552, 553, 554, 555, 556, 557,
+         558, 578, 579, 580, 581, 582, 583, 584, 585, 586, 587, 588, 589,
+         590, 591, 592, 593, 594, 595, 624, 625, 634, 635, 636, 649, 650,
+         651, 652, 653, 654, 714, 715, 716, 718, 719, 720, 721, 722, 723,
+         724, 725, 726, 728, 729, 730, 731, 732, 733, 734, 735, 736, 739,
+         740, 741, 742, 743, 744, 771, 772, 773]
+    b = list(a)
+    b[74:79] = [586, 607, 608, 634, 635]  # diverge, same lengths region
+    rb_a = RoaringBitmap.from_values(np.array(a, np.uint32))
+    rb_b = RoaringBitmap.from_values(np.array(sorted(set(b)), np.uint32))
+    assert rb_a != rb_b
+    rb_a.run_optimize()
+    assert rb_a != rb_b
+    rb_b.run_optimize()
+    assert rb_a != rb_b
+    # and the positive direction: kinds differ, contents equal
+    rb_c = RoaringBitmap.from_values(np.array(a, np.uint32))
+    assert rb_a == rb_c
+
+
+def test_issue377_remove_range_after_point_removes():
+    # TestRoaringBitmap.regressionTestRemove377:5441-5453
+    rb = RoaringBitmap()
+    rb.add_range(0, 64)
+    for i in range(64):
+        if i not in (30, 32):
+            rb.remove(i)
+    rb.remove_range(0, 31)
+    assert not rb.contains(30)
+    assert rb.contains(32)
+
+
+def test_issue623_contains_range_at_chunk_boundary():
+    # TestRoaringBitmap.issue623:5539-5552 (boundary essence; the 10^7
+    # loop is compressed to ranges crossing the 65536 boundary)
+    rb = RoaringBitmap.bitmap_of(65535, 65536)
+    assert rb.contains(65535) and rb.contains(65536)
+    assert rb.contains_range(65535, 65536)
+    assert rb.contains_range(65535, 65537)
+    rb.add_range(1, 200000)
+    for i in (1, 65535, 65536, 131071, 131072, 199999):
+        assert rb.contains_range(i, i + 1), i
+
+
+def test_issue1235_single_flip():
+    # TestRoaringBitmap.test1235:5554-5559
+    rb = RoaringBitmap.bitmap_of(1, 2, 3, 5)
+    rb.flip_range(4, 5)
+    assert rb == RoaringBitmap.bitmap_of(1, 2, 3, 4, 5)

@@ -27,6 +27,19 @@ print("wide AND cardinality:", aggregation.and_cardinality(bitmaps))
 
 # HBM-resident set: pack once, query many times
 ds = aggregation.DeviceBitmapSet(bitmaps)
-print("HBM resident:", round(ds.hbm_bytes() / 1e6, 1), "MB")
+print("HBM resident (dense):", round(ds.hbm_bytes() / 1e6, 1), "MB")
 assert ds.aggregate("or") == union
 print("resident aggregate matches one-shot: OK")
+
+# the counts-resident rung: ~60% of the dense HBM, OR/XOR straight off
+# 4-bit occurrence counts (no per-query scatter)
+dsc = aggregation.DeviceBitmapSet(bitmaps, layout="counts")
+print("HBM resident (counts):", round(dsc.hbm_bytes() / 1e6, 1), "MB")
+assert dsc.aggregate("or") == union
+print("counts-layout aggregate matches: OK")
+
+# let the advisor pick for a given HBM budget
+from roaringbitmap_tpu.insights.analysis import recommend_device_layout
+
+rec = recommend_device_layout(bitmaps, hbm_budget_bytes=8 << 20)
+print("advisor @8MB budget:", rec["layout"], "—", rec["why"])

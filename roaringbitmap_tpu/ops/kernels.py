@@ -269,99 +269,3 @@ def counts_segmented_reduce(op: str, counts: jnp.ndarray,
     heads = out[:num_segments].reshape(num_segments, WORDS32)
     cards = jnp.sum(jax.lax.population_count(heads).astype(jnp.int32), axis=-1)
     return heads, cards
-
-
-def _pairwise_popcount_kernel(op, emit_words: bool):
-    if emit_words:
-        def kernel(a_ref, b_ref, out_ref, card_ref):
-            r = op(a_ref[...], b_ref[...])
-            out_ref[...] = r
-            # per-lane partial popcounts (block_k, 128): the sublane
-            # reduction happens here on the VPU; a (block_k, 1) output block
-            # would violate Mosaic's lane-dimension layout floor, so the
-            # final 128-lane sum is left to XLA (it is K*128 i32 — trivial)
-            card_ref[...] = jnp.sum(
-                jax.lax.population_count(r).astype(jnp.int32), axis=1)
-    else:
-        # cardinality-only: no word store, so HBM traffic is read-read like
-        # the XLA fusion the round-3 comparison measured against (which
-        # dead-code-eliminates the unused words output; this kernel can't)
-        def kernel(a_ref, b_ref, card_ref):
-            r = op(a_ref[...], b_ref[...])
-            card_ref[...] = jnp.sum(
-                jax.lax.population_count(r).astype(jnp.int32), axis=1)
-
-    return kernel
-
-
-@functools.partial(jax.jit, static_argnames=("op", "block_k"))
-def pairwise_popcount_pallas(op: str, a: jnp.ndarray, b: jnp.ndarray,
-                             block_k: int = 8) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Fused batched pairwise op + cardinality: u32[K,2048] x2 -> (u32[K,2048], i32[K]).
-
-    One HBM pass instead of XLA's op-then-reduce two; the popcount rides the
-    VPU while the result block is still in VMEM (BitmapContainer.or's
-    branchless fused cardinality, BitmapContainer.java:1064-1085, done wide).
-    """
-    ops = dense.OPS
-    k = a.shape[0]
-    kp = -(-k // block_k) * block_k
-    if kp != k:
-        pad = ((0, kp - k), (0, 0))
-        a = jnp.pad(a, pad)
-        b = jnp.pad(b, pad)
-    a3 = a.reshape(kp, _SUB, _LANE)
-    b3 = b.reshape(kp, _SUB, _LANE)
-    grid = (kp // block_k,)
-    out, cards = pl.pallas_call(
-        _pairwise_popcount_kernel(ops[op], emit_words=True),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
-            pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
-            pl.BlockSpec((block_k, _LANE), lambda i: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((kp, _SUB, _LANE), jnp.uint32),
-            jax.ShapeDtypeStruct((kp, _LANE), jnp.int32),
-        ],
-        interpret=_use_interpret(),
-    )(a3, b3)
-    return out[:k].reshape(k, WORDS32), jnp.sum(cards[:k], axis=-1)
-
-
-@functools.partial(jax.jit, static_argnames=("op", "block_k"))
-def pairwise_cards_pallas(op: str, a: jnp.ndarray, b: jnp.ndarray,
-                          block_k: int = 8) -> jnp.ndarray:
-    """Cardinality-only batched pairwise op: u32[K,2048] x2 -> i32[K].
-
-    The andCardinality/orCardinality fast-path kernel: no word store, so
-    HBM traffic is two reads — structurally the same as the XLA
-    op+popcount fusion that the full kernel was (unfairly) measured
-    against in round 3, where XLA dead-code-eliminated the unused words
-    output while the Pallas kernel was forced to write it.
-    """
-    ops = dense.OPS
-    k = a.shape[0]
-    kp = -(-k // block_k) * block_k
-    if kp != k:
-        pad = ((0, kp - k), (0, 0))
-        a = jnp.pad(a, pad)
-        b = jnp.pad(b, pad)
-    a3 = a.reshape(kp, _SUB, _LANE)
-    b3 = b.reshape(kp, _SUB, _LANE)
-    cards = pl.pallas_call(
-        _pairwise_popcount_kernel(ops[op], emit_words=False),
-        grid=(kp // block_k,),
-        in_specs=[
-            pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
-            pl.BlockSpec((block_k, _SUB, _LANE), lambda i: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_k, _LANE), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((kp, _LANE), jnp.int32),
-        interpret=_use_interpret(),
-    )(a3, b3)
-    return jnp.sum(cards[:k], axis=-1)

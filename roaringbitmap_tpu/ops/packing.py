@@ -498,8 +498,8 @@ def key_presence_masks(bitmaps: list[RoaringBitmap]) -> np.ndarray:
 @dataclass
 class PackedPairwiseCompact:
     """P bitmap pairs aligned on per-pair key unions, as compact transfer
-    streams for the batched pairwise kernels (ops.kernels.
-    pairwise_popcount_pallas / ops.dense.pairwise).  Zero rows are the
+    streams for the batched pairwise kernel (ops.dense.pairwise — XLA's
+    multi-output fusion, the single pairwise engine).  Zero rows are the
     identity for or/xor/andnot and annihilate correctly for and, so one
     union alignment serves all ops.
 

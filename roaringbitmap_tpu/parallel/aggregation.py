@@ -15,10 +15,11 @@ exact cardinalities.  Strategy map from the reference:
 - repairAfterLazy (Container.java:869-873) -> fused popcount on the way out.
 
 Engine selection: "pallas" (fused single-pass kernel) on TPU, "xla" (doubling
-reduce) anywhere; "auto" picks by backend for the WIDE ops.  The pairwise
-paths resolve "auto" to XLA everywhere (its fused op+popcount already does
-one HBM pass and measures faster — see _pairwise_engine).  Both engines are
-tested for bit-equality on every path.
+reduce) anywhere; "auto" picks by backend for the WIDE ops.  Both engines are
+tested for bit-equality on every wide path.  Pairwise runs on XLA's fused
+op+popcount only — it out-measured every Pallas pairwise variant on every
+dataset (realdata_r04), so those kernels were deleted; pairwise `engine`
+kwargs are accepted for API stability and ignored.
 """
 
 from __future__ import annotations
@@ -211,14 +212,16 @@ def _flatten(bitmaps) -> list[RoaringBitmap]:
 
 
 # ---------------------------------------------------------- batched pairwise
-
-def _pairwise_engine(engine: str) -> str:
-    """Pairwise "auto" resolves to XLA even on TPU: the op+popcount fusion
-    XLA emits is already a single HBM pass, and it measures faster than the
-    Pallas kernel at every block size (census1881 chained marginals
-    2026-07-30: xla ~83 us vs pallas 108-142 us across block_k 8-64).
-    "pallas" stays selectable for comparison."""
-    return "xla" if engine == "auto" else engine
+#
+# Pairwise runs on ONE engine: XLA's op+popcount fusion.  The round-3/4
+# question of a dedicated Pallas pairwise kernel is settled by measurement
+# (benchmarks/realdata_r04.json pairwise_* marginals): XLA wins on every
+# dataset, in both the words-emitting mode (multi-output fusion writes
+# words + partial popcounts in the same pass) and the cardinality-only mode
+# (the unused words output is dead-code-eliminated; a dedicated cards-only
+# Pallas kernel measured 83-437 us vs XLA's 56-107 us).  The kernels were
+# deleted per the verdict rule: no engine in the tree may lose on every
+# measured shape.  The `engine` kwarg is kept for API stability and ignored.
 
 
 def _densify_side(streams: packing.CompactStreams, n_rows: int):
@@ -230,31 +233,6 @@ def _densify_side(streams: packing.CompactStreams, n_rows: int):
         jnp.asarray(s.dense_words), jnp.asarray(s.dense_dest),
         jnp.asarray(s.values), jnp.asarray(s.val_counts),
         jnp.asarray(s.val_dest), n_rows, s.total_values)
-
-
-def _dispatch_pairwise(op: str, a, b, eng: str):
-    """The single engine-dispatch point for aligned pairwise images.
-    `eng` must be pre-resolved (callers apply _pairwise_engine and the
-    empty-operand guard once)."""
-    if eng == "pallas":
-        return kernels.pairwise_popcount_pallas(op, a, b)
-    return dense.pairwise(op, a, b)
-
-
-def _dispatch_pairwise_cards(op: str, a, b, eng: str):
-    """Cardinality-only dispatch: neither engine stores the result words
-    (XLA dead-code-eliminates the unused output of its fusion; pallas runs
-    the dedicated cards kernel) — the andCardinality/orCardinality fast
-    path's no-materialization property, preserved per engine."""
-    if eng == "pallas":
-        return kernels.pairwise_cards_pallas(op, a, b)
-    return dense.pairwise(op, a, b)[1]
-
-
-def _resolve_pairwise_engine(engine: str, num_rows: int) -> str:
-    """_pairwise_engine plus the empty-operand guard: the pallas kernel
-    cannot tile a zero-row operand — route empty packs to the dense path."""
-    return _pairwise_engine(engine) if num_rows else "xla"
 
 
 def _unpack_pairs(keys: np.ndarray, heads: np.ndarray, words, cards,
@@ -274,15 +252,13 @@ def pairwise_device(op: str, pairs, engine: str = "auto"):
     BitmapContainer.or's branchless fused cardinality :1064-1085) done wide.
     Both operand sides ingest as compact byte streams and densify ON DEVICE
     (ops.dense.densify_streams), so host pack cost is ~serialized size like
-    the wide path: pallas engine = ops.kernels.pairwise_popcount_pallas
-    (single HBM pass), xla engine = ops.dense.pairwise (the default, see
-    _pairwise_engine).
+    the wide path; the op itself is ops.dense.pairwise (XLA's multi-output
+    fusion — the single pairwise engine, see the module docstring).
     """
     packed = packing.pack_pairwise(list(pairs))
     a = _densify_side(packed.a_streams, packed.n_rows)
     b = _densify_side(packed.b_streams, packed.n_rows)
-    words, cards = _dispatch_pairwise(
-        op, a, b, _resolve_pairwise_engine(engine, packed.keys.size))
+    words, cards = dense.pairwise(op, a, b)
     return words, cards, packed
 
 
@@ -360,15 +336,13 @@ class DevicePairSet:
     def pairwise_device(self, op: str, engine: str = "auto"):
         """(u32[M, 2048] result words, i32[M] cards) on device."""
         a, b = self._sides()
-        return _dispatch_pairwise(
-            op, a, b, _resolve_pairwise_engine(engine, self.keys.size))
+        return dense.pairwise(op, a, b)
 
     def cardinalities(self, op: str, engine: str = "auto") -> np.ndarray:
         """i64[P] per-pair result cardinalities (P scalars to host; no
         result words stored on either engine)."""
         a, b = self._sides()
-        cards = _dispatch_pairwise_cards(
-            op, a, b, _resolve_pairwise_engine(engine, self.keys.size))
+        cards = dense.pairwise(op, a, b)[1]
         return _per_pair_cards(cards, self.heads)
 
     def pairwise(self, op: str, engine: str = "auto",
@@ -382,7 +356,6 @@ class DevicePairSet:
         (the chained-marginal methodology).  Returns a jitted fn() -> total
         cardinality over all reps mod 2^32; compact layout densifies every
         iteration (that IS the per-query cost being measured)."""
-        eng = _resolve_pairwise_engine(engine, self.keys.size)
 
         # the resident tensors enter the jitted program as ARGUMENTS, not
         # closed-over constants: jit bakes captured device arrays into the
@@ -392,7 +365,7 @@ class DevicePairSet:
             def run(a, b):
                 def body(i, total):
                     ab, _ = jax.lax.optimization_barrier((a, total))
-                    cards = _dispatch_pairwise_cards(op, ab, b, eng)
+                    cards = dense.pairwise(op, ab, b)[1]
                     return total + jnp.sum(cards.astype(jnp.uint32))
 
                 return jax.lax.fori_loop(0, reps, body, jnp.uint32(0))
@@ -414,7 +387,7 @@ class DevicePairSet:
                 b = dense.densify_streams_impl(
                     bb[0], bb[1].astype(jnp.int32), bb[2], bb[3], bb[4],
                     n_rows, bv)
-                cards = _dispatch_pairwise_cards(op, a, b, eng)
+                cards = dense.pairwise(op, a, b)[1]
                 return total + jnp.sum(cards.astype(jnp.uint32))
 
             return jax.lax.fori_loop(0, reps, body_compact, jnp.uint32(0))
@@ -441,8 +414,7 @@ def pairwise_cardinality(op: str, pairs, engine: str = "auto") -> np.ndarray:
     packed = packing.pack_pairwise(list(pairs))
     a = _densify_side(packed.a_streams, packed.n_rows)
     b = _densify_side(packed.b_streams, packed.n_rows)
-    cards = _dispatch_pairwise_cards(
-        op, a, b, _resolve_pairwise_engine(engine, packed.keys.size))
+    cards = dense.pairwise(op, a, b)[1]
     return _per_pair_cards(cards, packed.heads)
 
 

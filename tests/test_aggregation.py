@@ -138,19 +138,22 @@ def test_device_set_range_cardinality(workload, oracles):
         assert ds.aggregate_range_cardinality("or", start, stop) == want
 
 
-@pytest.mark.parametrize("engine", ["xla", "pallas"])
 @pytest.mark.parametrize("op", ["or", "and", "xor", "andnot"])
-def test_batched_pairwise(workload, op, engine):
+def test_batched_pairwise(workload, op):
+    # single engine by design: pairwise Pallas variants lost to XLA's
+    # fused op+popcount on every measured dataset (realdata_r04) and were
+    # deleted; the engine kwarg is accepted and ignored
     from roaringbitmap_tpu.core.bitmap import and_ as h_and, andnot as h_andnot
     from roaringbitmap_tpu.core.bitmap import or_ as h_or, xor as h_xor
 
     host = {"or": h_or, "and": h_and, "xor": h_xor, "andnot": h_andnot}[op]
     pairs = list(zip(workload[0::2], workload[1::2]))
-    got = aggregation.pairwise(op, pairs, engine=engine)
+    got = aggregation.pairwise(op, pairs)
     want = [host(a, b) for a, b in pairs]
     assert got == want
-    cards = aggregation.pairwise_cardinality(op, pairs, engine=engine)
+    cards = aggregation.pairwise_cardinality(op, pairs)
     assert cards.tolist() == [w.cardinality for w in want]
+    assert aggregation.pairwise(op, pairs, engine="pallas") == want  # ignored
 
 
 def test_batched_pairwise_empty_and_disjoint():
@@ -415,10 +418,12 @@ class TestDevicePairSet:
         assert ps.cardinalities(op).tolist() == [
             w.cardinality for w in want[op]]
 
-    @pytest.mark.parametrize("engine", ["xla", "pallas"])
-    def test_engines_match(self, pairs, want, engine):
+    def test_engine_kwarg_accepted_and_ignored(self, pairs, want):
+        # pairwise runs one engine (see aggregation module docstring);
+        # legacy engine values must still be accepted
         ps = aggregation.DevicePairSet(pairs)
-        assert ps.pairwise("xor", engine=engine) == want["xor"]
+        for engine in ("auto", "xla", "pallas"):
+            assert ps.pairwise("xor", engine=engine) == want["xor"]
 
     @pytest.mark.parametrize("layout", ["dense", "compact"])
     def test_chained_cardinality(self, pairs, want, layout):
@@ -446,27 +451,6 @@ class TestDevicePairSet:
         assert got[0].is_empty() and got[1] == (a | b)
         assert ps.cardinalities("and").tolist() == [0, 0]
         assert ps.hbm_bytes() > 0
-
-
-def test_pairwise_cards_pallas_parity(rng):
-    """The cardinality-only pairwise kernel (no words store) must match the
-    fused XLA op+popcount bit-for-bit at every block size."""
-    from roaringbitmap_tpu.ops import dense as D
-    from roaringbitmap_tpu.ops import kernels
-
-    import jax.numpy as jnp
-
-    k = 21  # deliberately not a block multiple
-    a = jnp.asarray(rng.integers(0, 1 << 32, (k, D.WORDS32), dtype=np.uint64)
-                    .astype(np.uint32))
-    b = jnp.asarray(rng.integers(0, 1 << 32, (k, D.WORDS32), dtype=np.uint64)
-                    .astype(np.uint32))
-    for op in ("and", "or", "xor", "andnot"):
-        want = np.asarray(D.pairwise(op, a, b)[1])
-        for bk in (8, 16):
-            got = np.asarray(kernels.pairwise_cards_pallas(op, a, b,
-                                                           block_k=bk))
-            np.testing.assert_array_equal(got, want, err_msg=f"{op} bk={bk}")
 
 
 def test_contains_batch_rejects_non_integer_probes():

@@ -93,10 +93,9 @@ class TestWideOpsOnChip:
 
 
 class TestPairwiseOnChip:
-    @pytest.mark.parametrize("engine", ["xla", "pallas"])
-    def test_pairwise_parity(self, census, engine):
+    def test_pairwise_parity(self, census):
         pairs = list(zip(census[:-1], census[1:]))[:20]
-        got = aggregation.pairwise("and", pairs, engine=engine)
+        got = aggregation.pairwise("and", pairs)
         want = [a & b for a, b in pairs]
         assert got == want
 

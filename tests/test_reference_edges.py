@@ -435,3 +435,123 @@ def test_issue1235_single_flip():
     rb = RoaringBitmap.bitmap_of(1, 2, 3, 5)
     rb.flip_range(4, 5)
     assert rb == RoaringBitmap.bitmap_of(1, 2, 3, 4, 5)
+
+
+# ---------------------------------------------- 64-bit tier regressions
+# TestRoaring64Bitmap.java's numbered issues, at the Roaring64Bitmap level.
+
+def _rb64(*vals):
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    return Roaring64Bitmap.bitmap_of(*vals)
+
+
+def test_issue537_and_with_absent_member():
+    # TestRoaring64Bitmap.testIssue537:2079-2093: AND against a bitmap
+    # sharing the high-48 key must not resurrect an absent member
+    vals = [275845652, 275845746, 275846148, 275847372, 275847380,
+            275847388, 275847459, 275847528, 275847586, 275847588,
+            275847600, 275847607, 275847610, 275847613, 275847631]
+    a = _rb64(275846320)
+    b = _rb64(275846320)
+    c = _rb64(*vals)
+    c.iand(b)
+    assert not c.contains(275846320)
+    c.iand(a)
+    assert not c.contains(275846320)
+
+
+def test_issue558_add_remove_churn():
+    # TestRoaring64Bitmap.testIssue558:2097-2104: random add/remove churn
+    # over the full signed-long range must not corrupt the key index
+    # (compressed: 20k iterations instead of 1M)
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    rng = np.random.default_rng(1234)
+    rb = Roaring64Bitmap()
+    adds = rng.integers(0, 1 << 64, 20000, dtype=np.uint64)
+    dels = rng.integers(0, 1 << 64, 20000, dtype=np.uint64)
+    expect: set[int] = set()
+    for a, d in zip(adds.tolist(), dels.tolist()):
+        rb.add(a)
+        expect.add(a)
+        rb.remove(d)
+        expect.discard(d)
+    assert rb.cardinality == len(expect)
+    assert set(rb.to_array().tolist()) == expect
+
+
+def test_issue577_for_each_in_range():
+    # TestRoaring64Bitmap.testIssue577Case1/2/3:2107-2161: forEachInRange
+    # over >32-bit values (range start/length in the reference's
+    # (start, length) form -> [start, start+length) here)
+    b1 = _rb64(45011744312, 45008074636, 41842920068, 41829418930,
+               40860008694, 40232297287, 40182908832, 40171852270,
+               39933922233, 39794107638)
+    assert next(b1.reverse_long_iterator()) == 45011744312
+    b1.for_each_in_range(46000000000, 47000000000,
+                         lambda v: pytest.fail(f"no values here: {v}"))
+
+    b2 = _rb64(30385375409, 30399869293, 34362979339, 35541844320,
+               36637965094)
+    seen = []
+    # the reference's [33e9, 34e9) window contains NO member (its consumer
+    # assertion is vacuous); assert that explicitly, then widen to 35e9
+    # where exactly one member falls
+    b2.for_each_in_range(33000000000, 34000000000, seen.append)
+    assert seen == []
+    b2.for_each_in_range(33000000000, 35000000000, seen.append)
+    assert seen == [34362979339]
+
+    b3 = _rb64(14510802367, 26338197481, 32716744974, 32725817880,
+               35679129730)
+    seen = []
+    b3.for_each_in_range(32000000000, 33000000000, seen.append)
+    assert seen == [32716744974, 32725817880]
+
+
+def test_issue580_iterate_sparse_high_keys():
+    # TestRoaring64Bitmap.testIssue580:2166-2178: iteration across seven
+    # distinct high-48 keys
+    vals = [3242766498713841665, 3492544636360507394, 3418218112527884289,
+            3220956490660966402, 3495344165583036418, 3495023214002368514,
+            3485108231289675778]
+    rb = _rb64(*vals)
+    assert sorted(v for v in rb) == sorted(vals)
+    assert rb.cardinality == 7
+
+
+def test_issue619_repeated_andnot():
+    # TestRoaring64Bitmap.testIssue619:2265-2283: repeated add/andNot
+    # cycles must converge, not lose members
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    cleaner_vals = [140664568792144]
+    addr_vals = [140662937752432]
+    address_space = Roaring64Bitmap()
+    cleaner = Roaring64Bitmap.bitmap_of(*cleaner_vals)
+    for iteration in range(34):
+        for v in addr_vals:
+            address_space.add(v)
+        for v in cleaner_vals:
+            address_space.add(v)
+        if iteration == 33:
+            break
+        address_space.iandnot(cleaner)
+    assert address_space.int_cardinality == 2
+
+
+def test_with_yourself_64():
+    # TestRoaring64Bitmap.testWithYourself:2152-2163: self-ops
+    vals = list(range(1, 11))
+    b1 = _rb64(*vals)
+    b1.run_optimize()
+    b1.ior(b1)
+    assert b1 == _rb64(*vals)
+    b1.ixor(b1)
+    assert b1.is_empty()
+    b1 = _rb64(*vals)
+    b1.iand(b1)
+    assert b1 == _rb64(*vals)
+    b1.iandnot(b1)
+    assert b1.is_empty()

@@ -519,6 +519,7 @@ def main() -> None:
             # completed cells to one transient is worse than a retried
             # cell.  AssertionErrors are parity failures, NOT transients —
             # they must fail the run loudly, never become an ERROR cell.
+            before = dict(cells)
             for attempt in (1, 2):
                 try:
                     group_fn[g](st, cells, args.reps)
@@ -530,6 +531,10 @@ def main() -> None:
                           f"failed: {type(e).__name__}: {e}",
                           file=sys.stderr, flush=True)
                     if attempt == 2:
+                        # drop the group's partial cells: a half-measured
+                        # group must not read as clean data
+                        cells.clear()
+                        cells.update(before)
                         cells[f"{g}/ERROR"] = {"note": f"{e}"}
         result["datasets"][name] = {
             "n_bitmaps": len(st["bms"]),

@@ -153,7 +153,6 @@ def test_batched_pairwise(workload, op):
     assert got == want
     cards = aggregation.pairwise_cardinality(op, pairs)
     assert cards.tolist() == [w.cardinality for w in want]
-    assert aggregation.pairwise(op, pairs, engine="pallas") == want  # ignored
 
 
 def test_batched_pairwise_empty_and_disjoint():

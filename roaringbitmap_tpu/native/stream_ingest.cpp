@@ -252,7 +252,8 @@ IngestResult* rb_ingest(const uint8_t* const* bufs, const int64_t* lens,
   std::vector<int64_t> seg_of_key(1 << 16, -1);
   for (int64_t i = 0; i < K; i++) seg_of_key[keys[i]] = i;
 
-  // block selection: median of g (choose_block: >=16 -> 16 else 8)
+  // block selection: median of g (choose_block ladder: >=32 -> 32,
+  // >=16 -> 16, else 8)
   if (block <= 0) {
     if (g.empty()) block = 8;
     else {
@@ -265,7 +266,7 @@ IngestResult* rb_ingest(const uint8_t* const* bufs, const int64_t* lens,
         auto lo_it = std::max_element(tmp.begin(), tmp.begin() + tmp.size() / 2);
         median = 0.5 * ((double)*lo_it + (double)med_hi);
       }
-      block = median >= 16.0 ? 16 : 8;
+      block = median >= 32.0 ? 32 : median >= 16.0 ? 16 : 8;
     }
   }
   R->block = block;

@@ -366,11 +366,17 @@ class PackedBlockedCompact:
 
 def choose_block(seg_sizes: np.ndarray) -> int:
     """Per-set Pallas block size: larger blocks amortize grid-step overhead
-    (measured ~3x faster at 16-32 vs 8 on census1881) but pad every segment
-    to a block multiple, so small segments stay at 8."""
+    (wikileaks-noquotes chained marginal ~2x faster at 32 vs 16; census1881
+    ~3x faster at 16-32 vs 8) but pad every segment to a block multiple, so
+    the ladder climbs only while the median segment keeps padding waste
+    small.  Always a power of two times NIBBLE_GROUP (the blocked kernels
+    tree-reduce statically; the counts/compact layouts tile 8-row groups)."""
     if seg_sizes.size == 0:
         return 8
-    return 16 if float(np.median(seg_sizes)) >= 16 else 8
+    med = float(np.median(seg_sizes))
+    if med >= 32:
+        return 32
+    return 16 if med >= 16 else 8
 
 
 def pack_blocked_compact(sources: list, block: int | None = None,

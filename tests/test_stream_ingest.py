@@ -232,6 +232,25 @@ class TestNativeIngest:
             pytest.skip("native ingest unavailable")
         return native
 
+    def test_block32_ladder_parity(self, lib):
+        """Median segment >= 32 must select block 32 on BOTH the native
+        and NumPy paths (the choose_block ladder is mirrored in
+        stream_ingest.cpp; a divergence silently mismatches layouts
+        between byte and object ingest)."""
+        from roaringbitmap_tpu import RoaringBitmap
+
+        rng = np.random.default_rng(31)
+        bitmaps = [RoaringBitmap.from_values(np.concatenate(
+            [c * (1 << 16) + rng.integers(0, 1 << 14, 400)
+             for c in range(3)]).astype(np.uint32)) for _ in range(40)]
+        blobs = [b.serialize() for b in bitmaps]
+        nat = packing.pack_blocked_compact(blobs)
+        py = packing.pack_blocked_compact(
+            [spec.SerializedView(x) for x in blobs])
+        assert nat.block == py.block == 32
+        assert np.array_equal(nat.blk_seg, py.blk_seg)
+        assert (nat.n_blocks, nat.carry_row) == (py.n_blocks, py.carry_row)
+
     def test_metadata_and_image_parity(self, lib):
         bitmaps = _mixed_bitmaps(seed=21, n=10)
         blobs = [b.serialize() for b in bitmaps]

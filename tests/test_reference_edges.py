@@ -123,6 +123,46 @@ def test_rangebitmap_between_regression():
         assert rbm.between(lo, lo + 1) == (rbm.eq(lo) | rbm.eq(lo + 1))
 
 
+@pytest.mark.parametrize("size", [0xFFFF, 0x10001, 100_000])
+def test_rangebitmap_contiguous_values_multi_chunk(size):
+    # RangeBitmapTest.testInsertContiguousValues:68-93: contiguous column
+    # values crossing the 2^16 row-chunk boundary; every threshold form
+    # checked at decade points
+    app = RangeBitmap.appender(size)
+    app.add_many(np.arange(size, dtype=np.uint64))
+    rbm = app.build()
+    assert rbm.lte(size) == RoaringBitmap.from_range(0, size)
+    upper = 1
+    while upper < size:
+        expected = RoaringBitmap.from_range(0, upper + 1)
+        assert rbm.lte(upper) == expected
+        assert rbm.lte_cardinality(upper) == expected.cardinality
+        assert rbm.lt(upper) == RoaringBitmap.from_range(0, upper)
+        assert rbm.lt_cardinality(upper) == upper
+        assert rbm.eq(upper) == RoaringBitmap.bitmap_of(upper)
+        upper *= 10
+    lower = 1
+    while lower < size:
+        expected = RoaringBitmap.from_range(lower, size)
+        assert rbm.gte(lower) == expected
+        assert rbm.gte_cardinality(lower) == expected.cardinality
+        assert rbm.gt(lower) == RoaringBitmap.from_range(lower + 1, size)
+        lower *= 10
+
+
+def test_rangebitmap_empty_and_zero():
+    # RangeBitmapTest.testLessThanZeroEmpty:120-127 and
+    # testSerializeEmpty:291-300
+    app = RangeBitmap.appender(10)
+    rbm = app.build()
+    assert rbm.lte(5).is_empty() and rbm.row_count == 0
+    assert RangeBitmap.map(rbm.serialize()).lt_cardinality(10) == 0
+    app2 = RangeBitmap.appender(100)
+    app2.add_many(np.arange(50, dtype=np.uint64))
+    rbm2 = app2.build()
+    assert rbm2.lt(0).is_empty()  # lt(0): nothing below the minimum
+
+
 # ------------------------------------------------- 0xFFFF-adjacent run cases
 def test_run_reaching_65535():
     # TestRunContainer.testToString:3172-3176: run [32200,35000) plus the

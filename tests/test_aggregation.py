@@ -240,21 +240,29 @@ def test_counts_layout_parity():
     assert got == (reps * want["or"].cardinality) % 2**32
 
 
-def test_counts_layout_block16():
-    """block=16 -> two groups per kernel super-step; super-steps must not
-    split segments and parity must hold."""
+@pytest.mark.parametrize("n,block,gps", [(24, 16, 2), (40, 32, 4)])
+def test_counts_layout_multi_group_steps(n, block, gps):
+    """block=16/32 -> 2/4 groups per kernel super-step (the adaptive
+    ladder's upper rungs); super-steps must not split segments and parity
+    must hold."""
     from roaringbitmap_tpu.parallel import fast_aggregation
 
     rng = np.random.default_rng(13)
-    # 24 bitmaps sharing every key -> median segment 24 -> block 16
+    # n bitmaps sharing every key -> median segment n -> ladder picks block
     bms = [RoaringBitmap.from_values(np.concatenate(
         [c * (1 << 16) + rng.integers(0, 1 << 14, 800) for c in range(3)]
-        ).astype(np.uint32)) for _ in range(24)]
+        ).astype(np.uint32)) for _ in range(n)]
     ds = DeviceBitmapSet(bms, layout="counts")
-    assert ds.block == 16 and ds._gps == 2
+    assert ds.block == block and ds._gps == gps
+    # dense layout at the same rung: blocked kernel tree-reduces `block`
+    # rows per step
+    ds2 = DeviceBitmapSet(bms, layout="dense")
+    assert ds2.block == block
     for op, fn in (("or", fast_aggregation.or_),
                    ("xor", fast_aggregation.xor)):
-        assert ds.aggregate(op, engine="pallas") == fn(*bms), op
+        want = fn(*bms)
+        assert ds.aggregate(op, engine="pallas") == want, op
+        assert ds2.aggregate(op, engine="pallas") == want, op
 
 
 def test_fused_compact_nibble_count_saturation():

@@ -6,6 +6,9 @@ compression, pairwise 2x2 AND/OR latency, wide-OR latency, contains latency —
 
 Representations benchmarked:
   host    — the NumPy container tier (the JVM-normal analog)
+  buffer  — byte-backed ImmutableRoaringBitmaps, fresh views per rep so
+            the lazy container decode is inside the measurement (the
+            reference's buffer rows)
   device  — HBM-resident wide ops via the aggregation engine (the new tier)
 
 Usage: python benchmarks/simple_benchmark.py [dataset ...] [--reps N]
@@ -82,6 +85,29 @@ def bench_dataset(name: str, reps: int) -> None:
     device_wide_ns = _time(lambda: np.asarray(fn(ds.words)),
                            max(1, reps // 100)) / chain
 
+    # buffer variant (simplebenchmark.java prints normal AND buffer rows):
+    # the same 2x2 ops over byte-backed ImmutableRoaringBitmaps.  Fresh
+    # views are wrapped inside the timed closure: the view caches decoded
+    # containers, so reusing one across reps would time warm heap objects
+    # and hide exactly the lazy-decode cost this row exists to show
+    # (header wrap itself is a few us of the measured work).
+    from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+
+    blobs = [b.serialize() for b in bitmaps]
+
+    def ipair_and():
+        imms = [ImmutableRoaringBitmap(x) for x in blobs]
+        for a, b in zip(imms[:-1], imms[1:]):
+            rb_and(a, b)
+
+    def ipair_or():
+        imms = [ImmutableRoaringBitmap(x) for x in blobs]
+        for a, b in zip(imms[:-1], imms[1:]):
+            rb_or(a, b)
+
+    iand_ns = _time(ipair_and, max(1, reps // 10)) / (len(bitmaps) - 1)
+    ior_ns = _time(ipair_or, max(1, reps // 10)) / (len(bitmaps) - 1)
+
     # contains probes (hit + miss mix)
     rng = np.random.default_rng(7)
     probes = rng.integers(0, universe, 1000).astype(np.uint32)
@@ -93,8 +119,18 @@ def bench_dataset(name: str, reps: int) -> None:
 
     contains_ns = _time(contains_all, max(1, reps // 10)) / probes.size
 
-    print(f"{name:>24} {bits_per_value:10.2f} {and_ns:12.0f} {or_ns:12.0f} "
+    def icontains_all():
+        # fresh view per rep — same reasoning as the pairwise rows
+        probe_imm = ImmutableRoaringBitmap(blobs[len(blobs) // 2])
+        for p in probes:
+            probe_imm.contains(int(p))
+
+    icontains_ns = _time(icontains_all, max(1, reps // 10)) / probes.size
+
+    print(f"{name:>32} {bits_per_value:10.2f} {and_ns:12.0f} {or_ns:12.0f} "
           f"{host_wide_ns:14.0f} {device_wide_ns:14.0f} {contains_ns:10.1f}")
+    print(f"{name + ' (buffer)':>32} {'':>10} {iand_ns:12.0f} {ior_ns:12.0f} "
+          f"{'':>14} {'':>14} {icontains_ns:10.1f}")
 
 
 def main() -> None:
@@ -105,7 +141,7 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=100)
     args = ap.parse_args()
 
-    print(f"{'dataset':>24} {'bits/value':>10} {'2x2 AND ns':>12} "
+    print(f"{'dataset':>32} {'bits/value':>10} {'2x2 AND ns':>12} "
           f"{'2x2 OR ns':>12} {'host wideOR ns':>14} {'dev wideOR ns':>14} "
           f"{'contains ns':>10}")
     print("  (dev wideOR = steady state, 32768 chained reps per dispatch, "

@@ -33,6 +33,16 @@ def _with_kind_at(kinds: list[tuple[str, int]],
     rb = RoaringBitmap.from_values(
         np.unique(np.concatenate(parts)).astype(np.uint32))
     rb.run_optimize()
+    # pin the kinds: if promotion/run_optimize heuristics drift, the
+    # matrix must fail loudly rather than silently stop covering kinds
+    from roaringbitmap_tpu.core import containers as C
+
+    expected = {"bitmap": C.BitmapContainer, "array": C.ArrayContainer,
+                "run": C.RunContainer}
+    key_to_kind = {key: kind for kind, key in kinds}
+    for k, cont in zip(rb.keys, rb.containers):
+        want = expected[key_to_kind[int(k)]]
+        assert isinstance(cont, want), (int(k), type(cont), want)
     return rb
 
 

@@ -300,6 +300,38 @@ def test_run_intersects_range_boundary():
     assert rb.intersects_range(57, 216)
 
 
+@pytest.mark.parametrize("base_vals,flip", [
+    # TestRunContainer.inot1:952: empty flip range is the identity
+    ([0, 2, 55, 64, 256], (64, 64)),
+    # inot2/inot3-style: flip overlapping the value set's edges
+    ([0, 2, 55, 64, 256], (64, 65)),
+    ([0, 2, 55, 64, 256], (0, 65)),
+    ([0, 2, 55, 64, 256], (2, 257)),
+    # inot7-style: a solid run [500,505) flipped across its middle/ends
+    ([500, 501, 502, 503, 504], (502, 505)),
+    ([500, 501, 502, 503, 504], (498, 507)),
+    ([500, 501, 502, 503, 504], (500, 505)),
+    # inot14/inot15-style: flips touching the chunk top
+    ([65530, 65533, 65535], (65529, 65536)),
+    ([65530, 65533, 65535], (65535, 65536)),
+    # cross-chunk flip over values in two chunks
+    ([65535, 65536, 70000], (65000, 70001)),
+])
+def test_flip_range_endpoint_sweep(base_vals, flip):
+    # the TestRunContainer inot1-15 block (TestRunContainer.java:952-1260),
+    # as RoaringBitmap.flip_range vs the set oracle; container kind after
+    # the flip is the implementation's choice — contents must be exact
+    rb = RoaringBitmap.from_values(np.array(base_vals, np.uint32))
+    rb.run_optimize()
+    lo, hi = flip
+    rb.flip_range(lo, hi)
+    expect = set(base_vals) ^ set(range(lo, hi))
+    assert _oracle_set(rb) == expect
+    # involution: flipping again restores the original
+    rb.flip_range(lo, hi)
+    assert _oracle_set(rb) == set(base_vals)
+
+
 # --------------------------------------------- next/previous value boundaries
 def test_next_value_word_boundaries():
     # TestBitmapContainer.testNextValue2/testNextValueBetweenRuns:1036-1056 —

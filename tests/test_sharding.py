@@ -179,6 +179,29 @@ def test_sharded_bsi_parity(mesh8):
     assert sb.sum() == bsi.sum()
 
 
+def test_sharded_64bit_tier(mesh8):
+    """Roaring64Bitmap rides the same sharded wide ops: the segment axis
+    is the u64 high-48 key instead of the u16 key (SURVEY §2.3), and
+    unpack_result restores the 64-bit class from the key dtype."""
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    rng = np.random.default_rng(5)
+    bms = [Roaring64Bitmap.from_values(
+        rng.integers(0, 1 << 40, 5000, dtype=np.uint64)) for _ in range(8)]
+    oracles = {"or": Roaring64Bitmap(), "xor": Roaring64Bitmap(),
+               "and": bms[0].clone()}
+    for b in bms:
+        oracles["or"].ior(b)
+        oracles["xor"].ixor(b)
+    for b in bms[1:]:
+        oracles["and"].iand(b)
+    for op in ("or", "xor", "and"):
+        keys, words, cards = sharding.wide_aggregate_sharded(mesh8, op, bms)
+        got = packing.unpack_result(keys, words, cards)
+        assert isinstance(got, Roaring64Bitmap)
+        assert got == oracles[op], op
+
+
 def test_sharded_bsi_topk(mesh8):
     """ShardedBSI.top_k_cardinality == DeviceBSI's pre-trim candidate
     cardinality, and >= k whenever k rows exist."""

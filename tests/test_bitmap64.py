@@ -334,6 +334,12 @@ def test_device_set_with_u64_keys(rng):
     got = ds.aggregate("or", engine="xla")
     assert got == want
     assert np.array_equal(got.to_array(), want.to_array())
+    # all three residency layouts serve the 64-bit tier (key dtype rides
+    # through packing; unpack restores the class)
+    for layout in ("counts", "compact"):
+        dsl = DeviceBitmapSet(bms, layout=layout)
+        gl = dsl.aggregate("or")
+        assert isinstance(gl, Roaring64Bitmap) and gl == want, layout
 
 
 def test_long_tail_surface():

@@ -332,6 +332,32 @@ def test_flip_range_endpoint_sweep(base_vals, flip):
     assert _oracle_set(rb) == set(base_vals)
 
 
+@pytest.mark.parametrize("elements,begin,end,expected", [
+    # TestBufferRangeCardinality.data:21-28 (cardinalityInBitmapWordRange)
+    ([1, 3, 5, 7, 9], 3, 8, 3),
+    ([1, 3, 5, 7, 9], 2, 8, 3),
+    ([1, 3, 5, 7, 9], 3, 7, 2),
+    ([1, 3, 5, 7, 9], 0, 7, 3),
+    ([1, 3, 5, 7, 9], 0, 6, 3),
+    ([1, 3, 5, 7, 9, 0x7FFF], 0, 0x8000, 6),
+    ([1, 10000, 25000, 0x7FFE], 0, 0x7FFF, 4),
+    ([1 << 3, 1 << 8, 511, 512, 513, 1 << 12, 1 << 14], 0, 0x7FFF, 7),
+])
+def test_buffer_range_cardinality_word_boundaries(elements, begin, end,
+                                                  expected):
+    # host tier, byte-backed immutable tier, and the device image must all
+    # count the same word-boundary-straddling ranges
+    from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap
+
+    rb = RoaringBitmap.from_values(np.array(elements, np.uint32))
+    assert rb.range_cardinality(begin, end) == expected
+    imm = ImmutableRoaringBitmap(rb.serialize())
+    assert imm.range_cardinality(begin, end) == expected
+    db = DeviceBitmap.from_host(rb)
+    assert db.range_cardinality(begin, end) == expected
+
+
 # --------------------------------------------- next/previous value boundaries
 def test_next_value_word_boundaries():
     # TestBitmapContainer.testNextValue2/testNextValueBetweenRuns:1036-1056 —

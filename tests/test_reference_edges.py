@@ -70,6 +70,25 @@ def test_offset_corpus_roundtrip(name, offset):
     assert rb.add_offset(offset).add_offset(-offset) == rb
 
 
+@needs_corpus
+@pytest.mark.parametrize("name,offset", OFFSET_CASES)
+def test_offset_corpus_buffer_variant(name, offset):
+    # TestConcatenation.testElementwiseOffsetAppliedCorrectlyBuffer:92-97 /
+    # testCardinalityPreservedBuffer:108-112: the mutable buffer twin's
+    # offset, via the immutable pairing
+    from roaringbitmap_tpu.buffer import (ImmutableRoaringBitmap,
+                                          MutableRoaringBitmap)
+
+    vals = _read_int_list(name)
+    rb = RoaringBitmap.from_values(vals.astype(np.uint32))
+    mut = ImmutableRoaringBitmap(rb.serialize()).to_mutable()
+    assert isinstance(mut, MutableRoaringBitmap)
+    shifted = mut.add_offset(offset)
+    np.testing.assert_array_equal(
+        shifted.to_array().astype(np.int64), vals + offset)
+    assert shifted.cardinality == rb.cardinality
+
+
 def _mixed_container_bitmap(seed: int) -> RoaringBitmap:
     """A bitmap with an array, a run, and a bitmap container at distinct
     chunks — the testCase().withBitmapAt/withRunAt/withArrayAt construction

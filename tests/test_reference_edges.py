@@ -377,6 +377,67 @@ def test_buffer_range_cardinality_word_boundaries(elements, begin, end,
     assert db.range_cardinality(begin, end) == expected
 
 
+# ------------------------------------------------ batch iterator regressions
+def _batch_it(rb, batch_size):
+    from roaringbitmap_tpu.core.iterators import RoaringBatchIterator
+
+    return RoaringBatchIterator(rb, batch_size)
+
+
+def test_batch_iterator_timely_termination():
+    # RoaringBitmapBatchIteratorTest.testTimelyTermination:181-190 and
+    # testTimelyTerminationAfterAdvanceIfNeeded:193-199
+    rb = RoaringBitmap.bitmap_of(8511)
+    it = _batch_it(rb, 10)
+    assert it.has_next()
+    batch = it.next_batch()
+    assert batch.tolist() == [8511]
+    assert not it.has_next()
+
+    it2 = _batch_it(rb, 10)
+    assert it2.has_next()
+    it2.advance_if_needed(8512)
+    assert not it2.has_next()
+
+
+def test_batch_iterator_advance_before_first_key():
+    # testBatchIteratorWithAdvanceIfNeeded:202-214: seeking to 6 when the
+    # first container lives at chunk 3 must not skip it
+    rb = RoaringBitmap.bitmap_of(3 << 16, (3 << 16) + 5, (3 << 16) + 10)
+    it = _batch_it(rb, 10)
+    it.advance_if_needed(6)
+    assert it.has_next()
+    batch = it.next_batch()
+    assert batch.tolist() == [3 << 16, (3 << 16) + 5, (3 << 16) + 10]
+
+
+@pytest.mark.parametrize("number", [10, 11, 12, 13, 14, 15, 18, 20, 21,
+                                    23, 24])
+def test_batch_iterator_advance_in_run(number):
+    # testBatchIteratorWithAdvancedIfNeededWithZeroLengthRun:217-229
+    rb = RoaringBitmap.bitmap_of(10, 11, 12, 13, 14, 15, 18, 20, 21, 22,
+                                 23, 24)
+    rb.run_optimize()
+    it = _batch_it(rb, 10)
+    it.advance_if_needed(number)
+    assert it.has_next()
+    batch = it.next_batch()
+    assert number in batch.tolist()
+
+
+def test_batch_iterator_fills_across_containers():
+    # testBatchIteratorFillsBufferAcrossContainers:231-246: batches span
+    # container boundaries
+    vals = [3 << 4, 3 << 8, 3 << 12, 3 << 16, 3 << 20, 3 << 24, 3 << 28]
+    rb = RoaringBitmap.bitmap_of(*vals)
+    assert rb.container_count() == 5
+    it = _batch_it(rb, 3)
+    got = []
+    while it.has_next():
+        got.extend(it.next_batch().tolist())
+    assert got == vals
+
+
 # --------------------------------------------- next/previous value boundaries
 def test_next_value_word_boundaries():
     # TestBitmapContainer.testNextValue2/testNextValueBetweenRuns:1036-1056 —

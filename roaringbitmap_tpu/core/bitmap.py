@@ -406,6 +406,15 @@ class RoaringBitmap:
         self.add(x)
         return True
 
+    def add_n(self, values: np.ndarray, offset: int, n: int) -> None:
+        """Add n values starting at index offset (RoaringBitmap.addN:1199
+        — the partial-array form of addMany)."""
+        if n < 0 or offset < 0 or offset + n > len(values):
+            raise IndexError(
+                f"addN window [{offset}, {offset + n}) out of bounds "
+                f"for {len(values)} values")
+        self.add_many(np.asarray(values)[offset:offset + n])
+
     def add_many(self, values: np.ndarray) -> None:
         """Bulk insert (RoaringBitmap.add(int...) / addMany)."""
         other = RoaringBitmap.from_values(values)

@@ -377,6 +377,20 @@ def test_buffer_range_cardinality_word_boundaries(elements, begin, end,
     assert db.range_cardinality(begin, end) == expected
 
 
+def test_add_n_window():
+    # RoaringBitmap.addN:1199 — the partial-array add (offset, length)
+    vals = np.array([9, 1, 5, 70000, 3, 2], np.uint32)
+    rb = RoaringBitmap()
+    rb.add_n(vals, 1, 3)
+    assert rb.to_array().tolist() == [1, 5, 70000]
+    rb.add_n(vals, 0, 0)  # empty window is a no-op
+    assert rb.cardinality == 3
+    with pytest.raises(IndexError):
+        rb.add_n(vals, 4, 3)
+    with pytest.raises(IndexError):
+        rb.add_n(vals, -1, 2)
+
+
 # ------------------------------------------------ batch iterator regressions
 def _batch_it(rb, batch_size):
     from roaringbitmap_tpu.core.iterators import RoaringBatchIterator

@@ -23,6 +23,16 @@ def mesh8():
     return Mesh(np.array(jax.devices()).reshape(4, 2), ("rows", "lanes"))
 
 
+MESH_SHAPES = [(8, 1), (4, 2), (2, 4), (1, 8)]
+
+
+@pytest.fixture(params=MESH_SHAPES, ids=lambda s: f"{s[0]}x{s[1]}")
+def mesh(request):
+    rows, lanes = request.param
+    return Mesh(np.array(jax.devices()).reshape(rows, lanes),
+                ("rows", "lanes"))
+
+
 @pytest.fixture(scope="module")
 def oracle_or(workload):
     acc = RoaringBitmap()
@@ -31,21 +41,16 @@ def oracle_or(workload):
     return acc
 
 
-@pytest.mark.parametrize("rows,lanes", [(8, 1), (4, 2), (2, 4), (1, 8)])
-def test_sharded_or_all_mesh_shapes(workload, oracle_or, rows, lanes):
-    devs = np.array(jax.devices()).reshape(rows, lanes)
-    mesh = Mesh(devs, ("rows", "lanes"))
+def test_sharded_or_all_mesh_shapes(workload, oracle_or, mesh):
     keys, words, cards = sharding.wide_aggregate_sharded(mesh, "or", workload)
     got = packing.unpack_result(keys, words, cards)
     assert got == oracle_or
 
 
-def test_sharded_xor_matches_host(workload):
+def test_sharded_xor_all_mesh_shapes(workload, mesh):
     acc = RoaringBitmap()
     for b in workload:
         acc.ixor(b)
-    devs = np.array(jax.devices()).reshape(4, 2)
-    mesh = Mesh(devs, ("rows", "lanes"))
     keys, words, cards = sharding.wide_aggregate_sharded(mesh, "xor", workload)
     got = packing.unpack_result(keys, words, cards)
     assert got == acc
@@ -60,13 +65,10 @@ def test_ragged_aggregator_rejects_and():
         sharding.make_sharded_aggregator(mesh, "and", 4, 2)
 
 
-@pytest.mark.parametrize("rows,lanes", [(8, 1), (4, 2), (2, 4)])
-def test_sharded_and_matches_host(workload, rows, lanes):
+def test_sharded_and_matches_host(workload, mesh):
     acc = workload[0].clone()
     for b in workload[1:]:
         acc.iand(b)
-    devs = np.array(jax.devices()).reshape(rows, lanes)
-    mesh = Mesh(devs, ("rows", "lanes"))
     keys, words, cards = sharding.wide_aggregate_sharded(mesh, "and", workload)
     got = packing.unpack_result(keys, words, cards)
     assert got == acc
@@ -103,10 +105,11 @@ def test_sharded_census1881_parity(op):
     assert packing.unpack_result(keys, words, cards) == oracle
 
 
-def test_compact_ingest_sharded_parity(mesh8, rng):
+def test_compact_ingest_sharded_parity(rng, mesh):
     """ingest="compact" (streams sharded, per-shard device densify) must be
     bit-identical to the host-densified dense ingest — incl. byte-backed
-    sources, which ship ~serialized-size to the mesh."""
+    sources, which ship ~serialized-size to the mesh — on every mesh
+    factorization (the shard split changes with the row-axis size)."""
     bms = []
     for i in range(12):
         vals = [rng.integers(0, 1 << 20, 600),
@@ -117,9 +120,9 @@ def test_compact_ingest_sharded_parity(mesh8, rng):
         b.run_optimize()
         bms.append(b)
     for op in ("or", "xor"):
-        kd, wd, cd = sharding.wide_aggregate_sharded(mesh8, op, bms, ingest="dense")
+        kd, wd, cd = sharding.wide_aggregate_sharded(mesh, op, bms, ingest="dense")
         for src in (bms, [b.serialize() for b in bms]):
-            kc, wc, cc = sharding.wide_aggregate_sharded(mesh8, op, src,
+            kc, wc, cc = sharding.wide_aggregate_sharded(mesh, op, src,
                                                    ingest="compact")
             got = packing.unpack_result(kc, wc, cc)
             want = packing.unpack_result(kd, wd, cd)

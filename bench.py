@@ -39,7 +39,17 @@ Methodology
 --profile writes a jax.profiler trace (the JMH -prof analog) to
   /tmp/rb_tpu_trace and reports per-kernel device-time totals parsed from it.
 
-Prints ONE JSON line with metric/value/unit/vs_baseline + detail.
+Prints ONE JSON line with metric/value/unit/vs_baseline + detail — and
+NOTHING else on stdout: fd 1 is redirected to stderr for the whole run (any
+library print / warning lands there) and the document is written to the
+saved real stdout at the end, so the driver's parse always sees a pure JSON
+stream (VERDICT r4 missing #5).
+
+The two north-star cells additionally report a median + spread over
+--spread fresh-process re-measurements (default 5, incl. this process) —
+single-point marginals at these working-set sizes drift with VMEM
+scheduling between compilations (r03 vs r04 wikileaks), so one capture
+cannot distinguish variance from regression.
 """
 
 from __future__ import annotations
@@ -48,6 +58,8 @@ import argparse
 import contextlib
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -297,11 +309,87 @@ def parse_profile_trace(trace_dir: str) -> dict:
         return {"error": f"trace parse failed: {e}"}
 
 
+def spread_runs(n: int, own: dict[str, float]) -> dict:
+    """Median + spread of the best-engine steady-state marginal per
+    north-star dataset over n fresh-process measurements (this process's
+    capture counts as one).  Each subprocess re-runs the same ingest +
+    chained-marginal pipeline under a fresh XLA compilation/scheduling
+    draw — the quantity that moved 5x between r03 and r04."""
+    import jax
+
+    parent_backend = jax.default_backend()
+    samples = {name: [us] for name, us in own.items()}
+    errors: list[str] = []
+    for _ in range(max(0, n - 1)):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--spread-cell"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                timeout=900, cwd=os.path.dirname(os.path.abspath(__file__)))
+            row = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+            if row.pop("backend", None) != parent_backend:
+                # a child that lost the device and fell back to another
+                # backend must not pollute the spread with alien timings
+                errors.append("backend mismatch")
+                continue
+            for name, us in row.items():
+                samples.setdefault(name, []).append(us)
+        except Exception as e:
+            errors.append(type(e).__name__)
+    out = {}
+    for name, xs in samples.items():
+        out[name] = {
+            "n": len(xs),
+            "marginal_us_median": round(float(np.median(xs)), 2),
+            "marginal_us_min": round(min(xs), 2),
+            "marginal_us_max": round(max(xs), 2),
+            "samples_us": [round(x, 2) for x in xs],
+        }
+    out["backend"] = parent_backend
+    if errors:
+        out["failed_runs"] = errors
+    return out
+
+
+def spread_cell_main() -> None:
+    """Subprocess body for spread_runs: measure both north-star marginals
+    once and print {dataset: best_marginal_us} as the only stdout line."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/rb_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    import jax.numpy as jnp
+
+    jnp.square(jax.device_put(np.ones(8, np.float32))).block_until_ready()
+    states = {name: ingest_phase(name) for name in BENCH_DATASETS}
+    row = {"backend": jax.default_backend()}
+    for name in BENCH_DATASETS:
+        r = query_phase(states[name], profile=False)
+        row[name] = min(r["marginal_us_per_wide_or"].values())
+    print(json.dumps(row))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--profile", action="store_true",
                     help="capture a jax.profiler trace of the measured runs")
+    ap.add_argument("--spread", type=int, default=5,
+                    help="fresh-process re-measurements of the north-star "
+                         "marginals (0/1 disables the extra processes)")
+    ap.add_argument("--spread-cell", action="store_true",
+                    help="internal: emit one spread sample and exit")
     args = ap.parse_args()
+
+    if args.spread_cell:
+        spread_cell_main()
+        return
+
+    # stdout hygiene: everything during the run (library prints, warnings
+    # routed through stdout) goes to stderr; ONLY the final document is
+    # written to the real stdout
+    real_stdout = os.fdopen(os.dup(1), "w")
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
 
     import jax
 
@@ -346,7 +434,12 @@ def main() -> None:
         out["detail"]["profile_trace_dir"] = "/tmp/rb_tpu_trace"
         out["detail"]["profile_kernel_us"] = parse_profile_trace(
             "/tmp/rb_tpu_trace")
-    print(json.dumps(out))
+    if args.spread > 1:
+        own = {name: min(r["marginal_us_per_wide_or"].values())
+               for name, r in results.items()}
+        out["detail"]["north_star_spread"] = spread_runs(args.spread, own)
+    print(json.dumps(out), file=real_stdout)
+    real_stdout.flush()
 
 
 if __name__ == "__main__":

@@ -24,6 +24,7 @@ kwargs are accepted for API stability and ignored.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -526,11 +527,13 @@ class DeviceBitmapSet:
         """Dense-wire rows reordered by destination row so their segment ids
         are sorted ascending (the fused reduce's doubling pass needs sorted
         segments; the NumPy pack already emits them sorted, the native
-        engine's interleaved walk may not)."""
+        engine's interleaved walk may not).  Returns a private copy — the
+        input streams object belongs to self._packed and other consumers
+        rely on its emitted row order."""
         if s.dense_dest.size and np.any(np.diff(s.dense_dest) < 0):
             order = np.argsort(s.dense_dest, kind="stable")
-            s.dense_words = s.dense_words[order]
-            s.dense_dest = s.dense_dest[order]
+            s = dataclasses.replace(s, dense_words=s.dense_words[order],
+                                    dense_dest=s.dense_dest[order])
         return s
 
     def _compact_meta(self, s: packing.CompactStreams) -> None:
@@ -763,7 +766,11 @@ class DeviceBitmapSet:
                 return jax.lax.fori_loop(
                     0, reps, body, (words, jnp.uint32(0)))[1]
 
-            return jax.jit(run)
+            f = jax.jit(run)
+            default = self.words
+            # uniform probe convention across layouts: callable with no
+            # argument (counts/compact ignore one), words overridable
+            return lambda words=None: f(default if words is None else words)
 
         if self.counts is not None:
             # counts layout: barrier-chained (the OR write-back would make
@@ -829,7 +836,9 @@ class DeviceBitmapSet:
                 return jax.lax.fori_loop(
                     0, reps, body, (words, jnp.uint32(0)))[1]
 
-            return jax.jit(run)
+            f = jax.jit(run)
+            default = self.words
+            return lambda words=None: f(default if words is None else words)
 
         if self.counts is not None and op in ("or", "xor"):
             # counts layout: one kernel pass off the barriered counts per

@@ -567,7 +567,10 @@ class ShardedRangeBitmap:
         from ..core.bitmap import RoaringBitmap
         from ..core.rangebitmap import RangeBitmap as HostRangeBitmap
 
-        assert isinstance(rb, HostRangeBitmap)
+        if not isinstance(rb, HostRangeBitmap):
+            raise TypeError(
+                f"ShardedRangeBitmap needs a core.rangebitmap.RangeBitmap, "
+                f"got {type(rb).__name__}")
         self.mesh = _intern_mesh(mesh)
         self.row_axis, self.lane_axis = row_axis, lane_axis
         self.rows = rb.row_count

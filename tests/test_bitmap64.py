@@ -385,3 +385,74 @@ def test_long_tail_u64_boundaries():
     assert list(rb.reverse_long_iterator_from(top)) == [top, 5]
     assert list(rb.reverse_long_iterator_from(top - 1)) == [5]
     assert rb.limit(1).to_array().tolist() == [5]
+
+
+# ------------------------------------------------------------ ART wire codec
+# HighLowContainer.serialize:155-185 / Art.serializeArt / Containers.serialize
+# — the reference Roaring64Bitmap's native format (VERDICT r4 missing #2).
+
+def _art_workloads(rng):
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    yield Roaring64Bitmap()                                     # empty tag
+    yield Roaring64Bitmap.from_values(
+        np.array([42], dtype=np.uint64))                        # leaf root
+    # >48 distinct second bytes under one first byte -> Node256 on that level,
+    # plus spread over first bytes for Node4/16/48 shapes, plus container mix
+    vals = [rng.integers(0, 1 << 20, 300).astype(np.uint64),    # arrays
+            np.arange(5 << 16, (5 << 16) + 30000, dtype=np.uint64),  # bitmap
+            (np.arange(0, 300, dtype=np.uint64) << np.uint64(24)) + 7,
+            (np.arange(0, 60, dtype=np.uint64) << np.uint64(17)),
+            np.array([0, (1 << 48) - 1, (1 << 63), (1 << 64) - 1],
+                     dtype=np.uint64)]
+    rb = Roaring64Bitmap.from_values(np.unique(np.concatenate(vals)))
+    rb.run_optimize()
+    yield rb
+
+
+def test_art_roundtrip(rng):
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    for rb in _art_workloads(rng):
+        blob = rb.serialize_art()
+        back = Roaring64Bitmap.deserialize_art(blob)
+        assert back == rb
+        # deserialize() auto-detects the ART stream (and still reads its own)
+        assert Roaring64Bitmap.deserialize(blob) == rb
+        assert Roaring64Bitmap.deserialize(rb.serialize()) == rb
+
+
+@pytest.mark.parametrize("fan,kind", [(3, 0), (12, 1), (40, 2), (60, 3)])
+def test_art_node_kind_coverage(fan, kind):
+    """The canonical writer emits Node4/16/48/256 by fanout; the root kind
+    byte directly follows the i64 key count.  Each shape round-trips."""
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    # i << 56 puts i in the top byte of the high-48 key -> root fanout == fan
+    vals = (np.arange(fan, dtype=np.uint64) << np.uint64(56)) + np.uint64(9)
+    rb = Roaring64Bitmap.from_values(vals)
+    blob = rb.serialize_art()
+    assert blob[9] == kind
+    assert Roaring64Bitmap.deserialize_art(blob) == rb
+
+
+def test_art_hostile_streams(rng):
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+    from roaringbitmap_tpu.format.spec import InvalidRoaringFormat
+
+    rb = list(_art_workloads(rng))[-1]
+    blob = bytearray(rb.serialize_art())
+    hostile = [
+        b"", b"\x07", b"\x01", b"\x01" + b"\x00" * 8,
+        bytes(blob[:40]),                      # truncated node stream
+        bytes(blob[:len(blob) - 7]),           # truncated trailer
+        b"\x01" + (2 ** 62).to_bytes(8, "little") + bytes(blob[9:]),
+        b"\x01" + (8).to_bytes(8, "little", signed=True)
+        + b"\x00\x01\x00\x00" * 500,           # NODE4 chain nesting attack
+    ]
+    for h in hostile:
+        with pytest.raises(InvalidRoaringFormat):
+            Roaring64Bitmap.deserialize_art(h)
+    # the auto-detecting entry names both formats on garbage
+    with pytest.raises(InvalidRoaringFormat, match="neither portable"):
+        Roaring64Bitmap.deserialize(b"\x07\x03" * 9)

@@ -1,0 +1,83 @@
+"""On-TPU lane runner: compiled-Mosaic bit-exactness, provable from artifacts.
+
+Runs tests/test_on_tpu.py against the REAL backend (RB_TPU_TESTS=1 — compiled
+Pallas/Mosaic kernels, not interpret mode) and writes
+benchmarks/on_tpu_r{N}.json with pass/fail per test and per kernel family,
+so a round's artifacts prove the lane ran green on that round's chip
+(VERDICT r4 weak #7: 20 default-skips were otherwise invisible).
+
+    python benchmarks/run_on_tpu_lane.py [--round N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ["RB_TPU_TESTS"] = "1"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # the package imports resolve from the repo root
+
+
+class _Collector:
+    """pytest plugin: outcome per test node, grouped by class = kernel
+    family (wide ops / pairwise / index tiers / plans+native)."""
+
+    def __init__(self) -> None:
+        self.tests: dict[str, str] = {}
+
+    def pytest_runtest_logreport(self, report) -> None:
+        key = report.nodeid.split("::", 1)[-1]
+        if report.failed:  # incl. fixture/teardown errors
+            self.tests[key] = "failed"
+        elif report.when == "call" or (report.when == "setup"
+                                       and report.skipped):
+            self.tests[key] = "skipped" if report.skipped else "passed"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import pytest
+
+    col = _Collector()
+    rc = pytest.main(
+        ["-q", os.path.join(REPO, "tests", "test_on_tpu.py")], plugins=[col])
+
+    families: dict[str, dict[str, int]] = {}
+    for nodeid, outcome in col.tests.items():
+        fam = nodeid.split("::")[0] if "::" in nodeid else "module"
+        row = families.setdefault(
+            fam, {"passed": 0, "failed": 0, "skipped": 0})
+        row[outcome] += 1
+
+    dev = jax.devices()[0]
+    doc = {
+        "round": args.round,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "compiled_mosaic": jax.default_backend() == "tpu",
+        "exit_code": int(rc),
+        # green REQUIRES the real backend: a CPU fallback run never compiles
+        # a Mosaic kernel, which is the thing this artifact exists to prove
+        "ok": (int(rc) == 0 and jax.default_backend() == "tpu"
+               and any(f["passed"] for f in families.values())),
+        "families": families,
+        "tests": col.tests,
+    }
+    path = os.path.join(REPO, "benchmarks", f"on_tpu_r{args.round:02d}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(json.dumps({k: doc[k] for k in
+                      ("backend", "ok", "exit_code", "families")}))
+    return int(rc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

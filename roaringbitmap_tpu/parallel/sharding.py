@@ -28,6 +28,18 @@ from ..ops import dense, packing
 
 WORDS32 = packing.WORDS32
 
+#: Per-device dense-accumulator ceiling, in keys.  Each device materializes
+#: u32[K+1, 2048] (8 KiB/key) before the butterfly, so K is a direct HBM
+#: budget: 4096 keys = 32 MiB.  wide_aggregate_sharded chunks the key axis
+#: at this granularity (per-device memory stays bounded for any K up to the
+#: 2^16-key universe); make_sharded_aggregator itself refuses larger K with
+#: a typed error rather than silently allocating O(K) on every device.
+MAX_KEYS_PER_SHARD_PASS = 4096
+
+
+class ShardedKeyBudgetError(ValueError):
+    """num_keys exceeds the per-device dense-accumulator ceiling."""
+
 
 def _local_dense_accumulate(op: str, words, seg_ids, num_keys: int, n_steps: int):
     """Reduce local rows -> dense u32[K+1, 2048] accumulator over ALL keys.
@@ -88,6 +100,14 @@ def _make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
     """
     if op not in ("or", "xor"):
         raise ValueError("sharded ragged aggregation supports or/xor only")
+    if num_keys > MAX_KEYS_PER_SHARD_PASS:
+        raise ShardedKeyBudgetError(
+            f"{num_keys} keys would allocate a "
+            f"{(num_keys + 1) * 8 // 1024} MiB dense accumulator on EVERY "
+            f"row-shard device (ceiling {MAX_KEYS_PER_SHARD_PASS} keys = "
+            f"{(MAX_KEYS_PER_SHARD_PASS + 1) * 8 // 1024} MiB); use "
+            "wide_aggregate_sharded, which chunks the key axis under the "
+            "ceiling")
     axis_size = mesh.shape[row_axis]
 
     def step(words, seg_ids):
@@ -120,18 +140,62 @@ def make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
 def shard_packed(mesh: Mesh, packed: packing.PackedAggregation,
                  row_axis: str = "rows", lane_axis: str = "lanes"):
     """Pad rows to the mesh row-axis multiple and device_put with shardings."""
+    return _shard_rows(mesh, packed.words, packed.seg_ids, packed.num_keys,
+                       row_axis, lane_axis)
+
+
+def _shard_rows(mesh: Mesh, words: np.ndarray, seg_ids: np.ndarray,
+                scratch_seg: int, row_axis: str = "rows",
+                lane_axis: str = "lanes"):
+    """shard_packed over raw (words, seg_ids) arrays; padding rows target
+    the scratch segment (index scratch_seg, one past the real keys)."""
     n_rows = mesh.shape[row_axis]
-    m_pad = -(-packed.words.shape[0] // n_rows) * n_rows
-    words = packed.words
-    seg_ids = packed.seg_ids
+    m_pad = max(-(-words.shape[0] // n_rows) * n_rows, n_rows)
     if m_pad != words.shape[0]:
         extra = m_pad - words.shape[0]
         words = np.concatenate([words, np.zeros((extra, WORDS32), np.uint32)])
         seg_ids = np.concatenate(
-            [seg_ids, np.full(extra, packed.num_keys, np.int32)])
+            [seg_ids, np.full(extra, scratch_seg, np.int32)])
     words_d = jax.device_put(words, NamedSharding(mesh, P(row_axis, lane_axis)))
     segs_d = jax.device_put(seg_ids, NamedSharding(mesh, P(row_axis)))
     return words_d, segs_d
+
+
+def _key_chunks(num_keys: int) -> list[tuple[int, int]]:
+    step = MAX_KEYS_PER_SHARD_PASS
+    return [(k, min(k + step, num_keys)) for k in range(0, num_keys, step)]
+
+
+def _slice_blocked(blocked: packing.PackedBlockedCompact, k0: int, k1: int
+                   ) -> packing.PackedBlockedCompact:
+    """Key-range [k0, k1) slice of a blocked compact pack: blocks are sorted
+    by segment, so the slice is a contiguous block range whose streams are
+    re-based to row 0 — the unit wide_aggregate_sharded feeds the mesh when
+    the full key axis would blow the per-device accumulator ceiling."""
+    block = blocked.block
+    b0 = int(np.searchsorted(blocked.blk_seg, k0, side="left"))
+    b1 = int(np.searchsorted(blocked.blk_seg, k1, side="left"))
+    row0, row1 = b0 * block, b1 * block
+    s = blocked.streams
+    dm = (s.dense_dest >= row0) & (s.dense_dest < row1)
+    heads = np.concatenate(([0], np.cumsum(s.val_counts)))
+    vi = np.flatnonzero((s.val_dest >= row0) & (s.val_dest < row1))
+    values = (np.concatenate([s.values[heads[i]:heads[i + 1]] for i in vi])
+              if vi.size else np.empty(0, np.uint16))
+    streams = packing.CompactStreams(
+        n_rows=row1 - row0,
+        dense_words=s.dense_words[dm],
+        dense_dest=(s.dense_dest[dm] - row0).astype(np.int32),
+        values=values,
+        val_counts=s.val_counts[vi].astype(np.int32),
+        val_dest=(s.val_dest[vi] - row0).astype(np.int32))
+    return packing.PackedBlockedCompact(
+        keys=blocked.keys[k0:k1],
+        blk_seg=(blocked.blk_seg[b0:b1] - k0).astype(np.int32),
+        block=block, n_blocks=b1 - b0,
+        seg_sizes=blocked.seg_sizes[k0:k1],
+        seg_offsets=blocked.seg_offsets[k0:k1] - row0,
+        streams=streams, carry_row=-1)
 
 
 def _split_streams_by_shard(s: packing.CompactStreams, rows_per_shard: int,
@@ -245,6 +309,8 @@ def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,
     """
     if ingest not in ("dense", "compact"):
         raise ValueError(f"unknown ingest {ingest!r}")
+    if op not in ("or", "xor", "and"):
+        raise ValueError(f"unsupported sharded wide op {op!r}")
     # byte-backed sources work on every path: zero-copy wrap for the object
     # consumers (pack_for_aggregation / the AND key intersection); the
     # compact packer handles bytes natively
@@ -254,20 +320,53 @@ def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,
         bitmaps = _wrap_bytes(bitmaps)
     if ingest == "compact":
         blocked = packing.pack_blocked_compact(bitmaps, carry_slot=False)
-        words_d, segs_d, blk_seg = shard_streams(mesh, blocked)
-        # max padded group size in O(K): groups are block-multiple-padded
-        gp_max = int((-(-blocked.seg_sizes // blocked.block)
-                      * blocked.block).max()) if blocked.keys.size else 0
-        step = make_sharded_aggregator(mesh, op, blocked.keys.size,
-                                       dense.n_steps_for(gp_max))
-        heads, cards = step(words_d, segs_d)
-        return blocked.keys, np.asarray(heads), np.asarray(cards)
+        heads_parts, cards_parts = [], []
+        for k0, k1 in _key_chunks(blocked.keys.size):
+            sub = blocked if (k0, k1) == (0, blocked.keys.size) \
+                else _slice_blocked(blocked, k0, k1)
+            words_d, segs_d, _ = shard_streams(mesh, sub)
+            # max padded group size in O(K): groups are block-multiple-padded
+            gp_max = int((-(-sub.seg_sizes // sub.block)
+                          * sub.block).max()) if sub.keys.size else 0
+            step = make_sharded_aggregator(mesh, op, sub.keys.size,
+                                           dense.n_steps_for(gp_max))
+            heads, cards = step(words_d, segs_d)
+            heads_parts.append(np.asarray(heads))
+            cards_parts.append(np.asarray(cards))
+        return (blocked.keys,
+                _concat_chunks(heads_parts, (0, WORDS32), np.uint32),
+                _concat_chunks(cards_parts, (0,), np.int32))
     packed = packing.pack_for_aggregation(bitmaps)
-    step = make_sharded_aggregator(mesh, op, packed.num_keys,
-                                   dense.n_steps_for(packed.max_group))
-    words_d, segs_d = shard_packed(mesh, packed)
-    heads, cards = step(words_d, segs_d)
-    return packed.keys, np.asarray(heads), np.asarray(cards)
+    heads_parts, cards_parts = [], []
+    for k0, k1 in _key_chunks(packed.num_keys):
+        if (k0, k1) == (0, packed.num_keys):
+            # single chunk: keep the pow2-padded pack rows so repeated
+            # calls with drifting row counts reuse bucketed executables
+            words_d, segs_d = shard_packed(mesh, packed)
+            step = make_sharded_aggregator(mesh, op, packed.num_keys,
+                                           dense.n_steps_for(packed.max_group))
+        else:
+            row0 = int(packed.head_idx[k0])
+            row1 = (int(packed.head_idx[k1]) if k1 < packed.num_keys
+                    else packed.m)
+            sub_segs = (packed.seg_ids[row0:row1] - k0).astype(np.int32)
+            max_group = int(packed.seg_sizes[k0:k1].max())
+            words_d, segs_d = _shard_rows(mesh, packed.words[row0:row1],
+                                          sub_segs, k1 - k0)
+            step = make_sharded_aggregator(mesh, op, k1 - k0,
+                                           dense.n_steps_for(max_group))
+        heads, cards = step(words_d, segs_d)
+        heads_parts.append(np.asarray(heads))
+        cards_parts.append(np.asarray(cards))
+    return (packed.keys,
+            _concat_chunks(heads_parts, (0, WORDS32), np.uint32),
+            _concat_chunks(cards_parts, (0,), np.int32))
+
+
+def _concat_chunks(parts: list[np.ndarray], empty_shape, dtype) -> np.ndarray:
+    if not parts:
+        return np.zeros(empty_shape, dtype)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
 
 def _pad_to_multiple(arr: np.ndarray, multiple: int, fill,

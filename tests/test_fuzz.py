@@ -99,6 +99,36 @@ class TestAlgebraicInvariants:
             return c == a
         fuzz.verify_invariance(prop, n_bitmaps=1, iterations=IT)
 
+    def test_add_offset_model(self):
+        """Container-granular shift == the value-array oracle, offset drawn
+        from the straddling/aligned/negative/overflow mix each iteration
+        (TestConcatenation invariants at fuzz depth)."""
+        offsets = [1, -1, 20, 65535, 1 << 16, -(1 << 16), (1 << 16) + 3,
+                   (1 << 31), -(1 << 31), (1 << 33)]
+        state = {"i": 0}
+
+        def prop(a):
+            off = offsets[state["i"] % len(offsets)]
+            state["i"] += 1
+            want = _arr(a).astype(np.int64) + off
+            want = want[(want >= 0) & (want <= 0xFFFFFFFF)]
+            return np.array_equal(_arr(a.add_offset(off)).astype(np.int64),
+                                  want)
+        fuzz.verify_invariance(prop, n_bitmaps=1, iterations=IT)
+
+    def test_inplace_delta_model(self):
+        """O(delta) in-place merges == static algebra (the addN-contract
+        rewrite must stay bit-identical for every kind mix)."""
+        def prop(a, b):
+            for op, fn in (("ior", or_), ("ixor", xor),
+                           ("iandnot", andnot), ("iand", and_)):
+                c = a.clone()
+                getattr(c, op)(b)
+                if c != fn(a, b):
+                    return False
+            return True
+        fuzz.verify_invariance(prop, iterations=IT)
+
 
 class TestDeviceParityFuzz:
     """jit-vs-host parity — the race-detector analog (SURVEY §5): device

@@ -1,0 +1,103 @@
+"""Parallel test execution — SURVEY §2.7.2/2.7.3's test-parallelism analog.
+
+The reference runs its JUnit suites in parallel forks (gradle
+``maxParallelForks`` / the CI matrix); pytest here runs serially by default
+and no xdist plugin is baked into the image, so this runner shards the test
+FILES across worker processes:
+
+    python tools/partest.py [-n WORKERS] [pytest args...]
+
+Each worker is a fresh interpreter running ``pytest <its files> -q`` (every
+worker re-applies tests/conftest.py's 8-virtual-device CPU pinning, so
+shards are hermetic), files are balanced across workers by size as a
+runtime proxy (largest first), and the aggregate exit code is nonzero iff
+any shard fails.  On a single-core host this degrades gracefully to ~serial
+wall-clock; on a many-core host wall-clock approaches the largest shard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _file_part(sel: str) -> str:
+    """The filesystem path of a pytest selector (strip ::Class::test)."""
+    return sel.split("::", 1)[0]
+
+
+def shard_files(files: list[str], n: int) -> list[list[str]]:
+    """Greedy longest-processing-time balance, file size as runtime proxy
+    (selectors weigh as their file)."""
+    size = {f: os.path.getsize(_file_part(f)) for f in files}
+    sized = sorted(files, key=lambda f: -size[f])
+    buckets: list[tuple[int, list[str]]] = [(0, []) for _ in range(n)]
+    for f in sized:
+        i = min(range(n), key=lambda j: buckets[j][0])
+        total, fs = buckets[i]
+        buckets[i] = (total + size[f], fs + [f])
+    return [fs for _, fs in buckets if fs]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--workers", type=int,
+                    default=max(os.cpu_count() or 1, 1))
+    ap.add_argument("pytest_args", nargs="*", default=[],
+                    help="test files to shard (default: all of tests/); "
+                         "non-path entries and unknown flags pass through "
+                         "to pytest")
+    args, passthrough = ap.parse_known_args()
+    args.pytest_args += passthrough
+    args.workers = max(args.workers, 1)
+
+    # existing .py paths (or file::Class::test selectors on them) pick the
+    # shard set; anything else goes to pytest
+    picked = [a for a in args.pytest_args
+              if _file_part(a).endswith(".py")
+              and os.path.exists(os.path.join(REPO, _file_part(a)))]
+    args.pytest_args = [a for a in args.pytest_args if a not in picked]
+    if picked:
+        files = [os.path.join(REPO, a) for a in picked]
+    else:
+        test_dir = os.path.join(REPO, "tests")
+        files = sorted(
+            os.path.join(test_dir, f) for f in os.listdir(test_dir)
+            if f.startswith("test_") and f.endswith(".py"))
+    shards = shard_files(files, args.workers)
+    t0 = time.perf_counter()
+    procs = []
+    for i, shard in enumerate(shards):
+        cmd = [sys.executable, "-m", "pytest", "-q", *args.pytest_args,
+               *shard]
+        # log to a temp FILE, not a pipe: a failing shard's tracebacks can
+        # exceed the pipe buffer and stall that worker mid-run
+        log = tempfile.TemporaryFile()
+        procs.append((i, shard, log, subprocess.Popen(
+            cmd, cwd=REPO, stdout=log, stderr=subprocess.STDOUT)))
+    rc = 0
+    for i, shard, log, p in procs:
+        p.wait()
+        log.seek(0)
+        out = log.read().decode(errors="replace")
+        log.close()
+        tail = out.strip().splitlines()
+        summary = tail[-1] if tail else "(no output)"
+        names = ",".join(os.path.basename(_file_part(f)) for f in shard)
+        print(f"[shard {i}] {summary}   <- {names}")
+        if p.returncode != 0:
+            rc = p.returncode
+            sys.stdout.write(out)
+    print(f"partest: {len(shards)} shards, rc={rc}, "
+          f"{time.perf_counter() - t0:.1f}s wall")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,3 +17,12 @@ nm = Roaring64NavigableMap.from_roaring64(rb)
 assert np.array_equal(nm.to_array(), rb.to_array())
 print("portable bytes:", len(rb.serialize()),
       "| legacy bytes:", len(nm.serialize_legacy()))
+
+# Reference-interop: the Java Roaring64Bitmap's native ART serialization
+# (HighLowContainer.serialize) round-trips through the dedicated codec, and
+# plain deserialize() auto-detects which of the two formats it was handed.
+art_blob = rb.serialize_art()
+assert Roaring64Bitmap.deserialize_art(art_blob) == rb
+assert Roaring64Bitmap.deserialize(art_blob) == rb       # auto-detected
+assert Roaring64Bitmap.deserialize(rb.serialize()) == rb  # portable spec
+print("ART bytes:", len(art_blob), "| auto-detect roundtrip ok")

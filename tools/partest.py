@@ -58,11 +58,26 @@ def main() -> int:
     args.workers = max(args.workers, 1)
 
     # existing .py paths (or file::Class::test selectors on them) pick the
-    # shard set; anything else goes to pytest
-    picked = [a for a in args.pytest_args
-              if _file_part(a).endswith(".py")
-              and os.path.exists(os.path.join(REPO, _file_part(a)))]
-    args.pytest_args = [a for a in args.pytest_args if a not in picked]
+    # shard set; anything else goes to pytest.  A path that is the VALUE of
+    # a value-taking pytest flag (--ignore tests/x.py) must stay with its
+    # flag, not become a sharded file.
+    value_flags = {"-k", "-m", "-o", "-p", "-c", "--ignore", "--ignore-glob",
+                   "--deselect", "--rootdir", "--confcutdir", "--junitxml"}
+    picked, through = [], []
+    take_value = False
+    for a in args.pytest_args:
+        if take_value:
+            through.append(a)
+            take_value = False
+        elif a in value_flags:
+            through.append(a)
+            take_value = True
+        elif (_file_part(a).endswith(".py")
+              and os.path.exists(os.path.join(REPO, _file_part(a)))):
+            picked.append(a)
+        else:
+            through.append(a)
+    args.pytest_args = through
     if picked:
         files = [os.path.join(REPO, a) for a in picked]
     else:
@@ -81,7 +96,7 @@ def main() -> int:
         log = tempfile.TemporaryFile()
         procs.append((i, shard, log, subprocess.Popen(
             cmd, cwd=REPO, stdout=log, stderr=subprocess.STDOUT)))
-    rc = 0
+    rcs = []
     for i, shard, log, p in procs:
         p.wait()
         log.seek(0)
@@ -91,9 +106,13 @@ def main() -> int:
         summary = tail[-1] if tail else "(no output)"
         names = ",".join(os.path.basename(_file_part(f)) for f in shard)
         print(f"[shard {i}] {summary}   <- {names}")
-        if p.returncode != 0:
-            rc = p.returncode
+        rcs.append(p.returncode)
+        if p.returncode not in (0, 5):  # 5 = no tests collected (xdist rule)
             sys.stdout.write(out)
+    # a -k filter legitimately empties some shards (rc 5); fail only when a
+    # shard really failed, or when NO shard collected anything at all
+    hard = [r for r in rcs if r not in (0, 5)]
+    rc = hard[0] if hard else (5 if rcs and all(r == 5 for r in rcs) else 0)
     print(f"partest: {len(shards)} shards, rc={rc}, "
           f"{time.perf_counter() - t0:.1f}s wall")
     return rc

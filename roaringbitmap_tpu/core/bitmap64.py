@@ -496,7 +496,7 @@ class Roaring64Bitmap:
         if self.keys.size != o.keys.size or not np.array_equal(self.keys, o.keys):
             return False
         return all(
-            a.cardinality == b.cardinality and np.array_equal(a.values(), b.values())
+            C.container_equals(a, b)
             for a, b in zip(self.containers, o.containers))
 
     def __hash__(self) -> int:

@@ -17,7 +17,6 @@ wall-clock; on a many-core host wall-clock approaches the largest shard.
 
 from __future__ import annotations
 
-import argparse
 import os
 import subprocess
 import sys
@@ -46,38 +45,40 @@ def shard_files(files: list[str], n: int) -> list[list[str]]:
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("-n", "--workers", type=int,
-                    default=max(os.cpu_count() or 1, 1))
-    ap.add_argument("pytest_args", nargs="*", default=[],
-                    help="test files to shard (default: all of tests/); "
-                         "non-path entries and unknown flags pass through "
-                         "to pytest")
-    args, passthrough = ap.parse_known_args()
-    args.pytest_args += passthrough
-    args.workers = max(args.workers, 1)
-
-    # existing .py paths (or file::Class::test selectors on them) pick the
-    # shard set; anything else goes to pytest.  A path that is the VALUE of
-    # a value-taking pytest flag (--ignore tests/x.py) must stay with its
-    # flag, not become a sharded file.
+    # hand-rolled parse over sys.argv IN ORDER: argparse's parse_known_args
+    # reorders positionals away from their preceding flags, which breaks the
+    # flag/value pairing below (--ignore tests/x.py must stay a pair)
+    argv = sys.argv[1:]
+    workers = max(os.cpu_count() or 1, 1)
     value_flags = {"-k", "-m", "-o", "-p", "-c", "--ignore", "--ignore-glob",
                    "--deselect", "--rootdir", "--confcutdir", "--junitxml"}
-    picked, through = [], []
-    take_value = False
-    for a in args.pytest_args:
-        if take_value:
-            through.append(a)
-            take_value = False
-        elif a in value_flags:
-            through.append(a)
-            take_value = True
+    picked: list[str] = []
+    through: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        if a in ("-n", "--workers") and i + 1 < len(argv):
+            workers = max(int(argv[i + 1]), 1)
+            i += 2
+        elif a.startswith("--workers="):
+            workers = max(int(a.split("=", 1)[1]), 1)
+            i += 1
+        elif a in value_flags and i + 1 < len(argv):
+            # a path that is the VALUE of a value-taking pytest flag must
+            # stay with its flag, not become a sharded file
+            through.extend(argv[i:i + 2])
+            i += 2
         elif (_file_part(a).endswith(".py")
               and os.path.exists(os.path.join(REPO, _file_part(a)))):
             picked.append(a)
+            i += 1
         else:
             through.append(a)
-    args.pytest_args = through
+            i += 1
+
     if picked:
         files = [os.path.join(REPO, a) for a in picked]
     else:
@@ -85,12 +86,11 @@ def main() -> int:
         files = sorted(
             os.path.join(test_dir, f) for f in os.listdir(test_dir)
             if f.startswith("test_") and f.endswith(".py"))
-    shards = shard_files(files, args.workers)
+    shards = shard_files(files, workers)
     t0 = time.perf_counter()
     procs = []
     for i, shard in enumerate(shards):
-        cmd = [sys.executable, "-m", "pytest", "-q", *args.pytest_args,
-               *shard]
+        cmd = [sys.executable, "-m", "pytest", "-q", *through, *shard]
         # log to a temp FILE, not a pipe: a failing shard's tracebacks can
         # exceed the pipe buffer and stall that worker mid-run
         log = tempfile.TemporaryFile()

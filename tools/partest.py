@@ -60,12 +60,15 @@ def main() -> int:
         if a in ("-h", "--help"):
             print(__doc__)
             return 0
-        if a in ("-n", "--workers") and i + 1 < len(argv):
-            workers = max(int(argv[i + 1]), 1)
-            i += 2
-        elif a.startswith("--workers="):
-            workers = max(int(a.split("=", 1)[1]), 1)
-            i += 1
+        if a in ("-n", "--workers") or a.startswith("--workers="):
+            val = (a.split("=", 1)[1] if "=" in a
+                   else argv[i + 1] if i + 1 < len(argv) else "")
+            if not val.lstrip("-").isdigit():
+                print(f"partest: {a} needs an integer worker count "
+                      f"(got {val!r}); see --help", file=sys.stderr)
+                return 2
+            workers = max(int(val), 1)
+            i += 1 if "=" in a else 2
         elif a in value_flags and i + 1 < len(argv):
             # a path that is the VALUE of a value-taking pytest flag must
             # stay with its flag, not become a sharded file

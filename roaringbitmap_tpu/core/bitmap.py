@@ -353,13 +353,31 @@ class RoaringBitmap:
         return False
 
     def select_range(self, start: int, end: int) -> "RoaringBitmap":
-        """Members with rank in [start, end), as a bitmap (selectRange)."""
+        """Members with rank in [start, end), as a bitmap (selectRange).
+
+        Container-granular like the reference's selectRangeWithoutCopy:
+        wholly-included containers are shared (persistent), only the two
+        rank-boundary containers materialize values — never the whole
+        bitmap.
+        """
         if start < 0 or end <= start:
             raise ValueError("invalid rank range")
-        arr = self.to_array()
-        if start >= arr.size:
+        keys: list[int] = []
+        conts: list[Container] = []
+        pos = 0
+        for k, c in zip(self.keys, self.containers):
+            card = c.cardinality
+            if pos + card > start:
+                lo, hi = max(start - pos, 0), min(end - pos, card)
+                conts.append(c if (lo, hi) == (0, card)
+                             else C.from_values(c.values()[lo:hi]))
+                keys.append(int(k))
+            pos += card
+            if pos >= end:
+                break
+        if pos <= start:
             raise ValueError("select_range: start beyond cardinality")
-        return RoaringBitmap.from_values(arr[start:min(end, arr.size)])
+        return RoaringBitmap(np.array(keys, dtype=np.uint16), conts)
 
     def rank_long(self, x: int) -> int:
         """rankLong: Python ints never overflow; alias of rank."""

@@ -336,3 +336,32 @@ class TestRoaringBatchIterator:
         it.advance_if_needed(150 << 16)
         assert int(it.next_batch()[0]) == (150 << 16)
         assert len(im._cache) <= 2     # skipped containers never decoded
+
+
+def test_select_range_container_granular(rng):
+    """select_range == the array-slice oracle across container boundaries,
+    and wholly-included containers are SHARED, not copied."""
+    rb = rand_bitmap(rng)
+    rb.run_optimize()
+    arr = rb.to_array()
+    card = arr.size
+    for start, end in [(0, card), (1, card - 1), (card // 3, 2 * card // 3),
+                       (0, 1), (card - 1, card), (card // 2, card + 500)]:
+        got = rb.select_range(start, end)
+        np.testing.assert_array_equal(got.to_array(),
+                                      arr[start:min(end, card)])
+    full = rb.select_range(0, card)
+    assert all(a is b for a, b in zip(rb.containers, full.containers))
+    # deterministic shape so the boundary container provably has >1 value:
+    # chunk 0 holds 10 values, later chunks shared untouched
+    det = RoaringBitmap.from_values(np.concatenate(
+        [np.arange(10, dtype=np.uint32),
+         (np.arange(3, dtype=np.uint32) + 2) << 16]).astype(np.uint32))
+    mid = det.select_range(1, det.cardinality)
+    assert mid.containers[0] is not det.containers[0]  # sliced boundary
+    assert all(a is b for a, b in
+               zip(det.containers[1:], mid.containers[1:]))
+    with pytest.raises(ValueError):
+        rb.select_range(card, card + 5)
+    with pytest.raises(ValueError):
+        rb.select_range(3, 3)

@@ -63,11 +63,12 @@ def main() -> int:
         if a in ("-n", "--workers") or a.startswith("--workers="):
             val = (a.split("=", 1)[1] if "=" in a
                    else argv[i + 1] if i + 1 < len(argv) else "")
-            if not val.lstrip("-").isdigit():
+            try:
+                workers = max(int(val), 1)
+            except ValueError:
                 print(f"partest: {a} needs an integer worker count "
                       f"(got {val!r}); see --help", file=sys.stderr)
                 return 2
-            workers = max(int(val), 1)
             i += 1 if "=" in a else 2
         elif a in value_flags and i + 1 < len(argv):
             # a path that is the VALUE of a value-taking pytest flag must

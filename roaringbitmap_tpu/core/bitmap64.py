@@ -813,10 +813,18 @@ def _chunk_ranges64(start: int, stop: int):
 class Roaring64NavigableMap:
     """Map of high-32-bit key -> 32-bit RoaringBitmap
     (longlong/Roaring64NavigableMap.java), with signed or unsigned long
-    ordering and both serialization formats."""
+    ordering and both serialization formats.
 
-    def __init__(self, signed_longs: bool = False):
+    ``supplier`` is the BitmapDataProviderSupplier analog
+    (Roaring64NavigableMap.java ctor overloads / RoaringBitmapSupplier):
+    a zero-arg callable producing each bucket's 32-bit bitmap, so the
+    backend is pluggable — e.g. ``FastRankRoaringBitmap`` for rank-heavy
+    workloads or ``MutableRoaringBitmap`` for the buffer tier.
+    """
+
+    def __init__(self, signed_longs: bool = False, supplier=None):
         self.signed_longs = signed_longs
+        self._supplier = supplier or RoaringBitmap
         self._map: dict[int, RoaringBitmap] = {}  # unsigned u32 high -> bitmap
         self._sorted_highs: list[int] | None = None
         self._cum_cards: np.ndarray | None = None
@@ -830,9 +838,9 @@ class Roaring64NavigableMap:
         return rb
 
     @staticmethod
-    def from_values(values: np.ndarray,
-                    signed_longs: bool = False) -> "Roaring64NavigableMap":
-        rb = Roaring64NavigableMap(signed_longs)
+    def from_values(values: np.ndarray, signed_longs: bool = False,
+                    supplier=None) -> "Roaring64NavigableMap":
+        rb = Roaring64NavigableMap(signed_longs, supplier)
         v = np.unique(np.asarray(values, dtype=np.uint64))
         if v.size == 0:
             return rb
@@ -841,7 +849,12 @@ class Roaring64NavigableMap:
         bounds = np.append(starts, v.size)
         for i, h in enumerate(highs):
             lows = (v[bounds[i]:bounds[i + 1]] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-            rb._map[int(h)] = RoaringBitmap.from_values(lows)
+            if rb._supplier is RoaringBitmap:
+                rb._map[int(h)] = RoaringBitmap.from_values(lows)
+            else:  # pluggable backend: bulk-ingest into a supplied bucket
+                b = rb._supplier()
+                b.add_many(lows)
+                rb._map[int(h)] = b
         rb._invalidate()
         return rb
 
@@ -933,7 +946,7 @@ class Roaring64NavigableMap:
         h = x >> 32
         b = self._map.get(h)
         if b is None:
-            b = RoaringBitmap()
+            b = self._supplier()
             self._map[h] = b
             self._sorted_highs = None
         b.add(x & 0xFFFFFFFF)
@@ -966,7 +979,10 @@ class Roaring64NavigableMap:
         for h in range(h_first, h_last + 1):
             lo = start & 0xFFFFFFFF if h == h_first else 0
             hi = ((stop - 1) & 0xFFFFFFFF) + 1 if h == h_last else 1 << 32
-            b = self._map.setdefault(h, RoaringBitmap())
+            b = self._map.get(h)
+            if b is None:
+                b = self._supplier()
+                self._map[h] = b
             b.add_range(lo, hi)
         self._invalidate()
 
@@ -1125,9 +1141,11 @@ class Roaring64NavigableMap:
                             for b in self._map.values())
 
     def __reduce__(self):
-        """Pickle in the legacy format (which carries signedLongs)."""
-        return (Roaring64NavigableMap.deserialize_legacy,
-                (self.serialize_legacy(),))
+        """Pickle in the legacy format (which carries signedLongs); the
+        supplier rides alongside so a pluggable backend survives the
+        round-trip (the wire format itself has no supplier field)."""
+        return (_restore_navigable_map,
+                (self.serialize_legacy(), self._supplier))
 
     # ------------------------------------------------------------- interop
     def to_roaring64(self) -> Roaring64Bitmap:
@@ -1152,3 +1170,16 @@ class Roaring64NavigableMap:
             out._map[high] = RoaringBitmap(rb32.keys.copy(),
                                            list(rb32.containers))
         return out
+
+
+def _restore_navigable_map(blob: bytes, supplier) -> Roaring64NavigableMap:
+    """Pickle restore: legacy-format payload + re-bucketing under the
+    original supplier (module-level so pickle can name it)."""
+    nm = Roaring64NavigableMap.deserialize_legacy(blob)
+    nm._supplier = supplier or RoaringBitmap
+    if nm._supplier is not RoaringBitmap:
+        for h, b in list(nm._map.items()):
+            fresh = nm._supplier()
+            fresh.ior(b)
+            nm._map[h] = fresh
+    return nm

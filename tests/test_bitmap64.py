@@ -456,3 +456,35 @@ def test_art_hostile_streams(rng):
     # the auto-detecting entry names both formats on garbage
     with pytest.raises(InvalidRoaringFormat, match="neither portable"):
         Roaring64Bitmap.deserialize(b"\x07\x03" * 9)
+
+
+def test_navigable_map_supplier(rng):
+    """BitmapDataProviderSupplier analog: the 32-bit bucket backend is
+    pluggable (Roaring64NavigableMap.java ctor overloads) — FastRank for
+    rank-heavy use, MutableRoaringBitmap for the buffer tier."""
+    from roaringbitmap_tpu.buffer import MutableRoaringBitmap
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64NavigableMap
+    from roaringbitmap_tpu.core.fastrank import FastRankRoaringBitmap
+
+    vals = rng.integers(0, 1 << 40, 5000, dtype=np.uint64)
+    plain = Roaring64NavigableMap.from_values(vals)
+    for supplier in (FastRankRoaringBitmap, MutableRoaringBitmap):
+        nm = Roaring64NavigableMap.from_values(vals, supplier=supplier)
+        assert all(isinstance(b, supplier) for b in nm._map.values())
+        assert nm.cardinality == plain.cardinality
+        assert nm.select(17) == plain.select(17)
+        assert nm.rank(int(vals[0])) == plain.rank(int(vals[0]))
+        nm.add((1 << 52) + 5)         # fresh high word: add() allocates
+        assert isinstance(nm._map[(1 << 52) >> 32], supplier)
+        nm.add_range(1 << 50, (1 << 50) + 10)  # and so does add_range()
+        assert isinstance(nm._map[(1 << 50) >> 32], supplier)
+        # supplier-backed buckets serialize interchangeably with plain ones
+        rt = Roaring64NavigableMap.deserialize_portable(
+            nm.serialize_portable())
+        nm_plain = Roaring64NavigableMap.from_values(nm.to_array())
+        assert rt == nm_plain
+        import pickle
+
+        back = pickle.loads(pickle.dumps(nm))
+        assert back == nm_plain
+        assert all(isinstance(b, supplier) for b in back._map.values())

@@ -747,3 +747,69 @@ def test_with_yourself_64():
     assert b1 == _rb64(*vals)
     b1.iandnot(b1)
     assert b1.is_empty()
+
+
+# ------------------------------------------------- orNot truncation suite
+# OrNotTruncationTest.java:17-63: a's members AT/ABOVE range_end must
+# survive orNot regardless of the other operand's container-kind mix.
+
+def _truncation_others():
+    yield RoaringBitmap()
+    yield RoaringBitmap.bitmap_of(2)
+    yield RoaringBitmap.bitmap_of(2, 3, 4)
+    b = RoaringBitmap(); b.add_range(2, 5); yield b
+    b = RoaringBitmap(); b.add_range(3, 5); yield b
+    b = RoaringBitmap(); b.add_range(1, 10); b.remove_range(2, 10); yield b
+    yield RoaringBitmap.from_values(np.arange(7, dtype=np.uint32))
+    for seed in (0, 1):
+        yield _mixed_container_bitmap(seed)
+    shifted = _mixed_container_bitmap(2).add_offset(1 << 16)
+    yield shifted  # kinds starting at chunk 1, like withArrayAt(1) etc.
+
+
+def test_ornot_does_not_truncate():
+    from roaringbitmap_tpu.core.bitmap import or_not
+
+    for other in _truncation_others():
+        one = RoaringBitmap.bitmap_of(0, 10)
+        got = or_not(one, other, 7)
+        assert got.contains(10), "orNot truncated a member above range_end"
+        assert got.contains(0)
+
+
+# ------------------------------------- interval intersection/containment
+# RoaringBitmapIntervalIntersectionTest.java: intersects(min, sup) and
+# contains(min, sup) must agree with the materialized-range oracle across
+# container-kind mixes and the 2^31 sign boundary.
+
+def _interval_cases():
+    yield RoaringBitmap.bitmap_of(1, 2, 3), 0, 1 << 16
+    yield RoaringBitmap.bitmap_of((1 << 31) | (1 << 30)), 0, 1 << 16
+    yield RoaringBitmap.bitmap_of((1 << 31) | (1 << 30)), 0, 256
+    yield RoaringBitmap.bitmap_of(1, (1 << 31) | (1 << 30)), 0, 256
+    yield RoaringBitmap.bitmap_of(1, 1 << 16, (1 << 31) | (1 << 30)), 0, 1 << 32
+    m = _mixed_container_bitmap(3)
+    m.add_range(70000, 150000)
+    yield m, 70000, 150000
+    yield m, 71000, 140000
+    yield _mixed_container_bitmap(4), 67000, 150000
+    big = _mixed_container_bitmap(5)
+    big.add_many(((200 << 16) + np.arange(0, 60000, 3)).astype(np.uint32))
+    yield big, 199 << 16, (200 << 16) + (1 << 14)
+
+
+@pytest.fixture(scope="module")
+def interval_cases():
+    return list(_interval_cases())
+
+
+@pytest.mark.parametrize("case", range(9))
+def test_interval_intersects_and_contains(interval_cases, case):
+    bitmap, lo, hi = interval_cases[case]
+    rng_bm = RoaringBitmap.from_range(lo, hi)
+    assert bitmap.intersects_range(lo, hi) == bitmap.intersects(rng_bm)
+    want_contains = (not rng_bm.is_empty()) and rng_bm.is_subset_of(bitmap)
+    assert bitmap.contains_range(lo, hi) == want_contains
+    assert rng_bm.is_empty() or rng_bm.contains_range(lo, hi)
+    if bitmap.contains_range(lo, hi) and lo < hi:
+        assert bitmap.intersects_range(lo, hi)

@@ -803,7 +803,7 @@ def interval_cases():
     return list(_interval_cases())
 
 
-@pytest.mark.parametrize("case", range(9))
+@pytest.mark.parametrize("case", range(len(list(_interval_cases()))))
 def test_interval_intersects_and_contains(interval_cases, case):
     bitmap, lo, hi = interval_cases[case]
     rng_bm = RoaringBitmap.from_range(lo, hi)
@@ -813,3 +813,81 @@ def test_interval_intersects_and_contains(interval_cases, case):
     assert rng_bm.is_empty() or rng_bm.contains_range(lo, hi)
     if bitmap.contains_range(lo, hi) and lo < hi:
         assert bitmap.intersects_range(lo, hi)
+
+
+# ------------------------------------------------------ subset param matrix
+# RoaringBitmapSubsetTest.java:15-140: contains(RoaringBitmap) across every
+# container-kind pairing, verified against the Python-set oracle.
+
+def _subset_cases():
+    def rng_set(lo, hi):  # closed range like ContiguousSet
+        return np.arange(lo, hi + 1, dtype=np.uint32)
+
+    div4_15 = np.arange(4, (1 << 15) + 1, 4, dtype=np.uint32)
+    div4_16 = np.arange(4, (1 << 16) + 1, 4, dtype=np.uint32)
+    a = np.array
+    return [
+        (a([1, 2, 3, 4]), a([2, 3])),                 # array vs array
+        (a([1, 2, 3, 4]), a([], np.uint32)),          # array vs empty
+        (a([1, 2, 3, 4]), a([1, 2, 3, 4])),           # identical arrays
+        (a([10, 12, 14, 15]), a([1, 2, 3, 4])),       # disjoint arrays
+        (a([10, 12, 14]), a([1, 2, 3, 4])),           # card mismatch
+        (rng_set(1, 1 << 8), a([1, 2, 3, 4])),        # run vs array subset
+        (rng_set(1, 1 << 16), a([1, 2, 3, 4])),
+        (rng_set(1, 1 << 16), a([], np.uint32)),      # run vs empty
+        (rng_set(1, 1 << 16), rng_set(1, 1 << 16)),   # identical runs
+        (rng_set(1, 1 << 20), rng_set(1, 1 << 20)),   # identical 2-cont runs
+        (rng_set(1, 1 << 16), a([(1 << 16) + i for i in (1, 2, 3, 4)])),
+        (rng_set(3, 1 << 16), a([1, 2])),
+        (rng_set(1, 1 << 8), rng_set(1 << 4, 1 << 12)),  # run/run shift
+        (rng_set(1, 1 << 20), a([1, 1 << 8])),
+        (rng_set(1, 1 << 20), a([1 << 6, 1 << 26])),
+        (a([1, 1 << 16]), rng_set(0, 1 << 20)),
+        (div4_15, a([4, 8])),                         # bitmap vs array
+        (div4_16, div4_15),                           # bitmap card mismatch
+        (div4_15, a([], np.uint32)),                  # bitmap vs empty
+        (div4_15, div4_15),                           # identical bitmaps
+        (a([3, 7]), div4_15),                         # array vs bitmap
+    ]
+
+
+@pytest.mark.parametrize("case", range(len(_subset_cases())))
+def test_subset_param_matrix(subset_cases, case):
+    sup_v, sub_v = subset_cases[case]
+    superset = RoaringBitmap.from_values(np.asarray(sup_v, dtype=np.uint32))
+    superset.run_optimize()
+    subset = RoaringBitmap.from_values(np.asarray(sub_v, dtype=np.uint32))
+    subset.run_optimize()  # run containers on the SUBSET side too
+    want = set(np.asarray(sub_v).tolist()) <= set(np.asarray(sup_v).tolist())
+    assert subset.is_subset_of(superset) == want
+    # and symmetric probes for free
+    assert superset.is_subset_of(superset)
+    assert RoaringBitmap().is_subset_of(superset)
+
+
+@pytest.fixture(scope="module")
+def subset_cases():
+    return _subset_cases()
+
+
+def test_pickle_roundtrip_all_classes(rng):
+    """KryoTest analog: every serializable class round-trips through
+    pickle (the reference round-trips RoaringBitmap/Roaring64NavigableMap
+    through Kryo, KryoTest.java)."""
+    import pickle
+
+    from roaringbitmap_tpu import (Roaring64Bitmap, Roaring64NavigableMap)
+    from roaringbitmap_tpu.core.fastrank import FastRankRoaringBitmap
+
+    rb = _mixed_container_bitmap(6)
+    rb.run_optimize()
+    assert pickle.loads(pickle.dumps(rb)) == rb
+    fr = FastRankRoaringBitmap(rb.keys, rb.containers)
+    back = pickle.loads(pickle.dumps(fr))
+    assert back == fr and isinstance(back, FastRankRoaringBitmap)
+    v = rng.integers(0, 1 << 44, 3000, dtype=np.uint64)
+    r64 = Roaring64Bitmap.from_values(v)
+    assert pickle.loads(pickle.dumps(r64)) == r64
+    nm = Roaring64NavigableMap.from_values(v, signed_longs=True)
+    back = pickle.loads(pickle.dumps(nm))
+    assert back == nm and back.signed_longs

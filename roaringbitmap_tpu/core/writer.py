@@ -19,7 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from . import containers as C
-from .bitmap import RoaringBitmap, or_ as rb_or
+from .bitmap import RoaringBitmap
 
 
 class RoaringBitmapWriter:
@@ -115,13 +115,19 @@ class RoaringBitmapWriter:
         # starting container type.
         if self.run_compress:
             chunk.run_optimize()
-        self._result = rb_or(self._result, chunk)
+        self._result.ior(chunk)  # O(delta): touches only the chunk's keys
 
     def get(self) -> RoaringBitmap:
         """Flush and return the built bitmap (underlying() / get())."""
         self.flush()
         if self.run_compress:
             self._result.run_optimize()
+        return self._result
+
+    def get_underlying(self) -> RoaringBitmap:
+        """The raw underlying bitmap WITHOUT flushing
+        (RoaringBitmapWriter.getUnderlying's expert contract: buffered
+        adds are not visible until flush())."""
         return self._result
 
     def reset(self) -> None:

@@ -423,3 +423,34 @@ class TestImmutableLongTail:
         sel = im.select_range(150, 250)
         assert sel == rb.select_range(150, 250)
         assert im.limit(5) == rb.limit(5)
+
+
+class TestWriterRandomised:
+    """RoaringBitmapWriterRandomisedTest: the writer must build the same
+    bitmap as bulk construction for random unordered inputs across four
+    orders of magnitude, via point adds, add_many, and both appender
+    strategies (shouldBuildSameBitmapAsBitmapOf*)."""
+
+    @pytest.mark.parametrize("n", [4, 0, 10, 100, 1000, 10_000, 100_000])
+    def test_point_adds_match_bulk(self, rng, n):
+        values = (np.arange(4, dtype=np.uint32) if n == 4
+                  else rng.integers(0, 1 << 26, n).astype(np.uint32))
+        want = RoaringBitmap.from_values(values)
+        w = RoaringBitmapWriter.wizard().get()
+        for v in values.tolist():
+            w.add(int(v))
+        w.flush()
+        assert w.get_underlying() == want
+
+    @pytest.mark.parametrize("n", [1000, 100_000])
+    @pytest.mark.parametrize("constant_memory", [False, True])
+    def test_add_many_matches_bulk(self, rng, n, constant_memory):
+        values = rng.integers(0, 1 << 28, n).astype(np.uint32)
+        want = RoaringBitmap.from_values(values)
+        wiz = RoaringBitmapWriter.wizard()
+        if constant_memory:
+            wiz = wiz.constant_memory()
+        w = wiz.get()
+        w.add_many(values)
+        w.flush()
+        assert w.get_underlying() == want

@@ -15,6 +15,7 @@ bitmap holds at most 2^16 values (256 KB) at a time.
 
 from __future__ import annotations
 
+import copy
 import numpy as np
 
 
@@ -103,9 +104,7 @@ class PeekableIntIterator:
                 self._load(self._ci + 1)
 
     def clone(self) -> "PeekableIntIterator":
-        out = self.__class__.__new__(self.__class__)
-        out.__dict__ = dict(self.__dict__)
-        return out
+        return copy.copy(self)
 
     def __iter__(self):
         while self.has_next():
@@ -172,6 +171,11 @@ class ReverseIntIterator:
         if self._pos < 0:
             self._load(self._ci - 1)
         return v
+
+    def clone(self) -> "ReverseIntIterator":
+        """Independent cursor over the same snapshot
+        (ReverseIntIteratorFlyweight.clone)."""
+        return copy.copy(self)
 
     def __iter__(self):
         while self.has_next():
@@ -258,6 +262,12 @@ class RoaringBatchIterator:
             if self._pos >= self._cur.size:
                 self._cur = None
                 self._ci += 1
+
+    def clone(self) -> "RoaringBatchIterator":
+        """Independent cursor over the same container snapshot
+        (RoaringBatchIterator.clone / CloneBatchIteratorTest): clones
+        advance separately; the shared containers are persistent."""
+        return copy.copy(self)
 
     def __iter__(self):
         while self.has_next():

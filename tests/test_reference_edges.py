@@ -891,3 +891,33 @@ def test_pickle_roundtrip_all_classes(rng):
     nm = Roaring64NavigableMap.from_values(v, signed_longs=True)
     back = pickle.loads(pickle.dumps(nm))
     assert back == nm and back.signed_longs
+
+
+def test_batch_iterator_clone_independence(rng):
+    """CloneBatchIteratorTest.java: a cloned batch iterator advances
+    independently of its source, from any mid-iteration position, and the
+    same holds for the value-iterator flyweights."""
+    vals = np.concatenate([np.array([1, 10, 20, 65560, 70000], np.uint32),
+                           rng.integers(0, 1 << 22, 20000).astype(np.uint32)])
+    rb = RoaringBitmap.from_values(vals)
+    arr = rb.to_array()
+    it1 = rb.get_batch_iterator(7)
+    consumed = [it1.next_batch() for _ in range(3)]
+    it2 = it1.clone()
+    rest1 = np.concatenate(list(it1)) if it1.has_next() else np.empty(0)
+    rest2 = np.concatenate(list(it2)) if it2.has_next() else np.empty(0)
+    np.testing.assert_array_equal(rest1, rest2)
+    np.testing.assert_array_equal(
+        np.concatenate(consumed + [rest1]), arr)
+    # clone after seek keeps the seek position
+    it3 = rb.get_batch_iterator(16)
+    it3.advance_if_needed(int(arr[arr.size // 2]))
+    it4 = it3.clone()
+    np.testing.assert_array_equal(np.concatenate(list(it3)),
+                                  np.concatenate(list(it4)))
+    # reverse flyweight clone
+    rit = rb.get_reverse_int_iterator()
+    for _ in range(5):
+        rit.next()
+    rit2 = rit.clone()
+    assert list(rit) == list(rit2)

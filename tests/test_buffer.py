@@ -237,3 +237,22 @@ class TestLazyBufferTier:
         out = im.to_bitmap()
         out.add(12345)
         assert out.contains(12345) and not im.contains(12345)
+
+
+@pytest.mark.parametrize("name", [f"crashproneinput{i}.bin"
+                                  for i in range(1, 9)])
+def test_buffer_adversarial_inputs(name):
+    """TestBufferAdversarialInputs.java: the zero-copy buffer tier must
+    reject every crash-prone corpus input with InvalidRoaringFormat — at
+    wrap or at first decode — never a crash or silent misparse."""
+    from roaringbitmap_tpu.format.spec import InvalidRoaringFormat
+
+    path = os.path.join(TESTDATA, name)
+    if not os.path.exists(path):
+        pytest.skip("reference corpus not mounted")
+    with open(path, "rb") as f:
+        raw = f.read()
+    with pytest.raises(InvalidRoaringFormat):
+        b = ImmutableRoaringBitmap(raw)
+        for c in b.containers:  # force the lazy decode of every slot
+            c.cardinality

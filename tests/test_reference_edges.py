@@ -921,3 +921,70 @@ def test_batch_iterator_clone_independence(rng):
         rit.next()
     rit2 = rit.clone()
     assert list(rit) == list(rit2)
+
+
+# ------------------------------------------------------- range op sweeps
+# TestRange.java:569-760: exhaustive small-range sweeps where range ops
+# must equal the point-op fold, across boundary alignments.
+
+def test_clear_ranges_sweep():
+    # testClearRanges:569-584
+    N = 16
+    for end in range(1, N):
+        for start in range(end):
+            a = RoaringBitmap.from_range(0, N)
+            for k in range(start, end):
+                a.remove(k)
+            b = RoaringBitmap.from_range(0, N)
+            b.remove_range(start, end)
+            assert a == b, (start, end)
+
+
+def test_flip_ranges_sweep():
+    # testFlipRanges:587-601 (N reduced: per-point flip is the slow oracle)
+    N = 64
+    for end in range(1, N):
+        for start in range(end):
+            a = RoaringBitmap()
+            for k in range(start, end):
+                a.flip_range(k, k + 1)
+            b = RoaringBitmap()
+            b.flip_range(start, end)
+            assert b.cardinality == end - start
+            assert a == b, (start, end)
+
+
+def test_set_ranges_sweep():
+    # testSetRanges:706-719 — point-add oracle at small N, then the full
+    # N=256 sweep (covering 64-bit word boundary crossings) against the
+    # independent bulk-construction path
+    for end in range(1, 16):
+        for start in range(end):
+            a = RoaringBitmap()
+            for k in range(start, end):
+                a.add(k)
+            b = RoaringBitmap()
+            b.add_range(start, end)
+            assert a == b, (start, end)
+    N = 256
+    for end in range(1, N):
+        for start in range(end):
+            b = RoaringBitmap()
+            b.add_range(start, end)
+            want = RoaringBitmap.from_values(
+                np.arange(start, end, dtype=np.uint32))
+            assert b == want, (start, end)
+
+
+def test_range_removal_idempotent():
+    # testRangeRemoval:604-617
+    bm = RoaringBitmap()
+    bm.add(1)
+    bm.run_optimize()
+    bm.remove_run_compression()
+    assert bm.cardinality == 1 and bm.contains(1)
+    bm.remove_range(0, 1)   # no-op
+    assert bm.cardinality == 1
+    bm.remove_range(1, 2)
+    bm.remove_range(1, 2)   # second removal of the same range: no-op
+    assert bm.is_empty()

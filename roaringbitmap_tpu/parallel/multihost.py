@@ -1,0 +1,107 @@
+"""Multi-host bootstrap for the sharded engine (SURVEY §2.7 / brief:
+"distributed comm backend that scales to multi-host").
+
+The reference's only parallelism is a single-JVM ForkJoinPool; this
+framework's scale-out axis is a jax.sharding.Mesh, and every sharded entry
+point (parallel.sharding.wide_aggregate_sharded, ShardedBSI,
+ShardedRangeBitmap) takes an arbitrary mesh.  This module provides the two
+pieces a multi-host deployment needs around those entry points:
+
+- ``initialize()`` — jax.distributed.initialize wrapper (the NCCL/MPI-rank
+  analog: one process per host, a coordinator address, and a process id).
+- ``global_mesh()`` — a (rows, lanes) mesh over ALL hosts' devices, laid
+  out so the row axis (the ppermute OR/XOR butterfly — the heavy,
+  accumulator-sized traffic) stays within each host's ICI domain and the
+  lane axis (the final cardinality psum — scalars per key) is the axis
+  that crosses DCN.  Collectives ride ICI where the bytes are.
+
+On a single host both degenerate to the local mesh the tests and dryrun
+use, so the same program text runs from one chip to a multi-host pod —
+that is the whole point of expressing the backend as mesh + collectives
+instead of explicit rank-to-rank sends.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join (or bootstrap) the multi-host runtime.
+
+    No-arg form uses the cluster environment (TPU pod metadata / launcher
+    env vars), matching jax.distributed.initialize's auto-detection; the
+    explicit form mirrors an MPI-style rank launch.  Call once per
+    process, before any backend use.  Single-process runs may skip this
+    entirely.
+    """
+    import jax
+
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id)
+
+
+def global_mesh(lanes: int | None = None,
+                row_axis: str = "rows", lane_axis: str = "lanes"):
+    """A (rows, lanes) mesh over every device of every participating host.
+
+    Device placement: each mesh COLUMN (a fixed lane, all rows) is filled
+    with devices of a single process wherever the factorization allows,
+    grouping by ``device.process_index`` rather than trusting global
+    device-id order (which interleaves hosts on some TPU topologies).  The
+    row axis carries the ppermute butterfly — accumulator-sized traffic
+    that should ride intra-host ICI — while the lane axis (scalar
+    cardinality psums) is the one that crosses hosts/DCN.  The default row
+    length is the largest power of two dividing every process's local
+    device count, making host-pure columns by construction; an explicit
+    ``lanes`` that forces rows to span hosts is honored (the user asked
+    for it), falling back to process-ordered placement.  Row length must
+    be a power of two (the butterfly pairs partners by XOR).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    arr = _arrange(jax.devices(), lanes)
+    return Mesh(arr, (row_axis, lane_axis))
+
+
+def _arrange(devices, lanes: int | None) -> np.ndarray:
+    """Pure placement: (rows, lanes) object array per global_mesh's
+    contract — host-pure row columns whenever the factorization allows."""
+    n = len(devices)
+    by_proc: dict[int, list] = {}
+    for d in devices:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    local_counts = [len(v) for v in by_proc.values()]
+    if lanes is None:
+        rows = 1 << (min(local_counts).bit_length() - 1)
+        while rows > 1 and any(lc % rows for lc in local_counts):
+            rows >>= 1
+        lanes = n // rows
+    if lanes < 1 or n % lanes:
+        raise ValueError(
+            f"lane axis {lanes} does not divide the {n} global devices")
+    rows = n // lanes
+    if rows & (rows - 1):
+        raise ValueError(
+            f"row axis {rows} (= {n} devices / {lanes} lanes) must be a "
+            "power of two: the bitwise reduce butterfly pairs partners by "
+            "XOR; pick a different lane count")
+    if all(lc % rows == 0 for lc in local_counts):
+        # host-pure columns: chunk each process's devices into row groups
+        cols = []
+        for pid in sorted(by_proc):
+            ds = by_proc[pid]
+            cols.extend(ds[i:i + rows] for i in range(0, len(ds), rows))
+        arr = np.empty((lanes, rows), dtype=object)
+        for j, col in enumerate(cols):
+            arr[j, :] = col
+        return arr.T
+    # explicit lanes forcing rows to straddle hosts (the user asked)
+    ordered = [d for pid in sorted(by_proc) for d in by_proc[pid]]
+    arr = np.empty((lanes, rows), dtype=object)
+    for j in range(lanes):
+        arr[j, :] = ordered[j * rows:(j + 1) * rows]
+    return arr.T

@@ -287,3 +287,59 @@ def test_sharded_chunked_wide_keyspace(mesh8, ingest):
     # the ceiling constant must still equal the documented 32 MiB budget
     # (8 KiB per key row), independent recomputation not a tautology
     assert sharding.MAX_KEYS_PER_SHARD_PASS * 8192 == 32 << 20
+
+
+def test_global_mesh_single_host(workload, oracle_or):
+    """multihost.global_mesh degenerates to the local mesh on one host and
+    feeds the sharded engine unchanged — the same program text scales to a
+    pod by changing only the launcher."""
+    from roaringbitmap_tpu.parallel import multihost
+
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    r, l = mesh.devices.shape
+    assert r * l == 8 and r & (r - 1) == 0
+    # single host: every device is local, so the butterfly row axis takes
+    # them all and consecutive devices are row-adjacent
+    assert (r, l) == (8, 1)
+    assert [d.id for d in mesh.devices[:, 0]] == sorted(
+        d.id for d in jax.devices())
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "or", workload)
+    assert packing.unpack_result(keys, words, cards) == oracle_or
+    # explicit lane counts, incl. every valid factorization
+    for lanes in (1, 2, 4, 8):
+        m = multihost.global_mesh(lanes=lanes)
+        assert m.devices.shape == (8 // lanes, lanes)
+    with pytest.raises(ValueError, match="does not divide"):
+        multihost.global_mesh(lanes=3)
+
+
+def test_global_mesh_groups_by_process():
+    """Multi-host placement (pure _arrange): row columns are host-pure
+    even when the global device order interleaves hosts, and the default
+    row length divides every process's local count."""
+    from roaringbitmap_tpu.parallel import multihost
+
+    class Dev:
+        def __init__(self, i, p):
+            self.id, self.process_index = i, p
+
+        def __repr__(self):
+            return f"d{self.id}@p{self.process_index}"
+
+    # 2 hosts x 6 devices, ids interleaved across hosts
+    devs = [Dev(i, i % 2) for i in range(12)]
+    arr = multihost._arrange(devs, lanes=None)
+    rows, lanes = arr.shape
+    assert rows == 2 and lanes == 6  # pow2 floor dividing local count 6
+    for j in range(lanes):  # every column single-process
+        assert len({d.process_index for d in arr[:, j]}) == 1
+    # both hosts contribute whole columns
+    procs = [arr[0, j].process_index for j in range(lanes)]
+    assert procs == [0, 0, 0, 1, 1, 1]
+    # all 12 devices placed exactly once
+    assert sorted(d.id for d in arr.ravel()) == list(range(12))
+    # explicit lanes that force cross-host rows still place every device
+    arr2 = multihost._arrange(devs, lanes=3)
+    assert arr2.shape == (4, 3)
+    assert sorted(d.id for d in arr2.ravel()) == list(range(12))

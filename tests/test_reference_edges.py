@@ -988,3 +988,79 @@ def test_range_removal_idempotent():
     bm.remove_range(1, 2)
     bm.remove_range(1, 2)   # second removal of the same range: no-op
     assert bm.is_empty()
+
+
+# ------------------------------------------------------ orNot numbered cases
+# TestRoaringBitmapOrNot.java:26-380 — the deterministic orNot regressions
+# (the fuzz model covers the bulk; these pin the exact shapes that broke).
+
+def _ornot(a: RoaringBitmap, b: RoaringBitmap, end: int) -> RoaringBitmap:
+    from roaringbitmap_tpu.core.bitmap import or_not
+    return or_not(a, b, end)
+
+
+def test_ornot_numbered_cases():
+    # orNot1: complement fills to a dense prefix
+    rb = RoaringBitmap.bitmap_of(2, 1, 1 << 16, 2 << 16, 3 << 16)
+    rb2 = RoaringBitmap.bitmap_of(1 << 16, 3 << 16)
+    got = _ornot(rb, rb2, (4 << 16) - 1)
+    assert got.cardinality == (4 << 16) - 1
+    np.testing.assert_array_equal(
+        got.to_array(), np.arange((4 << 16) - 1, dtype=np.uint32))
+    # orNot2: the only excluded position is b's single member
+    rb = RoaringBitmap.bitmap_of(0, 1 << 16, 3 << 16)
+    rb2 = RoaringBitmap.bitmap_of((4 << 16) - 1)
+    got = _ornot(rb, rb2, 4 << 16)
+    assert got.cardinality == (4 << 16) - 1
+    np.testing.assert_array_equal(
+        got.to_array(), np.arange((4 << 16) - 1, dtype=np.uint32))
+    # orNot10: range_end below b's only member; a's last survives
+    got = _ornot(RoaringBitmap.bitmap_of(5), RoaringBitmap.bitmap_of(10), 6)
+    assert got.last() == 5
+    # orNot11: extreme high chunks, sparse b far below range_end
+    hi = 65535 * 65536 + 65523
+    got = _ornot(RoaringBitmap.bitmap_of(hi),
+                 RoaringBitmap.bitmap_of(65493 * 65536 + 65520), hi + 1)
+    assert got.last() == hi
+
+
+def test_ornot_against_full_bitmap():
+    # orNotAgainstFullBitmap / NonEmpty / Static variants:345-380
+    full = RoaringBitmap.from_range(0, 0x40000)
+    assert _ornot(RoaringBitmap(), full, 0x30000).is_empty()
+    rb = RoaringBitmap.bitmap_of(1, 0x10001, 0x20001)
+    assert _ornot(rb, full, 0x30000) == rb
+
+
+# ------------------------------------------------------- rank iterator sweep
+# TestRankIterator.java:38-79: peekNextRank must equal bitmap.rank(next)
+# at every position, both stepping singly and seeking by varied strides.
+
+@pytest.mark.parametrize("advance", [0, 1, 3, 5, 7, 11, 131, 65537])
+def test_rank_iterator_advance_sweep(advance):
+    from roaringbitmap_tpu.core.iterators import PeekableIntRankIterator
+
+    rb = _mixed_container_bitmap(8)
+    # the withFull variant: a dense run spanning the chunk-0/1 boundary
+    # (reference uses 262144; 70k keeps the per-position Python sweep fast
+    # while still crossing container boundaries mid-iteration), plus
+    # members at the top of the universe so the overflow guard below is
+    # genuinely reachable
+    rb.add_range(0, 70000)
+    rb.add_many(np.array([0xFFFFFFFE, 0xFFFFFFFF], dtype=np.uint32))
+    it = PeekableIntRankIterator(rb)
+    if advance == 0:
+        n = 0
+        while it.has_next():
+            n += 1
+            assert it.peek_next_rank() == n
+            it.next()
+        assert n == rb.cardinality
+    else:
+        while it.has_next():
+            bit = it.peek_next()
+            assert it.peek_next_rank() == rb.rank(bit)
+            if bit + advance < 0xFFFFFFFF:
+                it.advance_if_needed(bit + advance)
+            else:
+                break

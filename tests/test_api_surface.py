@@ -454,3 +454,47 @@ class TestWriterRandomised:
         w.add_many(values)
         w.flush()
         assert w.get_underlying() == want
+
+
+class TestRoaringBitSetModel:
+    """RoaringBitSetTest.testLogicalIdentities analog: randomized BitSet
+    surface vs a Python-set oracle (the reference models against
+    java.util.BitSet)."""
+
+    def test_randomized_vs_set_oracle(self, rng):
+        bs = RoaringBitSet()
+        ref: set[int] = set()
+        universe = 1 << 18
+        for _ in range(400):
+            op = int(rng.integers(5))
+            i = int(rng.integers(universe))
+            j = i + int(rng.integers(1, 5000))
+            if op == 0:
+                bs.set(i)
+                ref.add(i)
+            elif op == 1:
+                bs.set(i, j)
+                ref.update(range(i, j))
+            elif op == 2:
+                bs.clear(i, j)
+                ref.difference_update(range(i, j))
+            elif op == 3:
+                bs.flip(i, j)
+                ref.symmetric_difference_update(range(i, j))
+            else:
+                assert bs.get(i) == (i in ref)
+        assert sorted(bs.stream().tolist()) == sorted(ref)
+        assert bs.cardinality() == len(ref)
+        if ref:
+            assert bs.length() == max(ref) + 1
+            probe = min(ref)
+            assert bs.next_set_bit(probe) == probe
+        # logical identities vs a second random set
+        other_vals = rng.integers(0, universe, 4000).astype(np.uint32)
+        other = RoaringBitSet(RoaringBitmap.from_values(other_vals))
+        oref = set(other_vals.tolist())
+        for name, fold in (("and_", ref & oref), ("or_", ref | oref),
+                           ("xor", ref ^ oref), ("and_not", ref - oref)):
+            c = RoaringBitSet(bs.to_bitmap().clone())
+            getattr(c, name)(other)
+            assert sorted(c.stream().tolist()) == sorted(fold), name

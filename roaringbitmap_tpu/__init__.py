@@ -29,6 +29,11 @@ from .core.bitmap import (
     xor_cardinality,
 )
 from .core import containers
+
+# camelCase-familiar aliases (RoaringBitmap.andNot / andNotCardinality)
+and_not = andnot
+and_not_cardinality = andnot_cardinality
+
 from .core.bitmap64 import Roaring64Bitmap, Roaring64NavigableMap
 from .core.bitset import RoaringBitSet
 from .core.fastrank import FastRankRoaringBitmap
@@ -41,8 +46,9 @@ __all__ = [
     "RoaringBitmap", "Roaring64Bitmap", "Roaring64NavigableMap",
     "RangeBitmap", "FastRankRoaringBitmap", "RoaringBitSet",
     "RoaringBitmapWriter",
-    "and_", "or_", "xor", "andnot", "or_not", "flip",
-    "and_cardinality", "or_cardinality", "xor_cardinality", "andnot_cardinality",
+    "and_", "or_", "xor", "andnot", "and_not", "or_not", "flip",
+    "and_cardinality", "or_cardinality", "xor_cardinality",
+    "andnot_cardinality", "and_not_cardinality",
     "containers", "spec", "InvalidRoaringFormat",
 ]
 

@@ -402,6 +402,38 @@ class RoaringBitmap:
             np.asarray(values, dtype=np.uint32))
 
     @staticmethod
+    def bitmap_of_range(start: int, stop: int) -> "RoaringBitmap":
+        """bitmapOfRange(long, long): alias of from_range."""
+        return RoaringBitmap.from_range(start, stop)
+
+    def append(self, key: int, container: Container) -> None:
+        """Expert API: append a container at a key strictly above the last
+        (RoaringBitmap.append:3237 / RoaringArray.append:111); raises on
+        out-of-order keys instead of corrupting the index."""
+        if not (0 <= key <= 0xFFFF):
+            raise ValueError(f"key {key} outside the u16 key space")
+        if self.keys.size and key <= int(self.keys[-1]):
+            raise ValueError(
+                f"append key {key} not above last key {int(self.keys[-1])}")
+        if container.cardinality == 0:
+            raise ValueError(
+                "append of an empty container (the wire format has no "
+                "empty-slot encoding)")
+        self._insert(int(self.keys.size), np.uint16(key), container)
+
+    def get_container_pointer(self) -> "ContainerPointer":
+        """Expert container cursor (getContainerPointer /
+        ContainerPointer.java:16-61)."""
+        return ContainerPointer(self)
+
+    def to_mutable_roaring_bitmap(self):
+        """Copy into the buffer tier's mutable class
+        (toMutableRoaringBitmap:3243)."""
+        from ..buffer import MutableRoaringBitmap
+
+        return MutableRoaringBitmap(self.keys.copy(), list(self.containers))
+
+    @staticmethod
     def maximum_serialized_size(cardinality: int, universe_size: int) -> int:
         """Analytic bound (RoaringBitmap.maximumSerializedSize:3030)."""
         from ..format import spec
@@ -758,6 +790,44 @@ class RoaringBitmap:
     # ------------------------------------------------------------- statistics
     def container_count(self) -> int:
         return len(self.containers)
+
+
+class ContainerPointer:
+    """Expert cursor over (key, container) slots — ContainerPointer.java.
+
+    The reference exposes this for container-granular walks (insights'
+    analyser, merge machinery); here it is a thin index cursor over the
+    SoA pair."""
+
+    def __init__(self, rb: RoaringBitmap, pos: int = 0):
+        self._rb = rb
+        self._pos = pos
+
+    def advance(self) -> None:
+        self._pos += 1
+
+    def clone(self) -> "ContainerPointer":
+        return ContainerPointer(self._rb, self._pos)
+
+    def has_container(self) -> bool:
+        return self._pos < len(self._rb.containers)
+
+    def key(self) -> int:
+        return int(self._rb.keys[self._pos])
+
+    def get_container(self) -> Container | None:
+        if not self.has_container():
+            return None
+        return self._rb.containers[self._pos]
+
+    def get_cardinality(self) -> int:
+        return self._rb.containers[self._pos].cardinality
+
+    def is_bitmap_container(self) -> bool:
+        return isinstance(self._rb.containers[self._pos], C.BitmapContainer)
+
+    def is_run_container(self) -> bool:
+        return self._rb.containers[self._pos].is_run()
 
 
 def _chunk_ranges(start: int, stop: int):

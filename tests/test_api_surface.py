@@ -498,3 +498,49 @@ class TestRoaringBitSetModel:
             c = RoaringBitSet(bs.to_bitmap().clone())
             getattr(c, name)(other)
             assert sorted(c.stream().tolist()) == sorted(fold), name
+
+
+class TestExpertSurface:
+    """The last unmapped names from the reference sweep: append (expert
+    container splice, RoaringBitmap.java:3237), getContainerPointer
+    (ContainerPointer.java:16-61), bitmapOfRange, toMutableRoaringBitmap,
+    and the camelCase-familiar andNot aliases."""
+
+    def test_append_and_pointer(self):
+        rb = RoaringBitmap.bitmap_of(1, 2, 3)
+        from roaringbitmap_tpu.core import containers as C
+
+        rb.append(5, C.ArrayContainer(np.array([7, 9], dtype=np.uint16)))
+        assert rb.contains((5 << 16) + 7) and rb.cardinality == 5
+        with pytest.raises(ValueError, match="not above"):
+            rb.append(5, C.ArrayContainer(np.array([1], dtype=np.uint16)))
+        with pytest.raises(ValueError, match="key space"):
+            rb.append(1 << 16, C.ArrayContainer(np.array([1], np.uint16)))
+        with pytest.raises(ValueError, match="empty container"):
+            rb.append(9, C.ArrayContainer(np.empty(0, np.uint16)))
+        ptr = rb.get_container_pointer()
+        seen = []
+        while ptr.has_container():
+            seen.append((ptr.key(), ptr.get_cardinality(),
+                         ptr.is_run_container(), ptr.is_bitmap_container()))
+            ptr.advance()
+        assert seen == [(0, 3, False, False), (5, 2, False, False)]
+        assert ptr.get_container() is None
+        p2 = rb.get_container_pointer()
+        p3 = p2.clone()
+        p2.advance()
+        assert p3.key() == 0 and p2.key() == 5  # clones are independent
+
+    def test_range_builder_and_mutable_conversion(self):
+        import roaringbitmap_tpu as rt
+        from roaringbitmap_tpu.buffer import MutableRoaringBitmap
+
+        rb = RoaringBitmap.bitmap_of_range(10, 200000)
+        assert rb == RoaringBitmap.from_range(10, 200000)
+        mut = rb.to_mutable_roaring_bitmap()
+        assert isinstance(mut, MutableRoaringBitmap) and mut == rb
+        mut.add(5)  # copies: the source must not see the mutation
+        assert not rb.contains(5)
+        a, b = RoaringBitmap.bitmap_of(1, 2), RoaringBitmap.bitmap_of(2)
+        assert rt.and_not(a, b) == rt.andnot(a, b)
+        assert rt.and_not_cardinality(a, b) == 1

@@ -238,6 +238,15 @@ class Roaring64Bitmap:
         ints don't overflow, so this equals cardinality."""
         return self.cardinality
 
+    @property
+    def long_cardinality(self) -> int:
+        """getLongCardinality alias."""
+        return self.cardinality
+
+    def and_not(self, o: "Roaring64Bitmap") -> None:
+        """In-place difference, Java's andNot(other) naming."""
+        self.iandnot(o)
+
     def get_long_size_in_bytes(self) -> int:
         return self.get_size_in_bytes()
 
@@ -1045,6 +1054,91 @@ class Roaring64NavigableMap:
 
     def run_optimize(self) -> bool:
         return any([b.run_optimize() for b in self._map.values()])
+
+    # ------------------------------------------------- long-tail API parity
+    def clear(self) -> None:
+        """Empty the map (Roaring64NavigableMap.clear)."""
+        self._map = {}
+        self._invalidate()
+
+    def flip(self, x: int) -> None:
+        """Single-bit flip (flip(long))."""
+        if x in self:
+            self.remove(x)
+        else:
+            self.add(x)
+
+    def for_each(self, fn) -> None:
+        """Visit every member in the active key order (forEach/accept)."""
+        for v in self:
+            fn(v)
+
+    def get_long_iterator(self) -> Iterator[int]:
+        """Ascending (in the active order) value iterator (getLongIterator)."""
+        return iter(self)
+
+    def get_reverse_long_iterator(self) -> Iterator[int]:
+        """Descending value iterator (getReverseLongIterator)."""
+        for h in reversed(self._highs()):
+            base = (h << 32) & U64_MAX
+            for v in self._map[h].to_array()[::-1]:
+                yield base | int(v)
+
+    def limit(self, max_cardinality: int) -> "Roaring64NavigableMap":
+        """First max_cardinality members in the active order (limit)."""
+        out = Roaring64NavigableMap(self.signed_longs, self._supplier)
+        left = max_cardinality
+        for h in self._highs():
+            if left <= 0:
+                break
+            b = self._map[h]
+            take = b if b.cardinality <= left else b.limit(left)
+            bucket = self._supplier()  # keep the pluggable backend
+            bucket.ior(RoaringBitmap(take.keys.copy(),
+                                     list(take.containers)))
+            out._map[h] = bucket
+            left -= take.cardinality
+        out._invalidate()
+        return out
+
+    def trim(self) -> None:
+        """trim(): exact-sized NumPy arrays already; API parity."""
+
+    def get_size_in_bytes(self) -> int:
+        """Rough in-memory footprint (getSizeInBytes analog)."""
+        return 8 + sum(8 + b.get_size_in_bytes() for b in self._map.values())
+
+    def get_long_size_in_bytes(self) -> int:
+        return self.get_size_in_bytes()
+
+    @property
+    def long_cardinality(self) -> int:
+        """getLongCardinality alias."""
+        return self.cardinality
+
+    @property
+    def int_cardinality(self) -> int:
+        """getIntCardinality: raises when the count exceeds a signed
+        32-bit int, like the reference's UnsupportedOperationException."""
+        card = self.cardinality
+        if card > 0x7FFFFFFF:
+            raise OverflowError("cardinality exceeds a 32-bit int")
+        return card
+
+    def naive_lazy_or(self, o: "Roaring64NavigableMap") -> None:
+        """naivelazyor: the reference defers per-container cardinality
+        during OR chains and repairs at the end; here lazy repair is
+        absorbed by the fused-popcount design (SURVEY §2.7.5), so this is
+        the plain in-place union."""
+        self.ior(o)
+
+    def repair_after_lazy(self) -> None:
+        """repairAfterLazy: no deferred state to repair (see
+        naive_lazy_or)."""
+
+    def and_not(self, o: "Roaring64NavigableMap") -> None:
+        """In-place difference, Java's andNot(other) naming."""
+        self.iandnot(o)
 
     def __eq__(self, o: object) -> bool:
         if not isinstance(o, Roaring64NavigableMap):

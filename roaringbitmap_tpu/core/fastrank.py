@@ -42,6 +42,10 @@ class FastRankRoaringBitmap(RoaringBitmap):
         self._invalidate()
         super().add(x)
 
+    def append(self, key: int, container) -> None:
+        self._invalidate()
+        super().append(key, container)
+
     def remove(self, x: int) -> None:
         self._invalidate()
         super().remove(x)

@@ -488,3 +488,45 @@ def test_navigable_map_supplier(rng):
         back = pickle.loads(pickle.dumps(nm))
         assert back == nm_plain
         assert all(isinstance(b, supplier) for b in back._map.values())
+
+
+def test_navigable_map_long_tail_surface(rng):
+    """The NavigableMap's remaining reference surface (clear/flip/forEach/
+    limit/iterators/size accessors/lazy aliases), against the
+    Roaring64Bitmap twin as oracle."""
+    vals = np.unique(rng.integers(0, 1 << 40, 4000, dtype=np.uint64))
+    nm = Roaring64NavigableMap.from_values(vals)
+    seen = []
+    nm.for_each(seen.append)
+    assert seen == vals.tolist()
+    assert list(nm.get_long_iterator()) == vals.tolist()
+    assert list(nm.get_reverse_long_iterator()) == vals.tolist()[::-1]
+    assert np.array_equal(nm.limit(100).to_array(), vals[:100])
+    assert nm.limit(1 << 30) == nm
+    assert nm.long_cardinality == nm.cardinality == vals.size
+    assert nm.int_cardinality == vals.size
+    assert nm.get_size_in_bytes() == nm.get_long_size_in_bytes() > 0
+    nm.trim()
+    x = int(vals[7])
+    nm.flip(x)
+    assert x not in nm
+    nm.flip(x)
+    assert x in nm
+    lazy = Roaring64NavigableMap.from_values(vals[:100])
+    lazy.naive_lazy_or(Roaring64NavigableMap.from_values(vals[100:]))
+    lazy.repair_after_lazy()
+    assert lazy == nm
+    d = Roaring64NavigableMap.from_values(vals)
+    d.and_not(Roaring64NavigableMap.from_values(vals[::2]))
+    assert np.array_equal(d.to_array(), vals[1::2])
+    d.clear()
+    assert d.cardinality == 0
+    # signed order: reverse iterator follows the signed sequence
+    sv = np.array([5, (1 << 63) + 9, 100], dtype=np.uint64)
+    sn = Roaring64NavigableMap.from_values(sv, signed_longs=True)
+    assert list(sn.get_reverse_long_iterator()) == [100, 5, (1 << 63) + 9]
+    # Roaring64Bitmap twins of the new aliases
+    rb = Roaring64Bitmap.from_values(vals)
+    assert rb.long_cardinality == vals.size
+    rb.and_not(Roaring64Bitmap.from_values(vals[::2]))
+    assert np.array_equal(rb.to_array(), vals[1::2])

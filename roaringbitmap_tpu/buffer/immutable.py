@@ -209,6 +209,28 @@ class ImmutableRoaringBitmap:
         return MutableRoaringBitmap(self._view.keys.copy(),
                                     list(self.containers))
 
+    def to_roaring_bitmap(self) -> RoaringBitmap:
+        """toRoaringBitmap naming alias of to_bitmap."""
+        return self.to_bitmap()
+
+    def to_mutable_roaring_bitmap(self) -> "MutableRoaringBitmap":
+        """toMutableRoaringBitmap naming alias of to_mutable."""
+        return self.to_mutable()
+
+    def get_container_pointer(self):
+        """Expert container cursor over the lazy sequence — containers
+        decode one at a time as the pointer visits them."""
+        from ..core.bitmap import ContainerPointer
+
+        return ContainerPointer(self)
+
+    def is_hamming_similar(self, o, tolerance: int) -> bool:
+        """Symmetric-difference cardinality <= tolerance
+        (ImmutableRoaringBitmap.isHammingSimilar)."""
+        from ..core.bitmap import xor_cardinality
+
+        return xor_cardinality(self, o) <= tolerance
+
     # ------------------------------------------------- read-only long tail
     # Same reuse discipline as the iteration block: RoaringBitmap's
     # implementations run against the lazy sequence, decoding only the
@@ -347,6 +369,12 @@ class MutableRoaringBitmap(RoaringBitmap):
         """toImmutableRoaringBitmap (constant-time upcast in the reference;
         here one serialization pass)."""
         return ImmutableRoaringBitmap(self.serialize())
+
+    def to_immutable_roaring_bitmap(self) -> ImmutableRoaringBitmap:
+        """toImmutableRoaringBitmap naming alias of to_immutable."""
+        return self.to_immutable()
+
+    # and_not(other) comes from core RoaringBitmap
 
     @staticmethod
     def from_immutable(im: ImmutableRoaringBitmap) -> "MutableRoaringBitmap":

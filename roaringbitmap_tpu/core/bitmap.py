@@ -701,6 +701,11 @@ class RoaringBitmap:
         self.keys = np.delete(self.keys, kill)
         self._insert_missing(o, np.flatnonzero(~match))
 
+    def and_not(self, o: "RoaringBitmap") -> None:
+        """In-place difference, Java's andNot(other) naming
+        (MutableRoaringBitmap.andNot:918; covers every subclass)."""
+        self.iandnot(o)
+
     def iandnot(self, o: "RoaringBitmap") -> None:
         if o.is_empty() or self.is_empty():
             return

@@ -1078,11 +1078,12 @@ class Roaring64NavigableMap:
         return iter(self)
 
     def get_reverse_long_iterator(self) -> Iterator[int]:
-        """Descending value iterator (getReverseLongIterator)."""
+        """Descending value iterator (getReverseLongIterator) — the
+        per-bucket reverse flyweight keeps memory O(one container)."""
         for h in reversed(self._highs()):
             base = (h << 32) & U64_MAX
-            for v in self._map[h].to_array()[::-1]:
-                yield base | int(v)
+            for v in self._map[h].get_reverse_int_iterator():
+                yield base | v
 
     def limit(self, max_cardinality: int) -> "Roaring64NavigableMap":
         """First max_cardinality members in the active order (limit)."""
@@ -1094,8 +1095,7 @@ class Roaring64NavigableMap:
             b = self._map[h]
             take = b if b.cardinality <= left else b.limit(left)
             bucket = self._supplier()  # keep the pluggable backend
-            bucket.ior(RoaringBitmap(take.keys.copy(),
-                                     list(take.containers)))
+            bucket.ior(take)  # splices shared (persistent) containers
             out._map[h] = bucket
             left -= take.cardinality
         out._invalidate()

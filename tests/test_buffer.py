@@ -258,3 +258,29 @@ def test_buffer_adversarial_inputs(name):
         b = ImmutableRoaringBitmap(raw)
         for _ in b.containers:  # force the lazy decode of every slot
             pass
+
+
+def test_buffer_naming_aliases_and_pointer(sample, imm):
+    """The reference-named conversion/expert surface on the buffer tier:
+    toRoaringBitmap / toMutableRoaringBitmap / toImmutableRoaringBitmap,
+    isHammingSimilar, andNot(other) in-place, and a container pointer that
+    decodes lazily as it advances."""
+    rb = imm.to_roaring_bitmap()
+    assert rb == imm.to_bitmap()
+    mut = imm.to_mutable_roaring_bitmap()
+    assert isinstance(mut, MutableRoaringBitmap) and mut == rb
+    assert mut.to_immutable_roaring_bitmap().serialize() == imm.serialize()
+    assert imm.is_hamming_similar(imm, 0)
+    tweak = mut.to_immutable()
+    mut.add(4242424242)
+    assert imm.is_hamming_similar(mut, 1)
+    ptr = imm.get_container_pointer()
+    total = 0
+    while ptr.has_container():
+        total += ptr.get_cardinality()
+        ptr.advance()
+    assert total == imm.cardinality
+    m2 = imm.to_mutable()
+    m2.and_not(rb)
+    assert m2.is_empty()
+    assert tweak == rb

@@ -213,23 +213,31 @@ class ImmutableRoaringBitmap:
         """toRoaringBitmap naming alias of to_bitmap."""
         return self.to_bitmap()
 
+    @staticmethod
+    def bitmap_of(*values: int) -> "MutableRoaringBitmap":
+        """ImmutableRoaringBitmap.bitmapOf — returns the MUTABLE class,
+        like the reference (an immutable needs backing bytes)."""
+        rb = RoaringBitmap.bitmap_of(*values)
+        return MutableRoaringBitmap(rb.keys, rb.containers)
+
+    @staticmethod
+    def remove(rb, range_start: int, range_end: int) -> "MutableRoaringBitmap":
+        """Static range-remove producing a new bitmap
+        (ImmutableRoaringBitmap.remove(rb, long, long))."""
+        out = (rb.to_mutable() if isinstance(rb, ImmutableRoaringBitmap)
+               else MutableRoaringBitmap(rb.keys.copy(),
+                                         list(rb.containers)))
+        out.remove_range(range_start, range_end)
+        return out
+
     def to_mutable_roaring_bitmap(self) -> "MutableRoaringBitmap":
         """toMutableRoaringBitmap naming alias of to_mutable."""
         return self.to_mutable()
 
-    def get_container_pointer(self):
-        """Expert container cursor over the lazy sequence — containers
-        decode one at a time as the pointer visits them."""
-        from ..core.bitmap import ContainerPointer
-
-        return ContainerPointer(self)
-
-    def is_hamming_similar(self, o, tolerance: int) -> bool:
-        """Symmetric-difference cardinality <= tolerance
-        (ImmutableRoaringBitmap.isHammingSimilar)."""
-        from ..core.bitmap import xor_cardinality
-
-        return xor_cardinality(self, o) <= tolerance
+    # both run unchanged against the lazy sequence (they only touch
+    # .keys/.containers/.cardinality), same aliasing as the read-only block
+    get_container_pointer = RoaringBitmap.get_container_pointer
+    is_hamming_similar = RoaringBitmap.is_hamming_similar
 
     # ------------------------------------------------- read-only long tail
     # Same reuse discipline as the iteration block: RoaringBitmap's
@@ -375,6 +383,18 @@ class MutableRoaringBitmap(RoaringBitmap):
         return self.to_immutable()
 
     # and_not(other) comes from core RoaringBitmap
+
+    def get_mappeable_roaring_array(self):
+        """Expert backing-array accessor (getMappeableRoaringArray): the
+        SoA pair IS the array here — the object itself exposes
+        .keys/.containers, the PointableRoaringArray seam every internal
+        consumer duck-types against."""
+        return self
+
+    # NOTE: the static range-remove overload lives only on
+    # ImmutableRoaringBitmap — on this class `remove` must stay the
+    # inherited point-removal instance method (Python has no overloads)
+    bitmap_of = staticmethod(ImmutableRoaringBitmap.bitmap_of)
 
     @staticmethod
     def from_immutable(im: ImmutableRoaringBitmap) -> "MutableRoaringBitmap":

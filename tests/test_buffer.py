@@ -284,3 +284,20 @@ def test_buffer_naming_aliases_and_pointer(sample, imm):
     m2.and_not(rb)
     assert m2.is_empty()
     assert tweak == rb
+
+
+def test_buffer_static_builders(sample, imm):
+    """bitmapOf / static range-remove on the buffer classes; the mutable
+    class keeps its inherited point remove(x)."""
+    m = ImmutableRoaringBitmap.bitmap_of(1, 5, 70000)
+    assert isinstance(m, MutableRoaringBitmap)
+    assert sorted(m.to_array().tolist()) == [1, 5, 70000]
+    assert isinstance(MutableRoaringBitmap.bitmap_of(3), MutableRoaringBitmap)
+    removed = ImmutableRoaringBitmap.remove(imm, 0, 1 << 32)
+    assert removed.is_empty() and imm.cardinality > 0  # source untouched
+    partial = ImmutableRoaringBitmap.remove(imm, 0, int(imm.to_array()[1]))
+    assert partial.cardinality == imm.cardinality - 1
+    mm = MutableRoaringBitmap.bitmap_of(9, 10)
+    mm.remove(9)  # point removal still works on the mutable class
+    assert mm.to_array().tolist() == [10]
+    assert imm.to_mutable().get_mappeable_roaring_array().keys is not None

@@ -524,3 +524,21 @@ def test_naive_andnot_strategy():
     for b in bms[1:]:
         oracle -= set(b.to_array().tolist())
     assert set(got.to_array().tolist()) == oracle
+
+
+def test_aggregation_accepts_iterators():
+    """FastAggregation.and/or/xor(Iterator<RoaringBitmap>) analog
+    (TestFastAggregation.testAndWithIterator:85-105 etc.): generator and
+    iterator inputs work on both the host strategy set and the device
+    engine, with subclass inputs (the ExtendedRoaringBitmap case) too."""
+    from roaringbitmap_tpu.core.fastrank import FastRankRoaringBitmap
+    from roaringbitmap_tpu.parallel import fast_aggregation
+
+    a, b = RoaringBitmap.bitmap_of(1, 2), RoaringBitmap.bitmap_of(2, 3)
+    for mod in (fast_aggregation, aggregation):
+        assert mod.and_(iter([a, b])).to_array().tolist() == [2]
+        assert mod.or_(iter([a, b])).to_array().tolist() == [1, 2, 3]
+        assert mod.xor(x for x in (a, b)).to_array().tolist() == [1, 3]
+    ea = FastRankRoaringBitmap.from_values(np.array([1, 2], np.uint32))
+    eb = FastRankRoaringBitmap.from_values(np.array([2, 3], np.uint32))
+    assert fast_aggregation.and_(iter([ea, eb])).to_array().tolist() == [2]

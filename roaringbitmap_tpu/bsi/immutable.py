@@ -99,6 +99,13 @@ class ImmutableBitSliceIndex(RoaringBitmapSliceIndex):
     def run_optimize(self) -> None:
         self._immutable("run_optimize")
 
+    def add_digit(self, *a) -> None:
+        self._immutable("add_digit")
+
+    def to_mutable_bit_slice_index(self) -> RoaringBitmapSliceIndex:
+        """toMutableBitSliceIndex naming alias of to_mutable."""
+        return self.to_mutable()
+
 
 def _wrap_bitmap(mv: memoryview, pos: int) -> tuple[ImmutableRoaringBitmap, int]:
     """Zero-copy wrap of one embedded portable bitmap stream."""

@@ -239,6 +239,34 @@ class RoaringBitmapSliceIndex:
     def value_exists(self, column_id: int) -> bool:
         return self.ebm.contains(column_id)
 
+    def value_exist(self, column_id: int) -> bool:
+        """valueExist — the reference's (unpluralized) spelling."""
+        return self.value_exists(column_id)
+
+    @property
+    def long_cardinality(self) -> int:
+        """getLongCardinality alias."""
+        return self.cardinality
+
+    def serialize(self) -> bytes:
+        """Canonical wire form = the ByteBuffer (fixed-width) format — the
+        one serialized_size_in_bytes measures, so
+        len(serialize()) == serialized_size_in_bytes().  The
+        WritableUtils/DataOutput vint twin stays available as
+        serialize_stream."""
+        return self.serialize_buffer()
+
+    @staticmethod
+    def deserialize(buf: bytes | memoryview) -> "RoaringBitmapSliceIndex":
+        """deserialize(ByteBuffer) analog of serialize()."""
+        return RoaringBitmapSliceIndex.deserialize_buffer(buf)
+
+    def add_digit(self, digit: RoaringBitmap, i: int) -> None:
+        """Public carry-propagating slice addition (addDigit): add the
+        column set `digit` into slice i, rippling carries upward."""
+        self._add_digit(digit, i)
+        self._recompute_min_max()
+
     def get_value(self, column_id: int) -> tuple[int, bool]:
         """getValue (:181-189) -> (value, exists)."""
         if not self.ebm.contains(column_id):

@@ -490,3 +490,31 @@ def test_range_bounds_beyond_bit_count_clamped(rng):
     # low bound below min_value clamps too
     assert bsi.compare(Operation.RANGE, -50, 40).cardinality == \
         int((vals <= 40).sum())
+
+
+def test_bsi_reference_naming_surface(rng):
+    """The last BSI sweep names: serialize/deserialize canonical pair,
+    valueExist spelling, getLongCardinality, and the immutable's
+    toMutableBitSliceIndex + mutator guards."""
+    from roaringbitmap_tpu.bsi.immutable import ImmutableBitSliceIndex
+    from roaringbitmap_tpu.bsi.slice_index import RoaringBitmapSliceIndex
+
+    cols = np.unique(rng.integers(0, 1 << 18, 2000)).astype(np.uint32)
+    vals = rng.integers(0, 1 << 12, cols.size).astype(np.uint64)
+    bsi = RoaringBitmapSliceIndex.from_pairs(cols, vals)
+    assert RoaringBitmapSliceIndex.deserialize(bsi.serialize()) == bsi
+    # canonical form pairs with serialized_size_in_bytes (buffer format)
+    assert len(bsi.serialize()) == bsi.serialized_size_in_bytes()
+    assert bsi.serialize() == bsi.serialize_buffer()
+    # public addDigit ripples carries like add()
+    from roaringbitmap_tpu import RoaringBitmap as RB32
+    twin = RoaringBitmapSliceIndex.from_pairs(cols, vals)
+    twin.add_digit(twin.get_existence_bitmap(), 0)  # +1 to every column
+    got = [twin.get_value(int(c))[0] for c in cols[:50]]
+    assert got == [int(v) + 1 for v in vals[:50]]
+    assert bsi.value_exist(int(cols[0])) and not bsi.value_exist(1 << 30)
+    assert bsi.long_cardinality == bsi.cardinality == cols.size
+    imm = ImmutableBitSliceIndex(bsi.serialize_buffer())
+    assert imm.to_mutable_bit_slice_index() == bsi
+    with pytest.raises(TypeError):
+        imm.add_digit(0, 1)

@@ -301,3 +301,64 @@ def test_buffer_static_builders(sample, imm):
     mm.remove(9)  # point removal still works on the mutable class
     assert mm.to_array().tolist() == [10]
     assert imm.to_mutable().get_mappeable_roaring_array().keys is not None
+
+
+class TestBufferBatchIteratorSweep:
+    """ImmutableRoaringBitmapBatchIteratorTest analogs over BOTH tiers:
+    randomized seek to present/absent/beyond values, and the
+    zero-length-run seek regression (:185-213)."""
+
+    def _tiers(self, rb):
+        yield rb
+        yield ImmutableRoaringBitmap(rb.serialize())
+
+    @pytest.mark.parametrize("batch", [1, 7, 128, 65536])
+    def test_advance_to_random_positions(self, rng, batch):
+        vals = np.unique(np.concatenate([
+            rng.integers(0, 1 << 22, 30000),
+            np.arange(5 << 16, (5 << 16) + 4000)])).astype(np.uint32)
+        src = RoaringBitmap.from_values(vals)
+        src.run_optimize()
+        for rb in self._tiers(src):
+            for target_kind in ("present", "absent", "beyond"):
+                if target_kind == "present":
+                    t = int(vals[int(rng.integers(vals.size))])
+                elif target_kind == "absent":
+                    t = int(vals[-1]) // 2
+                    while t in src:
+                        t += 1
+                else:
+                    t = int(vals[-1]) + 1
+                it = rb.get_batch_iterator(batch)
+                it.advance_if_needed(t)
+                got = (np.concatenate(list(it)) if it.has_next()
+                       else np.empty(0, np.uint32))
+                want = vals[vals >= t]
+                np.testing.assert_array_equal(got, want, err_msg=target_kind)
+
+    def test_zero_length_run_seek(self):
+        # :200-213 — runOptimized container with single-value runs; seeking
+        # to each member must land exactly on it
+        vals = np.array([10, 11, 12, 13, 14, 15, 18, 20, 21, 22, 23, 24],
+                        dtype=np.uint32)
+        src = RoaringBitmap.from_values(vals)
+        src.run_optimize()
+        for rb in self._tiers(src):
+            for number in (10, 11, 12, 13, 14, 15, 18, 20, 21, 23, 24):
+                it = rb.get_batch_iterator(10)
+                it.advance_if_needed(number)
+                assert it.has_next()
+                batch = it.next_batch()
+                assert number in batch.tolist()
+
+    def test_timely_termination(self):
+        # :165-183 — an exhausted iterator reports has_next() False and
+        # returns empty batches, also after a beyond-last seek — on BOTH
+        # tiers (the reference test targets the byte-backed class)
+        for rb in self._tiers(RoaringBitmap.bitmap_of(1, 2, 3)):
+            it = rb.get_batch_iterator(10)
+            assert it.next_batch().size == 3
+            assert not it.has_next() and it.next_batch().size == 0
+            it2 = rb.get_batch_iterator(10)
+            it2.advance_if_needed(100)
+            assert not it2.has_next() and it2.next_batch().size == 0

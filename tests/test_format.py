@@ -72,3 +72,19 @@ def test_garbage_rejected():
         RoaringBitmap.deserialize(b"\x00" * 64)
     with pytest.raises(InvalidRoaringFormat):
         RoaringBitmap.deserialize(b"\x3a\x30")  # truncated cookie
+
+
+def test_compression_rate_by_gap():
+    """TestCompressionRates.SimpleCompressionRateTest: serialized bits per
+    value stays below min(gap, 16) + 1 as density thins by powers of two —
+    the size guarantee the container promote/demote thresholds exist for."""
+    n = 500_000
+    gap = 1
+    while gap < 1024:
+        # NO run_optimize, like the reference: the bound must hold from
+        # the array/bitmap promote thresholds alone
+        rb = RoaringBitmap.from_values(
+            np.arange(0, n * gap, gap, dtype=np.uint32))
+        bits_per_value = rb.serialized_size_in_bytes() * 8.0 / n
+        assert bits_per_value < min(gap, 16) + 1, (gap, bits_per_value)
+        gap *= 2

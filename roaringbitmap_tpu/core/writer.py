@@ -34,7 +34,9 @@ class RoaringBitmapWriter:
                  optimize_for_runs: bool = False,
                  partially_sort: bool = False,
                  run_compress: bool = True,
-                 expected_range: tuple[int, int] | None = None):
+                 expected_range: tuple[int, int] | None = None,
+                 result_cls=None):
+        self.result_cls = result_cls or RoaringBitmap
         self.constant_memory = constant_memory
         self.optimize_for_runs = optimize_for_runs
         self.partially_sort = partially_sort
@@ -49,7 +51,7 @@ class RoaringBitmapWriter:
         self._scratch_key: int | None = None
         self._scratch_dirty = False
         self._pending: list[np.ndarray] = []
-        self._result = RoaringBitmap()
+        self._result = self.result_cls()
 
     @staticmethod
     def wizard() -> "Wizard":
@@ -132,7 +134,7 @@ class RoaringBitmapWriter:
 
     def reset(self) -> None:
         self._pending = []
-        self._result = RoaringBitmap()
+        self._result = self.result_cls()
         if self._scratch is not None:
             self._scratch[:] = 0
             self._scratch_dirty = False
@@ -143,6 +145,7 @@ class Wizard:
     """Fluent configuration (RoaringBitmapWriter.Wizard :9-50)."""
 
     def __init__(self):
+        self._result_cls = None
         self._constant_memory = False
         self._optimize_for_runs = False
         self._partially_sort = False
@@ -190,6 +193,15 @@ class Wizard:
         self._run_compress = enabled
         return self
 
+    def fast_rank(self) -> "Wizard":
+        """fastRank(): the built bitmap is a FastRankRoaringBitmap
+        (TestRoaringBitmapWriterWizard:17; the buffer wizard throws in the
+        reference — here one writer serves both tiers)."""
+        from .fastrank import FastRankRoaringBitmap
+
+        self._result_cls = FastRankRoaringBitmap
+        return self
+
     def get(self) -> RoaringBitmapWriter:
         return RoaringBitmapWriter(
             constant_memory=self._constant_memory,
@@ -198,4 +210,5 @@ class Wizard:
             optimize_for_runs=self._optimize_for_runs,
             partially_sort=self._partially_sort,
             run_compress=self._run_compress,
-            expected_range=self._expected_range)
+            expected_range=self._expected_range,
+            result_cls=self._result_cls)

@@ -544,3 +544,20 @@ class TestExpertSurface:
         a, b = RoaringBitmap.bitmap_of(1, 2), RoaringBitmap.bitmap_of(2)
         assert rt.and_not(a, b) == rt.andnot(a, b)
         assert rt.and_not_cardinality(a, b) == 1
+
+
+def test_wizard_fast_rank_knob(rng):
+    """fastRank() wizard knob (TestRoaringBitmapWriterWizard:17-26): the
+    built bitmap is a FastRankRoaringBitmap, on both appender strategies."""
+    from roaringbitmap_tpu.core.fastrank import FastRankRoaringBitmap
+
+    vals = rng.integers(0, 1 << 20, 5000).astype(np.uint32)
+    for wiz in (RoaringBitmapWriter.wizard().fast_rank(),
+                RoaringBitmapWriter.wizard().fast_rank().constant_memory()):
+        w = wiz.get()
+        w.add_many(vals)
+        out = w.get()
+        assert isinstance(out, FastRankRoaringBitmap)
+        assert out == RoaringBitmap.from_values(vals)
+        mid = out.select(out.cardinality // 2)  # rank cache path works
+        assert out.rank(mid) == out.cardinality // 2 + 1

@@ -561,3 +561,26 @@ def test_wizard_fast_rank_knob(rng):
         assert out == RoaringBitmap.from_values(vals)
         mid = out.select(out.cardinality // 2)  # rank cache path works
         assert out.rank(mid) == out.cardinality // 2 + 1
+
+
+def test_empty_bitmap_iterators():
+    """TestEmptyRoaringBatchIterator + empty flyweight edges: every
+    iterator form over an empty bitmap terminates immediately, including
+    after seeks, on both tiers."""
+    from roaringbitmap_tpu.buffer import ImmutableRoaringBitmap
+
+    for rb in (RoaringBitmap(), ImmutableRoaringBitmap(
+            RoaringBitmap().serialize())):
+        bi = rb.get_batch_iterator(16)
+        assert not bi.has_next() and bi.next_batch().size == 0
+        bi.advance_if_needed(12345)
+        assert not bi.has_next()
+        assert bi.clone().next_batch().size == 0
+        assert list(rb.get_int_iterator()) == []
+        assert list(rb.get_reverse_int_iterator()) == []
+        it = rb.get_int_iterator()
+        it.advance_if_needed(7)
+        assert not it.has_next()
+        with pytest.raises(StopIteration):
+            it.peek_next()
+        assert list(rb.batch_iterator(8)) == []

@@ -365,3 +365,25 @@ def test_select_range_container_granular(rng):
         rb.select_range(card, card + 5)
     with pytest.raises(ValueError):
         rb.select_range(3, 3)
+
+
+@pytest.mark.parametrize("batch_size", [1, 3, 63, 64, 65, 256, 1024, 65536])
+def test_batch_iterator_rebuilds_random_shapes(rng, batch_size):
+    """RoaringBitmapBatchIteratorTest.test / testBatchIteratorAsIntIterator:
+    paging any random container mix through any batch size and feeding the
+    values back through the constant-memory writer reproduces the bitmap."""
+    from roaringbitmap_tpu import RoaringBitmapWriter
+
+    for style in ("sparse", "dense", "runs", "mixed"):
+        rb = rand_bitmap(rng, style=style)
+        rb.run_optimize()
+        it = rb.get_batch_iterator(batch_size)
+        parts = list(it)
+        got = (np.concatenate(parts) if parts
+               else np.empty(0, np.uint32))
+        np.testing.assert_array_equal(got, rb.to_array())
+        assert all(p.size <= batch_size for p in parts)
+        w = RoaringBitmapWriter.wizard().constant_memory().get()
+        for p in parts:
+            w.add_many(p)
+        assert w.get() == rb, (style, batch_size)

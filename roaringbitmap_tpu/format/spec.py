@@ -148,9 +148,11 @@ class SerializedView:
             pos += nmarker
         else:
             self.is_run = np.zeros(size, dtype=bool)
-        desc = np.frombuffer(buf[pos:pos + 4 * size], dtype="<u2")
-        if desc.size != 2 * size:
+        if len(buf) < pos + 4 * size:
+            # length-check BEFORE frombuffer: an odd-length tail would make
+            # numpy raise ValueError instead of the contracted format error
             raise InvalidRoaringFormat("truncated descriptive header")
+        desc = np.frombuffer(buf[pos:pos + 4 * size], dtype="<u2")
         self.keys = desc[0::2].astype(np.uint16)
         if size > 1 and bool(np.any(self.keys[1:] <= self.keys[:-1])):
             raise InvalidRoaringFormat("keys not strictly increasing")

@@ -206,6 +206,104 @@ def fused_nibble_reduce(op: str, counts: jnp.ndarray,
     return heads, cards
 
 
+# ---------------------------------------------------------- Pallas densify
+#
+# The compact layout's per-query rebuild was scatter-bound: XLA lowers the
+# value scatter-add of ops.dense.densify_streams to a serial ~13 ns/value
+# update loop on TPU (~13 ms/query at 10^6 values — the r5 verdict's "weak"
+# item 2, which effectively excluded the capacity rung of the residency
+# ladder from hot queries).  This kernel replaces the scatter with per-block
+# one-hot accumulation in VMEM: the value stream arrives pre-chunked
+# (ops.packing.chunk_value_stream — every chunk owns ONE destination row),
+# each grid step converts its chunk to a (16, 128) word tile and ORs it into
+# the row's VMEM accumulator (the segmented-reduce output-BlockSpec
+# mechanism, so a row's tile stays on-chip across its chunks).
+#
+# The chunk -> tile conversion runs on the MXU, not as 2048 VPU compares per
+# value: per byte plane p and sublane s, A[16p+s, j] = [sub_j == s] *
+# byte_p(bit_j); B[l, j] = [lane_j == l]; then tile bytes = A @ B^T — one
+# (64, C) x (C, 128) f32 matmul per chunk.  Exactness: values within a
+# container are distinct, so each (word, bit) contributes at most once and
+# every byte-plane sum stays <= 255 (exact in f32); padding slots carry the
+# CHUNK_PAD sentinel and are masked to zero (a SUM is not duplicate-
+# idempotent the way the OR it replaces was).
+
+#: Values per chunk — must match ops.packing.CHUNK_VALUES.
+DENSIFY_CHUNK = 128
+
+
+def _densify_chunk_kernel(chunk: int):
+    def kernel(row_ref, vals_ref, out_ref):
+        i = pl.program_id(0)
+        prev = row_ref[jnp.maximum(i - 1, 0)]
+        is_head = jnp.logical_or(i == 0, row_ref[i] != prev)
+        v = vals_ref[...].astype(jnp.uint32)                  # (1, chunk)
+        valid = v <= jnp.uint32(0xFFFF)
+        w = ((v & jnp.uint32(0xFFFF)) >> 5).astype(jnp.int32)  # word 0..2047
+        sub = w >> 7                                           # sublane 0..15
+        lane = w & 127                                         # lane 0..127
+        bit = jnp.where(valid, jnp.uint32(1) << (v & 31), jnp.uint32(0))
+        sub_iota = jax.lax.broadcasted_iota(jnp.int32, (_SUB, chunk), 0)
+        mask_sub = (sub_iota == sub).astype(jnp.float32)       # (16, chunk)
+        a = jnp.concatenate(
+            [mask_sub * ((bit >> (8 * p)) & jnp.uint32(0xFF)
+                         ).astype(jnp.float32)
+             for p in range(4)], axis=0)                       # (64, chunk)
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (_LANE, chunk), 0)
+        b = (lane_iota == lane).astype(jnp.float32)            # (128, chunk)
+        r = jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        planes = [r[16 * p:16 * (p + 1)].astype(jnp.uint32) for p in range(4)]
+        tile = (planes[0] | (planes[1] << 8)
+                | (planes[2] << 16) | (planes[3] << 24))
+
+        @pl.when(is_head)
+        def _init():
+            out_ref[0] = tile
+
+        @pl.when(jnp.logical_not(is_head))
+        def _accum():
+            out_ref[0] = out_ref[0] | tile
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("n_rows",))
+def densify_chunks_pallas(chunk_vals: jnp.ndarray, chunk_row: jnp.ndarray,
+                          row_live: jnp.ndarray, n_rows: int) -> jnp.ndarray:
+    """Chunked value stream -> dense u32[n_rows, 2048] container image.
+
+    chunk_vals u32[NC, CHUNK], chunk_row i32[NC] sorted ascending (padding
+    chunks carry n_rows, the scratch row); row_live u32[n_rows + 1] is 1 for
+    rows that own at least one chunk.  Rows with no chunks are never touched
+    by the grid, so their (undefined) buffer contents are masked to zero on
+    the way out — dense-wire rows are overwritten by the caller's row .set
+    either way.  Bit-exact vs ops.dense.densify_streams' value scatter.
+    """
+    return densify_chunks_impl(chunk_vals, chunk_row, row_live, n_rows)
+
+
+def densify_chunks_impl(chunk_vals, chunk_row, row_live,
+                        n_rows: int) -> jnp.ndarray:
+    """Traceable body of densify_chunks_pallas (callers inline it inside
+    chained loops / larger one-dispatch programs)."""
+    nc, chunk = chunk_vals.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nc,),
+        in_specs=[pl.BlockSpec((1, chunk), lambda i, row: (i, 0))],
+        out_specs=pl.BlockSpec((1, _SUB, _LANE), lambda i, row: (row[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        _densify_chunk_kernel(chunk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_rows + 1, _SUB, _LANE), jnp.uint32),
+        interpret=_use_interpret(),
+    )(chunk_row, chunk_vals)
+    out = jnp.where(row_live[:, None, None] != 0, out, jnp.uint32(0))
+    return out[:n_rows].reshape(n_rows, WORDS32)
+
+
 def _counts_reduce_kernel(op_name: str, op, groups: int):
     def kernel(seg_ref, counts_ref, out_ref):
         i = pl.program_id(0)

@@ -346,6 +346,60 @@ def pad_streams_pow2(s: CompactStreams) -> CompactStreams:
                           val_counts=val_counts, val_dest=val_dest)
 
 
+#: Values per densify chunk (ops.kernels.densify_chunks_pallas): one VPU
+#: lane row.  Each chunk belongs to exactly one destination row, so padding
+#: waste is bounded by (CHUNK_VALUES - 1) values per non-empty container.
+CHUNK_VALUES = 128
+
+#: Chunk-slot sentinel: any u32 > 0xFFFF is outside the 2^16-bit container
+#: domain; the kernel masks its contribution to zero.  (In-chunk padding
+#: does NOT use it — see chunk_value_stream.)
+CHUNK_PAD = np.uint32(0xFFFFFFFF)
+
+
+def chunk_value_stream(values: np.ndarray, val_counts: np.ndarray,
+                       val_dest: np.ndarray, n_rows: int,
+                       chunk: int = CHUNK_VALUES,
+                       pad_chunks_pow2: bool = True
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Sparse value streams -> fixed-shape chunks for the Pallas densify
+    kernel: (u32[NC, chunk] chunk values, i32[NC] chunk destination rows).
+
+    Every chunk's values land in ONE destination row, so the kernel's
+    output BlockSpec can route consecutive same-row chunks to one VMEM
+    accumulator tile (the segmented-reduce mechanism).  All padding — a
+    container's final partial chunk AND whole padding chunks (pow2 rounding
+    of the chunk count, destination n_rows = the scratch row) — carries the
+    CHUNK_PAD sentinel: the kernel accumulates per-word BYTE-PLANE SUMS on
+    the MXU (exact only while every contributing value is distinct), so
+    padding must contribute zero, not a duplicated value.  chunk
+    destinations ascend whenever val_dest does (every packer emits it
+    sorted).
+    """
+    counts = np.asarray(val_counts, dtype=np.int64)
+    nz = counts > 0
+    counts_nz = counts[nz]
+    dest_nz = np.asarray(val_dest, dtype=np.int64)[nz]
+    m = -(-counts_nz // chunk)                       # chunks per container
+    nc = int(m.sum())
+    nc_pad = max(next_pow2(nc), 1) if pad_chunks_pow2 else max(nc, 1)
+    chunk_vals = np.full((nc_pad, chunk), CHUNK_PAD, dtype=np.uint32)
+    chunk_row = np.full(nc_pad, n_rows, dtype=np.int32)
+    if nc:
+        cont_of = np.repeat(np.arange(counts_nz.size), m)
+        chunk_head = np.concatenate(([0], np.cumsum(m)[:-1]))
+        within = np.arange(nc) - chunk_head[cont_of]
+        starts = np.concatenate(([0], np.cumsum(counts_nz)[:-1]))
+        base = starts[cont_of] + within * chunk
+        idx = base[:, None] + np.arange(chunk)
+        last = (starts + counts_nz - 1)[cont_of][:, None]
+        cv = np.asarray(values, dtype=np.uint32)[np.minimum(idx, last)]
+        cv[idx > last] = CHUNK_PAD  # partial-chunk slots must contribute 0
+        chunk_vals[:nc] = cv
+        chunk_row[:nc] = dest_nz[cont_of]
+    return chunk_vals, chunk_row
+
+
 @dataclass
 class PackedBlockedCompact:
     """Blocked-layout metadata + compact transfer streams (no host densify)."""
@@ -358,30 +412,45 @@ class PackedBlockedCompact:
     seg_offsets: np.ndarray  # i64[K] first (padded) row of each segment
     streams: CompactStreams
     carry_row: int           # a padding row of segment 0 (loop-carry slot)
+    row_src: np.ndarray = None  # i32[n_rows] source-bitmap index per row
+    #                             (-1 for padding rows) — the batch engine's
+    #                             query-subset selector (parallel.batch_engine)
 
     @property
     def n_rows(self) -> int:
         return int(self.blk_seg.size) * self.block
 
 
-def choose_block(seg_sizes: np.ndarray) -> int:
+def choose_block(seg_sizes: np.ndarray, min_block: int = 8) -> int:
     """Per-set Pallas block size: larger blocks amortize grid-step overhead
     (wikileaks-noquotes chained marginal ~2x faster at 32 vs 16; census1881
     ~3x faster at 16-32 vs 8) but pad every segment to a block multiple, so
     the ladder climbs only while the median segment keeps padding waste
     small.  Always a power of two times NIBBLE_GROUP (the blocked kernels
-    tree-reduce statically; the counts/compact layouts tile 8-row groups)."""
+    tree-reduce statically; the counts/compact layouts tile 8-row groups).
+
+    min_block=4 opens a downward rung for DENSE-layout sets whose median
+    segment is tiny (the uscensus2000 shape: ~4,800 mostly-singleton
+    containers — block 8 pads every 1-row segment 8x, inflating the image
+    the kernel must stream; see docs/USCENSUS2000_CLIFF.md).  The counts/
+    compact fused layouts keep min_block=8: their group tiling needs
+    NIBBLE_GROUP (8) to divide the block."""
     if seg_sizes.size == 0:
-        return 8
+        return max(min_block, 8) if min_block >= 8 else 8
     med = float(np.median(seg_sizes))
     if med >= 32:
         return 32
-    return 16 if med >= 16 else 8
+    if med >= 16:
+        return 16
+    if med >= 4 or min_block >= 8:
+        return 8
+    return 4
 
 
 def pack_blocked_compact(sources: list, block: int | None = None,
                          round_blocks: int = 8,
-                         carry_slot: bool = True) -> PackedBlockedCompact:
+                         carry_slot: bool = True,
+                         min_block: int = 8) -> PackedBlockedCompact:
     """Group-by-key rotation emitting compact streams instead of a host-built
     dense tensor.  ``sources`` may mix RoaringBitmaps, ImmutableRoaringBitmaps,
     SerializedViews, and raw serialized bytes.
@@ -390,7 +459,17 @@ def pack_blocked_compact(sources: list, block: int | None = None,
     DeviceBitmapSet.chained_wide_or as the loop-carried write-back slot.
     round_blocks pads the block count to a multiple (NOT pow2 — a resident set
     compiles for one shape, so tight padding wins back HBM).
+    min_block (see choose_block) lets dense-layout residents drop to block 4
+    on ultra-sparse key-heavy shapes.
     """
+    if block is None and min_block < 8 and sources:
+        # the downward rung must bind BEFORE the native fast path (the C++
+        # engine's internal ladder stops at 8); key counts are cheap to read
+        # off any source kind
+        _, counts = np.unique(
+            np.concatenate([_keys_of(s) for s in sources]),
+            return_counts=True)
+        block = choose_block(counts, min_block=min_block)
     # native fast path: pure-bytes 32-bit inputs go through the C++ ingest
     # engine (roaringbitmap_tpu.native) — same semantics, same hostile-input
     # guards, one pass over the wire bytes; falls back to this NumPy
@@ -401,6 +480,8 @@ def pack_blocked_compact(sources: list, block: int | None = None,
         packed = native.pack_blocked_compact_native(
             [bytes(s) for s in sources], block, round_blocks, carry_slot)
         if packed is not None:
+            if packed.row_src is None:
+                packed.row_src = _row_sources(packed, sources)
             return packed
 
     # parse byte-backed sources ONCE; _as_view is idempotent on views
@@ -428,12 +509,37 @@ def pack_blocked_compact(sources: list, block: int | None = None,
     blk_seg = np.full(nb_pad, k, dtype=np.int32)
     blk_seg[:n_blocks] = np.repeat(np.arange(k, dtype=np.int32),
                                    (gp // block).astype(np.int64))
+    row_src = np.full(nb_pad * block, -1, dtype=np.int32)
+    row_src[dest] = np.repeat(np.arange(len(sources), dtype=np.int32),
+                              [k_.size for k_ in all_keys])[order]
     return PackedBlockedCompact(
         keys=keys, blk_seg=blk_seg, block=block, n_blocks=n_blocks,
         seg_sizes=g, seg_offsets=offs[:-1], streams=streams,
         # without a reserved slot, g[0] may be a live row of segment 1 —
         # poison the field instead of pointing consumers at foreign data
-        carry_row=int(g[0]) if (carry_slot and k) else -1)
+        carry_row=int(g[0]) if (carry_slot and k) else -1,
+        row_src=row_src)
+
+
+def _row_sources(packed: PackedBlockedCompact, sources: list) -> np.ndarray:
+    """i32[n_rows] source index per row of an already-packed blocked layout
+    (-1 padding), rebuilt from key arrays alone.  Used for native-engine
+    packs: the layout contract (rows sorted by segment, within a segment by
+    source order — the stable-argsort rotation both engines implement)
+    fully determines row placement from the per-source key sets."""
+    all_keys = [_keys_of(v if (v := _as_view(s)) is not None else s)
+                for s in sources]
+    flat_keys = (np.concatenate(all_keys) if all_keys
+                 else np.empty(0, np.uint16))
+    order = np.argsort(flat_keys, kind="stable")
+    seg_sorted = np.searchsorted(packed.keys, flat_keys[order])
+    head = np.searchsorted(seg_sorted, np.arange(packed.keys.size))
+    within = np.arange(flat_keys.size) - head[seg_sorted]
+    dest = packed.seg_offsets[seg_sorted] + within
+    row_src = np.full(packed.n_rows, -1, dtype=np.int32)
+    row_src[dest] = np.repeat(np.arange(len(sources), dtype=np.int32),
+                              [k.size for k in all_keys])[order]
+    return row_src
 
 
 def blocked_ragged_meta(blk_seg: np.ndarray, block: int, n_blocks: int,

@@ -1,4 +1,6 @@
-from . import aggregation, sharding
+from . import aggregation, batch_engine, sharding
 from .aggregation import DeviceBitmapSet
+from .batch_engine import BatchEngine, BatchQuery, BatchResult
 
-__all__ = ["aggregation", "sharding", "DeviceBitmapSet"]
+__all__ = ["aggregation", "batch_engine", "sharding", "DeviceBitmapSet",
+           "BatchEngine", "BatchQuery", "BatchResult"]

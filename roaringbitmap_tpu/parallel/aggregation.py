@@ -467,13 +467,18 @@ class DeviceBitmapSet:
         queries run one Pallas pass straight off the counts (~2x dense
         query cost, no scatter), AND falls back to a transient densify.
       - "compact": HBM holds only the compact streams (~serialized size,
-        5-30x smaller than dense on the SURVEY datasets); every query
-        rebuilds on device.  The rebuild is scatter-bound (XLA lowers
-        scatter-add to a serial update loop on TPU, ~13 ns/value — ~13 ms
-        per query at 10^6 values), so this rung is for capacity-bound
-        sets queried rarely.  (Round 3 reported 31 us here; that was a
-        measurement artifact — the scatter was being hoisted out of the
-        chained loop.)
+        5-30x smaller than dense on the SURVEY datasets) plus the chunked
+        value stream (ops.packing.chunk_value_stream); every query rebuilds
+        on device.  Under the pallas engine the rebuild is the chunked
+        one-hot kernel (ops.kernels.densify_chunks_pallas — per-row VMEM
+        accumulation, no serial scatter); the xla engine keeps the
+        scatter-add reference (XLA lowers it to a serial ~13 ns/value
+        update loop on TPU — ~13 ms per query at 10^6 values, which is
+        what previously excluded this rung from hot queries).  The legacy
+        fused nibble-count path remains reachable as engine
+        "pallas-nibble" for cross-checks.  (Round 3 reported 31 us here;
+        that was a measurement artifact — the scatter was being hoisted
+        out of the chained loop.)
     """
 
     def __init__(self, bitmaps: list, block: int | None = None,
@@ -497,13 +502,31 @@ class DeviceBitmapSet:
         # ragged input for the XLA doubling pass and the Pallas blocked
         # kernel's native shape (and its per-block scalar array stays far
         # under the SMEM prefetch ceiling at any realistic scale).
-        self._packed = packing.pack_blocked_compact(bitmaps, block=block)
+        # Dense residents may take the block-4 rung (min_block=4): on
+        # ultra-sparse key-heavy shapes (uscensus2000: ~4,800 mostly-
+        # singleton containers) block 8 pads every 1-row segment 8x and the
+        # kernel streams the padding — see docs/USCENSUS2000_CLIFF.md.  The
+        # counts/compact group tiling needs NIBBLE_GROUP (8) | block.
+        self._packed = packing.pack_blocked_compact(
+            bitmaps, block=block,
+            min_block=4 if (layout == "dense" and block is None) else 8)
         self.block = self._packed.block
         self.keys = self._packed.keys
         s = self._packed.streams
+        self._chunks = None
         if layout in ("compact", "counts"):
             s = self._sort_dense_stream(s)
             self._compact_meta(s)
+            # tight chunk count (no pow2): a resident set compiles for one
+            # shape, so padding only costs HBM — same policy as
+            # round_blocks
+            cv, cr = packing.chunk_value_stream(
+                s.values, s.val_counts, s.val_dest, s.n_rows,
+                pad_chunks_pow2=False)
+            live = np.zeros(s.n_rows + 1, np.uint32)
+            live[cr] = 1
+            self._chunks = (jax.device_put(cv), jax.device_put(cr))
+            self._row_live = jax.device_put(live)
         self._streams = tuple(jax.device_put(a) for a in (
             s.dense_words, s.dense_dest, s.values, s.val_counts, s.val_dest))
         self._n_rows, self._total_values = s.n_rows, s.total_values
@@ -630,27 +653,54 @@ class DeviceBitmapSet:
             dseg, head, valid, steps, self._n_groups, self._total_values,
             self.keys.size)
 
-    def _resident_words(self):
+    def _resident_words(self, engine: str = "auto"):
         """Dense image: resident (dense layout) or transient device densify
-        (compact layout)."""
+        (compact layout; the pallas engine rebuilds via the chunked one-hot
+        kernel, xla via the scatter-add reference)."""
         if self.words is not None:
             return self.words
-        return dense.densify_streams(
-            *self._streams, self._n_rows, self._total_values)
+        eng = self._select_engine(engine)
+        return self._densify_from(
+            self._streams, self._chunks if eng == "pallas" else None, eng)
 
     def _select_engine(self, engine: str) -> str:
         """Engine choice with the SMEM guard: the per-block scalar prefetch
         must fit SMEM (same bound as _run_ragged); beyond it every entry
         point falls back to the doubling engine.  The compact layout's
-        fused kernel prefetches the per-group array instead (up to 2x the
-        per-block one)."""
+        fused nibble kernel prefetches the per-group array (up to 2x the
+        per-block one) and the chunk densify the per-chunk row array."""
         eng = _engine(engine)
-        if eng == "pallas" and int(self.blk_seg.size) > kernels.SMEM_PREFETCH_MAX:
+        if eng == "pallas-nibble" and self.words is not None:
+            eng = "pallas"  # nibble path only exists for stream layouts
+        if (eng in ("pallas", "pallas-nibble")
+                and int(self.blk_seg.size) > kernels.SMEM_PREFETCH_MAX):
             eng = "xla"
-        if (eng == "pallas" and self.words is None
+        if (eng == "pallas-nibble" and self.words is None
                 and self._n_groups + 1 > kernels.SMEM_PREFETCH_MAX):
             eng = "xla"
+        if (eng == "pallas" and self._chunks is not None
+                and int(self._chunks[1].size) > kernels.SMEM_PREFETCH_MAX):
+            eng = "xla"
         return eng
+
+    def _densify_from(self, streams, chunks, eng: str, carry=None):
+        """Device rebuild of the blocked row image from (possibly barrier-
+        passed) compact streams.  pallas: chunked one-hot kernel, no serial
+        scatter; xla: the scatter-add reference.  `carry` overwrites the
+        reserved segment-0 padding row (chained_wide_or's write-back slot).
+        Traceable — chained probes inline it in their loops."""
+        if eng == "pallas" and chunks is not None:
+            words = kernels.densify_chunks_impl(
+                chunks[0], chunks[1], self._row_live, self._n_rows)
+            if streams[0].shape[0]:
+                words = words.at[streams[1].astype(jnp.int32)].set(streams[0])
+        else:
+            words = dense.densify_streams_impl(
+                streams[0], streams[1].astype(jnp.int32), streams[2],
+                streams[3], streams[4], self._n_rows, self._total_values)
+        if carry is not None:
+            words = words.at[self._packed.carry_row].set(carry)
+        return words
 
     def aggregate_device(self, op: str, engine: str = "auto"):
         """Run the wide op; returns device (words u32[K,2048], cards i32[K]).
@@ -666,16 +716,24 @@ class DeviceBitmapSet:
             return self._and_device()
         if op not in ("or", "xor"):
             raise ValueError(f"unsupported wide op {op!r}")
+        eng = self._select_engine(engine)
         if self.counts is not None:
             # counts layout: one pass off the resident counts, no scatter
-            return self._counts_reduce(op, self.counts,
-                                       self._select_engine(engine))
-        if self.words is None and self._select_engine(engine) == "pallas":
-            # compact layout + pallas: the fused path never materializes
-            # the row image (half the scatter traffic, no reduce re-read)
+            return self._counts_reduce(
+                op, self.counts, "pallas" if eng == "pallas-nibble" else eng)
+        if self.words is None and eng == "pallas-nibble":
+            # legacy fused nibble path (cross-check engine): nibble-count
+            # scatter + Pallas accumulator, no row image
             return self._fused_compact(op, self._streams)
+        if self.words is None and eng == "pallas":
+            # compact layout + pallas: chunked one-hot densify (no serial
+            # scatter) + blocked reduce, fused into one dispatch
+            return _chunk_compact_run(
+                op, *self._chunks, self._row_live, self._streams[0],
+                self._streams[1], self.blk_seg, self._n_rows,
+                self.keys.size, self.block)
         words = self._resident_words()
-        if self._select_engine(engine) == "pallas":
+        if eng in ("pallas", "pallas-nibble"):
             return kernels.segmented_reduce_pallas_blocked(
                 op, words, self.blk_seg, self.keys.size, self.block)
         return dense.segmented_reduce(
@@ -721,6 +779,9 @@ class DeviceBitmapSet:
         meta += sum(int(a.nbytes) for a in (
             self._grp_seg, self._dseg, self._dseg_carry,
             *self._dmeta[:2], *self._dmeta_carry[:2]))
+        if self._chunks is not None:
+            meta += sum(int(a.nbytes) for a in self._chunks)
+            meta += int(self._row_live.nbytes)
         total = sum(int(a.nbytes) for a in self._streams) + meta
         if self.counts is not None:
             total += int(self.counts.nbytes + self._grp_seg_counts.nbytes
@@ -859,64 +920,63 @@ class DeviceBitmapSet:
         # inside the loop — that per-iteration rebuild IS the query cost.
         # Streams enter as jit ARGUMENTS (closed-over device arrays would be
         # baked into the HLO as constants — compile bloat, tunnel limits)
-        n_rows, total_values = self._n_rows, self._total_values
-        use_fused = eng == "pallas" and op in ("or", "xor")
+        use_nibble = eng == "pallas-nibble" and op in ("or", "xor")
+        chunks = self._chunks if eng == "pallas" else None
 
-        def run_compact(streams):
+        def run_compact(ins):
+            streams, chks = ins
+
             def body_compact(i, total):
-                # barrier EVERY stream array so the whole rebuild (value
-                # scatter included) stays loop-variant — nothing hoistable
-                s, _ = jax.lax.optimization_barrier((streams, total))
-                if use_fused:
+                # barrier EVERY stream/chunk array so the whole rebuild
+                # (value scatter / chunk kernel included) stays
+                # loop-variant — nothing hoistable
+                (s, c), _ = jax.lax.optimization_barrier(
+                    ((streams, chks), total))
+                if use_nibble:
                     _, cards = self._fused_compact(op, s)
                 else:
-                    words = dense.densify_streams_impl(
-                        s[0], s[1].astype(jnp.int32), s[2], s[3], s[4],
-                        n_rows, total_values)
+                    words = self._densify_from(s, c, eng)
                     cards = reduce_cards(words)
                 return total + jnp.sum(cards.astype(jnp.uint32))
 
             return jax.lax.fori_loop(0, reps, body_compact, jnp.uint32(0))
 
         f = jax.jit(run_compact)
-        return lambda _words_unused=None: f(self._streams)
+        return lambda _words_unused=None: f((self._streams, chunks))
 
     def _chained_compact(self, reps: int, eng: str):
         """chained_wide_or body for the compact layout: rebuild from the
         streams every iteration (that IS the query cost), carry row threaded
-        through the dense stream."""
+        through the rebuild (reserved segment-0 padding row)."""
         n_rows, total_values = self._n_rows, self._total_values
         carry_row = self._packed.carry_row
         blk_seg, seg_ids, head_idx, n_keys, n_steps, block = (
             self.blk_seg, self.seg_ids, self.head_idx, self.keys.size,
             self.n_steps, self.block)
+        chunks = self._chunks if eng == "pallas" else None
 
         def reduce_step(words):
-            if eng == "pallas":
+            if eng in ("pallas", "pallas-nibble"):
                 return kernels.segmented_reduce_pallas_blocked(
                     "or", words, blk_seg, n_keys, block)
             return dense.segmented_reduce(
                 "or", words, seg_ids, head_idx, n_steps)
 
-        def run_compact(streams):
+        def run_compact(ins):
+            streams, chks = ins
+
             def body_compact(i, state):
                 carry, total = state
-                # the carry write-back makes the dense-stream set
-                # loop-variant; barrier the sparse streams too so the value
-                # scatter can't be hoisted either
-                s, _ = jax.lax.optimization_barrier((streams, total))
-                if eng == "pallas":
-                    # fused path: the carry rides as a prepended segment-0
-                    # dense row instead of a reserved destination row
+                # the carry write-back makes the rebuild loop-variant;
+                # barrier the streams too so no piece can be hoisted
+                (s, c), _ = jax.lax.optimization_barrier(
+                    ((streams, chks), total))
+                if eng == "pallas-nibble":
+                    # fused nibble path: the carry rides as a prepended
+                    # segment-0 dense row instead of a reserved row
                     heads, cards = self._fused_compact("or", s, carry=carry)
                 else:
-                    dw = jnp.concatenate([s[0], carry[None]], axis=0)
-                    dd = jnp.concatenate(
-                        [s[1].astype(jnp.int32),
-                         jnp.full((1,), carry_row, jnp.int32)])
-                    words = dense.densify_streams_impl(
-                        dw, dd, s[2], s[3], s[4],
-                        n_rows, total_values)
+                    words = self._densify_from(s, c, eng, carry=carry)
                     heads, cards = reduce_step(words)
                 return heads[0], total + jnp.sum(cards.astype(jnp.uint32))
 
@@ -925,7 +985,22 @@ class DeviceBitmapSet:
                 0, reps, body_compact, (carry0, jnp.uint32(0)))[1]
 
         f = jax.jit(run_compact)
-        return lambda _words_unused=None: f(self._streams)
+        return lambda _words_unused=None: f((self._streams, chunks))
+
+
+@functools.partial(jax.jit, static_argnames=("op", "n_rows", "k", "block"))
+def _chunk_compact_run(op: str, chunk_vals, chunk_row, row_live,
+                       dense_words, dense_dest, blk_seg,
+                       n_rows: int, k: int, block: int):
+    """Jitted compact-layout query via the chunked densify kernel: one
+    dispatch for the one-hot rebuild + dense-row placement + the blocked
+    Pallas segmented reduce."""
+    words = kernels.densify_chunks_impl(chunk_vals, chunk_row, row_live,
+                                        n_rows)
+    if dense_words.shape[0]:
+        words = words.at[dense_dest.astype(jnp.int32)].set(dense_words)
+    return kernels.segmented_reduce_pallas_blocked(op, words, blk_seg, k,
+                                                   block)
 
 
 @functools.partial(jax.jit, static_argnames=("op", "steps", "n_groups",

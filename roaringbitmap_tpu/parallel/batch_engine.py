@@ -1,0 +1,447 @@
+"""Batched multi-query aggregation: Q wide ops per device dispatch.
+
+BENCH_r05 showed the wide-aggregation path is dispatch-floor-bound, not
+work-bound: wikileaks-noquotes' steady-state marginal is ~10 us/op against
+34.9/80.9 us (pallas/xla) of per-dispatch overhead, so a serving system
+issuing one aggregation per launch wastes most of the device.  This engine
+accepts Q independent wide-aggregation requests — each an op in
+{or, and, xor, andnot}, a subset of the bitmaps of one HBM-resident
+DeviceBitmapSet, and a result form (cardinality-only or materialized
+bitmap) — and executes the whole batch in ONE device dispatch, amortizing
+the dispatch floor across Q queries.
+
+Execution model
+---------------
+The resident blocked layout (ops.packing.pack_blocked_compact) stores one
+densified container per row, sorted by key segment; ``row_src`` records
+each row's source bitmap.  A query over subset S selects its rows on the
+host (NumPy), and the planner lays every query of a batch out as segments
+of ONE flat segmented-reduce problem:
+
+    flat segment id = q * (K_pad + 1) + local_key_slot
+
+so the whole batch is a single run of the EXISTING engines — the Pallas
+segmented VMEM-accumulator kernel (ops.kernels.segmented_reduce_pallas) or
+the XLA doubling pass (ops.dense) — over a [sum_q R_pad, 2048] gather of
+resident rows.  Flattening the query axis into the segment axis is the
+batch-vmap transform done by hand: it keeps one kernel launch, works
+identically for both engines, and a genuinely vmapped variant of the XLA
+engine ("xla-vmap") is kept as a cross-check that the flattening is
+equivalent.
+
+Per-op lowering:
+  or / xor   masked rows (padding) carry the identity 0.
+  and        padding rows carry the annihilator-safe identity 0xFFFFFFFF;
+             key slots whose subset-presence count < |S| are zeroed after
+             the reduce (a missing container annihilates the AND — the
+             workShyAnd rule, FastAggregation.java:356-380).
+  andnot     operands[0] minus OR(operands[1:]): the reduce computes the
+             rest-union on the head's key slots, then one fused
+             head & ~rest pass.
+
+Shape bucketing
+---------------
+Compiled programs specialize on shapes.  To bound recompilation, queries
+are grouped by (op, pow2(|operands|)) and each bucket pads its per-query
+row count, key count, and query count to powers of two; the jitted batch
+program is cached by the tuple of bucket signatures.  The tradeoff is
+padding waste (gathered zero rows the kernel still streams) versus compile
+count — bounded by the handful of pow2 rungs a workload's subset sizes
+occupy.  See docs/BATCH_ENGINE.md for the policy and measured curves.
+
+Resident layouts: a dense-layout set gathers straight from its resident
+image; a compact-layout set rebuilds the image INSIDE the same program
+(ops.kernels.densify_chunks_impl under the pallas engine — no serial
+scatter — or the scatter-add reference under xla), so even the capacity
+rung of the residency ladder serves batched queries in one dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.bitmap import RoaringBitmap
+from ..ops import dense, kernels, packing
+from .aggregation import DeviceBitmapSet, _engine
+
+WORDS32 = packing.WORDS32
+
+_RED_OP = {"or": "or", "xor": "xor", "and": "and", "andnot": "or"}
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchQuery:
+    """One wide-aggregation request against a resident set.
+
+    operands are indices into the resident DeviceBitmapSet's input list and
+    are treated as a SET (duplicates dropped; ops are set-algebraic).
+    form "cardinality" returns only the count; "bitmap" also materializes
+    the per-query result bitmap on the host.
+    """
+
+    op: str
+    operands: tuple[int, ...]
+    form: str = "cardinality"
+
+    def __post_init__(self):
+        if self.op not in ("or", "and", "xor", "andnot"):
+            raise ValueError(f"unsupported batch op {self.op!r}")
+        if self.form not in ("cardinality", "bitmap"):
+            raise ValueError(f"unsupported result form {self.form!r}")
+
+
+@dataclasses.dataclass
+class BatchResult:
+    cardinality: int
+    bitmap: RoaringBitmap | None = None
+
+
+@dataclasses.dataclass
+class _Bucket:
+    """One shape-specialized slice of a batch plan."""
+
+    op: str
+    qids: list            # original query indices, bucket order
+    keys: list            # per-query np key arrays (true K_q, unpadded)
+    q: int                # padded query count (pow2)
+    r_pad: int            # padded rows per query (pow2)
+    k_pad: int            # padded key slots per query (pow2)
+    n_steps: int
+    needs_words: bool
+    arrays: dict          # device arrays, see _plan_bucket
+
+    @property
+    def signature(self):
+        return (self.op, self.q, self.r_pad, self.k_pad, self.n_steps,
+                self.needs_words)
+
+
+class BatchEngine:
+    """Plan + execute mixed-op query batches over one resident set.
+
+    ``engine`` as elsewhere: "auto" picks pallas on TPU, xla otherwise;
+    "xla-vmap" runs the vmapped XLA cross-check.  Compiled batch programs
+    are cached on the instance, keyed by (engine, bucket signatures).
+    """
+
+    def __init__(self, ds: DeviceBitmapSet):
+        if ds._packed.row_src is None:
+            raise ValueError(
+                "resident set lacks row_src metadata (repack required)")
+        self._ds = ds
+        self.n = ds.n
+        self.keys = ds.keys
+        self._row_src = np.asarray(ds._packed.row_src)
+        self._row_seg = np.repeat(np.asarray(ds._packed.blk_seg),
+                                  ds.block).astype(np.int32)
+        self._programs: dict = {}
+        self._plans: dict = {}
+
+    @classmethod
+    def from_bitmaps(cls, bitmaps: list, layout: str = "dense",
+                     **kw) -> "BatchEngine":
+        return cls(DeviceBitmapSet(bitmaps, layout=layout, **kw))
+
+    # ------------------------------------------------------------- planning
+
+    def _plan_query(self, q: BatchQuery):
+        """(gather_rows, seg_local, keys_q, key_keep, head_rows) — all
+        NumPy, unpadded.  seg_local ascends (rows are key-sorted)."""
+        ops_ = np.unique(np.asarray(q.operands, dtype=np.int64))
+        if ops_.size and (ops_[0] < 0 or ops_[-1] >= self.n):
+            raise IndexError(
+                f"operand index out of range 0..{self.n - 1}: {q.operands}")
+        if q.op == "andnot":
+            if not len(q.operands):
+                return (np.empty(0, np.int64), np.empty(0, np.int32),
+                        self.keys[:0], None, np.empty(0, np.int64))
+            head = int(q.operands[0])
+            rest = np.unique(np.asarray(q.operands[1:], dtype=np.int64))
+            hrows = np.flatnonzero(self._row_src == head)
+            hsegs = self._row_seg[hrows]        # unique & ascending
+            rrows = np.flatnonzero(np.isin(self._row_src, rest)
+                                   & np.isin(self._row_seg, hsegs))
+            seg_local = np.searchsorted(
+                hsegs, self._row_seg[rrows]).astype(np.int32)
+            return (rrows, seg_local, self.keys[hsegs], None, hrows)
+        rows = np.flatnonzero(np.isin(self._row_src, ops_))
+        segs = self._row_seg[rows]
+        uniq, seg_local = np.unique(segs, return_inverse=True)
+        key_keep = None
+        if q.op == "and":
+            key_keep = np.bincount(
+                seg_local, minlength=uniq.size) == ops_.size
+        return (rows, seg_local.astype(np.int32), self.keys[uniq],
+                key_keep, None)
+
+    def _plan_bucket(self, op: str, items) -> _Bucket:
+        """items: [(qid, query, gather, seg_local, keys_q, key_keep,
+        head_rows)] sharing (op, operand-count rung)."""
+        qn = packing.next_pow2(len(items))
+        r_pad = packing.next_pow2(max(1, max(it[2].size for it in items)))
+        k_pad = packing.next_pow2(max(1, max(it[4].size for it in items)))
+        gather = np.zeros((qn, r_pad), np.int32)
+        valid = np.zeros((qn, r_pad), bool)
+        seg_local = np.full((qn, r_pad), k_pad, np.int32)
+        heads_ok = np.zeros((qn, k_pad), bool)
+        key_keep = np.ones((qn, k_pad), bool) if op == "and" else None
+        head_gather = (np.zeros((qn, k_pad), np.int32)
+                       if op == "andnot" else None)
+        head_ok = np.zeros((qn, k_pad), bool) if op == "andnot" else None
+        max_group = 1
+        for i, (qid, q, rows, segs, keys_q, keep, hrows) in enumerate(items):
+            gather[i, :rows.size] = rows
+            valid[i, :rows.size] = True
+            seg_local[i, :rows.size] = segs
+            present = np.unique(segs)
+            heads_ok[i, present] = True
+            if segs.size:
+                max_group = max(max_group,
+                                int(np.bincount(segs).max()))
+            if op == "and":
+                key_keep[i, :keep.size] = keep
+                key_keep[i, keep.size:] = False
+            if op == "andnot":
+                head_gather[i, :hrows.size] = hrows
+                head_ok[i, :hrows.size] = True
+        flat_seg = (seg_local
+                    + (k_pad + 1) * np.arange(qn, dtype=np.int32)[:, None]
+                    ).reshape(-1)
+        flat_head = np.searchsorted(
+            flat_seg, np.arange(qn * (k_pad + 1), dtype=np.int64)
+        ).astype(np.int32)
+        # per-query head index for the vmapped cross-check engine
+        head_local = np.empty((qn, k_pad + 1), np.int32)
+        for i in range(qn):
+            head_local[i] = np.searchsorted(seg_local[i],
+                                            np.arange(k_pad + 1))
+        arrays = {
+            "gather": jnp.asarray(gather),
+            "valid": jnp.asarray(valid),
+            "seg_local": jnp.asarray(seg_local),
+            "flat_seg": jnp.asarray(flat_seg),
+            "flat_head": jnp.asarray(flat_head),
+            "head_local": jnp.asarray(head_local),
+            "heads_ok": jnp.asarray(heads_ok),
+        }
+        if key_keep is not None:
+            arrays["key_keep"] = jnp.asarray(key_keep)
+        if head_gather is not None:
+            arrays["head_gather"] = jnp.asarray(head_gather)
+            arrays["head_ok"] = jnp.asarray(head_ok)
+        return _Bucket(
+            op=op, qids=[it[0] for it in items],
+            keys=[it[4] for it in items], q=qn, r_pad=r_pad, k_pad=k_pad,
+            n_steps=dense.n_steps_for(max_group),
+            needs_words=any(it[1].form == "bitmap" for it in items),
+            arrays=arrays)
+
+    def plan(self, queries) -> list:
+        """Bucketed plan: group by (op, pow2 operand count), pad shapes.
+
+        Plans are cached by the exact query tuple (BatchQuery is frozen/
+        hashable) — the prepared-statement pattern: a serving loop reissuing
+        the same batch shape pays the NumPy planning and array upload once.
+        """
+        key = tuple(queries)
+        cached = self._plans.get(key)
+        if cached is not None:
+            return cached
+        groups: dict = {}
+        for qid, q in enumerate(queries):
+            rows, segs, keys_q, keep, hrows = self._plan_query(q)
+            rung = packing.next_pow2(max(1, len(set(q.operands))))
+            groups.setdefault((q.op, rung), []).append(
+                (qid, q, rows, segs, keys_q, keep, hrows))
+        plan = [self._plan_bucket(op, items)
+                for (op, _), items in sorted(groups.items())]
+        if len(self._plans) >= 256:   # bound the prepared-plan cache
+            self._plans.clear()
+        self._plans[key] = plan
+        return plan
+
+    # ------------------------------------------------------------ execution
+
+    def _resident_src(self):
+        """(program source operand, static layout tag).  Dense sets pass
+        the resident image; compact/counts sets pass streams + chunks and
+        rebuild inside the program (one dispatch either way)."""
+        ds = self._ds
+        if ds.words is not None:
+            return ds.words, "dense"
+        return (ds._streams, ds._chunks, ds._row_live), "streams"
+
+    def _words_from_src(self, src, kind: str, eng: str):
+        if kind == "dense":
+            return src
+        streams, chunks, _ = src
+        return self._ds._densify_from(
+            streams, chunks if eng == "pallas" else None, eng)
+
+    def _bucket_body(self, words, b_sig, arrays, eng: str):
+        """Traced body for one bucket: gather -> flat segmented reduce ->
+        per-op post pass.  Returns (heads or None, cards)."""
+        op, qn, r_pad, k_pad, n_steps, needs_words = b_sig
+        red = _RED_OP[op]
+        g = words[arrays["gather"].reshape(-1)]
+        ident = jnp.uint32(0xFFFFFFFF if op == "and" else 0)
+        g = jnp.where(arrays["valid"].reshape(-1, 1), g, ident)
+        nseg = qn * (k_pad + 1)
+        if eng == "pallas":
+            heads, _ = kernels.segmented_reduce_pallas(
+                red, g, arrays["flat_seg"], nseg)
+            heads = heads.reshape(qn, k_pad + 1, WORDS32)
+        elif eng == "xla-vmap":
+            g3 = g.reshape(qn, r_pad, WORDS32)
+            heads, _ = jax.vmap(
+                lambda w, s, h: dense.segmented_reduce(red, w, s, h,
+                                                       n_steps)
+            )(g3, arrays["seg_local"], arrays["head_local"])
+        else:
+            red_rows = dense.doubling_pass(dense.OPS[red], g,
+                                           arrays["flat_seg"], n_steps)
+            safe = jnp.minimum(arrays["flat_head"], g.shape[0] - 1)
+            heads = red_rows[safe].reshape(qn, k_pad + 1, WORDS32)
+        heads = heads[:, :k_pad]
+        # zero key slots with no contributing rows (untouched kernel output
+        # rows / clamped doubling heads are undefined, and an empty rest-
+        # union must read as 0)
+        heads = jnp.where(arrays["heads_ok"][:, :, None], heads,
+                          jnp.uint32(0))
+        if op == "and":
+            heads = jnp.where(arrays["key_keep"][:, :, None], heads,
+                              jnp.uint32(0))
+        elif op == "andnot":
+            hg = words[arrays["head_gather"].reshape(-1)].reshape(
+                qn, k_pad, WORDS32)
+            hg = jnp.where(arrays["head_ok"][:, :, None], hg, jnp.uint32(0))
+            heads = hg & ~heads
+        cards = dense.popcount(heads)
+        return (heads if needs_words else None), cards
+
+    def _program(self, plan, engine: str):
+        """Jitted (and eager) batch program for this plan's signature: ONE
+        call = one compiled XLA program = one device dispatch."""
+        eng = self._bucket_engine(plan, engine)
+        src, kind = self._resident_src()
+        sig = (eng, kind, tuple(b.signature for b in plan))
+        cached = self._programs.get(sig)
+        if cached is not None:
+            return cached
+        b_sigs = [b.signature for b in plan]
+
+        def run(src_in, barrays):
+            words = self._words_from_src(src_in, kind, eng)
+            return [self._bucket_body(words, s, a, eng)
+                    for s, a in zip(b_sigs, barrays)]
+
+        cached = (run, jax.jit(run))
+        self._programs[sig] = cached
+        return cached
+
+    def _bucket_engine(self, plan, engine: str) -> str:
+        eng = _engine(engine)
+        if eng == "pallas":
+            longest = max((b.q * b.r_pad for b in plan), default=0)
+            if longest > kernels.SMEM_PREFETCH_MAX:
+                eng = "xla"  # flat_seg prefetch must fit SMEM
+            ds = self._ds
+            if (ds.words is None and ds._chunks is not None
+                    and int(ds._chunks[1].size) > kernels.SMEM_PREFETCH_MAX):
+                eng = "xla"  # in-program chunk densify: chunk_row prefetch
+        return eng
+
+    def execute(self, queries, engine: str = "auto",
+                jit: bool = True) -> list[BatchResult]:
+        """Run Q queries in one device dispatch; results in input order."""
+        queries = list(queries)
+        if not queries:
+            return []
+        plan = self.plan(queries)
+        run, run_jit = self._program(plan, engine)
+        src, _ = self._resident_src()
+        outs = (run_jit if jit else run)(src, [b.arrays for b in plan])
+        results: list = [None] * len(queries)
+        for b, (heads, cards) in zip(plan, outs):
+            cards = np.asarray(cards)
+            heads = None if heads is None else np.asarray(heads)
+            for slot, (qid, keys_q) in enumerate(zip(b.qids, b.keys)):
+                kq = keys_q.size
+                card = int(cards[slot, :kq].sum()) if kq else 0
+                bm = None
+                if queries[qid].form == "bitmap":
+                    bm = packing.unpack_result(
+                        keys_q,
+                        heads[slot, :kq] if kq else
+                        np.zeros((0, WORDS32), np.uint32),
+                        cards[slot, :kq])
+                results[qid] = BatchResult(cardinality=card, bitmap=bm)
+        return results
+
+    def cardinalities(self, queries, engine: str = "auto") -> np.ndarray:
+        """i64[Q] result cardinalities, one dispatch."""
+        return np.array([r.cardinality
+                         for r in self.execute(queries, engine=engine)],
+                        dtype=np.int64)
+
+    def chained_cardinality(self, queries, reps: int,
+                            engine: str = "auto"):
+        """Steady-state probe: `reps` dependent executions of the WHOLE
+        batch inside one jit, barrier-serialized (the chained-marginal
+        methodology of DeviceBitmapSet.chained_aggregate).  Returns a
+        jitted fn() -> sum over reps of every query's cardinality, modulo
+        2^32; callers assert == (reps * expected_total) % 2^32."""
+        plan = self.plan(list(queries))
+        eng = self._bucket_engine(plan, engine)
+        src, kind = self._resident_src()
+        b_sigs = [b.signature for b in plan]
+        barrays = [b.arrays for b in plan]
+
+        def run(src_in, arrs):
+            def body(i, total):
+                (s, a), _ = jax.lax.optimization_barrier(((src_in, arrs),
+                                                          total))
+                words = self._words_from_src(s, kind, eng)
+                for sig, arr in zip(b_sigs, a):
+                    _, cards = self._bucket_body(words, sig, arr, eng)
+                    total = total + jnp.sum(cards.astype(jnp.uint32))
+                return total
+
+            return jax.lax.fori_loop(0, reps, body, jnp.uint32(0))
+
+        f = jax.jit(run)
+        return lambda: f(src, barrays)
+
+    def hbm_bytes(self) -> int:
+        return self._ds.hbm_bytes()
+
+
+def execute_batch(ds: DeviceBitmapSet, queries, engine: str = "auto"
+                  ) -> list[BatchResult]:
+    """One-shot convenience: plan + run a batch against a resident set."""
+    return BatchEngine(ds).execute(queries, engine=engine)
+
+
+def random_query_pool(n_bitmaps: int, q: int, seed: int = 0xBA7C,
+                      max_operands: int = 16) -> list[BatchQuery]:
+    """Deterministic mixed-op query pool over ``n_bitmaps`` residents —
+    the shared workload generator for the bench lanes (bench.py
+    batched_phase and benchmarks/realdata.py bench_batch measure the SAME
+    batch shapes) and the acceptance tests.  Cycles or/xor/and/andnot with
+    random subset sizes in [2, max_operands]."""
+    if n_bitmaps < 2:
+        raise ValueError("query pool needs at least 2 resident bitmaps")
+    rng = np.random.default_rng(seed)
+    hi = max(3, min(max_operands + 1, n_bitmaps))
+    pool = []
+    for i in range(q):
+        op = ("or", "xor", "and", "andnot")[i % 4]
+        k = int(rng.integers(2, hi))
+        pool.append(BatchQuery(op=op, operands=tuple(
+            int(x) for x in rng.choice(n_bitmaps, size=k, replace=False))))
+    return pool

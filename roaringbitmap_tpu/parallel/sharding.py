@@ -24,6 +24,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 exports shard_map at top level; 0.4.x keeps it
+    shard_map = jax.shard_map  # experimental (this image's 0.4.37 has no
+except AttributeError:        # top-level alias at all — seed suite red)
+    from jax.experimental.shard_map import shard_map as _shard_map_04x
+
+    def shard_map(f, **kw):
+        # 0.4.x named the replication check check_rep, not check_vma
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_04x(f, **kw)
+
 from ..ops import dense, packing
 
 WORDS32 = packing.WORDS32
@@ -120,7 +131,7 @@ def _make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
 
     # check_vma=False: after the ppermute butterfly every device holds the
     # full reduction, but JAX cannot prove ppermute outputs replicated.
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(row_axis, lane_axis), P(row_axis)),
         out_specs=(P(None, lane_axis), P()),
@@ -281,7 +292,7 @@ def _sharded_densify_cached(mesh: Mesh, row_axis: str, rows_per_shard: int,
             dw[0], dd[0], v[0], vc[0], vdst[0],
             rows_per_shard, total_values)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         densify_local, mesh=mesh,
         in_specs=(P(row_axis), P(row_axis), P(row_axis), P(row_axis),
                   P(row_axis)),
@@ -396,7 +407,7 @@ def make_sharded_and(mesh: Mesh,
         cards = jax.lax.psum(cards, lane_axis)
         return acc, cards
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(None, row_axis, lane_axis),),
         out_specs=(P(None, lane_axis), P()),
@@ -469,7 +480,7 @@ def _make_sharded_bsi_compare(mesh: Mesh, op: str, row_axis: str,
         card = jnp.sum(jax.lax.population_count(res).astype(jnp.int32))
         return jax.lax.psum(card, (row_axis, lane_axis))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         step, mesh=mesh,
         in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis),
                   P(), P()),
@@ -504,7 +515,7 @@ def _make_sharded_bsi_topk(mesh: Mesh, row_axis: str, lane_axis: str):
         card = jnp.sum(bsi_dev.popcount(g | e).astype(jnp.int32))
         return jax.lax.psum(card, (row_axis, lane_axis))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         step_fn, mesh=mesh,
         in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis), P()),
         out_specs=P(),
@@ -526,7 +537,7 @@ def _make_sharded_range_compare(mesh: Mesh, op: str, row_axis: str,
         card = jnp.sum(jax.lax.population_count(res).astype(jnp.int32))
         return jax.lax.psum(card, (row_axis, lane_axis))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         step, mesh=mesh,
         in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis),
                   P(), P()),
@@ -568,7 +579,7 @@ def _make_sharded_bsi_slice_cards(mesh: Mesh, row_axis: str, lane_axis: str):
         return (jax.lax.psum(cards, (row_axis, lane_axis)),
                 jax.lax.psum(count, (row_axis, lane_axis)))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         step, mesh=mesh,
         in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis)),
         out_specs=(P(), P()),

@@ -1,0 +1,266 @@
+"""Batched multi-query engine parity: every mixed-op batch must be
+bit-exact, query by query, against the sequential ParallelAggregation path
+(parallel.aggregation or_/and_/xor over the same subset), across engines
+(Pallas vs XLA vs the vmapped-XLA cross-check), jit vs eager, and resident
+layouts (dense vs compact)."""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.parallel import (BatchEngine, BatchQuery,
+                                        aggregation, batch_engine)
+
+N = 16
+
+
+@pytest.fixture(scope="module")
+def workload():
+    """Mixed container kinds with a guaranteed shared range so wide ANDs
+    are non-empty, plus one dense chunk (bitmap containers)."""
+    rng = np.random.default_rng(0xBA7C)
+    common = np.arange(500, 900, dtype=np.uint32)
+    bms = []
+    for i in range(N):
+        vals = [rng.integers(0, 1 << 18, 3000).astype(np.uint32), common]
+        if i % 5 == 0:  # dense rows exercise the dense-wire stream
+            vals.append(np.arange(1 << 16, (1 << 16) + 20000,
+                                  dtype=np.uint32))
+        bms.append(RoaringBitmap.from_values(
+            np.unique(np.concatenate(vals))))
+    return bms
+
+
+@pytest.fixture(scope="module")
+def engine(workload):
+    return BatchEngine.from_bitmaps(workload)
+
+
+def _mixed_queries(q, form="cardinality", seed=1):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(q):
+        op = ("or", "and", "xor", "andnot")[i % 4]
+        k = int(rng.integers(2, min(9, N)))
+        sub = tuple(int(x) for x in rng.choice(N, size=k, replace=False))
+        out.append(BatchQuery(op=op, operands=sub, form=form))
+    return out
+
+
+def _sequential(bms, q: BatchQuery) -> RoaringBitmap:
+    sub = [bms[i] for i in q.operands]
+    if q.op == "or":
+        return aggregation.or_(*sub)
+    if q.op == "and":
+        return aggregation.and_(*sub)
+    if q.op == "xor":
+        return aggregation.xor(*sub)
+    rest = aggregation.or_(*sub[1:]) if len(sub) > 1 else RoaringBitmap()
+    return _sequential(bms, BatchQuery("or", (q.operands[0],))) - rest
+
+
+@pytest.fixture(scope="module")
+def oracle(workload):
+    cache = {}
+
+    def get(q: BatchQuery) -> RoaringBitmap:
+        key = (q.op, q.operands)
+        if key not in cache:
+            cache[key] = _sequential(workload, q)
+        return cache[key]
+
+    return get
+
+
+@pytest.mark.parametrize("q,engines", [
+    (8, ("xla", "xla-vmap", "pallas")),
+    (64, ("xla", "pallas")),
+    (256, ("xla",)),  # interpret-mode Pallas at Q=256 is CI-prohibitive;
+    #                   the TPU lane runs the full matrix on census1881
+])
+def test_mixed_op_batches_match_sequential(workload, engine, oracle,
+                                           q, engines):
+    queries = _mixed_queries(q, form="bitmap", seed=q)
+    want = [oracle(x) for x in queries]
+    assert any(w.cardinality for w in want)
+    for eng in engines:
+        res = engine.execute(queries, engine=eng)
+        for x, r, w in zip(queries, res, want):
+            assert r.cardinality == w.cardinality, (eng, x)
+            assert r.bitmap == w, (eng, x)
+
+
+def test_jit_vs_eager(engine, oracle):
+    queries = _mixed_queries(8, form="bitmap", seed=99)
+    want = [oracle(x) for x in queries]
+    jitted = engine.execute(queries, engine="xla", jit=True)
+    eager = engine.execute(queries, engine="xla", jit=False)
+    for w, a, b in zip(want, jitted, eager):
+        assert a.bitmap == w and b.bitmap == w
+        assert a.cardinality == b.cardinality == w.cardinality
+
+
+def test_and_partial_presence_annihilates(workload, engine):
+    """A key missing from ANY operand must annihilate that key's AND —
+    the workShyAnd rule, exercised through the batched mask path."""
+    a = RoaringBitmap.bitmap_of(1, 2, 3)
+    b = RoaringBitmap.bitmap_of(2, 3, 0x20001)       # extra key
+    c = RoaringBitmap.bitmap_of(2, 0x20001, 0x30005)
+    eng = BatchEngine.from_bitmaps([a, b, c])
+    res = eng.execute([
+        BatchQuery("and", (0, 1, 2), form="bitmap"),
+        BatchQuery("and", (1, 2), form="bitmap"),
+        BatchQuery("andnot", (1, 0), form="bitmap"),
+    ], engine="xla")
+    assert res[0].bitmap.to_array().tolist() == [2]
+    assert res[1].bitmap.to_array().tolist() == [2, 0x20001]
+    assert res[2].bitmap.to_array().tolist() == [0x20001]
+
+
+def test_edge_queries(workload, engine, oracle):
+    queries = [
+        BatchQuery("or", (3,), form="bitmap"),          # single operand
+        BatchQuery("or", (), form="bitmap"),            # empty subset
+        BatchQuery("andnot", (2,), form="bitmap"),      # head, no rest
+        BatchQuery("xor", (5, 5, 7), form="bitmap"),    # duplicate operand
+        BatchQuery("or", (0, 1), form="cardinality"),
+        BatchQuery("or", (0, 1), form="cardinality"),   # duplicate query
+    ]
+    res = engine.execute(queries, engine="xla")
+    assert res[0].bitmap == workload[3]
+    assert res[1].cardinality == 0 and res[1].bitmap.is_empty()
+    assert res[2].bitmap == workload[2]
+    # operands are set-semantic: {5, 5, 7} == {5, 7}
+    assert res[3].bitmap == oracle(BatchQuery("xor", (5, 7)))
+    assert res[4].cardinality == res[5].cardinality \
+        == oracle(BatchQuery("or", (0, 1))).cardinality
+
+
+def test_invalid_queries(engine):
+    with pytest.raises(ValueError, match="unsupported batch op"):
+        BatchQuery("nand", (0, 1))
+    with pytest.raises(ValueError, match="result form"):
+        BatchQuery("or", (0, 1), form="words")
+    with pytest.raises(IndexError):
+        engine.execute([BatchQuery("or", (0, N + 3))])
+    assert engine.execute([]) == []
+
+
+def test_bucketing_bounds_recompiles(engine):
+    """Same (op, operand-rung, padded-shape) signature must reuse the
+    compiled program; a novel rung adds exactly the new signature."""
+    q1 = [BatchQuery("or", (0, 1)), BatchQuery("or", (2, 3))]
+    engine._programs.clear()
+    engine.execute(q1, engine="xla")
+    n1 = len(engine._programs)
+    engine.execute([BatchQuery("or", (4, 5)), BatchQuery("or", (6, 7))],
+                   engine="xla")
+    assert len(engine._programs) == n1  # same signature -> cache hit
+    engine.execute([BatchQuery("or", tuple(range(12)))], engine="xla")
+    assert len(engine._programs) == n1 + 1  # new operand rung
+
+
+def test_plan_shapes_are_pow2(engine):
+    plan = engine.plan(_mixed_queries(10, seed=4))
+    for b in plan:
+        for v in (b.q, b.r_pad, b.k_pad):
+            assert v & (v - 1) == 0 and v >= 1
+    # mixed ops split into per-op buckets
+    assert len({b.op for b in plan}) == 4
+
+
+@pytest.mark.parametrize("engine_name", ["xla", "pallas"])
+def test_compact_layout_batches(workload, oracle, engine_name):
+    """Compact residents rebuild the image inside the batch program (the
+    chunked one-hot kernel under pallas) — parity must hold."""
+    eng = BatchEngine.from_bitmaps(workload, layout="compact")
+    queries = _mixed_queries(8, form="bitmap", seed=21)
+    res = eng.execute(queries, engine=engine_name)
+    for x, r in zip(queries, res):
+        w = oracle(x)
+        assert r.cardinality == w.cardinality and r.bitmap == w, \
+            (engine_name, x)
+
+
+def test_chained_batch_cardinality(workload, engine, oracle):
+    queries = _mixed_queries(12, seed=7)
+    total = sum(oracle(x).cardinality for x in queries)
+    for eng_name in ("xla", "pallas"):
+        fn = engine.chained_cardinality(queries, 4, engine=eng_name)
+        got = int(np.asarray(fn()))
+        assert got == (4 * total) % 2**32, eng_name
+
+
+def test_u64_tier_batch():
+    from roaringbitmap_tpu.core.bitmap64 import Roaring64Bitmap
+
+    bms = [Roaring64Bitmap.from_values(
+        (np.uint64(i % 3) << np.uint64(40))
+        + np.arange(i * 50, 3000, dtype=np.uint64)) for i in range(6)]
+    eng = BatchEngine.from_bitmaps(bms)
+    res = eng.execute([BatchQuery("or", (0, 3), form="bitmap"),
+                       BatchQuery("and", (1, 4), form="bitmap")],
+                      engine="xla")
+    want_or = aggregation.or64(bms[0], bms[3])
+    want_and = aggregation.and64(bms[1], bms[4])
+    assert isinstance(res[0].bitmap, Roaring64Bitmap)
+    assert res[0].bitmap == want_or
+    assert res[1].bitmap == want_and
+
+
+def test_one_shot_helper(workload, oracle):
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+
+    ds = DeviceBitmapSet(workload)
+    res = batch_engine.execute_batch(
+        ds, [BatchQuery("or", (0, 1, 2), form="bitmap")], engine="xla")
+    assert res[0].bitmap == oracle(BatchQuery("or", (0, 1, 2)))
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not __import__(
+    "roaringbitmap_tpu.utils.datasets", fromlist=["has_dataset"]
+).has_dataset("census1881"), reason="census1881 zip not mounted")
+@pytest.mark.parametrize("q", [8, 64, 256])
+def test_census1881_mixed_batches(q):
+    """The acceptance matrix on real data (runs where the dataset is
+    mounted — the TPU lane): mixed-op batches at Q in {8, 64, 256},
+    bit-exact vs the sequential path, Pallas vs XLA, jit vs eager."""
+    from roaringbitmap_tpu.utils import datasets
+
+    bms = datasets.load_bitmaps("census1881")
+    eng = BatchEngine.from_bitmaps(bms)
+    rng = np.random.default_rng(q)
+    queries = []
+    for i in range(q):
+        op = ("or", "and", "xor", "andnot")[i % 4]
+        k = int(rng.integers(2, 17))
+        queries.append(BatchQuery(
+            op=op, operands=tuple(
+                int(x) for x in rng.choice(len(bms), size=k,
+                                           replace=False)),
+            form="bitmap"))
+    want = [_sequential(bms, x) for x in queries]
+    import jax
+
+    engines = ["xla"]
+    if jax.default_backend() == "tpu":
+        engines.append("pallas")
+    for eng_name in engines:
+        res = eng.execute(queries, engine=eng_name)
+        for x, r, w in zip(queries, res, want):
+            assert r.cardinality == w.cardinality, (eng_name, x)
+            assert r.bitmap == w, (eng_name, x)
+    eager = eng.execute(queries[:8], engine="xla", jit=False)
+    assert all(r.bitmap == w for r, w in zip(eager, want[:8]))
+
+
+def test_byte_backed_resident_set(workload, oracle):
+    """Serialized-bytes ingest (native or NumPy packer) must still carry
+    the row_src metadata the planner needs."""
+    blobs = [b.serialize() for b in workload]
+    eng = BatchEngine.from_bitmaps(blobs)
+    queries = _mixed_queries(8, form="bitmap", seed=33)
+    res = eng.execute(queries, engine="xla")
+    for x, r in zip(queries, res):
+        assert r.bitmap == oracle(x), x

@@ -1,0 +1,160 @@
+"""Chunked Pallas densify kernel (ops.kernels.densify_chunks_pallas) vs the
+XLA scatter-add reference (ops.dense.densify_streams), plus the host chunk
+prep (ops.packing.chunk_value_stream) and the compact-layout integration."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.ops import dense, kernels, packing
+
+
+def _scatter_oracle(streams_args, n_rows):
+    dense_words, dense_dest, values, val_counts, val_dest = streams_args
+    return np.asarray(dense.densify_streams(
+        jnp.asarray(dense_words), jnp.asarray(dense_dest),
+        jnp.asarray(values), jnp.asarray(val_counts),
+        jnp.asarray(val_dest), n_rows, int(values.size)))
+
+
+def _chunk_run(values, val_counts, val_dest, n_rows,
+               dense_words=None, dense_dest=None):
+    cv, cr = packing.chunk_value_stream(values, val_counts, val_dest, n_rows)
+    live = np.zeros(n_rows + 1, np.uint32)
+    live[cr] = 1
+    out = kernels.densify_chunks_pallas(
+        jnp.asarray(cv), jnp.asarray(cr), jnp.asarray(live), n_rows)
+    if dense_words is not None and dense_words.shape[0]:
+        out = out.at[jnp.asarray(dense_dest)].set(jnp.asarray(dense_words))
+    return np.asarray(out)
+
+
+def test_chunk_prep_shapes_and_padding():
+    values = np.concatenate([np.arange(300, dtype=np.uint16),
+                             np.array([7], np.uint16)])
+    val_counts = np.array([300, 0, 1], np.int32)  # zero-count skipped
+    val_dest = np.array([2, 3, 5], np.int32)
+    cv, cr = packing.chunk_value_stream(values, val_counts, val_dest, 8)
+    assert cv.shape[1] == packing.CHUNK_VALUES == kernels.DENSIFY_CHUNK
+    assert cv.shape[0] & (cv.shape[0] - 1) == 0  # pow2 chunk count
+    # 300 values -> 3 chunks of row 2, then 1 chunk of row 5
+    assert cr[:4].tolist() == [2, 2, 2, 5]
+    assert (cr[4:] == 8).all()  # padding chunks target the scratch row
+    # partial-chunk padding is the sentinel, never a duplicated value
+    assert (cv[2][300 - 256:] == packing.CHUNK_PAD).all()
+    assert cv[3][0] == 7 and (cv[3][1:] == packing.CHUNK_PAD).all()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_kernel_matches_scatter_reference(seed):
+    rng = np.random.default_rng(seed)
+    n_rows = 11
+    rows = sorted(rng.choice(n_rows, size=6, replace=False))
+    pieces = [np.unique(rng.integers(0, 65536, rng.integers(1, 4097))
+                        .astype(np.uint16)) for _ in rows]
+    values = np.concatenate(pieces)
+    val_counts = np.array([p.size for p in pieces], np.int32)
+    val_dest = np.array(rows, np.int32)
+    dense_words = rng.integers(0, 1 << 32, (2, 2048)).astype(np.uint32)
+    free = [r for r in range(n_rows) if r not in rows][:2]
+    dense_dest = np.array(free, np.int32)
+    args = (dense_words, dense_dest, values, val_counts, val_dest)
+    want = _scatter_oracle(args, n_rows)
+    got = _chunk_run(values, val_counts, val_dest, n_rows,
+                     dense_words, dense_dest)
+    assert np.array_equal(got, want)
+
+
+def test_kernel_empty_and_single_value():
+    got = _chunk_run(np.empty(0, np.uint16), np.empty(0, np.int32),
+                     np.empty(0, np.int32), 3)
+    assert got.shape == (3, 2048) and not got.any()
+    got = _chunk_run(np.array([65535], np.uint16), np.array([1], np.int32),
+                     np.array([1], np.int32), 3)
+    assert got[1].view(np.uint64)[-1] == np.uint64(1) << np.uint64(63)
+    assert got[0].sum() == 0 and got[2].sum() == 0
+
+
+def test_kernel_full_container():
+    """All 65536 bits of one row set — every byte-plane sum at its
+    maximum, the exactness edge of the MXU accumulation."""
+    values = np.arange(65536, dtype=np.uint16)
+    got = _chunk_run(values, np.array([65536], np.int32),
+                     np.array([0], np.int32), 2)
+    assert (got[0] == 0xFFFFFFFF).all() and not got[1].any()
+
+
+def test_compact_layout_uses_chunk_kernel():
+    """DeviceBitmapSet compact: pallas engine rebuilds via the chunk
+    kernel, pallas-nibble keeps the legacy fused path, xla the scatter —
+    all three bit-exact with the host oracle."""
+    from roaringbitmap_tpu.parallel import aggregation, fast_aggregation
+
+    rng = np.random.default_rng(9)
+    bms = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 18, 4000).astype(np.uint32))
+        for _ in range(10)]
+    bms[0] = bms[0] | RoaringBitmap.from_values(
+        np.arange(1 << 17, (1 << 17) + 30000, dtype=np.uint32))
+    ds = aggregation.DeviceBitmapSet(bms, layout="compact")
+    assert ds._chunks is not None
+    for op, fn in (("or", fast_aggregation.or_),
+                   ("xor", fast_aggregation.xor)):
+        want = fn(*bms)
+        for eng in ("pallas", "pallas-nibble", "xla"):
+            assert ds.aggregate(op, engine=eng) == want, (op, eng)
+    # chained probes through the chunk path stay loop-variant + bit-exact
+    want_or = fast_aggregation.or_(*bms).cardinality
+    got = int(np.asarray(ds.chained_wide_or(3, engine="pallas")(None)))
+    assert got == (3 * want_or) % 2**32
+    got = int(np.asarray(
+        ds.chained_aggregate("or", 3, engine="pallas-nibble")(None)))
+    assert got == (3 * want_or) % 2**32
+
+
+def test_dense_block4_rung_parity():
+    """Ultra-sparse key-heavy shapes (the uscensus2000 profile: mostly
+    singleton segments) take the block-4 dense rung; parity must hold on
+    both engines and the image must shrink vs block 8."""
+    from roaringbitmap_tpu.parallel import aggregation, fast_aggregation
+
+    rng = np.random.default_rng(3)
+    # ~1 value per container, keys mostly disjoint -> median segment 1
+    bms = [RoaringBitmap.from_values(np.unique(
+        (rng.choice(500, size=25, replace=False).astype(np.uint32) << 16)
+        + rng.integers(0, 65536, 25).astype(np.uint32)))
+        for _ in range(12)]
+    ds = aggregation.DeviceBitmapSet(bms)
+    assert ds.block == 4
+    ds8 = aggregation.DeviceBitmapSet(bms, block=8)
+    assert ds.words.nbytes < ds8.words.nbytes
+    for op, fn in (("or", fast_aggregation.or_),
+                   ("xor", fast_aggregation.xor)):
+        want = fn(*bms)
+        for eng in ("pallas", "xla"):
+            assert ds.aggregate(op, engine=eng) == want, (op, eng)
+    assert ds.aggregate("and") == fast_aggregation.and_(*bms)
+    # counts/compact layouts must keep the NIBBLE_GROUP-divisible floor
+    dsc = aggregation.DeviceBitmapSet(bms, layout="counts")
+    assert dsc.block >= 8
+    assert dsc.aggregate("or") == fast_aggregation.or_(*bms)
+
+
+def test_row_src_metadata():
+    """pack_blocked_compact must report each row's source bitmap (batch
+    engine selector), identically for object and byte inputs."""
+    bms = [RoaringBitmap.bitmap_of(1, 0x10001),
+           RoaringBitmap.bitmap_of(2, 0x20002),
+           RoaringBitmap.bitmap_of(3, 0x10003)]
+    p_obj = packing.pack_blocked_compact(bms)
+    p_byte = packing.pack_blocked_compact([b.serialize() for b in bms])
+    for p in (p_obj, p_byte):
+        assert p.row_src is not None and p.row_src.size == p.n_rows
+        # key 0 -> sources {0,1,2}; key 1 -> {0,2}; key 2 -> {1}
+        for seg, want in enumerate(([0, 1, 2], [0, 2], [1])):
+            off = p.seg_offsets[seg]
+            got = p.row_src[off:off + p.seg_sizes[seg]].tolist()
+            assert got == want, (seg, got)
+        live = p.row_src >= 0
+        assert int(live.sum()) == 6

@@ -344,7 +344,43 @@ def batched_phase(state: dict) -> dict:
     amort = out.get("q64_e2e_qps", 0.0) / out["q1_seq_dispatch_qps"]
     out["q64_vs_q1_amortization_x"] = round(amort, 2)
     out["meets_5x"] = amort >= 5.0
+    out["fault_lane"] = fault_lane_phase(eng, pool, best_of)
     return out
+
+
+def fault_lane_phase(eng, pool, best_of) -> dict:
+    """Degraded-mode QPS probe (ISSUE 2): the same Q-query batch measured
+    (a) clean, (b) with the top engine rung killed by an injected lowering
+    fault (the guard demotes one rung down the chain), and (c) with EVERY
+    device rung killed (the guard lands on the CPU sequential reference).
+    The ratios quantify what a production incident costs in throughput —
+    degradation is availability-preserving and bit-exact by construction,
+    so throughput is the only axis that moves."""
+    import jax
+
+    from roaringbitmap_tpu.runtime import faults
+
+    q = min(64, len(pool))
+    batch = pool[:q]
+    clean = [r.cardinality for r in eng.execute(batch)]
+    t_clean = best_of(lambda: eng.cardinalities(batch), reps=3)
+    top = "pallas" if jax.default_backend() == "tpu" else "xla"
+    with faults.inject(f"lowering@{top}=1.0:0xFA"):
+        demoted = [r.cardinality for r in eng.execute(batch)]
+        t_demoted = best_of(lambda: eng.cardinalities(batch), reps=3)
+    with faults.inject("lowering=1.0:0xFB"):
+        floor = [r.cardinality for r in eng.execute(batch)]
+        t_floor = best_of(lambda: eng.cardinalities(batch), reps=3)
+    assert demoted == clean and floor == clean, \
+        "degraded lanes must stay bit-exact"
+    return {
+        "q": q, "top_rung": top,
+        "qps_clean": round(q / t_clean, 1),
+        "qps_demoted_one_rung": round(q / t_demoted, 1),
+        "qps_sequential_floor": round(q / t_floor, 1),
+        "demotion_overhead_x": round(t_demoted / t_clean, 3),
+        "sequential_floor_cost_x": round(t_floor / t_clean, 3),
+    }
 
 
 def build_summary(out: dict, full_path: str) -> dict:
@@ -378,6 +414,13 @@ def build_summary(out: dict, full_path: str) -> dict:
                     "q1_seq_dispatch_qps", "q8_e2e_qps", "q64_e2e_qps",
                     "q256_e2e_qps", "q64_steady_qps",
                     "q64_vs_q1_amortization_x", "meets_5x") if k in row}
+            fl = row.get("fault_lane") or {}
+            if "demotion_overhead_x" in fl:
+                # degraded-mode cost, compact: x-overhead one rung down
+                # and at the sequential floor (docs/ROBUSTNESS.md)
+                batched[name]["degraded_x"] = [
+                    fl["demotion_overhead_x"],
+                    fl["sequential_floor_cost_x"]]
     if batched:
         s["batched_qps"] = batched
     return s

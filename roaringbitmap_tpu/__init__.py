@@ -42,6 +42,10 @@ from .core.writer import RoaringBitmapWriter
 from .format import spec
 from .format.spec import InvalidRoaringFormat
 
+# hardened query runtime: typed error taxonomy, guarded dispatch with the
+# engine fallback chain, deterministic fault injection (docs/ROBUSTNESS.md)
+from . import runtime
+
 __all__ = [
     "RoaringBitmap", "Roaring64Bitmap", "Roaring64NavigableMap",
     "RangeBitmap", "FastRankRoaringBitmap", "RoaringBitSet",
@@ -49,7 +53,7 @@ __all__ = [
     "and_", "or_", "xor", "andnot", "and_not", "or_not", "flip",
     "and_cardinality", "or_cardinality", "xor_cardinality",
     "andnot_cardinality", "and_not_cardinality",
-    "containers", "spec", "InvalidRoaringFormat",
+    "containers", "spec", "InvalidRoaringFormat", "runtime",
 ]
 
 __version__ = "0.1.0"

@@ -56,6 +56,22 @@ class InvalidRoaringFormat(ValueError):
     """Raised on cookie/bounds violations (InvalidRoaringFormat.java analog)."""
 
 
+def validate_runs(runs: np.ndarray, i: int) -> tuple[np.ndarray, np.ndarray]:
+    """Structural invariants of a run payload ((start, length-1) u16
+    pairs), shared by the eager container decoder and the packing ingest:
+    runs sorted, non-overlapping, confined to the 2^16 chunk.  Returns
+    (starts, inclusive ends) as int64 for further checks."""
+    starts = runs[0::2].astype(np.int64)
+    ends = starts + runs[1::2].astype(np.int64)
+    if ends.size and int(ends.max()) > 0xFFFF:
+        raise InvalidRoaringFormat(
+            f"container {i}: run extends past 65535")
+    if starts.size > 1 and bool(np.any(starts[1:] <= ends[:-1])):
+        raise InvalidRoaringFormat(
+            f"container {i}: overlapping/unsorted runs")
+    return starts, ends
+
+
 def serialized_size_in_bytes(keys: np.ndarray, containers: list[Container]) -> int:
     size = len(containers)
     hasrun = any(c.is_run() for c in containers)
@@ -160,7 +176,13 @@ class SerializedView:
         pos += 4 * size
         self.is_bitmap = (self.cardinalities > ARRAY_MAX_SIZE) & ~self.is_run
         if (not hasrun) or size >= NO_OFFSET_THRESHOLD:
-            pos += 4 * size  # offsets are redundant; recompute instead of trusting them
+            # offsets are redundant; recompute instead of trusting them —
+            # but the block itself must exist, or the recomputed payload
+            # offsets would index from a position past the buffer
+            if len(buf) < pos + 4 * size:
+                raise InvalidRoaringFormat(
+                    "offset block past buffer end")
+            pos += 4 * size
         sizes = np.zeros(size, dtype=np.int64)
         is_array = ~self.is_bitmap & ~self.is_run
         sizes[is_array] = 2 * self.cardinalities[is_array]
@@ -195,23 +217,39 @@ class SerializedView:
     def container(self, i: int) -> Container:
         """Decode container i — zero-copy on little-endian hosts: the
         payload array is a read-only view into the backing buffer (a
-        big-endian host pays one astype copy)."""
+        big-endian host pays one astype copy).
+
+        Decode is also the validation boundary for payload LIES the header
+        scan cannot see: a declared cardinality that disagrees with the
+        payload, unsorted/duplicated array values, and runs that are out
+        of order, overlapping, or extend past the 2^16 container end.
+        Every such input raises InvalidRoaringFormat (re-exported as
+        runtime.errors.CorruptInput) — admitting one would hand downstream
+        set algebra a container whose invariants do not hold, i.e. silent
+        corruption rather than a crash."""
         payload = self.container_payload(i)
         if self.is_run[i]:
             nruns = int(np.frombuffer(payload[:2], dtype="<u2")[0])
             runs = np.frombuffer(payload[2:2 + 4 * nruns], dtype="<u2")
             if not _LITTLE_ENDIAN:
                 runs = runs.astype(np.uint16)
+            validate_runs(runs, i)
             c: Container = RunContainer(runs)
         elif self.is_bitmap[i]:
             words = np.frombuffer(payload, dtype="<u8")
             if not _LITTLE_ENDIAN:
                 words = words.astype(np.uint64)
-            c = BitmapContainer(words, int(self.cardinalities[i]))
+            # cardinality=None: the constructor computes the REAL popcount
+            # (not the possibly-lying declared value), so the declared-vs-
+            # actual check at the tail catches bitmap cardinality lies
+            c = BitmapContainer(words)
         else:
             vals = np.frombuffer(payload, dtype="<u2")
             if not _LITTLE_ENDIAN:
                 vals = vals.astype(np.uint16)
+            if vals.size > 1 and bool(np.any(vals[1:] <= vals[:-1])):
+                raise InvalidRoaringFormat(
+                    f"container {i}: array values not strictly increasing")
             c = ArrayContainer(vals)
         if c.cardinality != int(self.cardinalities[i]):
             raise InvalidRoaringFormat(

@@ -245,7 +245,7 @@ def _emit_container_streams(sources: list, order: np.ndarray, dest: np.ndarray,
     idx_in_src = np.concatenate([np.arange(k) for k in sizes]) if sizes \
         else np.empty(0, np.int64)
 
-    from ..format.spec import InvalidRoaringFormat
+    from ..format.spec import InvalidRoaringFormat, validate_runs
 
     dense_rows: list[int] = []
     dense_words: list[np.ndarray] = []
@@ -275,16 +275,11 @@ def _emit_container_streams(sources: list, order: np.ndarray, dest: np.ndarray,
                 if runs.size != 2 * nruns:
                     raise InvalidRoaringFormat(
                         f"container {i}: truncated run payload")
-                starts = runs[0::2].astype(np.int64)
-                ends = starts + runs[1::2]
-                if nruns and int(ends.max()) > 0xFFFF:
-                    # start + length-1 must stay within the 2^16 chunk, or
-                    # runs_to_values' uint16 wrap corrupts low values
-                    raise InvalidRoaringFormat(
-                        f"container {i}: run extends past 65535")
-                if nruns > 1 and bool(np.any(starts[1:] <= ends[:-1])):
-                    raise InvalidRoaringFormat(
-                        f"container {i}: overlapping/unsorted runs")
+                # shared structural checks (sorted, non-overlapping, within
+                # the 2^16 chunk — else runs_to_values' uint16 wrap would
+                # corrupt low values); spec.validate_runs is the one
+                # definition both decode paths use
+                starts, ends = validate_runs(runs, i)
                 if int((ends - starts + 1).sum()) != int(view.cardinalities[i]):
                     raise InvalidRoaringFormat(
                         f"container {i}: run cardinality mismatch")

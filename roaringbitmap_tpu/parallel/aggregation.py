@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import operator
 
 import jax
 import jax.numpy as jnp
@@ -33,12 +34,68 @@ import numpy as np
 
 from ..core.bitmap import RoaringBitmap
 from ..ops import dense, kernels, packing
+from ..runtime import faults, guard
 
 
 def _engine(engine: str) -> str:
     if engine == "auto":
         return "pallas" if jax.default_backend() == "tpu" else "xla"
     return engine
+
+
+#: wide-path engine ladder (runtime.guard appends the sequential rung)
+ENGINE_LADDER = ("pallas", "xla")
+
+_SEQ_OP = {"or": operator.or_, "and": operator.and_, "xor": operator.xor}
+
+
+def _sequential_reduce(op: str, bitmaps: list):
+    """CPU sequential reference: a host container-algebra fold, no device
+    involvement — the terminal rung of every wide-aggregation fallback
+    chain and the oracle of the shadow cross-check.  Bit-exact with the
+    engines by construction (the parity suites pin them against exactly
+    this algebra)."""
+    acc = _materialize(bitmaps[0])   # defensive copy of the seed only
+    fn = _SEQ_OP[op]
+    for b in bitmaps[1:]:
+        # the pairwise host ops consume (keys, containers) without
+        # mutating their right operand, so anything exposing that
+        # interface folds in place; only opaque operands materialize
+        acc = fn(acc, b if hasattr(b, "containers") else _materialize(b))
+    return acc
+
+
+def _guarded_wide(op: str, bitmaps: list, engine: str, attempt,
+                  sequential=None, site: str = "aggregation",
+                  chain=None):
+    """Shared guard harness for the wide entry points: run ``attempt(eng)``
+    down the fallback ladder with the host fold as the terminal rung
+    (``sequential`` overrides it for cardinality-only callers); optionally
+    shadow-check the winner against the reference.  ``chain`` overrides
+    the ladder for paths with a single device engine (wide AND), where a
+    pallas->xla "demotion" would just re-run identical code."""
+    policy = guard.GuardPolicy.from_env()
+    res, rung = guard.run_with_fallback(
+        site, chain or guard.chain_from(_engine(engine), ENGINE_LADDER),
+        attempt, policy=policy,
+        sequential=sequential or (lambda: _sequential_reduce(op, bitmaps)))
+    if (rung != guard.SEQUENTIAL and policy.shadow_rate > 0.0
+            and guard.shadow_sample(1, policy.shadow_rate,
+                                    policy.shadow_seed, site)):
+        from ..runtime import errors
+
+        ref = _sequential_reduce(op, bitmaps)
+        if hasattr(res, "cardinality"):   # materialized result
+            bad, got, want = res != ref, res.cardinality, ref.cardinality
+        else:                             # cardinality-only result
+            bad, got, want = res != ref.cardinality, res, ref.cardinality
+        if bad:
+            detail = (f"cardinality {got} != {want}" if got != want else
+                      f"equal cardinality {got} but differing members")
+            raise errors.ShadowMismatch(
+                f"wide {op} over {len(bitmaps)} bitmaps diverged from the "
+                f"sequential reference: {detail}")
+    return res
 
 
 #: Blocked-layout rows per Pallas grid step for ad-hoc (non-resident) calls
@@ -48,12 +105,33 @@ BLOCK = 8
 
 
 def _aggregate_ragged(op: str, bitmaps: list[RoaringBitmap],
-                      engine: str, out_cls=None) -> RoaringBitmap:
+                      engine: str, out_cls=None,
+                      fallback: bool = True) -> RoaringBitmap:
+    """Guarded wide aggregation: the device body rides the runtime
+    fallback chain (retry transient, demote lowering/OOM, degrade to the
+    host sequential fold) so a single engine failure cannot take down the
+    query — see runtime.guard.  ``fallback=False`` runs the requested
+    engine raw (no guard, no injection): the escape hatch engine-pinned
+    parity tests need so a broken engine FAILS them instead of silently
+    demoting to a rung that still passes."""
     bitmaps = [b for b in bitmaps if not b.is_empty()]
     if not bitmaps:
         return (out_cls or RoaringBitmap)()
     if len(bitmaps) == 1:
         return _materialize(bitmaps[0])
+    if not fallback:
+        return _aggregate_ragged_device(op, bitmaps, _engine(engine),
+                                        out_cls)
+
+    def attempt(eng):
+        faults.maybe_fail("aggregation", eng)
+        return _aggregate_ragged_device(op, bitmaps, eng, out_cls)
+
+    return _guarded_wide(op, bitmaps, engine, attempt)
+
+
+def _aggregate_ragged_device(op: str, bitmaps: list[RoaringBitmap],
+                             engine: str, out_cls=None) -> RoaringBitmap:
     # block count is computable from key counts alone — check the SMEM
     # ceiling BEFORE densifying the blocked tensor
     use_blocked = (packing.blocked_block_count(bitmaps, BLOCK)
@@ -105,14 +183,18 @@ def _run_ragged(op: str, packed: packing.PackedAggregation, engine: str):
         jnp.asarray(packed.head_idx), dense.n_steps_for(packed.max_group))
 
 
-def or_(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
+def or_(*bitmaps: RoaringBitmap, engine: str = "auto",
+        fallback: bool = True) -> RoaringBitmap:
     """Wide union on device (FastAggregation.or :664 / ParallelAggregation.or :160)."""
-    return _aggregate_ragged("or", _flatten(bitmaps), engine)
+    return _aggregate_ragged("or", _flatten(bitmaps), engine,
+                             fallback=fallback)
 
 
-def xor(*bitmaps: RoaringBitmap, engine: str = "auto") -> RoaringBitmap:
+def xor(*bitmaps: RoaringBitmap, engine: str = "auto",
+        fallback: bool = True) -> RoaringBitmap:
     """Wide symmetric difference (FastAggregation.xor / ParallelAggregation.xor)."""
-    return _aggregate_ragged("xor", _flatten(bitmaps), engine)
+    return _aggregate_ragged("xor", _flatten(bitmaps), engine,
+                             fallback=fallback)
 
 
 def _intersect_keys(bitmaps: list[RoaringBitmap]) -> np.ndarray:
@@ -150,9 +232,10 @@ def _and_device_words(bitmaps: list[RoaringBitmap]):
 
 
 def and_(*bitmaps: RoaringBitmap, engine: str = "auto",
-         out_cls=None) -> RoaringBitmap:
+         out_cls=None, fallback: bool = True) -> RoaringBitmap:
     """Wide intersection (FastAggregation.and workShyAnd :356): key-mask
-    intersection, then one regular [K, N, 2048] AND-reduce."""
+    intersection, then one regular [K, N, 2048] AND-reduce — guarded, with
+    the host fold as the degradation rung."""
     cls = out_cls or RoaringBitmap
     bitmaps = _flatten(bitmaps)
     if not bitmaps:
@@ -161,43 +244,90 @@ def and_(*bitmaps: RoaringBitmap, engine: str = "auto",
         return cls()
     if len(bitmaps) == 1:
         return _materialize(bitmaps[0])
-    res = _and_device_words(bitmaps)
-    if res is None:
-        return cls()
-    keys, words, cards = res
-    return packing.unpack_result(keys, np.asarray(words),
-                                 np.asarray(cards), out_cls=cls)
+
+    def raw():
+        res = _and_device_words(bitmaps)
+        if res is None:
+            return cls()
+        keys, words, cards = res
+        return packing.unpack_result(keys, np.asarray(words),
+                                     np.asarray(cards), out_cls=cls)
+
+    if not fallback:
+        return raw()           # raw path: no guard, no injection
+
+    def attempt(eng):
+        faults.maybe_fail("aggregation", eng)
+        return raw()
+
+    # the AND pipeline has ONE device engine (regular_reduce_and is plain
+    # XLA, no engine parameter), so the only honest demotion is straight
+    # to the host fold
+    return _guarded_wide("and", bitmaps, engine, attempt, chain=("xla",))
 
 
-def or_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto") -> int:
+def _wide_cardinality(op: str, bitmaps: list, engine: str,
+                      fallback: bool = True) -> int:
+    """Guarded cardinality-only wide op: one pack, engine-parameterized
+    reduce, host fold as the terminal rung."""
+    packed = packing.pack_for_aggregation(bitmaps)
+
+    def raw(eng):
+        _, cards = _run_ragged(op, packed, eng)
+        return int(np.asarray(jnp.sum(cards)))
+
+    if not fallback:
+        return raw(_engine(engine))   # raw path: no guard, no injection
+
+    def attempt(eng):
+        faults.maybe_fail("aggregation", eng)
+        return raw(eng)
+
+    return _guarded_wide(
+        op, bitmaps, engine, attempt,
+        sequential=lambda: _sequential_reduce(op, bitmaps).cardinality)
+
+
+def or_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto",
+                   fallback: bool = True) -> int:
     """Cardinality of the wide union without materializing it on host."""
     bitmaps = [b for b in _flatten(bitmaps) if not b.is_empty()]
     if not bitmaps:
         return 0
-    packed = packing.pack_for_aggregation(bitmaps)
-    _, cards = _run_ragged("or", packed, engine)
-    return int(np.asarray(jnp.sum(cards)))
+    return _wide_cardinality("or", bitmaps, engine, fallback)
 
 
-def and_cardinality(*bitmaps: RoaringBitmap) -> int:
+def and_cardinality(*bitmaps: RoaringBitmap, fallback: bool = True) -> int:
     bitmaps = _flatten(bitmaps)
     if not bitmaps or any(b.is_empty() for b in bitmaps):
         return 0
     if len(bitmaps) == 1:
         return bitmaps[0].cardinality
-    res = _and_device_words(bitmaps)
-    if res is None:
-        return 0
-    return int(np.asarray(jnp.sum(res[2])))
+
+    def raw():
+        res = _and_device_words(bitmaps)
+        if res is None:
+            return 0
+        return int(np.asarray(jnp.sum(res[2])))
+
+    if not fallback:
+        return raw()           # raw path: no guard, no injection
+
+    def attempt(eng):
+        faults.maybe_fail("aggregation", eng)
+        return raw()
+
+    return _guarded_wide(
+        "and", bitmaps, "auto", attempt, chain=("xla",),
+        sequential=lambda: _sequential_reduce("and", bitmaps).cardinality)
 
 
-def xor_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto") -> int:
+def xor_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto",
+                    fallback: bool = True) -> int:
     bitmaps = [b for b in _flatten(bitmaps) if not b.is_empty()]
     if not bitmaps:
         return 0
-    packed = packing.pack_for_aggregation(bitmaps)
-    _, cards = _run_ragged("xor", packed, engine)
-    return int(np.asarray(jnp.sum(cards)))
+    return _wide_cardinality("xor", bitmaps, engine, fallback)
 
 
 def _materialize(b) -> RoaringBitmap:
@@ -424,24 +554,25 @@ def pairwise_cardinality(op: str, pairs, engine: str = "auto") -> np.ndarray:
 # is the u64 high-48 key instead of the u16 key (SURVEY §2.3 — the 64-bit
 # extension reuses the same packed container pools).
 
-def or64(*bitmaps, engine: str = "auto"):
+def or64(*bitmaps, engine: str = "auto", fallback: bool = True):
     from ..core.bitmap64 import Roaring64Bitmap
 
     return _aggregate_ragged("or", _flatten(bitmaps), engine,
-                             out_cls=Roaring64Bitmap)
+                             out_cls=Roaring64Bitmap, fallback=fallback)
 
 
-def xor64(*bitmaps, engine: str = "auto"):
+def xor64(*bitmaps, engine: str = "auto", fallback: bool = True):
     from ..core.bitmap64 import Roaring64Bitmap
 
     return _aggregate_ragged("xor", _flatten(bitmaps), engine,
-                             out_cls=Roaring64Bitmap)
+                             out_cls=Roaring64Bitmap, fallback=fallback)
 
 
-def and64(*bitmaps, engine: str = "auto"):
+def and64(*bitmaps, engine: str = "auto", fallback: bool = True):
     from ..core.bitmap64 import Roaring64Bitmap
 
-    return and_(*bitmaps, engine=engine, out_cls=Roaring64Bitmap)
+    return and_(*bitmaps, engine=engine, out_cls=Roaring64Bitmap,
+                fallback=fallback)
 
 
 class DeviceBitmapSet:

@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import operator
 
 import jax
 import jax.numpy as jnp
@@ -67,11 +68,23 @@ import numpy as np
 
 from ..core.bitmap import RoaringBitmap
 from ..ops import dense, kernels, packing
+from ..runtime import faults, guard
+from ..runtime.cache import LRUCache
 from .aggregation import DeviceBitmapSet, _engine
 
 WORDS32 = packing.WORDS32
 
 _RED_OP = {"or": "or", "xor": "xor", "and": "and", "andnot": "or"}
+
+#: engine fallback ladder, fastest first; every guarded dispatch ends at
+#: the CPU sequential reference rung appended by runtime.guard
+ENGINE_LADDER = ("pallas", "xla", "xla-vmap")
+
+#: cache caps: a long-lived server with adversarial query shapes must not
+#: grow the prepared-plan / compiled-program maps without bound (plans are
+#: host arrays, programs pin compiled XLA executables)
+PLAN_CACHE_MAX = 256
+PROGRAM_CACHE_MAX = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -139,8 +152,10 @@ class BatchEngine:
         self._row_src = np.asarray(ds._packed.row_src)
         self._row_seg = np.repeat(np.asarray(ds._packed.blk_seg),
                                   ds.block).astype(np.int32)
-        self._programs: dict = {}
-        self._plans: dict = {}
+        self._programs = LRUCache(PROGRAM_CACHE_MAX)
+        self._plans = LRUCache(PLAN_CACHE_MAX)
+        self._hosts = None        # lazy CPU-reference copies of the sources
+        self.split_count = 0      # ResourceExhausted batch halvings served
 
     @classmethod
     def from_bitmaps(cls, bitmaps: list, layout: str = "dense",
@@ -247,6 +262,9 @@ class BatchEngine:
         Plans are cached by the exact query tuple (BatchQuery is frozen/
         hashable) — the prepared-statement pattern: a serving loop reissuing
         the same batch shape pays the NumPy planning and array upload once.
+        Both this cache and the program cache are bounded LRUs
+        (runtime.cache.LRUCache) so adversarial query shapes cannot grow a
+        long-lived server without limit; see ``cache_stats``.
         """
         key = tuple(queries)
         cached = self._plans.get(key)
@@ -260,9 +278,7 @@ class BatchEngine:
                 (qid, q, rows, segs, keys_q, keep, hrows))
         plan = [self._plan_bucket(op, items)
                 for (op, _), items in sorted(groups.items())]
-        if len(self._plans) >= 256:   # bound the prepared-plan cache
-            self._plans.clear()
-        self._plans[key] = plan
+        self._plans.put(key, plan)
         return plan
 
     # ------------------------------------------------------------ execution
@@ -324,10 +340,11 @@ class BatchEngine:
         cards = dense.popcount(heads)
         return (heads if needs_words else None), cards
 
-    def _program(self, plan, engine: str):
+    def _program(self, plan, eng: str):
         """Jitted (and eager) batch program for this plan's signature: ONE
-        call = one compiled XLA program = one device dispatch."""
-        eng = self._bucket_engine(plan, engine)
+        call = one compiled XLA program = one device dispatch.  ``eng`` is
+        an already-resolved rung (the caller ran _bucket_engine): one
+        resolution per dispatch, shared with the faults hook."""
         src, kind = self._resident_src()
         sig = (eng, kind, tuple(b.signature for b in plan))
         cached = self._programs.get(sig)
@@ -341,7 +358,7 @@ class BatchEngine:
                     for s, a in zip(b_sigs, barrays)]
 
         cached = (run, jax.jit(run))
-        self._programs[sig] = cached
+        self._programs.put(sig, cached)
         return cached
 
     def _bucket_engine(self, plan, engine: str) -> str:
@@ -356,14 +373,77 @@ class BatchEngine:
                 eng = "xla"  # in-program chunk densify: chunk_row prefetch
         return eng
 
-    def execute(self, queries, engine: str = "auto",
-                jit: bool = True) -> list[BatchResult]:
-        """Run Q queries in one device dispatch; results in input order."""
+    def execute(self, queries, engine: str = "auto", jit: bool = True,
+                fallback: bool = True,
+                policy: guard.GuardPolicy | None = None
+                ) -> list[BatchResult]:
+        """Run Q queries in one device dispatch; results in input order.
+
+        Guarded dispatch (runtime.guard): transient device faults get
+        bounded retries, lowering/OOM failures demote down the engine
+        ladder (pallas -> xla -> xla-vmap -> CPU sequential reference),
+        ResourceExhausted first halves the batch (smaller gathers, smaller
+        peak HBM — the HBM-bounded-gathers split), and an opt-in shadow
+        mode (policy.shadow_rate / ROARING_TPU_SHADOW) cross-checks a
+        sampled fraction of queries against the sequential reference.
+        Every rung is bit-exact, so degradation changes throughput only.
+        ``fallback=False`` runs the raw single-engine path (parity probes
+        that must pin one engine).
+        """
         queries = list(queries)
         if not queries:
             return []
+        if not fallback:
+            # raw single-engine path: no guard AND no injection — a parity
+            # probe pinning one engine must see that engine's true output
+            return self._execute_once(queries, engine, jit, inject=False)
+        policy = policy or guard.GuardPolicy.from_env()
+        chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
+        return self._dispatch(queries, chain, jit, policy,
+                              guard.Deadline(policy.deadline))
+
+    def _dispatch(self, queries, chain, jit, policy, deadline):
+        """One guarded run of `queries` down `chain`; recurses on OOM
+        splits (each half restarts at the failing rung, sharing the
+        deadline)."""
+
+        split = False
+
+        def attempt(eng):
+            return self._execute_once(queries, eng, jit)
+
+        def on_oom(eng, fault, dl):
+            nonlocal split
+            if len(queries) < 2:
+                return guard.NO_SPLIT   # nothing to halve: demote instead
+            sub = chain[chain.index(eng):] if eng in chain else chain
+            mid = (len(queries) + 1) // 2
+            self.split_count += 1
+            split = True
+            return (self._dispatch(queries[:mid], sub, jit, policy, dl)
+                    + self._dispatch(queries[mid:], sub, jit, policy, dl))
+
+        results, rung = guard.run_with_fallback(
+            "batch_engine", chain, attempt, policy=policy,
+            sequential=lambda: self._execute_sequential(queries),
+            on_resource_exhausted=on_oom, deadline=deadline)
+        # split halves were shadow-checked inside their own dispatches
+        if rung != guard.SEQUENTIAL and not split \
+                and policy.shadow_rate > 0.0:
+            self._shadow_check(queries, results, policy)
+        return results
+
+    def _execute_once(self, queries, engine: str, jit: bool,
+                      inject: bool = True) -> list[BatchResult]:
+        """Raw single-engine batch: plan -> one compiled program -> host
+        assembly.  The faults hook sits at the engine boundary — exactly
+        where a real lowering/OOM/transient failure would surface;
+        ``inject=False`` (the fallback=False path) skips it entirely."""
         plan = self.plan(queries)
-        run, run_jit = self._program(plan, engine)
+        eng = self._bucket_engine(plan, engine)
+        if inject:
+            faults.maybe_fail("batch_engine", eng)
+        run, run_jit = self._program(plan, eng)
         src, _ = self._resident_src()
         outs = (run_jit if jit else run)(src, [b.arrays for b in plan])
         results: list = [None] * len(queries)
@@ -381,7 +461,97 @@ class BatchEngine:
                         np.zeros((0, WORDS32), np.uint32),
                         cards[slot, :kq])
                 results[qid] = BatchResult(cardinality=card, bitmap=bm)
+        if inject and faults.should_corrupt("batch_engine", eng):
+            # deterministic silent corruption (fault kind "silent"): the
+            # case only the shadow cross-check can catch
+            results[0] = BatchResult(cardinality=results[0].cardinality + 1,
+                                     bitmap=results[0].bitmap)
         return results
+
+    # ----------------------------------------------- CPU sequential rung
+
+    def _host_sources(self) -> list:
+        """Host copies of the resident source bitmaps, rebuilt ONCE from
+        the resident image via row_src/row_seg (works for any ingest —
+        objects, serialized bytes, views — because it reads what is
+        actually resident).  This is the data the sequential reference
+        rung and the shadow cross-check run on."""
+        if self._hosts is None:
+            words = np.asarray(self._ds._resident_words("xla"))
+            hosts = []
+            for i in range(self.n):
+                rows = np.flatnonzero(self._row_src == i)
+                w = words[rows]
+                cards = (np.unpackbits(w.view(np.uint8), axis=1).sum(axis=1)
+                         if rows.size else np.zeros(0, np.int64))
+                hosts.append(packing.unpack_result(
+                    self.keys[self._row_seg[rows]], w, cards))
+            self._hosts = hosts
+        return self._hosts
+
+    def _sequential_one(self, q: BatchQuery):
+        """Host-side reference for ONE query, mirroring the batch
+        semantics exactly (operands as a set; andnot = head minus the
+        union of the rest, head index included if repeated)."""
+        srcs = self._host_sources()
+        if not q.operands:
+            return srcs[0].__class__() if srcs else RoaringBitmap()
+        if q.op == "andnot":
+            head = srcs[int(q.operands[0])].clone()
+            rest = sorted({int(i) for i in q.operands[1:]})
+            acc = head
+            for i in rest:
+                acc = acc - srcs[i]
+            return acc
+        fn = {"or": operator.or_, "and": operator.and_,
+              "xor": operator.xor}[q.op]
+        sub = sorted({int(i) for i in q.operands})
+        acc = srcs[sub[0]].clone()
+        for i in sub[1:]:
+            acc = fn(acc, srcs[i])
+        return acc
+
+    def _execute_sequential(self, queries) -> list[BatchResult]:
+        """The terminal fallback rung: per-query host container algebra —
+        the bit-exact CPU reference every engine is pinned against."""
+        out = []
+        for q in queries:
+            rb = self._sequential_one(q)
+            out.append(BatchResult(
+                cardinality=rb.cardinality,
+                bitmap=rb if q.form == "bitmap" else None))
+        return out
+
+    def _shadow_check(self, queries, results, policy) -> None:
+        """Re-run a sampled fraction on the sequential reference; raise
+        ShadowMismatch on divergence (silent corruption detector)."""
+        from ..runtime import errors
+
+        idx = guard.shadow_sample(len(queries), policy.shadow_rate,
+                                  policy.shadow_seed, "batch_engine")
+        for i in idx:
+            ref = self._sequential_one(queries[i])
+            got = results[i]
+            bad = got.cardinality != ref.cardinality
+            if not bad and queries[i].form == "bitmap":
+                bad = got.bitmap != ref
+            if bad:
+                detail = (f"cardinality {got.cardinality} != "
+                          f"{ref.cardinality}"
+                          if got.cardinality != ref.cardinality else
+                          f"equal cardinality {ref.cardinality} but "
+                          f"differing members")
+                raise errors.ShadowMismatch(
+                    f"batch_engine query {i} ({queries[i].op} over "
+                    f"{queries[i].operands}) diverged from the sequential "
+                    f"reference: {detail}")
+
+    def cache_stats(self) -> dict:
+        """Observability for the bounded plan/program caches (size, cap,
+        hits, misses, evictions) plus the OOM split counter."""
+        return {"plans": self._plans.stats(),
+                "programs": self._programs.stats(),
+                "splits": self.split_count}
 
     def cardinalities(self, queries, engine: str = "auto") -> np.ndarray:
         """i64[Q] result cardinalities, one dispatch."""

@@ -23,12 +23,24 @@ instead of explicit rank-to-rank sends.
 
 from __future__ import annotations
 
+import inspect
+import os
+import socket
+import time
+
 import numpy as np
+
+#: default bound on the coordinator handshake, seconds (overridable per
+#: call); a missing peer must become a typed CoordinatorTimeout, not an
+#: indefinite hang in jax.distributed.initialize
+ENV_COORD_TIMEOUT = "ROARING_TPU_COORD_TIMEOUT_S"
+DEFAULT_COORD_TIMEOUT = 120.0
 
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
-               process_id: int | None = None) -> None:
+               process_id: int | None = None,
+               timeout: float | None = None) -> None:
     """Join (or bootstrap) the multi-host runtime.
 
     No-arg form uses the cluster environment (TPU pod metadata / launcher
@@ -36,11 +48,98 @@ def initialize(coordinator_address: str | None = None,
     explicit form mirrors an MPI-style rank launch.  Call once per
     process, before any backend use.  Single-process runs may skip this
     entirely.
-    """
-    import jax
 
-    jax.distributed.initialize(coordinator_address, num_processes,
-                               process_id)
+    ``timeout`` (default ``ROARING_TPU_COORD_TIMEOUT_S``, 120 s) bounds
+    the coordinator handshake: one budget shared by the pre-flight TCP
+    probe (non-coordinator ranks with an explicit address) and jax's own
+    ``initialization_timeout``.  An unreachable coordinator or a gRPC
+    deadline raises ``runtime.errors.CoordinatorTimeout`` naming the
+    coordinator address and process id instead of a raw gRPC traceback.
+    Other failures (bad arguments, double initialization) propagate
+    unchanged.  (On jax builds without ``initialization_timeout`` the
+    probe is the only typed protection — the C++ coordination client
+    aborts the process on its own internal deadline, so no Python-side
+    watchdog can bound the handshake once it is entered.)
+    """
+    from ..runtime import errors, faults
+
+    if timeout is None:
+        timeout = float(os.environ.get(ENV_COORD_TIMEOUT,
+                                       DEFAULT_COORD_TIMEOUT))
+
+    def describe() -> str:
+        return (f"coordinator {coordinator_address or '<auto-detected>'}, "
+                f"process_id {process_id if process_id is not None else '<auto>'}")
+
+    deadline = time.monotonic() + timeout
+    try:
+        faults.maybe_fail("multihost", "coordinator")
+        if coordinator_address and process_id not in (None, 0):
+            # pre-flight TCP probe with retry-until-deadline: XLA's
+            # coordination client LOG(FATAL)s the whole process when its
+            # own handshake deadline fires, so an unreachable coordinator
+            # must be detected BEFORE the C++ client is entered — that is
+            # the only place a typed Python error can still be raised
+            _probe_coordinator(coordinator_address, timeout, deadline,
+                               describe, errors)
+        import jax
+
+        # the handshake gets whatever the probe left of the ONE budget
+        remaining = max(deadline - time.monotonic(), 1.0)
+        kw = {}
+        params = inspect.signature(jax.distributed.initialize).parameters
+        if "initialization_timeout" in params:
+            # jax enforces the bound itself: the clean path — the connect
+            # loop gives up and raises instead of retrying forever
+            kw["initialization_timeout"] = max(int(remaining), 1)
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id, **kw)
+        else:
+            # old jax without the knob: call directly.  A watchdog thread
+            # would be worse than nothing — the abandoned C++ coordination
+            # client LOG(FATAL)s the whole process when ITS handshake
+            # deadline fires, after the caller already got a typed error
+            # and kept serving.  Without the knob, the pre-flight probe
+            # above is the only typed-timeout protection.
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id)
+    except errors.CoordinatorTimeout:
+        raise
+    except Exception as exc:
+        fault = errors.classify(exc)
+        if isinstance(fault, (errors.CoordinatorTimeout,
+                              errors.TransientDeviceError)):
+            raise errors.CoordinatorTimeout(
+                f"multihost.initialize: {describe()} unreachable within "
+                f"{timeout:g}s: {exc}") from exc
+        raise
+
+
+def _probe_coordinator(address: str, timeout: float, deadline: float,
+                       describe, errors) -> None:
+    """Block until a TCP connection to the coordinator succeeds or the
+    deadline (shared with the handshake stage) passes, raising a typed
+    CoordinatorTimeout.  Retries with backoff: the coordinator process
+    may legitimately bind a moment after its peers launch, exactly like
+    jax's own connect loop."""
+    host, _, port_s = address.rpartition(":")
+    host = host.strip("[]")   # bracketed IPv6 literals ([::1]:8476)
+    if not host or not port_s.isdigit():
+        return  # unparseable (unix socket, exotic scheme): let jax try
+    delay = 0.1
+    while True:
+        budget = deadline - time.monotonic()
+        try:
+            with socket.create_connection((host, int(port_s)),
+                                          timeout=max(0.1, min(2.0, budget))):
+                return
+        except OSError as exc:
+            if time.monotonic() >= deadline:
+                raise errors.CoordinatorTimeout(
+                    f"multihost.initialize: {describe()} unreachable "
+                    f"within {timeout:g}s: {exc}") from exc
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2.0, 2.0)
 
 
 def global_mesh(lanes: int | None = None,

@@ -307,7 +307,7 @@ def _sharded_densify(mesh: Mesh, row_axis: str, rows_per_shard: int,
 
 
 def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,
-                           ingest: str = "dense"
+                           ingest: str = "dense", fallback: bool = True
                            ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """End to end: pack, shard, reduce across the mesh. Returns (keys, words, cards).
 
@@ -317,11 +317,67 @@ def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,
     routes through the workShy key-intersection path for either ingest
     (byte-backed sources are wrapped zero-copy; only surviving containers
     materialize).
+
+    Guarded (runtime.guard): transient mesh/collective failures retry with
+    backoff; a classified fault that survives retries degrades to the host
+    sequential fold, which returns an equivalent (keys, words, cards)
+    triple (zero-cardinality keys normalized away) — a lost mesh costs
+    throughput, never availability or bits.  ``fallback=False`` runs the
+    sharded path raw (no guard, no injection), the pin parity tests use
+    so a sharded-path regression cannot hide behind the host fold.
     """
     if ingest not in ("dense", "compact"):
         raise ValueError(f"unknown ingest {ingest!r}")
     if op not in ("or", "xor", "and"):
         raise ValueError(f"unsupported sharded wide op {op!r}")
+    from ..runtime import faults, guard
+
+    bitmaps = list(bitmaps)
+    if not fallback:
+        return _wide_aggregate_sharded_device(mesh, op, bitmaps, ingest)
+
+    def attempt(rung):
+        faults.maybe_fail("sharding", rung)
+        return _wide_aggregate_sharded_device(mesh, op, bitmaps, ingest)
+
+    res, _ = guard.run_with_fallback(
+        "sharding", ("sharded",), attempt,
+        sequential=lambda: _sequential_sharded(op, bitmaps))
+    return res
+
+
+def _sequential_sharded(op: str, bitmaps
+                        ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """CPU sequential reference for the sharded wide ops, shaped like the
+    device result: host container fold, then one dense (keys, words,
+    cards) materialization."""
+    from .aggregation import _sequential_reduce
+
+    bs = [b for b in _wrap_bytes(bitmaps)]
+    empty = (np.empty(0, np.uint16), np.zeros((0, WORDS32), np.uint32),
+             np.zeros((0,), np.int32))
+    if not bs:
+        return empty
+    if op == "and" and any(b.is_empty() for b in bs):
+        return empty
+    if op != "and":
+        bs = [b for b in bs if not b.is_empty()]
+        if not bs:
+            return empty
+    acc = _sequential_reduce(op, bs)
+    if acc.is_empty():
+        return empty
+    packed = packing.pack_for_aggregation([acc], pad_rows=False)
+    words = np.asarray(packed.words, dtype=np.uint32)
+    cards = np.unpackbits(words.view(np.uint8), axis=1).sum(
+        axis=1).astype(np.int32)
+    return packed.keys, words, cards
+
+
+def _wide_aggregate_sharded_device(mesh: Mesh, op: str, bitmaps,
+                                   ingest: str
+                                   ) -> tuple[np.ndarray, np.ndarray,
+                                              np.ndarray]:
     # byte-backed sources work on every path: zero-copy wrap for the object
     # consumers (pack_for_aggregation / the AND key intersection); the
     # compact packer handles bytes natively

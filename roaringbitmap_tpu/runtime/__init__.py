@@ -1,0 +1,28 @@
+"""Hardened query runtime: typed errors, guarded dispatch, fault injection.
+
+See docs/ROBUSTNESS.md for the taxonomy, the fallback order, the
+``ROARING_TPU_FAULTS`` grammar, and the shadow cross-check knob.
+"""
+
+from . import cache, errors, faults, guard
+from .cache import LRUCache
+from .errors import (
+    CoordinatorTimeout,
+    CorruptInput,
+    EngineLoweringError,
+    ResourceExhausted,
+    RoaringRuntimeError,
+    ShadowMismatch,
+    TransientDeviceError,
+    classify,
+)
+from .guard import (Deadline, GuardPolicy, dispatch_stats,
+                    reset_dispatch_stats, run_with_fallback)
+
+__all__ = [
+    "cache", "errors", "faults", "guard", "LRUCache",
+    "RoaringRuntimeError", "TransientDeviceError", "ResourceExhausted",
+    "EngineLoweringError", "CoordinatorTimeout", "CorruptInput",
+    "ShadowMismatch", "classify", "Deadline", "GuardPolicy",
+    "dispatch_stats", "reset_dispatch_stats", "run_with_fallback",
+]

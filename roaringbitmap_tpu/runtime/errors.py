@@ -1,0 +1,140 @@
+"""Typed error taxonomy for the hardened query runtime.
+
+The reference library runs in one JVM and lets every failure surface as a
+Java exception to the caller; this rebuild dispatches work to accelerators,
+distributed coordinators, and serialized byte streams, where raw failures
+arrive as stringly-typed XLA status messages, gRPC tracebacks, or numpy
+struct errors.  This module is the single place those raw shapes are
+classified into a small taxonomy that callers (and runtime.guard) can act
+on mechanically:
+
+  retryable              -> TransientDeviceError, CoordinatorTimeout
+  demote / split         -> ResourceExhausted
+  demote (deterministic) -> EngineLoweringError
+  fatal (input's fault)  -> CorruptInput (== format.spec.InvalidRoaringFormat)
+  fatal (engine's fault) -> ShadowMismatch
+
+``classify`` maps a raw exception to a taxonomy instance, or ``None`` when
+the exception looks like a programming error — the guard re-raises those
+untouched so the fault-tolerance layer never masks a real bug.
+"""
+
+from __future__ import annotations
+
+# Corrupt serialized input already has a contracted type at the format
+# layer; the runtime taxonomy re-exports it rather than inventing a second
+# class for the same fault (satellite: format errors surface as
+# runtime.errors.CorruptInput).
+from ..format.spec import InvalidRoaringFormat
+
+CorruptInput = InvalidRoaringFormat
+
+
+class RoaringRuntimeError(Exception):
+    """Base of the runtime taxonomy (CorruptInput subclasses ValueError
+    via InvalidRoaringFormat instead — it predates this module and is
+    raised by parse layers that never import the runtime)."""
+
+    #: bounded retry on the same engine rung can plausibly succeed
+    retryable = False
+    #: falling to the next engine rung can plausibly succeed
+    demotable = False
+
+
+class TransientDeviceError(RoaringRuntimeError):
+    """Device/runtime hiccup (UNAVAILABLE, ABORTED, connection drop):
+    retry with backoff; exhausted retries demote."""
+
+    retryable = True
+    demotable = True
+
+
+class ResourceExhausted(RoaringRuntimeError):
+    """Device OOM / allocator failure: halve the batch (less peak HBM)
+    or demote to a cheaper engine; retrying the same shape cannot help."""
+
+    demotable = True
+
+
+class EngineLoweringError(RoaringRuntimeError):
+    """Compiler/lowering failure (Mosaic rejection, unsupported primitive):
+    deterministic for a given (engine, shape) — demote immediately."""
+
+    demotable = True
+
+
+class CoordinatorTimeout(RoaringRuntimeError):
+    """Distributed coordinator unreachable / barrier timed out.  Message
+    names the coordinator address and process id (multihost.initialize)."""
+
+    retryable = True
+    demotable = True
+
+
+class ShadowMismatch(RoaringRuntimeError):
+    """Shadow cross-check found an engine result diverging from the CPU
+    sequential reference: silent corruption — always fatal, never retried
+    (a retry that happens to pass would hide a miscompiling engine)."""
+
+
+#: message fragments -> taxonomy, checked in order (first hit wins).  OOM
+#: before transient: XLA RESOURCE_EXHAUSTED statuses often also carry
+#: "while running replica" noise that the transient patterns would catch.
+#: Two pattern tiers per class, both deliberately NARROW — a genuine bug
+#: whose message merely brushes a keyword must stay unclassified (the
+#: guard re-raises it raw): uppercase absl/gRPC status tokens matched
+#: case-SENSITIVELY against the raw message, and multi-word lowercase
+#: phrases no plausible programming error emits.  Bare short words
+#: ("oom", "aborted", "coordinator") are excluded on purpose — "zoom",
+#: "scan aborted: invalid plan state" etc. must not become retryable.
+_OOM_TOKENS = ("RESOURCE_EXHAUSTED",)
+_OOM_PHRASES = (
+    "out of memory", "memory allocation failed", "exceeds the hbm",
+    "exceeds available memory",
+)
+_LOWERING_PHRASES = (
+    # "mosaic" is the TPU kernel compiler's own name; bare "pallas" is NOT
+    # here — `TypeError: pallas_call() got an unexpected keyword` is a
+    # programming error and must propagate raw
+    "mosaic", "lowering failed", "unsupported primitive", "cannot lower",
+    "unimplemented primitive", "not implemented for platform",
+    "mlir translation rule",
+)
+_COORDINATOR_PHRASES = (
+    "coordination service", "barrier timed out", "preemption notice",
+    "heartbeat timeout",
+)
+_TRANSIENT_TOKENS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                     "CANCELLED")
+_TRANSIENT_PHRASES = (
+    "deadline exceeded", "connection reset", "socket closed",
+    "failed to connect", "network error", "transient",
+)
+
+
+def classify(exc: BaseException):
+    """Raw exception -> taxonomy instance, or None for a programming error.
+
+    Already-typed exceptions pass through unchanged (identity), so
+    classification is idempotent and injected typed faults keep their
+    class.  Everything else is matched on its message text — the only
+    stable surface XLA/gRPC errors offer across jax versions.
+    """
+    if isinstance(exc, (RoaringRuntimeError, InvalidRoaringFormat)):
+        return exc
+    msg = f"{type(exc).__name__}: {exc}"
+    low = msg.lower()
+    if any(t in msg for t in _OOM_TOKENS) \
+            or any(p in low for p in _OOM_PHRASES):
+        return ResourceExhausted(msg)
+    # NOT a blanket NotImplementedError match: a stubbed host method is a
+    # programming error and must propagate raw, not demote engines — only
+    # compiler-flavored messages classify as lowering failures
+    if any(p in low for p in _LOWERING_PHRASES):
+        return EngineLoweringError(msg)
+    if any(p in low for p in _COORDINATOR_PHRASES):
+        return CoordinatorTimeout(msg)
+    if any(t in msg for t in _TRANSIENT_TOKENS) \
+            or any(p in low for p in _TRANSIENT_PHRASES):
+        return TransientDeviceError(msg)
+    return None

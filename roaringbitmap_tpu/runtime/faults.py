@@ -1,0 +1,199 @@
+"""Deterministic fault injection at the engine dispatch boundary.
+
+The retry/demote/split/degrade machinery in runtime.guard is only worth
+shipping if it is exercised continuously — real device faults are rare and
+never appear on the CPU CI backend, so this harness injects synthetic ones
+at the exact seam where real ones would surface (immediately before an
+engine executes), driven by one environment variable:
+
+    ROARING_TPU_FAULTS = "<entry>[,<entry>...]:<seed>"
+    entry              = <kind>[@<scope>][=<rate>]
+
+kind   one of ``transient`` (retryable device hiccup), ``oom`` (device
+       allocator failure), ``lowering`` (compiler rejection), ``corrupt``
+       (corrupt serialized input), ``coordinator`` (distributed barrier
+       timeout), ``silent`` (result corrupted WITHOUT an exception — only
+       the shadow cross-check can catch it).
+scope  optional dispatch-site name ("batch_engine", "aggregation",
+       "sharding", "multihost") or engine rung ("pallas", "xla",
+       "xla-vmap", "sharded", "coordinator"); omitted = everywhere.
+rate   probability per dispatch in (0, 1]; omitted = 1.0.
+
+Examples::
+
+    ROARING_TPU_FAULTS="lowering@pallas=1.0:7"        # kill the top rung
+    ROARING_TPU_FAULTS="transient=0.05,oom=0.02:1337" # CI background noise
+
+Determinism: every draw comes from a counter-keyed Philox stream seeded by
+(seed, rule index, site hash, call ordinal), so a fixed seed and a fixed
+call sequence reproduce the exact same fault schedule in any process — the
+property the CI fault shard and failure repros rely on.  Injected
+exceptions deliberately take the RAW shapes real faults arrive in (status-
+string RuntimeErrors, NotImplementedError) so errors.classify is exercised
+end to end, not bypassed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import zlib
+
+import numpy as np
+
+from . import errors
+
+ENV_VAR = "ROARING_TPU_FAULTS"
+
+KINDS = ("transient", "oom", "lowering", "corrupt", "coordinator", "silent")
+#: kinds that raise at the boundary (everything but the silent corruption)
+RAISING_KINDS = KINDS[:-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    kind: str
+    scope: str | None   # site or engine name; None matches everywhere
+    rate: float
+
+
+class FaultPlan:
+    """A parsed spec plus the per-(rule, site) draw counters that make the
+    schedule deterministic under a fixed call order."""
+
+    def __init__(self, rules: list[FaultRule], seed: int):
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self._counters: dict = {}
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        body, sep, seed_s = spec.rpartition(":")
+        if not sep:
+            raise ValueError(
+                f"{ENV_VAR} needs a ':<seed>' suffix, got {spec!r}")
+        try:
+            seed = int(seed_s, 0)
+        except ValueError:
+            raise ValueError(
+                f"{ENV_VAR} seed must be an integer, got {seed_s!r}") from None
+        rules = []
+        for entry in body.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            kind, rate = entry, 1.0
+            if "=" in entry:
+                kind, rate_s = entry.split("=", 1)
+                try:
+                    rate = float(rate_s)
+                except ValueError:
+                    raise ValueError(
+                        f"bad fault rate {rate_s!r} in {entry!r}") from None
+            scope = None
+            if "@" in kind:
+                kind, scope = kind.split("@", 1)
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (one of {KINDS})")
+            if not 0.0 < rate <= 1.0:
+                raise ValueError(
+                    f"fault rate must be in (0, 1], got {rate} in {entry!r}")
+            rules.append(FaultRule(kind, scope or None, rate))
+        if not rules:
+            raise ValueError(f"{ENV_VAR} spec {spec!r} has no fault entries")
+        return cls(rules, seed)
+
+    def _draw(self, rule_index: int, site_key: str) -> float:
+        key = (rule_index, site_key)
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        rng = np.random.default_rng(
+            (self.seed, rule_index, zlib.crc32(site_key.encode()), n))
+        return float(rng.random())
+
+    def pick(self, site: str, engine: str | None,
+             kinds: tuple = RAISING_KINDS) -> str | None:
+        """First matching rule whose deterministic draw fires, else None."""
+        for i, r in enumerate(self.rules):
+            if r.kind not in kinds:
+                continue
+            if r.scope is not None and r.scope not in (site, engine):
+                continue
+            if self._draw(i, f"{site}/{engine}") < r.rate:
+                return r.kind
+        return None
+
+
+# --------------------------------------------------------------- activation
+
+#: plans cached per spec string so env-driven activation keeps ONE counter
+#: state per process (fresh spec -> fresh schedule)
+_env_plans: dict = {}
+#: test/bench override stack (faults.inject) — wins over the environment
+_override: list = []
+
+
+def active() -> FaultPlan | None:
+    if _override:
+        return _override[-1]
+    spec = os.environ.get(ENV_VAR)
+    if not spec:
+        return None
+    plan = _env_plans.get(spec)
+    if plan is None:
+        plan = _env_plans[spec] = FaultPlan.from_spec(spec)
+    return plan
+
+
+@contextlib.contextmanager
+def inject(spec: str):
+    """Scoped activation with a FRESH schedule (counters restart), for
+    tests and the bench degraded-mode lane."""
+    plan = FaultPlan.from_spec(spec)
+    _override.append(plan)
+    try:
+        yield plan
+    finally:
+        _override.pop()
+
+
+# ---------------------------------------------------------------- injection
+
+def maybe_fail(site: str, engine: str | None = None) -> None:
+    """The engine-boundary hook: raise an injected raw-shaped fault when the
+    active plan fires for (site, engine).  No active plan = zero work."""
+    plan = active()
+    if plan is None:
+        return
+    kind = plan.pick(site, engine)
+    if kind is not None:
+        raise_fault(kind, site, engine)
+
+
+def should_corrupt(site: str, engine: str | None = None) -> bool:
+    """True when a ``silent`` rule fires: the caller must perturb its own
+    result (the harness cannot reach into engine outputs generically)."""
+    plan = active()
+    return (plan is not None
+            and plan.pick(site, engine, kinds=("silent",)) is not None)
+
+
+def raise_fault(kind: str, site: str, engine: str | None):
+    tag = f"(injected fault at {site}/{engine or '-'})"
+    if kind == "transient":
+        raise RuntimeError(f"UNAVAILABLE: device connection dropped {tag}")
+    if kind == "oom":
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: out of memory allocating device "
+            f"buffer {tag}")
+    if kind == "lowering":
+        raise NotImplementedError(f"Mosaic lowering failed {tag}")
+    if kind == "corrupt":
+        raise errors.CorruptInput(f"corrupt serialized input {tag}")
+    if kind == "coordinator":
+        raise RuntimeError(
+            f"DEADLINE_EXCEEDED: coordination service barrier timed "
+            f"out {tag}")
+    raise ValueError(f"unknown fault kind {kind!r}")

@@ -1,0 +1,246 @@
+"""Guarded dispatch: bounded retry, engine fallback chain, deadline, shadow.
+
+Every query-serving entry point (parallel.batch_engine, the
+aggregation.wide_* functions, sharding.wide_aggregate_sharded) routes its
+engine execution through ``run_with_fallback``.  The contract:
+
+- **Transient faults** (errors.retryable) get bounded retries with
+  exponential backoff on the SAME rung; exhausted retries demote.
+- **Lowering faults** demote immediately — recompiling the same shape on
+  the same engine is deterministic failure.
+- **ResourceExhausted** first offers the call site a split (the batch
+  engine halves Q — smaller gathers, smaller peak HBM), then demotes.
+- **CorruptInput** is the input's fault: fatal immediately, no rung can
+  parse garbage into a correct answer.
+- Every chain ends at the call site's **CPU sequential reference** — the
+  bit-exact host path PR 1's parity suites pinned every engine against —
+  so degradation never changes results, only throughput.
+- An expired **deadline** stops the whole ladder and re-raises the last
+  classified fault (typed, never a bare RuntimeError).
+- Exceptions ``errors.classify`` cannot type are programming errors and
+  propagate untouched: the fault layer must never mask a real bug.
+
+The opt-in **shadow cross-check** (``ROARING_TPU_SHADOW=<rate>[:<seed>]``
+or GuardPolicy.shadow_rate) re-runs a sampled fraction of queries on the
+sequential reference after a successful engine dispatch and raises
+ShadowMismatch on any divergence — the only detector for an engine that
+silently miscompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+import zlib
+from typing import Callable
+
+import numpy as np
+
+from . import errors
+
+_log = logging.getLogger("roaringbitmap_tpu.runtime")
+
+#: the terminal rung of every chain: the CPU sequential reference path
+SEQUENTIAL = "sequential"
+
+#: sentinel a ResourceExhausted splitter returns to decline (fall through
+#: to demotion)
+NO_SPLIT = object()
+
+ENV_MAX_ATTEMPTS = "ROARING_TPU_MAX_ATTEMPTS"
+ENV_BACKOFF = "ROARING_TPU_BACKOFF_S"
+ENV_DEADLINE = "ROARING_TPU_DEADLINE_S"
+ENV_SHADOW = "ROARING_TPU_SHADOW"
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardPolicy:
+    """Knobs for one guarded dispatch; ``from_env`` is the serving default."""
+
+    max_attempts: int = 3          # per rung, transient faults only
+    backoff_base: float = 0.02    # seconds; doubles per retry
+    backoff_factor: float = 2.0
+    backoff_max: float = 1.0
+    deadline: float | None = None  # whole-dispatch wall budget, seconds
+    shadow_rate: float = 0.0       # fraction of queries cross-checked
+    shadow_seed: int = 0x5AD0
+    sleep: Callable[[float], None] = time.sleep
+
+    @classmethod
+    def from_env(cls, **overrides) -> "GuardPolicy":
+        env: dict = {}
+        if ENV_MAX_ATTEMPTS in os.environ:
+            env["max_attempts"] = max(1, int(os.environ[ENV_MAX_ATTEMPTS]))
+        if ENV_BACKOFF in os.environ:
+            env["backoff_base"] = float(os.environ[ENV_BACKOFF])
+        if ENV_DEADLINE in os.environ:
+            env["deadline"] = float(os.environ[ENV_DEADLINE])
+        if ENV_SHADOW in os.environ:
+            spec = os.environ[ENV_SHADOW]
+            rate, _, seed = spec.partition(":")
+            env["shadow_rate"] = float(rate)
+            if seed:
+                env["shadow_seed"] = int(seed, 0)
+        env.update(overrides)
+        return cls(**env)
+
+
+class Deadline:
+    """Monotonic wall budget shared across retries, rungs, and recursive
+    batch splits (a split must not reset the clock)."""
+
+    def __init__(self, seconds: float | None, clock=time.monotonic):
+        self.seconds = seconds
+        self._clock = clock
+        self._t0 = clock()
+
+    def expired(self) -> bool:
+        return (self.seconds is not None
+                and self._clock() - self._t0 >= self.seconds)
+
+    def remaining(self) -> float:
+        if self.seconds is None:
+            return float("inf")
+        return max(0.0, self.seconds - (self._clock() - self._t0))
+
+
+def chain_from(engine: str, ladder: tuple) -> tuple:
+    """Fallback chain starting at ``engine``'s rung of ``ladder`` and
+    always ending at the sequential reference.  An engine outside the
+    ladder (already-resolved special modes) gets itself + sequential."""
+    if engine in ladder:
+        return tuple(ladder[ladder.index(engine):]) + (SEQUENTIAL,)
+    return (engine, SEQUENTIAL)
+
+
+# --------------------------------------------------------- dispatch stats
+#
+# A server that silently demotes to a slower rung forever is the incident
+# this layer exists to survive — it must not also be invisible.  Every
+# retry / demotion / sequential landing bumps a per-site counter (and logs
+# at the matching level); operators poll dispatch_stats() next to
+# BatchEngine.cache_stats().
+
+_dispatch_stats: dict = {}
+
+
+def _bump(site: str, key: str) -> None:
+    row = _dispatch_stats.setdefault(
+        site, {"retries": 0, "demotions": 0, "sequential": 0})
+    row[key] += 1
+
+
+def dispatch_stats(site: str | None = None) -> dict:
+    """Per-site retry/demotion/sequential-landing counters (copies)."""
+    if site is not None:
+        return dict(_dispatch_stats.get(
+            site, {"retries": 0, "demotions": 0, "sequential": 0}))
+    return {s: dict(row) for s, row in _dispatch_stats.items()}
+
+
+def reset_dispatch_stats() -> None:
+    _dispatch_stats.clear()
+
+
+def _deadline_error(site: str, dl: Deadline, last):
+    msg = f"{site}: dispatch deadline of {dl.seconds}s exhausted"
+    if last is None:
+        return errors.TransientDeviceError(msg)
+    err = type(last)(f"{msg}; last fault: {last}")
+    err.__cause__ = last
+    return err
+
+
+def run_with_fallback(site: str, chain, attempt, *, policy=None,
+                      sequential=None, on_resource_exhausted=None,
+                      deadline: Deadline | None = None):
+    """Run ``attempt(rung)`` down the fallback chain; returns
+    ``(result, rung_used)``.
+
+    ``sequential()`` (no args) is the terminal reference path, appended to
+    the chain when not already present.  ``on_resource_exhausted(rung,
+    fault, deadline)`` may return a recovered result (e.g. from a split
+    batch) or NO_SPLIT to decline.
+    """
+    policy = policy or GuardPolicy.from_env()
+    dl = deadline or Deadline(policy.deadline)
+    rungs = [r for r in chain if r != SEQUENTIAL]
+    if sequential is not None:
+        rungs.append(SEQUENTIAL)
+    if not rungs:
+        raise ValueError(f"{site}: empty fallback chain")
+    last = None
+    for rung in rungs:
+        backoff = policy.backoff_base
+        for att in range(policy.max_attempts):
+            if dl.expired():
+                raise _deadline_error(site, dl, last)
+            try:
+                if rung == SEQUENTIAL:
+                    _bump(site, "sequential")
+                    _log.warning(
+                        "%s: serving from the CPU sequential reference "
+                        "(every engine rung failed; last fault: %s)",
+                        site, last)
+                    return sequential(), SEQUENTIAL
+                return attempt(rung), rung
+            except Exception as exc:
+                fault = errors.classify(exc)
+                if fault is None or isinstance(fault, errors.ShadowMismatch):
+                    raise          # programming error / proven corruption
+                last = fault
+                if isinstance(fault, errors.CorruptInput):
+                    # the input is garbage on every rung; fatal now
+                    if fault is exc:
+                        raise
+                    raise fault from exc
+                if isinstance(fault, errors.ResourceExhausted):
+                    if on_resource_exhausted is not None:
+                        res = on_resource_exhausted(rung, fault, dl)
+                        if res is not NO_SPLIT:
+                            return res, rung
+                    _bump(site, "demotions")
+                    _log.warning("%s: demoting off rung %s: %s",
+                                 site, rung, fault)
+                    break          # demote: same shape would OOM again
+                if isinstance(fault, errors.EngineLoweringError):
+                    _bump(site, "demotions")
+                    _log.warning("%s: demoting off rung %s: %s",
+                                 site, rung, fault)
+                    break          # demote: deterministic compile failure
+                # retryable (transient / coordinator): bounded backoff
+                if att + 1 >= policy.max_attempts:
+                    _bump(site, "demotions")
+                    _log.warning(
+                        "%s: retries exhausted on rung %s, demoting: %s",
+                        site, rung, fault)
+                    break          # retries exhausted on this rung: demote
+                _bump(site, "retries")
+                _log.debug("%s: transient fault on rung %s, retry %d: %s",
+                           site, rung, att + 1, fault)
+                policy.sleep(min(backoff, dl.remaining()))
+                backoff = min(backoff * policy.backoff_factor,
+                              policy.backoff_max)
+    assert last is not None  # a rung can only exit its loop via a fault
+    raise last
+
+
+# ------------------------------------------------------------ shadow checks
+
+_shadow_counters: dict = {}
+
+
+def shadow_sample(n: int, rate: float, seed: int, site: str) -> list[int]:
+    """Deterministic sample of query indices to cross-check: rate-sized
+    Bernoulli per index, keyed by a per-site call counter so repeated
+    batches sample different (but reproducible) subsets."""
+    if rate <= 0.0 or n == 0:
+        return []
+    if rate >= 1.0:
+        return list(range(n))
+    call = _shadow_counters.get(site, 0)
+    _shadow_counters[site] = call + 1
+    rng = np.random.default_rng((seed, zlib.crc32(site.encode()), call))
+    return [i for i in range(n) if rng.random() < rate]

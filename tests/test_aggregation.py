@@ -32,7 +32,9 @@ def oracles(workload):
 @pytest.mark.parametrize("op", ["or", "xor"])
 def test_ragged_engines_match_host(workload, oracles, op, engine):
     fn = aggregation.or_ if op == "or" else aggregation.xor
-    assert fn(workload, engine=engine) == oracles[op]
+    # fallback=False pins the engine: a regression must fail here, not
+    # demote down the runtime.guard chain and still pass
+    assert fn(workload, engine=engine, fallback=False) == oracles[op]
 
 
 def test_wide_and_matches_host(workload, oracles):

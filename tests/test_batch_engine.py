@@ -146,6 +146,9 @@ def test_invalid_queries(engine):
     assert engine.execute([]) == []
 
 
+@pytest.mark.skipif(bool(__import__("os").environ.get("ROARING_TPU_FAULTS")),
+                    reason="fault injection demotes engines, which adds "
+                           "extra program signatures by design")
 def test_bucketing_bounds_recompiles(engine):
     """Same (op, operand-rung, padded-shape) signature must reuse the
     compiled program; a novel rung adds exactly the new signature."""

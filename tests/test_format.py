@@ -74,6 +74,85 @@ def test_garbage_rejected():
         RoaringBitmap.deserialize(b"\x3a\x30")  # truncated cookie
 
 
+# ---- malformed-input hardening (robustness satellite): every lie class
+# raises InvalidRoaringFormat — also exported as runtime.errors.CorruptInput
+# — never a raw numpy/struct error and never a silently-corrupt container.
+
+def _no_run_header(size: int) -> bytes:
+    return (np.uint32(spec.SERIAL_COOKIE_NO_RUNCONTAINER
+                      ).astype("<u4").tobytes()
+            + np.uint32(size).astype("<u4").tobytes())
+
+
+def test_corrupt_input_is_the_runtime_alias():
+    from roaringbitmap_tpu.runtime import errors
+
+    assert errors.CorruptInput is InvalidRoaringFormat
+
+
+def test_out_of_order_keys_rejected():
+    rb = RoaringBitmap.from_values(
+        np.array([1, 70000, 140000], dtype=np.uint32))
+    b = bytearray(rb.serialize())
+    b[8:10], b[12:14] = b[12:14], b[8:10]   # swap first two keys
+    with pytest.raises(InvalidRoaringFormat, match="not strictly"):
+        RoaringBitmap.deserialize(bytes(b))
+
+
+def test_bitmap_cardinality_lie_rejected():
+    rb = RoaringBitmap.from_values(np.arange(0, 30000, 2, dtype=np.uint32))
+    b = bytearray(rb.serialize())
+    b[10] = (b[10] + 1) & 0xFF              # declared card of container 0
+    with pytest.raises(InvalidRoaringFormat, match="declared cardinality"):
+        RoaringBitmap.deserialize(bytes(b))
+
+
+def test_unsorted_array_payload_rejected():
+    b = (_no_run_header(1) + np.array([7, 2], dtype="<u2").tobytes()
+         + np.uint32(16).astype("<u4").tobytes()
+         + np.array([5, 3, 9], dtype="<u2").tobytes())
+    with pytest.raises(InvalidRoaringFormat, match="strictly increasing"):
+        RoaringBitmap.deserialize(b)
+
+
+def test_run_lies_rejected():
+    rhdr = (np.uint32(spec.SERIAL_COOKIE).astype("<u4").tobytes()
+            + bytes([1]))
+    # overlapping / out-of-order runs
+    b = (rhdr + np.array([0, 9], dtype="<u2").tobytes()
+         + np.uint16(2).astype("<u2").tobytes()
+         + np.array([10, 4, 8, 4], dtype="<u2").tobytes())
+    with pytest.raises(InvalidRoaringFormat, match="overlap"):
+        RoaringBitmap.deserialize(b)
+    # run extending past the 2^16 container end (length lie)
+    b = (rhdr + np.array([0, 99], dtype="<u2").tobytes()
+         + np.uint16(1).astype("<u2").tobytes()
+         + np.array([65530, 99], dtype="<u2").tobytes())
+    with pytest.raises(InvalidRoaringFormat, match="past 65535"):
+        RoaringBitmap.deserialize(b)
+    # zero runs while the descriptor declares cardinality 10
+    b = (rhdr + np.array([0, 9], dtype="<u2").tobytes()
+         + np.uint16(0).astype("<u2").tobytes())
+    with pytest.raises(InvalidRoaringFormat):
+        RoaringBitmap.deserialize(b)
+
+
+def test_length_fields_past_buffer_end_rejected():
+    rb = RoaringBitmap.from_values(
+        np.array([1, 70000, 140000, 300000, 400000], dtype=np.uint32))
+    blob = rb.serialize()
+    desc_end = 8 + 4 * 5
+    with pytest.raises(InvalidRoaringFormat, match="offset block"):
+        RoaringBitmap.deserialize(blob[:desc_end + 6])  # inside offsets
+    with pytest.raises(InvalidRoaringFormat):
+        RoaringBitmap.deserialize(blob[:len(blob) - 3])  # inside payload
+    # array cardinality inflated so its payload reads past the buffer
+    big = bytearray(blob)
+    big[10] = 0x40                       # container 0 card-1 low byte
+    with pytest.raises(InvalidRoaringFormat):
+        RoaringBitmap.deserialize(bytes(big))
+
+
 def test_compression_rate_by_gap():
     """TestCompressionRates.SimpleCompressionRateTest: serialized bits per
     value stays below min(gap, 16) + 1 as density thins by powers of two —

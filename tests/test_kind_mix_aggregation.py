@@ -93,9 +93,14 @@ def triple(request):
 @pytest.mark.parametrize("engine", ["xla", "pallas"])
 def test_wide_ops_every_kind_mix(triple, engine):
     bms, oracle = triple
-    assert aggregation.or_(*bms, engine=engine) == oracle["or"]
-    assert aggregation.xor(*bms, engine=engine) == oracle["xor"]
-    assert aggregation.and_(*bms, engine=engine) == oracle["and"]
+    # fallback=False: a broken engine must FAIL this parity test, not
+    # silently demote to a rung that still passes (runtime.guard)
+    assert aggregation.or_(*bms, engine=engine, fallback=False) \
+        == oracle["or"]
+    assert aggregation.xor(*bms, engine=engine, fallback=False) \
+        == oracle["xor"]
+    assert aggregation.and_(*bms, engine=engine, fallback=False) \
+        == oracle["and"]
 
 
 def test_cardinality_paths_every_kind_mix(triple):

@@ -22,10 +22,10 @@ def test_wide_or_census1881_bit_exact(census):
     arrs = census[:64]  # keep CPU-test runtime modest; bench runs the full set
     bms = [RoaringBitmap.from_values(a) for a in arrs]
     oracle = np.unique(np.concatenate(arrs))
-    got = aggregation.or_(bms, engine="xla")
+    got = aggregation.or_(bms, engine="xla", fallback=False)
     assert got.cardinality == oracle.size
     np.testing.assert_array_equal(got.to_array(), oracle)
-    got_p = aggregation.or_(bms, engine="pallas")
+    got_p = aggregation.or_(bms, engine="pallas", fallback=False)
     assert got_p == got
 
 

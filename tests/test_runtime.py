@@ -1,0 +1,490 @@
+"""Hardened query runtime: taxonomy, fault injection, guarded dispatch.
+
+The acceptance matrix of the robustness tentpole: under injected faults
+(fixed seeds, every error class, each engine rung) every batched query
+either returns a result bit-exact with the CPU sequential reference or
+raises a typed runtime.errors exception — zero silent corruption, zero
+bare RuntimeError/ValueError escapes."""
+
+import time
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap
+from roaringbitmap_tpu.parallel import (BatchEngine, BatchQuery,
+                                        aggregation, sharding)
+from roaringbitmap_tpu.runtime import errors, faults, guard
+from roaringbitmap_tpu.runtime.cache import LRUCache
+
+#: no real sleeping inside the suite; retries still count attempts
+NOSLEEP = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)
+
+N = 12
+
+
+@pytest.fixture(scope="module")
+def workload():
+    rng = np.random.default_rng(0xBEEF)
+    common = np.arange(300, 700, dtype=np.uint32)
+    out = []
+    for i in range(N):
+        vals = [rng.integers(0, 1 << 17, 2500).astype(np.uint32), common]
+        if i % 4 == 0:
+            vals.append(np.arange(1 << 16, (1 << 16) + 15000,
+                                  dtype=np.uint32))
+        out.append(RoaringBitmap.from_values(
+            np.unique(np.concatenate(vals))))
+    return out
+
+
+@pytest.fixture(scope="module")
+def engine(workload):
+    return BatchEngine.from_bitmaps(workload)
+
+
+def _queries(q, form="cardinality", seed=11):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(q):
+        op = ("or", "and", "xor", "andnot")[i % 4]
+        k = int(rng.integers(2, 7))
+        out.append(BatchQuery(
+            op=op, operands=tuple(
+                int(x) for x in rng.choice(N, size=k, replace=False)),
+            form=form))
+    return out
+
+
+# ------------------------------------------------------------ errors.classify
+
+class TestClassify:
+    @pytest.mark.parametrize("msg,cls", [
+        ("RESOURCE_EXHAUSTED: out of memory allocating 8388608 bytes",
+         errors.ResourceExhausted),
+        ("XlaRuntimeError: UNAVAILABLE: device connection dropped",
+         errors.TransientDeviceError),
+        ("DEADLINE_EXCEEDED: something slow", errors.TransientDeviceError),
+        ("INTERNAL: coordination service barrier timed out",
+         errors.CoordinatorTimeout),
+    ])
+    def test_message_families(self, msg, cls):
+        assert isinstance(errors.classify(RuntimeError(msg)), cls)
+
+    def test_lowering_by_message_not_type(self):
+        assert isinstance(
+            errors.classify(NotImplementedError("Mosaic lowering failed")),
+            errors.EngineLoweringError)
+        assert isinstance(
+            errors.classify(RuntimeError("Mosaic lowering failed")),
+            errors.EngineLoweringError)
+        # a stubbed host method is a programming error, not a demotable
+        # engine fault — the blanket NotImplementedError match was a bug
+        assert errors.classify(NotImplementedError("todo")) is None
+
+    def test_corrupt_input_identity(self):
+        e = errors.CorruptInput("bad cookie")
+        assert errors.classify(e) is e
+
+    def test_typed_passthrough_is_idempotent(self):
+        e = errors.ResourceExhausted("oom")
+        assert errors.classify(e) is e
+
+    def test_programming_errors_are_not_classified(self):
+        assert errors.classify(IndexError("operand out of range")) is None
+        assert errors.classify(KeyError("x")) is None
+        assert errors.classify(ValueError("plain bad arg")) is None
+
+    def test_keyword_brushes_stay_unclassified(self):
+        # genuine bugs whose messages merely brush a fault keyword must
+        # stay raw — lowercase 'aborted'/'oom'/'coordinator' are not
+        # status tokens (only the uppercase absl forms are)
+        for msg in ("scan aborted: invalid plan state",
+                    "cannot open /data/zoom_datasets/x.bin",
+                    "bad coordinator_address argument type",
+                    "value cancelled_flag must be bool"):
+            assert errors.classify(RuntimeError(msg)) is None, msg
+
+
+# ---------------------------------------------------------------- fault spec
+
+class TestFaultSpec:
+    def test_grammar(self):
+        plan = faults.FaultPlan.from_spec(
+            "transient=0.5,oom@pallas,lowering@batch_engine=0.25:42")
+        kinds = [(r.kind, r.scope, r.rate) for r in plan.rules]
+        assert kinds == [("transient", None, 0.5), ("oom", "pallas", 1.0),
+                         ("lowering", "batch_engine", 0.25)]
+        assert plan.seed == 42
+
+    @pytest.mark.parametrize("bad", [
+        "transient=0.5", "nosuchkind:3", "transient=2.0:3",
+        "transient=x:3", ":", "  :9",
+    ])
+    def test_bad_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            faults.FaultPlan.from_spec(bad)
+
+    def test_scoped_rule_only_fires_in_scope(self):
+        plan = faults.FaultPlan.from_spec("oom@pallas:1")
+        assert plan.pick("batch_engine", "pallas") == "oom"
+        assert plan.pick("batch_engine", "xla") is None
+        assert plan.pick("pallas", None) == "oom"   # site name also matches
+
+    def test_deterministic_schedule(self):
+        draws = []
+        for _ in range(2):
+            plan = faults.FaultPlan.from_spec("transient=0.3:99")
+            draws.append([plan.pick("s", "e") for _ in range(64)])
+        assert draws[0] == draws[1]
+        assert "transient" in draws[0]          # some fire
+        assert draws[0].count(None) > 0         # some do not
+
+    def test_silent_separated_from_raising(self):
+        plan = faults.FaultPlan.from_spec("silent:5")
+        assert plan.pick("s", "e") is None      # raising picker skips it
+        assert plan.pick("s", "e", kinds=("silent",)) == "silent"
+
+    def test_inject_overrides_and_restores(self):
+        prev = faults.active()      # None, or the CI fault shard's env plan
+        with faults.inject("oom:1") as plan:
+            assert faults.active() is plan
+        assert faults.active() is prev
+
+
+# ----------------------------------------------------------------- LRU cache
+
+class TestLRUCache:
+    def test_eviction_order_and_stats(self):
+        c = LRUCache(2)
+        c.put("a", 1)
+        c.put("b", 2)
+        assert c.get("a") == 1          # refresh a
+        c.put("c", 3)                   # evicts b (LRU)
+        assert c.get("b") is None
+        assert c.get("a") == 1 and c.get("c") == 3
+        s = c.stats()
+        assert s["evictions"] == 1 and s["size"] == 2
+        assert s["hits"] == 3 and s["misses"] == 1
+
+    def test_clear_and_contains(self):
+        c = LRUCache(4)
+        c.put("k", "v")
+        assert "k" in c and len(c) == 1
+        c.clear()
+        assert "k" not in c and len(c) == 0
+
+    def test_min_size(self):
+        with pytest.raises(ValueError):
+            LRUCache(0)
+
+
+# ------------------------------------------------------------ guard unit set
+
+class TestGuard:
+    def test_transient_retries_then_succeeds(self):
+        calls = []
+
+        def attempt(eng):
+            calls.append(eng)
+            if len(calls) < 3:
+                raise RuntimeError("UNAVAILABLE: flaky")
+            return "ok"
+
+        res, rung = guard.run_with_fallback(
+            "t", ("e1", "e2"), attempt, policy=NOSLEEP)
+        assert res == "ok" and rung == "e1" and calls == ["e1"] * 3
+
+    def test_retry_exhaustion_demotes(self):
+        calls = []
+
+        def attempt(eng):
+            calls.append(eng)
+            if eng == "e1":
+                raise RuntimeError("UNAVAILABLE: always down")
+            return "ok"
+
+        res, rung = guard.run_with_fallback(
+            "t", ("e1", "e2"), attempt, policy=NOSLEEP)
+        assert rung == "e2" and calls == ["e1"] * 3 + ["e2"]
+
+    def test_lowering_demotes_immediately(self):
+        calls = []
+
+        def attempt(eng):
+            calls.append(eng)
+            if eng == "e1":
+                raise NotImplementedError("Mosaic lowering failed")
+            return "ok"
+
+        res, rung = guard.run_with_fallback(
+            "t", ("e1", "e2"), attempt, policy=NOSLEEP)
+        assert rung == "e2" and calls == ["e1", "e2"]
+
+    def test_oom_offers_split_first(self):
+        def attempt(eng):
+            raise RuntimeError("RESOURCE_EXHAUSTED: oom")
+
+        def split(eng, fault, dl):
+            assert isinstance(fault, errors.ResourceExhausted)
+            return "halved"
+
+        res, rung = guard.run_with_fallback(
+            "t", ("e1",), attempt, policy=NOSLEEP,
+            on_resource_exhausted=split)
+        assert res == "halved"
+
+    def test_oom_split_declined_demotes(self):
+        seen = []
+
+        def attempt(eng):
+            seen.append(eng)
+            if eng == "e1":
+                raise RuntimeError("RESOURCE_EXHAUSTED: oom")
+            return "ok"
+
+        res, rung = guard.run_with_fallback(
+            "t", ("e1", "e2"), attempt, policy=NOSLEEP,
+            on_resource_exhausted=lambda *a: guard.NO_SPLIT)
+        assert rung == "e2" and seen == ["e1", "e2"]
+
+    def test_corrupt_input_is_fatal_immediately(self):
+        calls = []
+
+        def attempt(eng):
+            calls.append(eng)
+            raise errors.CorruptInput("bad payload")
+
+        with pytest.raises(errors.CorruptInput):
+            guard.run_with_fallback("t", ("e1", "e2"), attempt,
+                                    policy=NOSLEEP,
+                                    sequential=lambda: "never")
+        assert calls == ["e1"]
+
+    def test_unclassified_exceptions_propagate_raw(self):
+        def attempt(eng):
+            raise IndexError("planner bug")
+
+        with pytest.raises(IndexError):
+            guard.run_with_fallback("t", ("e1", "e2"), attempt,
+                                    policy=NOSLEEP,
+                                    sequential=lambda: "never")
+
+    def test_exhausted_chain_raises_typed(self):
+        def attempt(eng):
+            raise RuntimeError("UNAVAILABLE: dead device")
+
+        with pytest.raises(errors.TransientDeviceError):
+            guard.run_with_fallback("t", ("e1", "e2"), attempt,
+                                    policy=NOSLEEP)
+
+    def test_deadline_respected(self):
+        t0 = time.monotonic()
+        policy = guard.GuardPolicy(max_attempts=10_000,
+                                   backoff_base=0.005, deadline=0.15)
+
+        def attempt(eng):
+            raise RuntimeError("UNAVAILABLE: flaky forever")
+
+        with pytest.raises(errors.TransientDeviceError) as ei:
+            guard.run_with_fallback("t", ("e1", "e2"), attempt,
+                                    policy=policy,
+                                    sequential=lambda: "unreached")
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0                       # stopped promptly
+        assert "deadline" in str(ei.value)
+
+    def test_dispatch_stats_count_degradation(self):
+        guard.reset_dispatch_stats()
+
+        def attempt(eng):
+            raise RuntimeError("UNAVAILABLE: flaky")
+
+        res, rung = guard.run_with_fallback(
+            "statsite", ("e1",), attempt, policy=NOSLEEP,
+            sequential=lambda: "floor")
+        assert (res, rung) == ("floor", guard.SEQUENTIAL)
+        s = guard.dispatch_stats("statsite")
+        assert s["retries"] == 2       # 3 attempts = 2 retries
+        assert s["demotions"] == 1 and s["sequential"] == 1
+        # site isolation + copy semantics
+        assert guard.dispatch_stats("othersite")["retries"] == 0
+        guard.dispatch_stats("statsite")["retries"] = 99
+        assert guard.dispatch_stats("statsite")["retries"] == 2
+
+    def test_chain_from(self):
+        ladder = ("pallas", "xla", "xla-vmap")
+        assert guard.chain_from("pallas", ladder) == (
+            "pallas", "xla", "xla-vmap", guard.SEQUENTIAL)
+        assert guard.chain_from("xla-vmap", ladder) == (
+            "xla-vmap", guard.SEQUENTIAL)
+        assert guard.chain_from("weird", ladder) == (
+            "weird", guard.SEQUENTIAL)
+
+
+# ------------------------------------- fallback chain: the acceptance matrix
+
+RUNGS = ("pallas", "xla", "xla-vmap")
+
+
+class TestBatchFallbackChain:
+    @pytest.mark.parametrize("rung", RUNGS)
+    @pytest.mark.parametrize("kind", ("transient", "oom", "lowering"))
+    def test_fault_at_each_rung_stays_bit_exact(self, engine, rung, kind):
+        queries = _queries(10, form="bitmap", seed=ord(kind[0]))
+        want = engine._execute_sequential(queries)
+        with faults.inject(f"{kind}@{rung}=1.0:17"):
+            got = engine.execute(queries, engine=rung, policy=NOSLEEP)
+        for q, g, w in zip(queries, got, want):
+            assert g.cardinality == w.cardinality, (rung, kind, q)
+            assert g.bitmap == w.bitmap, (rung, kind, q)
+
+    @pytest.mark.parametrize("rung", RUNGS)
+    def test_corrupt_input_raises_typed_at_each_rung(self, engine, rung):
+        with faults.inject(f"corrupt@{rung}=1.0:17"):
+            with pytest.raises(errors.CorruptInput):
+                engine.execute(_queries(4), engine=rung, policy=NOSLEEP)
+
+    def test_every_engine_down_degrades_to_sequential(self, engine):
+        queries = _queries(9, form="bitmap", seed=5)
+        want = engine._execute_sequential(queries)
+        with faults.inject("lowering=1.0:23"):
+            got = engine.execute(queries, engine="pallas", policy=NOSLEEP)
+        assert [g.cardinality for g in got] == [w.cardinality for w in want]
+        assert all(g.bitmap == w.bitmap for g, w in zip(got, want))
+
+    def test_oom_splits_batch_and_stays_exact(self, engine):
+        queries = _queries(16, seed=31)
+        want = [w.cardinality for w in engine._execute_sequential(queries)]
+        before = engine.split_count
+        with faults.inject("oom@xla=1.0:31"):
+            got = engine.execute(queries, engine="xla", policy=NOSLEEP)
+        assert [g.cardinality for g in got] == want
+        assert engine.split_count > before   # halving really happened
+
+    def test_partial_oom_recovers_without_demotion(self, engine):
+        # 30% OOM rate: some (sub)batches split, everything stays exact
+        queries = _queries(12, seed=41)
+        want = [w.cardinality for w in engine._execute_sequential(queries)]
+        with faults.inject("oom@xla=0.3:41"):
+            got = engine.execute(queries, engine="xla", policy=NOSLEEP)
+        assert [g.cardinality for g in got] == want
+
+    def test_deadline_bounds_batch_dispatch(self, engine):
+        policy = guard.GuardPolicy(max_attempts=10_000, backoff_base=0.005,
+                                   deadline=0.2)
+        t0 = time.monotonic()
+        with faults.inject("transient=1.0:13"):
+            with pytest.raises(errors.TransientDeviceError):
+                engine.execute(_queries(4), engine="xla", policy=policy)
+        assert time.monotonic() - t0 < 10.0
+
+    def test_shadow_catches_silent_corruption(self, engine):
+        shadow = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None,
+                                   shadow_rate=1.0)
+        with faults.inject("silent@batch_engine=1.0:3"):
+            with pytest.raises(errors.ShadowMismatch):
+                engine.execute(_queries(6), engine="xla", policy=shadow)
+
+    def test_silent_fault_without_shadow_proves_the_knob_matters(self, engine):
+        # the harness really corrupts: without the shadow check the wrong
+        # answer sails through — that asymmetry is the knob's reason to exist
+        queries = _queries(6, seed=3)
+        want = engine._execute_sequential(queries)
+        with faults.inject("silent@batch_engine=1.0:3"):
+            got = engine.execute(queries, engine="xla", policy=NOSLEEP)
+        assert got[0].cardinality == want[0].cardinality + 1
+
+    def test_no_faults_no_behavior_change(self, engine):
+        queries = _queries(8, form="bitmap", seed=77)
+        want = engine._execute_sequential(queries)
+        got = engine.execute(queries, engine="xla")
+        assert all(g.bitmap == w.bitmap for g, w in zip(got, want))
+
+    def test_validation_errors_stay_raw(self, engine):
+        # programming errors must NOT be converted or degraded
+        with pytest.raises(IndexError):
+            engine.execute([BatchQuery("or", (0, N + 5))], policy=NOSLEEP)
+
+    def test_fallback_false_paths_skip_injection(self, engine, workload):
+        """The raw escape hatch means raw: with every fault kind firing at
+        rate 1.0, fallback=False paths neither raise injected faults nor
+        return corrupted results — pinned parity probes stay deterministic
+        under the CI fault shard's environment."""
+        queries = _queries(6, seed=61)
+        want = [w.cardinality for w in engine._execute_sequential(queries)]
+        ref_or = aggregation._sequential_reduce("or", workload)
+        with faults.inject(
+                "transient=1.0,oom=1.0,lowering=1.0,corrupt=1.0,"
+                "silent=1.0:9"):
+            got = engine.execute(queries, engine="xla", fallback=False)
+            assert [g.cardinality for g in got] == want
+            assert aggregation.or_(*workload, engine="xla",
+                                   fallback=False) == ref_or
+            assert aggregation.or_cardinality(
+                *workload, fallback=False) == ref_or.cardinality
+            assert aggregation.and_cardinality(*workload, fallback=False) \
+                == aggregation._sequential_reduce("and",
+                                                  workload).cardinality
+
+
+class TestBatchEngineCaches:
+    def test_cache_stats_exposed(self, workload):
+        eng = BatchEngine.from_bitmaps(workload)
+        eng.execute(_queries(4, seed=1), engine="xla")
+        s = eng.cache_stats()
+        assert s["plans"]["misses"] >= 1
+        assert s["programs"]["size"] >= 1
+        eng.execute(_queries(4, seed=1), engine="xla")
+        assert eng.cache_stats()["plans"]["hits"] >= 1
+
+    def test_plan_cache_bounded_with_eviction_counter(self, workload):
+        from roaringbitmap_tpu.runtime.cache import LRUCache as LC
+
+        eng = BatchEngine.from_bitmaps(workload)
+        eng._plans = LC(2)
+        for seed in range(4):     # 4 distinct batch shapes, cap 2
+            eng.execute(_queries(2, seed=100 + seed), engine="xla")
+        s = eng.cache_stats()["plans"]
+        assert s["size"] <= 2 and s["evictions"] >= 2
+
+
+# ----------------------------------------- aggregation + sharding degradation
+
+class TestWideDegradation:
+    def test_wide_ops_degrade_bit_exact(self, workload):
+        ref_or = aggregation._sequential_reduce("or", workload)
+        ref_xor = aggregation._sequential_reduce("xor", workload)
+        ref_and = aggregation._sequential_reduce("and", workload)
+        with faults.inject("lowering=1.0:19"):
+            assert aggregation.or_(*workload, engine="xla") == ref_or
+            assert aggregation.xor(*workload, engine="xla") == ref_xor
+            assert aggregation.and_(*workload) == ref_and
+
+    def test_wide_cardinalities_degrade(self, workload):
+        want = aggregation._sequential_reduce("or", workload).cardinality
+        with faults.inject("transient@aggregation=1.0:19"):
+            assert aggregation.or_cardinality(*workload) == want
+
+    def test_sharded_degrades_to_sequential(self, workload):
+        import jax
+        from jax.sharding import Mesh
+
+        from roaringbitmap_tpu.ops import packing
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2),
+                    ("rows", "lanes"))
+        want = aggregation._sequential_reduce("or", workload)
+        with faults.inject("transient@sharded=1.0:29"):
+            k, w, c = sharding.wide_aggregate_sharded(mesh, "or", workload)
+        assert packing.unpack_result(k, w, c) == want
+
+    def test_sharded_corrupt_input_typed(self, workload):
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4, 2),
+                    ("rows", "lanes"))
+        with faults.inject("corrupt@sharding=1.0:29"):
+            with pytest.raises(errors.CorruptInput):
+                sharding.wide_aggregate_sharded(mesh, "or", workload)

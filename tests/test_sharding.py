@@ -42,7 +42,8 @@ def oracle_or(workload):
 
 
 def test_sharded_or_all_mesh_shapes(workload, oracle_or, mesh):
-    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "or", workload)
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "or", workload,
+                                                          fallback=False)
     got = packing.unpack_result(keys, words, cards)
     assert got == oracle_or
 
@@ -51,7 +52,8 @@ def test_sharded_xor_all_mesh_shapes(workload, mesh):
     acc = RoaringBitmap()
     for b in workload:
         acc.ixor(b)
-    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "xor", workload)
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "xor", workload,
+                                                          fallback=False)
     got = packing.unpack_result(keys, words, cards)
     assert got == acc
 
@@ -69,7 +71,8 @@ def test_sharded_and_matches_host(workload, mesh):
     acc = workload[0].clone()
     for b in workload[1:]:
         acc.iand(b)
-    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "and", workload)
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "and", workload,
+                                                          fallback=False)
     got = packing.unpack_result(keys, words, cards)
     assert got == acc
 
@@ -81,7 +84,8 @@ def test_sharded_and_nonempty(workload):
     for b in bms[1:]:
         acc.iand(b)
     mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("rows", "lanes"))
-    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "and", bms)
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "and", bms,
+                                                          fallback=False)
     assert packing.unpack_result(keys, words, cards) == acc
     assert acc.cardinality >= base.cardinality
 
@@ -101,7 +105,8 @@ def test_sharded_census1881_parity(op):
         for b in bms:
             (oracle.ior if op == "or" else oracle.ixor)(b)
     mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("rows", "lanes"))
-    keys, words, cards = sharding.wide_aggregate_sharded(mesh, op, bms)
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, op, bms,
+                                                          fallback=False)
     assert packing.unpack_result(keys, words, cards) == oracle
 
 
@@ -120,9 +125,11 @@ def test_compact_ingest_sharded_parity(rng, mesh):
         b.run_optimize()
         bms.append(b)
     for op in ("or", "xor"):
-        kd, wd, cd = sharding.wide_aggregate_sharded(mesh, op, bms, ingest="dense")
+        kd, wd, cd = sharding.wide_aggregate_sharded(mesh, op, bms, ingest="dense",
+                                                    fallback=False)
         for src in (bms, [b.serialize() for b in bms]):
             kc, wc, cc = sharding.wide_aggregate_sharded(mesh, op, src,
+                                                         fallback=False,
                                                    ingest="compact")
             got = packing.unpack_result(kc, wc, cc)
             want = packing.unpack_result(kd, wd, cd)
@@ -140,7 +147,8 @@ def test_sharded_ingest_validation_and_bytes_and(mesh8, rng):
     want = bms[0] & bms[1] & bms[2] & bms[3]
     assert want.cardinality
     keys, words, cards = sharding.wide_aggregate_sharded(
-        mesh8, "and", [b.serialize() for b in bms], ingest="compact")
+        mesh8, "and", [b.serialize() for b in bms], ingest="compact",
+        fallback=False)
     assert packing.unpack_result(keys, words, cards) == want
 
 
@@ -151,7 +159,8 @@ def test_dense_ingest_accepts_bytes(mesh8, rng):
     for b in bms:
         want.ior(b)
     keys, words, cards = sharding.wide_aggregate_sharded(
-        mesh8, "or", [b.serialize() for b in bms], ingest="dense")
+        mesh8, "or", [b.serialize() for b in bms], ingest="dense",
+        fallback=False)
     assert packing.unpack_result(keys, words, cards) == want
 
 
@@ -199,7 +208,8 @@ def test_sharded_64bit_tier(mesh8):
     for b in bms[1:]:
         oracles["and"].iand(b)
     for op in ("or", "xor", "and"):
-        keys, words, cards = sharding.wide_aggregate_sharded(mesh8, op, bms)
+        keys, words, cards = sharding.wide_aggregate_sharded(mesh8, op, bms,
+                                                        fallback=False)
         got = packing.unpack_result(keys, words, cards)
         assert isinstance(got, Roaring64Bitmap)
         assert got == oracles[op], op
@@ -280,7 +290,7 @@ def test_sharded_chunked_wide_keyspace(mesh8, ingest):
         for b in bms:
             (oracle.ior if op == "or" else oracle.ixor)(b)
         keys, words, cards = sharding.wide_aggregate_sharded(
-            mesh8, op, bms, ingest=ingest)
+            mesh8, op, bms, ingest=ingest, fallback=False)
         assert keys.size == n_keys
         got = packing.unpack_result(keys, words, cards)
         assert got == oracle, op
@@ -304,7 +314,8 @@ def test_global_mesh_single_host(workload, oracle_or):
     assert (r, l) == (8, 1)
     assert [d.id for d in mesh.devices[:, 0]] == sorted(
         d.id for d in jax.devices())
-    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "or", workload)
+    keys, words, cards = sharding.wide_aggregate_sharded(mesh, "or", workload,
+                                                          fallback=False)
     assert packing.unpack_result(keys, words, cards) == oracle_or
     # explicit lane counts, incl. every valid factorization
     for lanes in (1, 2, 4, 8):

@@ -32,6 +32,14 @@ device->host readback (pipelined put regime); query cells follow.
 
 Cardinality parity against the host tier is asserted for every cell.
 
+Observability (docs/OBSERVABILITY.md): every cell is stamped with the
+trace span id of its (dataset, group) span when ``ROARING_TPU_TRACE`` is
+set — so a cell in the result JSON joins directly to the JSONL trace —
+and carries ``obs_hist``, the delta of the unified latency histograms
+accumulated while that cell was measured.  Cross-round artifacts alone
+can then distinguish "the kernel got slower" from "the measurement loop
+hit a different engine/rung" (the r03/r04 hoisting-artifact class).
+
 Usage:
   python benchmarks/realdata.py [--datasets ...] [--groups ...] [--reps N]
 Emits one JSON document on stdout (and a markdown table on stderr).
@@ -60,6 +68,37 @@ WIDE_R = (100, 4100)      # chained rep pair for wide marginals
 PAIR_R = (100, 2100)      # pairwise marginals
 IDX_R = (100, 8100)       # bsi/rangebitmap marginals (tiny kernels)
 BSI_ROWS = 100_000        # value-column length (rows) for bsi/rangebitmap
+
+
+class _ObsCells(dict):
+    """Cell dict that annotates each inserted cell with (a) the trace
+    span id of the group being measured and (b) the delta of the unified
+    metrics histograms since the previous cell — per-cell attribution of
+    engine/rung activity, recorded into the result JSON."""
+
+    def __init__(self):
+        super().__init__()
+        self.span_id = None          # set per group by the main loop
+        from roaringbitmap_tpu import obs
+
+        self._obs = obs
+        self._last = obs.metrics.REGISTRY.snapshot()
+
+    def __setitem__(self, key, value):
+        now = self._obs.metrics.REGISTRY.snapshot()
+        if isinstance(value, dict):
+            delta = self._obs.snapshot_delta(self._last, now)
+            hists = {
+                name: [{"labels": r["labels"], "count": r["count"],
+                        "sum_ms": round(r["sum"] * 1e3, 3)}
+                       for r in rows]
+                for name, rows in delta.get("histograms", {}).items()}
+            if hists:
+                value["obs_hist"] = hists
+            if self.span_id is not None:
+                value["span_id"] = self.span_id
+        self._last = now
+        super().__setitem__(key, value)
 
 
 def _timeit(fn, reps: int) -> float:
@@ -685,10 +724,13 @@ def main() -> None:
                 "micro": bench_micro, "containers": bench_containers,
                 "bsi": bench_bsi, "rangebitmap": bench_rangebitmap,
                 "batch": bench_batch, "cliff": bench_cliff}
+    from roaringbitmap_tpu import obs
+
     for name in args.datasets:
         print(f"[realdata] query {name} ...", file=sys.stderr, flush=True)
         st = states[name]
-        cells: dict = {}
+        cells = _ObsCells()
+        obs_spans: dict = {}
         for g in args.groups:
             # one retry per group: the tunnel's remote-compile endpoint
             # occasionally drops a response mid-read; losing an hour of
@@ -696,23 +738,34 @@ def main() -> None:
             # cell.  AssertionErrors are parity failures, NOT transients —
             # they must fail the run loudly, never become an ERROR cell.
             before = dict(cells)
-            for attempt in (1, 2):
-                try:
-                    group_fn[g](st, cells, args.reps)
-                    break
-                except AssertionError:
-                    raise
-                except Exception as e:  # noqa: BLE001
-                    print(f"[realdata] {name}/{g} attempt {attempt} "
-                          f"failed: {type(e).__name__}: {e}",
-                          file=sys.stderr, flush=True)
-                    if attempt == 2:
-                        # drop the group's partial cells: a half-measured
-                        # group must not read as clean data
-                        cells.clear()
-                        cells.update(before)
-                        cells[f"{g}/ERROR"] = {"note": f"{e}"}
+            with obs.span(f"realdata.{g}", dataset=name) as sp:
+                cells.span_id = sp.span_id
+                if sp.span_id is not None:
+                    obs_spans[g] = sp.span_id
+                for attempt in (1, 2):
+                    try:
+                        group_fn[g](st, cells, args.reps)
+                        break
+                    except AssertionError:
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        print(f"[realdata] {name}/{g} attempt {attempt} "
+                              f"failed: {type(e).__name__}: {e}",
+                              file=sys.stderr, flush=True)
+                        if attempt == 2:
+                            # drop the group's partial cells: a half-
+                            # measured group must not read as clean data
+                            cells.clear()
+                            cells.update(before)
+                            cells[f"{g}/ERROR"] = {"note": f"{e}"}
+                            # the swallowed failure must also mark the
+                            # group's trace span, or the artifact and
+                            # the trace disagree about what happened
+                            sp.tag(status="error",
+                                   error_class=type(e).__name__)
+            cells.span_id = None
         result["datasets"][name] = {
+            **({"obs_spans": obs_spans} if obs_spans else {}),
             "n_bitmaps": len(st["bms"]),
             "layout": st["layout"],
             "serialized_mb": round(st["serialized_mb"], 2),

@@ -46,6 +46,10 @@ from .format.spec import InvalidRoaringFormat
 # engine fallback chain, deterministic fault injection (docs/ROBUSTNESS.md)
 from . import runtime
 
+# query-path observability: span tracing (ROARING_TPU_TRACE), unified
+# metrics registry, Prometheus/JSON export (docs/OBSERVABILITY.md)
+from . import obs
+
 __all__ = [
     "RoaringBitmap", "Roaring64Bitmap", "Roaring64NavigableMap",
     "RangeBitmap", "FastRankRoaringBitmap", "RoaringBitSet",
@@ -53,7 +57,7 @@ __all__ = [
     "and_", "or_", "xor", "andnot", "and_not", "or_not", "flip",
     "and_cardinality", "or_cardinality", "xor_cardinality",
     "andnot_cardinality", "and_not_cardinality",
-    "containers", "spec", "InvalidRoaringFormat", "runtime",
+    "containers", "spec", "InvalidRoaringFormat", "runtime", "obs",
 ]
 
 __version__ = "0.1.0"

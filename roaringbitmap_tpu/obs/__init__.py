@@ -1,0 +1,47 @@
+"""Query-path observability: span tracing, unified metrics, exporters.
+
+Three pieces (docs/OBSERVABILITY.md is the operator reference):
+
+- ``obs.trace`` — structured spans over the full query path
+  (``batch.execute`` → plan/bucket/program_build/dispatch/readback,
+  ``guard.dispatch`` with retry/demote/split events, ``aggregation.wide``,
+  ``sharding.wide_aggregate``, ``multihost.initialize``), dumped as JSONL
+  via ``ROARING_TPU_TRACE=<path>``; near-zero overhead when disabled.
+- ``obs.metrics`` — always-on process registry: dispatch-event counters
+  (absorbing ``guard.dispatch_stats``), cache counters/gauges (absorbing
+  the runtime LRU ``cache_stats``), per-(site, engine) execute-latency
+  histograms.
+- ``obs.export`` — Prometheus text renderer over the registry.
+
+``snapshot()`` is the in-process JSON API: the full registry state plus
+the tracer's enablement — one dict a health endpoint can return verbatim.
+"""
+
+from . import export, metrics, trace
+from .export import render_prometheus
+from .metrics import (DEFAULT_LATENCY_BUCKETS, REGISTRY, counter, gauge,
+                      histogram, snapshot_delta)
+from .trace import (current, disable, enable, enabled, refresh_from_env,
+                    span)
+
+
+def snapshot() -> dict:
+    """Process observability state as one plain-JSON dict: every counter,
+    gauge, and histogram in the registry, plus tracer status."""
+    doc = metrics.REGISTRY.snapshot()
+    doc["trace"] = {"enabled": trace.enabled(), "path": trace.path()}
+    return doc
+
+
+def reset() -> None:
+    """Drop all registry instruments (tracer state untouched); symmetric
+    with ``snapshot()`` — see tests/test_obs.py."""
+    metrics.REGISTRY.reset()
+
+
+__all__ = [
+    "trace", "metrics", "export",
+    "span", "current", "enable", "disable", "enabled", "refresh_from_env",
+    "counter", "gauge", "histogram", "snapshot_delta", "REGISTRY",
+    "DEFAULT_LATENCY_BUCKETS", "render_prometheus", "snapshot", "reset",
+]

@@ -1,0 +1,241 @@
+"""Unified metrics registry: counters, gauges, fixed-bucket histograms.
+
+One process-wide ``REGISTRY`` absorbs the peepholes that used to live in
+separate corners — ``guard.dispatch_stats()`` retry/demotion/sequential
+counters, the runtime LRU ``cache_stats()``, and the new per-engine
+execute-latency histograms — as first-class instruments with one naming
+scheme, one snapshot API (``snapshot()`` → plain JSON-able dict), and one
+export surface (obs.export.render_prometheus).  The legacy dict-shaped
+accessors keep their exact shapes (docs/ROBUSTNESS.md and operator
+tooling reference them); the registry is the superset view.
+
+Instruments are keyed by (name, sorted label items) and created lazily on
+first touch, so instrumentation sites are one line::
+
+    REGISTRY.counter("rb_dispatch_events_total",
+                     site="batch_engine", event="demotions").inc()
+    REGISTRY.histogram("rb_execute_latency_seconds",
+                       site="aggregation", engine="xla").observe(dt)
+
+Metrics are always on (unlike the opt-in tracer): a handful of dict
+lookups and float adds per query, invisible next to a device dispatch.
+Updates are not locked — like the rest of the stack, dispatch is
+per-instance single-threaded; instrument *creation* is locked so lazy
+first-touch from helper threads cannot corrupt the table.
+
+``reset()``/``snapshot()`` are symmetric: after ``reset()`` a snapshot
+equals a fresh registry's (tests/test_obs.py pins this).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+#: default latency buckets, seconds: 100 us .. 10 s in a 1-2.5-5 ladder —
+#: spans both the ~10 us-scale steady-state marginals (lumped under the
+#: first bucket) and the ~100 ms tunnel-RTT dispatch regime
+DEFAULT_LATENCY_BUCKETS = (
+    1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    kind = "counter"
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    kind = "gauge"
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (prometheus semantics: ``counts[i]``
+    is the count of observations <= ``buckets[i]``, non-cumulative here;
+    the +Inf overflow rides in ``counts[-1]``)."""
+
+    kind = "histogram"
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets=DEFAULT_LATENCY_BUCKETS):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def cumulative(self):
+        """([(bound, cumulative_count <= bound)], total incl. overflow) —
+        the single source of Prometheus ``le`` semantics shared by
+        Registry.snapshot() and export.render_prometheus()."""
+        rows, cum = [], 0
+        for bound, c in zip(self.buckets, self.counts):
+            cum += c
+            rows.append((bound, cum))
+        return rows, cum + self.counts[-1]
+
+
+class Registry:
+    def __init__(self):
+        self._instruments: dict = {}   # (name, labels items) -> instrument
+        self._collectors: list = []
+        self._lock = threading.Lock()
+
+    def register_collector(self, fn) -> None:
+        """Register ``fn(registry)`` to run before every snapshot/render:
+        the pull-model seam for gauges whose truth lives elsewhere (e.g.
+        live LRU cache sizes) — computed at scrape time, they survive
+        ``reset()`` and cannot drift the way pushed deltas can.
+        Collectors persist across ``reset()``."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _collect(self) -> None:
+        # outside the lock: collectors call back into gauge()/_get
+        for fn in list(self._collectors):
+            fn(self)
+
+    def _get(self, name: str, labels: dict, factory, kind: str):
+        # label values stringify at registration: mixed-type values for
+        # one label key must stay sortable/renderable (Prometheus labels
+        # are strings anyway)
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        inst = self._instruments.get(key)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.get(key)
+                if inst is None:
+                    inst = self._instruments[key] = factory()
+        if inst.kind != kind:
+            raise TypeError(
+                f"metric {name!r} already registered as {inst.kind}, "
+                f"requested as {kind}")
+        return inst
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(name, labels, Counter, "counter")
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(name, labels, Gauge, "gauge")
+
+    def histogram(self, name: str, buckets=DEFAULT_LATENCY_BUCKETS,
+                  **labels) -> Histogram:
+        inst = self._get(name, labels, lambda: Histogram(buckets),
+                         "histogram")
+        want = tuple(sorted(float(b) for b in buckets))
+        if inst.buckets != want:
+            # first registration wins; silently dropping a different
+            # bucket spec would strand observations in unexpected bounds
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{inst.buckets}, requested {want}")
+        return inst
+
+    def instruments(self):
+        """[(name, labels dict, instrument)] sorted by (name, labels) —
+        the iteration order snapshot() and the Prometheus renderer share.
+        Runs collectors first, then copies the table under the lock so a
+        scrape thread cannot race a dispatch thread's lazy first-touch."""
+        self._collect()
+        with self._lock:
+            items = sorted(self._instruments.items())
+        return [(name, dict(li), inst) for (name, li), inst in items]
+
+    def snapshot(self) -> dict:
+        """Plain-JSON view: {"counters"|"gauges"|"histograms":
+        {name: [{"labels": ..., ...}]}}.  Histogram rows carry cumulative
+        bucket counts keyed by the stringified upper bound plus "+Inf",
+        and sum/count."""
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, labels, inst in self.instruments():
+            if inst.kind == "histogram":
+                rows, total = inst.cumulative()
+                buckets = {repr(bound): cum for bound, cum in rows}
+                buckets["+Inf"] = total
+                out["histograms"].setdefault(name, []).append({
+                    "labels": labels, "buckets": buckets,
+                    "sum": inst.sum, "count": inst.count})
+            else:
+                out[inst.kind + "s"].setdefault(name, []).append(
+                    {"labels": labels, "value": inst.value})
+        return out
+
+    def reset(self) -> None:
+        """Drop every instrument: snapshot() afterwards equals a fresh
+        registry's (the reset/snapshot symmetry contract).  Registered
+        collectors survive — collector-backed gauges reappear at the next
+        snapshot with freshly computed truth."""
+        with self._lock:
+            self._instruments.clear()
+
+
+def snapshot_delta(before: dict, after: dict) -> dict:
+    """Difference of two ``Registry.snapshot()`` docs, keeping only rows
+    that moved: counter/histogram rows subtract (sum, count, value,
+    buckets), gauge rows take the ``after`` value.  The per-cell
+    attribution primitive benchmarks use (benchmarks/realdata.py)."""
+
+    def rows_by_key(section):
+        return {(name, tuple(sorted(r["labels"].items()))): r
+                for name, rows in section.items() for r in rows}
+
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for kind in ("counters", "histograms"):
+        prev = rows_by_key(before.get(kind, {}))
+        for (name, lk), r in rows_by_key(after.get(kind, {})).items():
+            p = prev.get((name, lk))
+            if kind == "counters":
+                d = r["value"] - (p["value"] if p else 0.0)
+                if d:
+                    out[kind].setdefault(name, []).append(
+                        {"labels": r["labels"], "value": d})
+            else:
+                dc = r["count"] - (p["count"] if p else 0)
+                if dc:
+                    pb = p["buckets"] if p else {}
+                    out[kind].setdefault(name, []).append({
+                        "labels": r["labels"],
+                        "count": dc,
+                        "sum": r["sum"] - (p["sum"] if p else 0.0),
+                        "buckets": {k: v - pb.get(k, 0)
+                                    for k, v in r["buckets"].items()
+                                    if v - pb.get(k, 0)},
+                    })
+    prev = rows_by_key(before.get("gauges", {}))
+    for (name, lk), r in rows_by_key(after.get("gauges", {})).items():
+        p = prev.get((name, lk))
+        if p is None or p["value"] != r["value"]:
+            out["gauges"].setdefault(name, []).append(dict(r))
+    return out
+
+
+#: the process-wide registry every instrumentation site shares
+REGISTRY = Registry()
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+reset = REGISTRY.reset
+snapshot = REGISTRY.snapshot

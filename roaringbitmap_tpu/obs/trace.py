@@ -1,0 +1,256 @@
+"""Structured span tracer for the query path.
+
+A span is one timed stage of a query ("batch.execute", "batch.plan",
+"guard.dispatch", ...) with parent/child nesting, wall-clock duration, a
+flat tag dict (engine, Q, rung, demotion counts, ...), and a list of
+point-in-time events (guard retry/demote/split decisions carry the same
+schema the structured log lines use, so log scrapers and trace consumers
+read one vocabulary).  Completed spans are appended as one JSON object per
+line to the file named by ``ROARING_TPU_TRACE`` (JSONL) — a dump the
+driver, tools/check_trace.py, and notebooks can read with no deps.
+
+Design constraints (docs/OBSERVABILITY.md):
+
+- **Near-zero disabled overhead.**  When no trace path is configured,
+  ``span()`` returns one shared no-op object without allocating a Span,
+  touching a contextvar, or opening a file — the fast path is a module
+  flag check.  tools/check_obs_overhead.py pins this in CI (< 2% of a
+  ``BatchEngine.execute``).
+- **Crash-usable dumps.**  Each span is written and flushed when it
+  closes, so a trace survives the process dying mid-query; parents close
+  after children, hence appear later in the file (consumers must collect
+  ids before resolving ``parent_id``).
+- **Device alignment.**  ``ROARING_TPU_TRACE_XPROF=1`` additionally wraps
+  every span in ``jax.profiler.TraceAnnotation`` so spans line up with
+  XLA device traces in xprof/TensorBoard; ``Span.sync(x)`` blocks on a
+  jax pytree and records the wait as ``sync_ms`` — the device-side tail
+  of a dispatch that wall time alone cannot attribute.
+
+Env knobs::
+
+    ROARING_TPU_TRACE=/path/to/trace.jsonl   # enable, append spans here
+    ROARING_TPU_TRACE_XPROF=1                # bridge spans into xprof
+
+Programmatic: ``enable(path)`` / ``disable()`` / ``refresh_from_env()``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+
+ENV_TRACE = "ROARING_TPU_TRACE"
+ENV_XPROF = "ROARING_TPU_TRACE_XPROF"
+
+_log = logging.getLogger("roaringbitmap_tpu.obs")
+
+_enabled = False              # the one flag the span() fast path reads
+_path: str | None = None
+_xprof = False
+_file = None
+_write_lock = threading.Lock()
+_ids = itertools.count(1)
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "rb_tpu_span", default=None)
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled-mode fast path and the
+    ``current()`` result outside any active span.  Every method is a
+    cheap self-return so instrumentation sites need no enabled checks."""
+
+    __slots__ = ()
+    span_id = None
+
+    def tag(self, **tags):
+        return self
+
+    def event(self, name, **fields):
+        return self
+
+    def sync(self, x):
+        return x
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """One live span.  Created only while tracing is enabled; written as
+    a JSONL record on ``__exit__`` (tags set after exit are lost)."""
+
+    __slots__ = ("name", "span_id", "parent_id", "trace_id", "t_start",
+                 "_t0", "tags", "events", "_token", "_ann")
+
+    def __init__(self, name: str, tags: dict):
+        self.name = name
+        self.span_id = f"{os.getpid():x}-{next(_ids):x}"
+        self.tags = tags
+        self.events: list = []
+        self._ann = None
+
+    def __enter__(self):
+        parent = _current.get()
+        self.parent_id = parent.span_id if parent is not None else None
+        self.trace_id = (parent.trace_id if parent is not None
+                         else self.span_id)
+        self._token = _current.set(self)
+        if _xprof:
+            self._ann = _xprof_annotation(self.name)
+            if self._ann is not None:
+                self._ann.__enter__()
+        self.t_start = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        _current.reset(self._token)
+        if exc_type is not None:
+            self.tags.setdefault("status", "error")
+            self.tags.setdefault("error_class", exc_type.__name__)
+        _write({
+            "name": self.name, "span_id": self.span_id,
+            "parent_id": self.parent_id, "trace_id": self.trace_id,
+            "pid": os.getpid(), "t_start": round(self.t_start, 6),
+            "dur_ms": round(dur_ms, 4), "tags": self.tags,
+            "events": self.events,
+        })
+        return False
+
+    def tag(self, **tags) -> "Span":
+        self.tags.update(tags)
+        return self
+
+    def event(self, name: str, **fields) -> "Span":
+        """Point-in-time record inside the span (guard retry/demote/split
+        decisions); ``t_offset_ms`` is relative to the span start."""
+        fields["name"] = name
+        fields["t_offset_ms"] = round(
+            (time.perf_counter() - self._t0) * 1e3, 4)
+        self.events.append(fields)
+        return self
+
+    def sync(self, x):
+        """Block until the jax pytree ``x`` is device-complete, recording
+        the wait as ``sync_ms`` — wall time up to this point is host work
+        + queueing; sync_ms is the device-side remainder."""
+        import jax
+
+        t0 = time.perf_counter()
+        x = jax.block_until_ready(x)
+        self.tags["sync_ms"] = round((time.perf_counter() - t0) * 1e3, 4)
+        return x
+
+
+def _xprof_annotation(name: str):
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler backend unavailable
+        return None
+
+
+def span(name: str, **tags):
+    """Start a span (use as a context manager).  Disabled mode returns the
+    shared no-op without allocating."""
+    if not _enabled:
+        return _NOOP
+    return Span(name, tags)
+
+
+def current():
+    """The innermost active span, or the shared no-op — lets deep layers
+    (guard decisions) annotate their enclosing span without plumbing."""
+    sp = _current.get()
+    return sp if sp is not None else _NOOP
+
+
+def _write(record: dict) -> None:
+    with _write_lock:
+        if not _enabled or _file is None:
+            return
+        try:
+            _file.write(json.dumps(record, separators=(",", ":"),
+                                   default=str) + "\n")
+        except OSError as exc:
+            # a full disk / revoked fd must cost the trace, never the
+            # query that just succeeded (Span.__exit__ calls this)
+            _log.warning("trace write to %s failed, disabling tracer: %s",
+                         _path, exc)
+            _disable_locked()
+
+
+def enable(path: str, xprof: bool | None = None) -> None:
+    """Start appending completed spans to ``path`` (JSONL).  Opens the
+    file eagerly so a bad path fails HERE, at configuration time, with a
+    plain OSError — not out of the first query's span exit."""
+    global _enabled, _path, _file, _xprof
+    disable()
+    f = open(path, "a", buffering=1)
+    with _write_lock:
+        _path = path
+        _file = f
+        if xprof is not None:
+            _xprof = bool(xprof)
+        _enabled = True
+
+
+def disable() -> None:
+    with _write_lock:
+        _disable_locked()
+
+
+def _disable_locked() -> None:
+    global _enabled, _path, _file
+    _enabled = False
+    _path = None
+    if _file is not None:
+        try:
+            _file.close()
+        except OSError:  # pragma: no cover - close on a dead fd
+            pass
+        _file = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def path() -> str | None:
+    return _path
+
+
+def refresh_from_env() -> None:
+    """Re-read ``ROARING_TPU_TRACE`` / ``ROARING_TPU_TRACE_XPROF``.  Run
+    at import; call again after mutating the environment in-process."""
+    global _xprof
+    _xprof = os.environ.get(ENV_XPROF, "") not in ("", "0")
+    p = os.environ.get(ENV_TRACE)
+    if p:
+        try:
+            enable(p)
+        except OSError as exc:
+            # importing the library must survive a misconfigured env var;
+            # the operator gets one warning and no trace
+            _log.warning("%s=%s is not writable, tracing disabled: %s",
+                         ENV_TRACE, p, exc)
+    else:
+        disable()
+
+
+refresh_from_env()

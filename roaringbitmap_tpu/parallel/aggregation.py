@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.bitmap import RoaringBitmap
+from ..obs import trace as obs_trace
 from ..ops import dense, kernels, packing
 from ..runtime import faults, guard
 
@@ -75,10 +76,14 @@ def _guarded_wide(op: str, bitmaps: list, engine: str, attempt,
     the ladder for paths with a single device engine (wide AND), where a
     pallas->xla "demotion" would just re-run identical code."""
     policy = guard.GuardPolicy.from_env()
-    res, rung = guard.run_with_fallback(
-        site, chain or guard.chain_from(_engine(engine), ENGINE_LADDER),
-        attempt, policy=policy,
-        sequential=sequential or (lambda: _sequential_reduce(op, bitmaps)))
+    with obs_trace.span("aggregation.wide", site=site, op=op,
+                        n=len(bitmaps), engine=engine) as sp:
+        res, rung = guard.run_with_fallback(
+            site, chain or guard.chain_from(_engine(engine), ENGINE_LADDER),
+            attempt, policy=policy,
+            sequential=sequential or (lambda: _sequential_reduce(op,
+                                                                 bitmaps)))
+        sp.tag(rung_used=rung)
     if (rung != guard.SEQUENTIAL and policy.shadow_rate > 0.0
             and guard.shadow_sample(1, policy.shadow_rate,
                                     policy.shadow_seed, site)):

@@ -67,6 +67,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.bitmap import RoaringBitmap
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..ops import dense, kernels, packing
 from ..runtime import faults, guard
 from ..runtime.cache import LRUCache
@@ -152,8 +154,8 @@ class BatchEngine:
         self._row_src = np.asarray(ds._packed.row_src)
         self._row_seg = np.repeat(np.asarray(ds._packed.blk_seg),
                                   ds.block).astype(np.int32)
-        self._programs = LRUCache(PROGRAM_CACHE_MAX)
-        self._plans = LRUCache(PLAN_CACHE_MAX)
+        self._programs = LRUCache(PROGRAM_CACHE_MAX, name="batch_programs")
+        self._plans = LRUCache(PLAN_CACHE_MAX, name="batch_plans")
         self._hosts = None        # lazy CPU-reference copies of the sources
         self.split_count = 0      # ResourceExhausted batch halvings served
 
@@ -270,14 +272,17 @@ class BatchEngine:
         cached = self._plans.get(key)
         if cached is not None:
             return cached
-        groups: dict = {}
-        for qid, q in enumerate(queries):
-            rows, segs, keys_q, keep, hrows = self._plan_query(q)
-            rung = packing.next_pow2(max(1, len(set(q.operands))))
-            groups.setdefault((q.op, rung), []).append(
-                (qid, q, rows, segs, keys_q, keep, hrows))
-        plan = [self._plan_bucket(op, items)
-                for (op, _), items in sorted(groups.items())]
+        with obs_trace.span("batch.plan", q=len(queries)) as sp:
+            groups: dict = {}
+            for qid, q in enumerate(queries):
+                rows, segs, keys_q, keep, hrows = self._plan_query(q)
+                rung = packing.next_pow2(max(1, len(set(q.operands))))
+                groups.setdefault((q.op, rung), []).append(
+                    (qid, q, rows, segs, keys_q, keep, hrows))
+            with obs_trace.span("batch.bucket", groups=len(groups)):
+                plan = [self._plan_bucket(op, items)
+                        for (op, _), items in sorted(groups.items())]
+            sp.tag(buckets=len(plan))
         self._plans.put(key, plan)
         return plan
 
@@ -352,12 +357,18 @@ class BatchEngine:
             return cached
         b_sigs = [b.signature for b in plan]
 
-        def run(src_in, barrays):
-            words = self._words_from_src(src_in, kind, eng)
-            return [self._bucket_body(words, s, a, eng)
-                    for s, a in zip(b_sigs, barrays)]
+        # named program_build, not compile: this builds + jit-wraps the
+        # program; XLA compiles it lazily on the first dispatch, which
+        # that dispatch's batch.dispatch span absorbs (sync_ms carries
+        # the compile)
+        with obs_trace.span("batch.program_build", engine=eng, kind=kind,
+                            buckets=len(plan)):
+            def run(src_in, barrays):
+                words = self._words_from_src(src_in, kind, eng)
+                return [self._bucket_body(words, s, a, eng)
+                        for s, a in zip(b_sigs, barrays)]
 
-        cached = (run, jax.jit(run))
+            cached = (run, jax.jit(run))
         self._programs.put(sig, cached)
         return cached
 
@@ -393,14 +404,19 @@ class BatchEngine:
         queries = list(queries)
         if not queries:
             return []
-        if not fallback:
-            # raw single-engine path: no guard AND no injection — a parity
-            # probe pinning one engine must see that engine's true output
-            return self._execute_once(queries, engine, jit, inject=False)
-        policy = policy or guard.GuardPolicy.from_env()
-        chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
-        return self._dispatch(queries, chain, jit, policy,
-                              guard.Deadline(policy.deadline))
+        with obs_trace.span("batch.execute", site="batch_engine",
+                            q=len(queries), engine=engine,
+                            fallback=fallback):
+            if not fallback:
+                # raw single-engine path: no guard AND no injection — a
+                # parity probe pinning one engine must see that engine's
+                # true output
+                return self._execute_once(queries, engine, jit,
+                                          inject=False)
+            policy = policy or guard.GuardPolicy.from_env()
+            chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
+            return self._dispatch(queries, chain, jit, policy,
+                                  guard.Deadline(policy.deadline))
 
     def _dispatch(self, queries, chain, jit, policy, deadline):
         """One guarded run of `queries` down `chain`; recurses on OOM
@@ -419,6 +435,13 @@ class BatchEngine:
             sub = chain[chain.index(eng):] if eng in chain else chain
             mid = (len(queries) + 1) // 2
             self.split_count += 1
+            obs_metrics.counter("rb_batch_oom_splits_total",
+                                site="batch_engine").inc()
+            obs_trace.current().event("oom_split", site="batch_engine",
+                                      engine_from=eng, engine_to=eng,
+                                      q=len(queries), halves=(mid,
+                                                              len(queries)
+                                                              - mid))
             split = True
             return (self._dispatch(queries[:mid], sub, jit, policy, dl)
                     + self._dispatch(queries[mid:], sub, jit, policy, dl))
@@ -445,22 +468,28 @@ class BatchEngine:
             faults.maybe_fail("batch_engine", eng)
         run, run_jit = self._program(plan, eng)
         src, _ = self._resident_src()
-        outs = (run_jit if jit else run)(src, [b.arrays for b in plan])
-        results: list = [None] * len(queries)
-        for b, (heads, cards) in zip(plan, outs):
-            cards = np.asarray(cards)
-            heads = None if heads is None else np.asarray(heads)
-            for slot, (qid, keys_q) in enumerate(zip(b.qids, b.keys)):
-                kq = keys_q.size
-                card = int(cards[slot, :kq].sum()) if kq else 0
-                bm = None
-                if queries[qid].form == "bitmap":
-                    bm = packing.unpack_result(
-                        keys_q,
-                        heads[slot, :kq] if kq else
-                        np.zeros((0, WORDS32), np.uint32),
-                        cards[slot, :kq])
-                results[qid] = BatchResult(cardinality=card, bitmap=bm)
+        with obs_trace.span("batch.dispatch", engine=eng,
+                            q=len(queries), buckets=len(plan)) as sp:
+            outs = (run_jit if jit else run)(src, [b.arrays for b in plan])
+            # sync before readback: the span's wall time is host work +
+            # queueing, sync_ms is the device-side remainder
+            outs = sp.sync(outs)
+        with obs_trace.span("batch.readback", engine=eng, q=len(queries)):
+            results: list = [None] * len(queries)
+            for b, (heads, cards) in zip(plan, outs):
+                cards = np.asarray(cards)
+                heads = None if heads is None else np.asarray(heads)
+                for slot, (qid, keys_q) in enumerate(zip(b.qids, b.keys)):
+                    kq = keys_q.size
+                    card = int(cards[slot, :kq].sum()) if kq else 0
+                    bm = None
+                    if queries[qid].form == "bitmap":
+                        bm = packing.unpack_result(
+                            keys_q,
+                            heads[slot, :kq] if kq else
+                            np.zeros((0, WORDS32), np.uint32),
+                            cards[slot, :kq])
+                    results[qid] = BatchResult(cardinality=card, bitmap=bm)
         if inject and faults.should_corrupt("batch_engine", eng):
             # deterministic silent corruption (fault kind "silent"): the
             # case only the shadow cross-check can catch

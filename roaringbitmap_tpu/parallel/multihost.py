@@ -61,6 +61,7 @@ def initialize(coordinator_address: str | None = None,
     aborts the process on its own internal deadline, so no Python-side
     watchdog can bound the handshake once it is entered.)
     """
+    from ..obs import trace as obs_trace
     from ..runtime import errors, faults
 
     if timeout is None:
@@ -72,47 +73,56 @@ def initialize(coordinator_address: str | None = None,
                 f"process_id {process_id if process_id is not None else '<auto>'}")
 
     deadline = time.monotonic() + timeout
-    try:
-        faults.maybe_fail("multihost", "coordinator")
-        if coordinator_address and process_id not in (None, 0):
-            # pre-flight TCP probe with retry-until-deadline: XLA's
-            # coordination client LOG(FATAL)s the whole process when its
-            # own handshake deadline fires, so an unreachable coordinator
-            # must be detected BEFORE the C++ client is entered — that is
-            # the only place a typed Python error can still be raised
-            _probe_coordinator(coordinator_address, timeout, deadline,
-                               describe, errors)
-        import jax
+    with obs_trace.span(
+            "multihost.initialize",
+            coordinator=coordinator_address or "<auto-detected>",
+            process_id=process_id if process_id is not None else "<auto>",
+            timeout_s=timeout):
+        try:
+            faults.maybe_fail("multihost", "coordinator")
+            if coordinator_address and process_id not in (None, 0):
+                # pre-flight TCP probe with retry-until-deadline: XLA's
+                # coordination client LOG(FATAL)s the whole process when
+                # its own handshake deadline fires, so an unreachable
+                # coordinator must be detected BEFORE the C++ client is
+                # entered — that is the only place a typed Python error
+                # can still be raised
+                _probe_coordinator(coordinator_address, timeout, deadline,
+                                   describe, errors)
+            import jax
 
-        # the handshake gets whatever the probe left of the ONE budget
-        remaining = max(deadline - time.monotonic(), 1.0)
-        kw = {}
-        params = inspect.signature(jax.distributed.initialize).parameters
-        if "initialization_timeout" in params:
-            # jax enforces the bound itself: the clean path — the connect
-            # loop gives up and raises instead of retrying forever
-            kw["initialization_timeout"] = max(int(remaining), 1)
-            jax.distributed.initialize(coordinator_address, num_processes,
-                                       process_id, **kw)
-        else:
-            # old jax without the knob: call directly.  A watchdog thread
-            # would be worse than nothing — the abandoned C++ coordination
-            # client LOG(FATAL)s the whole process when ITS handshake
-            # deadline fires, after the caller already got a typed error
-            # and kept serving.  Without the knob, the pre-flight probe
-            # above is the only typed-timeout protection.
-            jax.distributed.initialize(coordinator_address, num_processes,
-                                       process_id)
-    except errors.CoordinatorTimeout:
-        raise
-    except Exception as exc:
-        fault = errors.classify(exc)
-        if isinstance(fault, (errors.CoordinatorTimeout,
-                              errors.TransientDeviceError)):
-            raise errors.CoordinatorTimeout(
-                f"multihost.initialize: {describe()} unreachable within "
-                f"{timeout:g}s: {exc}") from exc
-        raise
+            # the handshake gets whatever the probe left of the ONE budget
+            remaining = max(deadline - time.monotonic(), 1.0)
+            kw = {}
+            params = inspect.signature(
+                jax.distributed.initialize).parameters
+            if "initialization_timeout" in params:
+                # jax enforces the bound itself: the clean path — the
+                # connect loop gives up and raises instead of retrying
+                # forever
+                kw["initialization_timeout"] = max(int(remaining), 1)
+                jax.distributed.initialize(coordinator_address,
+                                           num_processes, process_id, **kw)
+            else:
+                # old jax without the knob: call directly.  A watchdog
+                # thread would be worse than nothing — the abandoned C++
+                # coordination client LOG(FATAL)s the whole process when
+                # ITS handshake deadline fires, after the caller already
+                # got a typed error and kept serving.  Without the knob,
+                # the pre-flight probe above is the only typed-timeout
+                # protection.
+                jax.distributed.initialize(coordinator_address,
+                                           num_processes, process_id)
+        except errors.CoordinatorTimeout:
+            raise
+        except Exception as exc:
+            fault = errors.classify(exc)
+            if isinstance(fault, (errors.CoordinatorTimeout,
+                                  errors.TransientDeviceError)):
+                raise errors.CoordinatorTimeout(
+                    f"multihost.initialize: {describe()} unreachable "
+                    f"within {timeout:g}s: {exc}") from exc
+            raise
 
 
 def _probe_coordinator(address: str, timeout: float, deadline: float,

@@ -330,20 +330,26 @@ def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,
         raise ValueError(f"unknown ingest {ingest!r}")
     if op not in ("or", "xor", "and"):
         raise ValueError(f"unsupported sharded wide op {op!r}")
+    from ..obs import trace as obs_trace
     from ..runtime import faults, guard
 
     bitmaps = list(bitmaps)
-    if not fallback:
-        return _wide_aggregate_sharded_device(mesh, op, bitmaps, ingest)
+    with obs_trace.span("sharding.wide_aggregate", site="sharding", op=op,
+                        ingest=ingest, n=len(bitmaps),
+                        devices=mesh.devices.size,
+                        fallback=fallback) as sp:
+        if not fallback:
+            return _wide_aggregate_sharded_device(mesh, op, bitmaps, ingest)
 
-    def attempt(rung):
-        faults.maybe_fail("sharding", rung)
-        return _wide_aggregate_sharded_device(mesh, op, bitmaps, ingest)
+        def attempt(rung):
+            faults.maybe_fail("sharding", rung)
+            return _wide_aggregate_sharded_device(mesh, op, bitmaps, ingest)
 
-    res, _ = guard.run_with_fallback(
-        "sharding", ("sharded",), attempt,
-        sequential=lambda: _sequential_sharded(op, bitmaps))
-    return res
+        res, rung = guard.run_with_fallback(
+            "sharding", ("sharded",), attempt,
+            sequential=lambda: _sequential_sharded(op, bitmaps))
+        sp.tag(rung_used=rung)
+        return res
 
 
 def _sequential_sharded(op: str, bitmaps

@@ -39,6 +39,8 @@ from typing import Callable
 import numpy as np
 
 from . import errors
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 
 _log = logging.getLogger("roaringbitmap_tpu.runtime")
 
@@ -121,7 +123,10 @@ def chain_from(engine: str, ladder: tuple) -> tuple:
 # this layer exists to survive — it must not also be invisible.  Every
 # retry / demotion / sequential landing bumps a per-site counter (and logs
 # at the matching level); operators poll dispatch_stats() next to
-# BatchEngine.cache_stats().
+# BatchEngine.cache_stats().  The same events are first-class instruments
+# in the unified registry (rb_dispatch_events_total{site,event} — see
+# docs/OBSERVABILITY.md); this dict is the legacy per-site view whose
+# exact shape operator tooling pins.
 
 _dispatch_stats: dict = {}
 
@@ -130,6 +135,8 @@ def _bump(site: str, key: str) -> None:
     row = _dispatch_stats.setdefault(
         site, {"retries": 0, "demotions": 0, "sequential": 0})
     row[key] += 1
+    obs_metrics.counter("rb_dispatch_events_total", site=site,
+                        event=key).inc()
 
 
 def dispatch_stats(site: str | None = None) -> dict:
@@ -153,6 +160,34 @@ def _deadline_error(site: str, dl: Deadline, last):
     return err
 
 
+def _log_transition(level: int, site: str, event: str, engine_from: str,
+                    engine_to: str | None, fault, span=None,
+                    **fields) -> None:
+    """One guard decision, emitted through ONE schema on two surfaces:
+    a structured log record (``extra=`` fields, ``rb_`` prefixed, for log
+    scrapers) and a span event on the enclosing trace span — so scraped
+    logs and JSONL traces join on identical (site, engine_from,
+    engine_to, error_class) keys."""
+    error_class = type(fault).__name__ if fault is not None else None
+    _log.log(level, "%s: %s %s -> %s: %s", site, event, engine_from,
+             engine_to or "-", fault,
+             extra={"rb_site": site, "rb_event": event,
+                    "rb_engine_from": engine_from,
+                    "rb_engine_to": engine_to,
+                    "rb_error_class": error_class,
+                    **{f"rb_{k}": v for k, v in fields.items()}})
+    (span if span is not None else obs_trace.current()).event(
+        event, site=site, engine_from=engine_from, engine_to=engine_to,
+        error_class=error_class, **fields)
+
+
+def _observe_latency(site: str, engine: str, seconds: float) -> None:
+    """Per-(site, engine) execute-latency histogram: the serving-latency
+    instrument obs.snapshot() / the Prometheus renderer export."""
+    obs_metrics.histogram("rb_execute_latency_seconds", site=site,
+                          engine=engine).observe(seconds)
+
+
 def run_with_fallback(site: str, chain, attempt, *, policy=None,
                       sequential=None, on_resource_exhausted=None,
                       deadline: Deadline | None = None):
@@ -172,59 +207,83 @@ def run_with_fallback(site: str, chain, attempt, *, policy=None,
     if not rungs:
         raise ValueError(f"{site}: empty fallback chain")
     last = None
-    for rung in rungs:
-        backoff = policy.backoff_base
-        for att in range(policy.max_attempts):
-            if dl.expired():
-                raise _deadline_error(site, dl, last)
-            try:
-                if rung == SEQUENTIAL:
-                    _bump(site, "sequential")
-                    _log.warning(
-                        "%s: serving from the CPU sequential reference "
-                        "(every engine rung failed; last fault: %s)",
-                        site, last)
-                    return sequential(), SEQUENTIAL
-                return attempt(rung), rung
-            except Exception as exc:
-                fault = errors.classify(exc)
-                if fault is None or isinstance(fault, errors.ShadowMismatch):
-                    raise          # programming error / proven corruption
-                last = fault
-                if isinstance(fault, errors.CorruptInput):
-                    # the input is garbage on every rung; fatal now
-                    if fault is exc:
-                        raise
-                    raise fault from exc
-                if isinstance(fault, errors.ResourceExhausted):
-                    if on_resource_exhausted is not None:
-                        res = on_resource_exhausted(rung, fault, dl)
-                        if res is not NO_SPLIT:
-                            return res, rung
-                    _bump(site, "demotions")
-                    _log.warning("%s: demoting off rung %s: %s",
-                                 site, rung, fault)
-                    break          # demote: same shape would OOM again
-                if isinstance(fault, errors.EngineLoweringError):
-                    _bump(site, "demotions")
-                    _log.warning("%s: demoting off rung %s: %s",
-                                 site, rung, fault)
-                    break          # demote: deterministic compile failure
-                # retryable (transient / coordinator): bounded backoff
-                if att + 1 >= policy.max_attempts:
-                    _bump(site, "demotions")
-                    _log.warning(
-                        "%s: retries exhausted on rung %s, demoting: %s",
-                        site, rung, fault)
-                    break          # retries exhausted on this rung: demote
-                _bump(site, "retries")
-                _log.debug("%s: transient fault on rung %s, retry %d: %s",
-                           site, rung, att + 1, fault)
-                policy.sleep(min(backoff, dl.remaining()))
-                backoff = min(backoff * policy.backoff_factor,
-                              policy.backoff_max)
-    assert last is not None  # a rung can only exit its loop via a fault
-    raise last
+    with obs_trace.span("guard.dispatch", site=site) as sp:
+        demotion_chain: list = []   # "pallas->xla"-style hops, in order
+        retries = 0
+
+        def _done(res, rung, **tags):
+            sp.tag(rung_used=rung, retries=retries,
+                   demotions=len(demotion_chain),
+                   demotion_chain=demotion_chain, **tags)
+            return res, rung
+
+        def _demote(rung, next_rung, fault, **fields):
+            _bump(site, "demotions")
+            demotion_chain.append(f"{rung}->{next_rung or '-'}")
+            _log_transition(logging.WARNING, site, "demote", rung,
+                            next_rung, fault, span=sp, **fields)
+
+        for ri, rung in enumerate(rungs):
+            next_rung = rungs[ri + 1] if ri + 1 < len(rungs) else None
+            backoff = policy.backoff_base
+            for att in range(policy.max_attempts):
+                if dl.expired():
+                    raise _deadline_error(site, dl, last)
+                try:
+                    if rung == SEQUENTIAL:
+                        _bump(site, "sequential")
+                        _log_transition(
+                            logging.WARNING, site, "sequential",
+                            rungs[ri - 1] if ri else SEQUENTIAL,
+                            SEQUENTIAL, last, span=sp)
+                        t0 = time.perf_counter()
+                        res = sequential()
+                        _observe_latency(site, SEQUENTIAL,
+                                         time.perf_counter() - t0)
+                        return _done(res, SEQUENTIAL)
+                    t0 = time.perf_counter()
+                    res = attempt(rung)
+                    _observe_latency(site, rung, time.perf_counter() - t0)
+                    return _done(res, rung)
+                except Exception as exc:
+                    fault = errors.classify(exc)
+                    if fault is None or isinstance(fault,
+                                                   errors.ShadowMismatch):
+                        raise      # programming error / proven corruption
+                    last = fault
+                    if isinstance(fault, errors.CorruptInput):
+                        # the input is garbage on every rung; fatal now
+                        _log_transition(logging.ERROR, site, "fatal",
+                                        rung, None, fault, span=sp)
+                        if fault is exc:
+                            raise
+                        raise fault from exc
+                    if isinstance(fault, errors.ResourceExhausted):
+                        if on_resource_exhausted is not None:
+                            res = on_resource_exhausted(rung, fault, dl)
+                            if res is not NO_SPLIT:
+                                return _done(res, rung, split=True)
+                        # demote: same shape would OOM again
+                        _demote(rung, next_rung, fault)
+                        break
+                    if isinstance(fault, errors.EngineLoweringError):
+                        # demote: deterministic compile failure
+                        _demote(rung, next_rung, fault)
+                        break
+                    # retryable (transient / coordinator): bounded backoff
+                    if att + 1 >= policy.max_attempts:
+                        _demote(rung, next_rung, fault,
+                                reason="retries_exhausted")
+                        break
+                    _bump(site, "retries")
+                    retries += 1
+                    _log_transition(logging.DEBUG, site, "retry", rung,
+                                    rung, fault, span=sp, attempt=att + 1)
+                    policy.sleep(min(backoff, dl.remaining()))
+                    backoff = min(backoff * policy.backoff_factor,
+                                  policy.backoff_max)
+        assert last is not None  # a rung can only exit its loop via a fault
+        raise last
 
 
 # ------------------------------------------------------------ shadow checks

@@ -115,3 +115,55 @@ def test_summary_is_one_small_line(tmp_path):
     assert parsed["batched_qps"]["census1881"]["meets_5x"] is True
     assert parsed["marginal_us_median"]["census1881"] == 13.05
     assert parsed["full_doc"].endswith("bench_full.json")
+    # the emitted line is the capped form and keeps the optional fields
+    # when the document is normal-sized
+    capped = bench.summary_line(doc, str(tmp_path / "bench_full.json"))
+    assert capped == line
+    assert len(capped.encode()) <= bench.SUMMARY_MAX_BYTES
+
+
+def _bloated_doc(n_datasets: int) -> dict:
+    """A document whose naive summary would overflow any bounded tail
+    capture: many datasets, each with full spread + batched rows."""
+    names = [f"dataset-{i:03d}" for i in range(n_datasets)]
+    return {
+        "metric": "wide_or_dataset-000_aggregations_per_sec",
+        "value": 1.0, "vs_baseline": 2.0, "unit": "wide-OR/s (...)",
+        "detail": {
+            "backend": "tpu",
+            "north_star": {n: {"vs_baseline": 12.3, "target": 10.0,
+                               "met": True} for n in names},
+            "north_star_spread": {
+                **{n: {"n": 5, "marginal_us_median": 13.05,
+                       "marginal_us_min": 12.98, "marginal_us_max": 13.1,
+                       "samples_us": [13.05] * 5} for n in names},
+                "backend": "tpu"},
+        },
+        "batched_by_dataset": {
+            n: {"q1_seq_dispatch_qps": 14000.0, "q8_e2e_qps": 90000.0,
+                "q64_e2e_qps": 400000.0, "q256_e2e_qps": 700000.0,
+                "q64_steady_qps": 900000.0,
+                "q64_vs_q1_amortization_x": 28.6, "meets_5x": True,
+                "fault_lane": {"demotion_overhead_x": 1.4,
+                               "sequential_floor_cost_x": 60.0}}
+            for n in names},
+    }
+
+
+def test_summary_line_holds_byte_cap_under_bloat(tmp_path):
+    """ADVICE r5: the driver's bounded tail capture truncated the summary
+    head for two rounds.  summary_line must stay under the fixed byte
+    budget for ANY document by shedding optional fields, while remaining
+    one line of valid JSON with the driver-gate core intact."""
+    full = str(tmp_path / "bench_full.json")
+    for n in (2, 8, 40):
+        line = bench.summary_line(_bloated_doc(n), full)
+        assert len(line.encode("utf-8")) <= bench.SUMMARY_MAX_BYTES, \
+            (n, len(line))
+        assert "\n" not in line
+        parsed = json.loads(line)
+        assert parsed["metric"] == "wide_or_dataset-000_aggregations_per_sec"
+        assert parsed["value"] == 1.0 and parsed["vs_baseline"] == 2.0
+    # normal-sized docs shed nothing
+    small = bench.summary_line(_bloated_doc(2), full)
+    assert "batched_qps" in json.loads(small)

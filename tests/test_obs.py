@@ -1,0 +1,425 @@
+"""Observability subsystem (roaringbitmap_tpu/obs) acceptance + contracts.
+
+ISSUE 3 acceptance pins:
+- a fault-injected demoted query (ROARING_TPU_FAULTS lowering fault)
+  produces a JSONL trace whose spans show the pallas->xla demotion with
+  the classified error tag;
+- obs.snapshot() histograms record per-engine execute latencies for a
+  Q=64 batch;
+- reset()/snapshot() symmetry for the metrics registry;
+- dispatch_stats() / cache_stats() keep their exact legacy dict shapes
+  (docs/ROBUSTNESS.md + operator tooling reference them);
+- disabled-mode span() is the shared no-op (the <2% overhead pin rides
+  on it; CI measures the fraction in tools/check_obs_overhead.py).
+"""
+
+import importlib.util
+import json
+import logging
+import os
+
+import pytest
+
+from roaringbitmap_tpu import obs
+from roaringbitmap_tpu.obs import metrics as obs_metrics
+from roaringbitmap_tpu.parallel import aggregation
+from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
+                                                     random_query_pool)
+from roaringbitmap_tpu.runtime import faults, guard
+from roaringbitmap_tpu.utils import datasets
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts from a fresh registry / disabled tracer and
+    leaves no global state behind."""
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    yield
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    bms = datasets.synthetic_bitmaps(16, seed=11, universe=1 << 18,
+                                     density=0.01)
+    return BatchEngine.from_bitmaps(bms)
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return random_query_pool(16, 64)
+
+
+def _read_trace(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# ------------------------------------------------------------ acceptance
+
+def test_demoted_query_trace_shows_demotion_chain(tmp_path, monkeypatch,
+                                                  engine, pool):
+    """ROARING_TPU_FAULTS lowering fault on the pallas rung -> the JSONL
+    trace records the pallas->xla demotion with the classified error."""
+    trace_path = tmp_path / "trace.jsonl"
+    monkeypatch.setenv("ROARING_TPU_TRACE", str(trace_path))
+    monkeypatch.setenv("ROARING_TPU_FAULTS", "lowering@pallas=1.0:7")
+    obs.refresh_from_env()
+    try:
+        got = [r.cardinality for r in engine.execute(pool[:8],
+                                                     engine="pallas")]
+    finally:
+        obs.disable()
+    # degraded, still bit-exact
+    want = [r.cardinality for r in engine._execute_sequential(pool[:8])]
+    assert got == want
+
+    spans = _read_trace(trace_path)
+    by_id = {s["span_id"]: s for s in spans}
+    dispatches = [s for s in spans if s["name"] == "guard.dispatch"]
+    assert dispatches, [s["name"] for s in spans]
+    demotes = [ev for s in dispatches for ev in s["events"]
+               if ev["name"] == "demote"]
+    assert any(ev["engine_from"] == "pallas" and ev["engine_to"] == "xla"
+               and ev["error_class"] == "EngineLoweringError"
+               and ev["site"] == "batch_engine" for ev in demotes), demotes
+    # the dispatch span records where the query actually landed
+    d = dispatches[-1]
+    assert d["tags"]["rung_used"] == "xla"
+    assert d["tags"]["demotion_chain"] == ["pallas->xla"]
+    # nesting: guard.dispatch rides under batch.execute
+    assert by_id[d["parent_id"]]["name"] == "batch.execute"
+
+
+def test_snapshot_histograms_record_per_engine_latency(engine, pool):
+    """Q=64 batch -> obs.snapshot() carries per-(site, engine) execute
+    latency histograms; a second engine gets its own row."""
+    engine.execute(pool)                       # Q=64, auto -> xla on CPU
+    engine.execute(pool[:8], engine="xla-vmap")
+    rows = obs.snapshot()["histograms"]["rb_execute_latency_seconds"]
+    by_labels = {tuple(sorted(r["labels"].items())): r for r in rows}
+    xla = by_labels[(("engine", "xla"), ("site", "batch_engine"))]
+    vmap = by_labels[(("engine", "xla-vmap"), ("site", "batch_engine"))]
+    assert xla["count"] >= 1 and vmap["count"] >= 1
+    assert xla["sum"] > 0
+    # cumulative buckets end at +Inf == count
+    assert xla["buckets"]["+Inf"] == xla["count"]
+
+
+def test_sequential_landing_records_sequential_histogram(engine, pool):
+    with faults.inject("lowering=1.0:0xBEEF"):
+        engine.execute(pool[:4])
+    rows = obs.snapshot()["histograms"]["rb_execute_latency_seconds"]
+    assert any(r["labels"] == {"engine": "sequential",
+                               "site": "batch_engine"} and r["count"] >= 1
+               for r in rows), rows
+
+
+# ------------------------------------------------- registry contracts
+
+def test_reset_snapshot_symmetry():
+    """reset() returns the registry to its fresh state: a snapshot after
+    reset equals one taken right after a previous reset.  (Gauges backed
+    by collectors — rb_cache_size over live caches — are recomputed at
+    every snapshot, so they appear identically on both sides.)"""
+    baseline = obs.snapshot()
+    assert baseline["counters"] == {} and baseline["histograms"] == {}
+    assert baseline["trace"] == {"enabled": False, "path": None}
+    obs.counter("rb_t_total", site="x").inc()
+    obs.gauge("rb_g", site="x").set(3)
+    obs.histogram("rb_h_seconds", site="x").observe(0.5)
+    assert obs.snapshot() != baseline
+    obs.reset()
+    assert obs.snapshot() == baseline
+
+
+def test_registry_kind_conflict_raises():
+    obs.counter("rb_conflict_total", a="b")
+    with pytest.raises(TypeError):
+        obs.gauge("rb_conflict_total", a="b")
+
+
+def test_histogram_bucket_conflict_raises():
+    obs.histogram("rb_bconf_seconds", buckets=(0.1, 1.0), site="s")
+    with pytest.raises(ValueError):
+        obs.histogram("rb_bconf_seconds", buckets=(0.5,), site="s")
+    # same spec: fine
+    obs.histogram("rb_bconf_seconds", buckets=(1.0, 0.1), site="s")
+
+
+def test_mixed_type_label_values_stringify():
+    obs.counter("rb_mixed_total", q=64).inc()
+    obs.counter("rb_mixed_total", q="auto").inc()
+    rows = obs.snapshot()["counters"]["rb_mixed_total"]
+    assert sorted(r["labels"]["q"] for r in rows) == ["64", "auto"]
+    assert "rb_mixed_total" in obs.render_prometheus()
+
+
+def test_snapshot_delta_counters_and_histograms():
+    before = obs.snapshot()
+    obs.counter("rb_d_total").inc(2)
+    h = obs.histogram("rb_d_seconds")
+    h.observe(0.001)
+    h.observe(0.2)
+    delta = obs.snapshot_delta(before, obs.snapshot())
+    assert delta["counters"]["rb_d_total"][0]["value"] == 2
+    hrow = delta["histograms"]["rb_d_seconds"][0]
+    assert hrow["count"] == 2
+    assert abs(hrow["sum"] - 0.201) < 1e-9
+    # second delta over an unchanged registry is empty
+    snap = obs.snapshot()
+    assert obs.snapshot_delta(snap, snap) == {
+        "counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_legacy_dispatch_stats_shape(engine, pool):
+    """docs/ROBUSTNESS.md + operator tooling pin these exact dict shapes;
+    the registry is a superset view, never a replacement."""
+    with faults.inject("lowering@pallas=1.0:3"):
+        engine.execute(pool[:4], engine="pallas")
+    row = guard.dispatch_stats("batch_engine")
+    assert set(row) == {"retries", "demotions", "sequential"}
+    assert all(isinstance(v, int) for v in row.values())
+    assert row["demotions"] == 1
+    full = guard.dispatch_stats()
+    assert set(full["batch_engine"]) == {"retries", "demotions",
+                                         "sequential"}
+
+
+def test_legacy_cache_stats_shape(engine, pool):
+    engine.execute(pool[:8])
+    cs = engine.cache_stats()
+    assert set(cs) == {"plans", "programs", "splits"}
+    for key in ("plans", "programs"):
+        assert set(cs[key]) == {"size", "maxsize", "hits", "misses",
+                                "evictions"}
+    assert isinstance(cs["splits"], int)
+
+
+def test_dispatch_and_cache_events_absorbed_in_registry():
+    # fresh engine: its cache misses/puts must land AFTER the registry
+    # reset for the counter/gauge assertions below
+    bms = datasets.synthetic_bitmaps(8, seed=13, universe=1 << 16,
+                                     density=0.02)
+    engine = BatchEngine.from_bitmaps(bms)
+    qs = random_query_pool(8, 4)
+    with faults.inject("lowering@pallas=1.0:3"):
+        engine.execute(qs, engine="pallas")
+    engine.execute(qs)                          # plan-cache hit this time
+    snap = obs.snapshot()
+    ev = {(r["labels"]["site"], r["labels"]["event"]): r["value"]
+          for r in snap["counters"]["rb_dispatch_events_total"]}
+    assert ev[("batch_engine", "demotions")] >= 1
+    cache = {(r["labels"]["cache"], r["labels"]["event"]): r["value"]
+             for r in snap["counters"]["rb_cache_events_total"]}
+    assert cache[("batch_plans", "hit")] >= 1
+    sizes = {r["labels"]["cache"]: r["value"]
+             for r in snap["gauges"]["rb_cache_size"]}
+    assert sizes["batch_plans"] >= 1
+
+
+# ------------------------------------------------- structured logging
+
+def test_guard_demotion_log_carries_structured_fields(caplog, engine,
+                                                      pool):
+    with caplog.at_level(logging.WARNING, "roaringbitmap_tpu.runtime"):
+        with faults.inject("lowering@pallas=1.0:5"):
+            engine.execute(pool[:2], engine="pallas")
+    recs = [r for r in caplog.records
+            if getattr(r, "rb_event", None) == "demote"]
+    assert recs, [r.message for r in caplog.records]
+    r = recs[0]
+    assert r.rb_site == "batch_engine"
+    assert r.rb_engine_from == "pallas"
+    assert r.rb_engine_to == "xla"
+    assert r.rb_error_class == "EngineLoweringError"
+
+
+# ------------------------------------------------------- tracer details
+
+def test_disabled_span_is_shared_noop():
+    assert not obs.enabled()
+    sp = obs.span("anything", q=64, engine="xla")
+    assert sp is obs.trace._NOOP
+    # the full no-op surface instrumentation sites touch
+    with sp as s:
+        assert s.tag(a=1) is s
+        assert s.event("x", y=2) is s
+        assert s.sync("payload") == "payload"
+        assert s.span_id is None
+
+
+def test_bad_trace_path_fails_at_enable_not_in_queries(tmp_path,
+                                                       monkeypatch):
+    """A misconfigured trace path must surface at configuration time (or
+    as one warning via the env route), never out of a query's span exit
+    — the robustness ladder must not see tracer OSErrors."""
+    bad = str(tmp_path / "no" / "such" / "dir" / "t.jsonl")
+    with pytest.raises(OSError):
+        obs.enable(bad)
+    assert not obs.enabled()
+    # env route: import-time/refresh survives with a warning, no raise
+    monkeypatch.setenv("ROARING_TPU_TRACE", bad)
+    obs.refresh_from_env()
+    assert not obs.enabled()
+    with obs.span("q"):        # still the no-op fast path
+        pass
+
+
+def test_span_nesting_and_error_status(tmp_path):
+    obs.enable(str(tmp_path / "t.jsonl"))
+    with pytest.raises(ValueError):
+        with obs.span("outer", q=1):
+            with obs.span("inner"):
+                raise ValueError("boom")
+    obs.disable()
+    inner, outer = _read_trace(tmp_path / "t.jsonl")
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["parent_id"] == outer["span_id"]
+    assert inner["trace_id"] == outer["span_id"]
+    assert inner["tags"]["status"] == "error"
+    assert inner["tags"]["error_class"] == "ValueError"
+    assert outer["dur_ms"] >= inner["dur_ms"] >= 0
+
+
+def test_aggregation_wide_span_and_histogram(tmp_path):
+    bms = datasets.synthetic_bitmaps(6, seed=5, universe=1 << 16,
+                                     density=0.02)
+    obs.enable(str(tmp_path / "agg.jsonl"))
+    try:
+        aggregation.or_(*bms)
+    finally:
+        obs.disable()
+    spans = _read_trace(tmp_path / "agg.jsonl")
+    wide = [s for s in spans if s["name"] == "aggregation.wide"]
+    assert wide and wide[0]["tags"]["op"] == "or"
+    assert wide[0]["tags"]["rung_used"] in ("pallas", "xla")
+    rows = obs.snapshot()["histograms"]["rb_execute_latency_seconds"]
+    assert any(r["labels"]["site"] == "aggregation" for r in rows)
+
+
+# --------------------------------------------------- export + validator
+
+def test_prometheus_render():
+    obs.counter("rb_p_total", site="s").inc(3)
+    obs.histogram("rb_p_seconds", buckets=(0.1, 1.0), site="s").observe(0.5)
+    text = obs.render_prometheus()
+    assert '# TYPE rb_p_total counter' in text
+    assert 'rb_p_total{site="s"} 3' in text
+    assert '# TYPE rb_p_seconds histogram' in text
+    assert 'rb_p_seconds_bucket{le="0.1",site="s"} 0' in text
+    assert 'rb_p_seconds_bucket{le="1.0",site="s"} 1' in text
+    assert 'rb_p_seconds_bucket{le="+Inf",site="s"} 1' in text
+    assert 'rb_p_seconds_sum{site="s"} 0.5' in text
+    assert 'rb_p_seconds_count{site="s"} 1' in text
+
+
+def _load_check_trace():
+    spec = importlib.util.spec_from_file_location(
+        "check_trace", os.path.join(REPO, "tools", "check_trace.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_trace_validates_real_dump(tmp_path, engine, pool):
+    path = tmp_path / "dump.jsonl"
+    obs.enable(str(path))
+    try:
+        with faults.inject("lowering@pallas=1.0:7"):
+            engine.execute(pool[:4], engine="pallas")
+    finally:
+        obs.disable()
+    ct = _load_check_trace()
+    assert ct.validate(str(path), workload_semantics=True) == []
+
+
+def test_check_trace_rejects_malformed(tmp_path):
+    ct = _load_check_trace()
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"name": "", "span_id": "a", "pid": 1, '
+                   '"t_start": 0, "dur_ms": -1, "tags": {}, '
+                   '"events": [{}], "parent_id": "ghost"}\n'
+                   'not json\n')
+    errs = ct.validate(str(bad), strict_refs=True)
+    assert any("empty span name" in e for e in errs)
+    assert any("negative dur_ms" in e for e in errs)
+    assert any("event 0 malformed" in e for e in errs)
+    assert any("not valid JSON" in e for e in errs)
+    assert any("ghost" in e for e in errs)
+    assert ct.validate(str(tmp_path / "missing.jsonl"))
+
+
+def test_check_trace_tolerates_crash_dump(tmp_path):
+    """A dump whose enclosing spans never closed (crash / live capture)
+    must pass plain validation: dangling parent refs are only violations
+    in strict-refs (complete-dump) mode."""
+    ct = _load_check_trace()
+    crash = tmp_path / "crash.jsonl"
+    crash.write_text(
+        '{"name": "guard.dispatch", "span_id": "x-2", '
+        '"parent_id": "x-1", "trace_id": "x-1", "pid": 1, '
+        '"t_start": 0, "dur_ms": 1.0, "tags": {}, "events": []}\n')
+    assert ct.validate(str(crash)) == []
+    assert any("x-1" in e for e in ct.validate(str(crash),
+                                               strict_refs=True))
+
+
+def test_cache_size_gauge_sums_across_instances():
+    """rb_cache_size is computed at scrape time over the live caches
+    sharing a name — two instances report their SUM, one instance's
+    clear() never erases the other's entries, and obs.reset() cannot
+    desync it (the collector recomputes on the next snapshot)."""
+    from roaringbitmap_tpu.runtime.cache import LRUCache
+
+    def scraped():
+        rows = obs.snapshot()["gauges"].get("rb_cache_size", [])
+        return {r["labels"]["cache"]: r["value"] for r in rows}
+
+    a = LRUCache(4, name="gauge_probe")
+    b = LRUCache(2, name="gauge_probe")
+    for i in range(3):
+        a.put(i, i)
+    for i in range(3):          # cap 2: one eviction
+        b.put(i, i)
+    assert scraped()["gauge_probe"] == len(a) + len(b) == 5
+    b.clear()
+    assert scraped()["gauge_probe"] == len(a) == 3
+    a.put(0, 99)                # overwrite: no size change
+    assert scraped()["gauge_probe"] == 3
+    obs.reset()                 # collector survives; gauge resyncs
+    assert scraped()["gauge_probe"] == 3
+
+
+def test_oom_split_counted_and_traced(tmp_path):
+    """An OOM on the top rung splits the batch; the split shows up as a
+    registry counter and an event on the dispatch span."""
+    bms = datasets.synthetic_bitmaps(8, seed=9, universe=1 << 16,
+                                     density=0.02)
+    eng = BatchEngine.from_bitmaps(bms)
+    qs = random_query_pool(8, 8)
+    want = [r.cardinality for r in eng.execute(qs)]
+    obs.enable(str(tmp_path / "oom.jsonl"))
+    try:
+        # xla (the CPU top rung) OOMs on EVERY dispatch: the batch splits
+        # down to Q=1 halves which then demote to xla-vmap — guaranteed
+        # splits, still bit-exact
+        with faults.inject("oom@xla=1.0:21"):
+            got = [r.cardinality for r in eng.execute(qs)]
+    finally:
+        obs.disable()
+    assert got == want
+    assert eng.split_count > 0
+    snap = obs.snapshot()
+    splits = snap["counters"]["rb_batch_oom_splits_total"][0]["value"]
+    assert splits == eng.split_count
+    spans = _read_trace(tmp_path / "oom.jsonl")
+    evs = [e for s in spans for e in s["events"]
+           if e["name"] == "oom_split"]
+    assert evs and evs[0]["site"] == "batch_engine"

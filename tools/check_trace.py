@@ -1,0 +1,185 @@
+"""Validate a ROARING_TPU_TRACE JSONL dump against the span schema.
+
+CI's observability lane runs::
+
+    python tools/check_trace.py --workload /tmp/rb_trace.jsonl
+
+which (1) runs a small batch workload with ``ROARING_TPU_TRACE`` pointed
+at the path — a clean Q=64 batch, a fault-injected pallas->xla demotion,
+and a wide aggregation — then (2) validates every emitted line:
+
+- each line parses as a JSON object with the required fields and types
+  (name, span_id, parent_id, trace_id, pid, t_start, dur_ms, tags,
+  events), dur_ms >= 0;
+- in strict-refs mode (implied by --workload, whose dump is complete):
+  every non-null parent_id / trace_id resolves to a span id present in
+  the file (parents close after children, so ids are collected first).
+  Plain validation tolerates dangling refs — a dump from a crashed or
+  still-serving process legitimately lacks spans that never closed;
+- every event carries name + t_offset_ms;
+- in --workload mode, semantic checks: a ``guard.dispatch`` span exists,
+  a ``demote`` event records the pallas->xla hop with its classified
+  error class, and the batch.execute -> guard.dispatch nesting holds.
+
+Validation-only mode (``python tools/check_trace.py <path>``) checks an
+existing dump, e.g. one captured from a serving process.
+
+Exit code 0 = valid; 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUIRED = {
+    "name": str, "span_id": str, "pid": int,
+    "t_start": (int, float), "dur_ms": (int, float),
+    "tags": dict, "events": list,
+    # present on every span: parent_id is null on roots, trace_id is the
+    # root span's own id
+    "parent_id": (str, type(None)), "trace_id": str,
+}
+
+
+def validate(path: str, workload_semantics: bool = False,
+             strict_refs: bool | None = None) -> list[str]:
+    """``strict_refs`` controls whether a parent_id/trace_id that resolves
+    to no span in the file is a violation.  Defaults to
+    ``workload_semantics``: the CI workload produces a COMPLETE dump, but
+    a dump captured from a crashed or still-serving process legitimately
+    lacks the enclosing spans that never closed (spans flush on close,
+    parents after children) — those dumps must validate."""
+    if strict_refs is None:
+        strict_refs = workload_semantics
+    errors: list[str] = []
+    spans: list[dict] = []
+    try:
+        with open(path) as f:
+            raw = f.readlines()
+    except OSError as e:
+        return [f"cannot read {path}: {e}"]
+    if not raw:
+        return [f"{path} is empty — no spans were emitted"]
+    for i, line in enumerate(raw, 1):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {i}: not valid JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"line {i}: not a JSON object")
+            continue
+        for field, types in REQUIRED.items():
+            if field not in rec:
+                errors.append(f"line {i}: missing field {field!r}")
+            elif not isinstance(rec[field], types):
+                errors.append(
+                    f"line {i}: field {field!r} has type "
+                    f"{type(rec[field]).__name__}, want {types}")
+        if not rec.get("name"):
+            errors.append(f"line {i}: empty span name")
+        if isinstance(rec.get("dur_ms"), (int, float)) and rec["dur_ms"] < 0:
+            errors.append(f"line {i}: negative dur_ms {rec['dur_ms']}")
+        for j, ev in enumerate(rec.get("events") or []):
+            if not isinstance(ev, dict) or not ev.get("name") \
+                    or not isinstance(ev.get("t_offset_ms"), (int, float)):
+                errors.append(
+                    f"line {i}: event {j} malformed (needs name + "
+                    f"t_offset_ms): {ev!r}")
+        spans.append((i, rec))
+    if strict_refs:
+        ids = {s.get("span_id") for _, s in spans}
+        for i, s in spans:
+            for ref in ("parent_id", "trace_id"):
+                v = s.get(ref)
+                if v is not None and v not in ids:
+                    errors.append(
+                        f"line {i}: {ref} {v!r} not present in the dump")
+    if workload_semantics:
+        errors += _workload_semantics([s for _, s in spans])
+    return errors
+
+
+def _workload_semantics(spans: list[dict]) -> list[str]:
+    errors: list[str] = []
+    by_id = {s["span_id"]: s for s in spans if "span_id" in s}
+    dispatches = [s for s in spans if s.get("name") == "guard.dispatch"]
+    if not dispatches:
+        errors.append("no guard.dispatch span — the guarded query path "
+                      "was not traced")
+    demotes = [ev for s in dispatches for ev in s.get("events", [])
+               if ev.get("name") == "demote"]
+    if not any(ev.get("engine_from") == "pallas"
+               and ev.get("engine_to") == "xla"
+               and ev.get("error_class") == "EngineLoweringError"
+               for ev in demotes):
+        errors.append(
+            "no demote event with engine_from=pallas engine_to=xla "
+            f"error_class=EngineLoweringError (saw: {demotes!r})")
+    nested = [s for s in dispatches
+              if by_id.get(s.get("parent_id"), {}).get("name")
+              == "batch.execute"]
+    if not nested:
+        errors.append("no guard.dispatch span nested under batch.execute")
+    return errors
+
+
+def run_workload(path: str) -> None:
+    """Small batch workload with the tracer on via the env knob (the
+    activation path production uses), including one fault-injected
+    demotion so the trace carries a demotion chain."""
+    if os.path.exists(path):
+        os.unlink(path)
+    os.environ["ROARING_TPU_TRACE"] = path
+
+    from roaringbitmap_tpu import obs
+    from roaringbitmap_tpu.parallel import aggregation
+    from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
+                                                         random_query_pool)
+    from roaringbitmap_tpu.runtime import faults
+    from roaringbitmap_tpu.utils import datasets
+
+    obs.refresh_from_env()
+    assert obs.enabled(), "tracer did not enable from ROARING_TPU_TRACE"
+    try:
+        bms = datasets.synthetic_bitmaps(16, seed=3, universe=1 << 18,
+                                         density=0.01)
+        eng = BatchEngine.from_bitmaps(bms)
+        pool = random_query_pool(16, 64)
+        clean = [r.cardinality for r in eng.execute(pool)]
+        with faults.inject("lowering@pallas=1.0:7"):
+            demoted = [r.cardinality
+                       for r in eng.execute(pool, engine="pallas")]
+        assert demoted == clean, "demoted batch diverged from clean batch"
+        aggregation.or_(*bms[:8])
+    finally:
+        obs.disable()
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:]]
+    workload = "--workload" in args
+    if workload:
+        args.remove("--workload")
+    if len(args) != 1:
+        print(__doc__)
+        return 2
+    path = args[0]
+    if workload:
+        run_workload(path)
+    errors = validate(path, workload_semantics=workload)
+    if errors:
+        for e in errors:
+            print(f"check_trace: {e}", file=sys.stderr)
+        return 1
+    n = sum(1 for _ in open(path))
+    print(f"check_trace: {path} OK ({n} spans)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -293,6 +293,7 @@ def batched_phase(state: dict) -> dict:
     invariant asserted on every chained run.  Before any timing, the batch
     results are asserted bit-equal to sequential single-query dispatches.
     """
+    from roaringbitmap_tpu.obs import memory as obs_memory
     from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
                                                          random_query_pool)
 
@@ -322,6 +323,11 @@ def batched_phase(state: dict) -> dict:
     for q in BATCH_SIZES[1:]:
         t = best_of(lambda q=q: eng.cardinalities(pool[:q]))
         out[f"q{q}_e2e_qps"] = round(q / t, 1)
+        hbm = obs_memory.dispatch_memory_cell(eng.last_dispatch_memory)
+        if hbm:
+            # predicted vs measured dispatch HBM (full doc only; the
+            # stdout summary line never carries these)
+            out[f"q{q}_hbm"] = hbm
         # chained steady state: marginal seconds per batch
         expected = sum(int(c) for c in eng.cardinalities(pool[:q]))
         fns = {r: eng.chained_cardinality(pool[:q], r) for r in BATCH_R}

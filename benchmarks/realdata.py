@@ -589,6 +589,7 @@ def bench_batch(st: dict, cells: dict, reps: int) -> None:
     per batch, parity-asserted against single-query dispatches — plus the
     compact-layout densify comparison (Pallas chunked one-hot kernel vs
     the XLA serial scatter-add it replaces, VERDICT r5 weak #2)."""
+    from roaringbitmap_tpu.obs import memory as obs_memory
     from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
                                                          random_query_pool)
 
@@ -607,6 +608,15 @@ def bench_batch(st: dict, cells: dict, reps: int) -> None:
         t = _timeit(lambda q=q: eng.cardinalities(pool[:q]), reps)
         cells[f"batch_q{q}/e2e"] = {
             "qps": round(q / t, 1), "note": "one dispatch, incl. RTT"}
+        hbm = obs_memory.dispatch_memory_cell(eng.last_dispatch_memory)
+        if hbm:
+            # predicted vs measured dispatch HBM (ISSUE 4): the dataset
+            # grid shows memory error alongside latency, so a predictor
+            # drift is visible from the artifact alone
+            cells[f"batch_q{q}/hbm"] = {
+                **hbm,
+                "note": "dispatch peak: unified-model prediction vs "
+                        "Compiled.memory_analysis (temp+output)"}
         expected = sum(int(c) for c in eng.cardinalities(pool[:q]))
         per = _marginal(
             lambda r, q=q: eng.chained_cardinality(pool[:q], r),

@@ -17,6 +17,8 @@ import numpy as np
 from roaringbitmap_tpu import RoaringBitmap
 from roaringbitmap_tpu.insights.analysis import recommend_device_layout
 from roaringbitmap_tpu.parallel.aggregation import DeviceBitmap, DeviceBitmapSet
+from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
+                                                     random_query_pool)
 
 
 def main() -> None:
@@ -50,6 +52,25 @@ def main() -> None:
 
     result = both.materialize()                 # single host-ward edge
     print(f"materialized: {result!r}")
+
+    # EXPLAIN a query batch before running it: per-query buckets/rungs,
+    # predicted dispatch HBM vs the budget, and the split plan — the
+    # dynamic analyser over the same resident set (docs/OBSERVABILITY.md)
+    eng = BatchEngine(DeviceBitmapSet(posts))
+    pool = random_query_pool(len(posts), 16, seed=7)
+    plan = eng.explain(pool)
+    print(f"explain: Q={plan['q']} engine={plan['engine']} "
+          f"buckets={len(plan['buckets'])} "
+          f"resident={plan['resident']['hbm_bytes'] / 1e6:.1f}MB "
+          f"predicted_dispatch={plan['predicted']['peak_bytes'] / 1e6:.1f}MB "
+          f"budget={plan['hbm_budget_bytes']} "
+          f"split={plan['proactive_split']['dispatches']}")
+    cards = eng.cardinalities(pool)
+    mem = eng.last_dispatch_memory
+    print(f"dispatched {len(cards)} queries: predicted "
+          f"{mem['predicted_bytes'] / 1e6:.1f}MB, measured "
+          f"{mem.get('measured_peak_bytes', 0) / 1e6:.1f}MB "
+          f"(residual {mem.get('residual_x', 'n/a')}x)")
 
     # parity against the host tier
     host_t, host_v = RoaringBitmap(), RoaringBitmap()

@@ -3,11 +3,20 @@ recommendation (insights/BitmapAnalyser.java:15-35, BitmapStatistics.java,
 NaiveWriterRecommender.java:7-14)."""
 
 from .analysis import (
+    ROW_BYTES,
     BitmapAnalyser,
     BitmapStatistics,
     NaiveWriterRecommender,
     analyse,
+    dense_rows_bytes,
+    hbm_footprint_bytes,
+    predict_batch_dispatch_bytes,
+    predict_resident_bytes,
+    recommend_device_layout,
+    resident_set_bytes,
 )
 
 __all__ = ["BitmapAnalyser", "BitmapStatistics", "NaiveWriterRecommender",
-           "analyse"]
+           "analyse", "ROW_BYTES", "dense_rows_bytes", "hbm_footprint_bytes",
+           "predict_batch_dispatch_bytes", "predict_resident_bytes",
+           "recommend_device_layout", "resident_set_bytes"]

@@ -125,11 +125,144 @@ class NaiveWriterRecommender:
         return NaiveWriterRecommender.recommend(BitmapAnalyser.analyse(rb))
 
 
+# ------------------------------------------------------ HBM footprint model
+#
+# THE unified device-memory model (ISSUE 4): every HBM byte computation in
+# the tree — this module's per-bitmap accounting, DeviceBitmapSet /
+# DevicePairSet .hbm_bytes(), the obs ledger registrations, and the batch
+# engine's per-dispatch predictor — derives from the constants and walkers
+# here, so the three views (a-priori prediction, resident measurement,
+# dispatch-peak prediction) cannot silently diverge.  The parity contract
+# (tests/test_memory_obs.py): predict_resident_bytes() computed from host
+# metadata alone equals the measured hbm_bytes() of the built set for the
+# dense and counts layouts.
+
+#: bytes of one densified container row: u32[2048] = 2^16 bits = 8 KiB
+ROW_BYTES = C.WORDS_PER_CONTAINER * 8
+
+#: bytes of one nibble-count group row (counts layout): 4 planes x 2048
+#: u32 words — half the dense rows it replaces (8 rows x 8 KiB -> 32 KiB)
+NIBBLE_GROUP_BYTES = 4 * 2048 * 4
+
+
+def dense_rows_bytes(n_rows: int) -> int:
+    """HBM bytes of ``n_rows`` densified container rows."""
+    return int(n_rows) * ROW_BYTES
+
+
 def hbm_footprint_bytes(rb: RoaringBitmap) -> int:
     """Bytes this bitmap occupies once densified into the device packing
     (u32[K, 2048] rows) — the HBM-accounting analog of the reference's JOL
     memory tests (SURVEY §5)."""
-    return rb.container_count() * C.WORDS_PER_CONTAINER * 8
+    return dense_rows_bytes(rb.container_count())
+
+
+def _nbytes(a) -> int:
+    return int(a.size) * a.dtype.itemsize
+
+
+def resident_set_bytes(ds) -> dict:
+    """Component breakdown {component: bytes} of a built DeviceBitmapSet —
+    the single implementation ``DeviceBitmapSet.hbm_bytes()`` sums and the
+    obs ledger registers.  Components: ``meta`` (segment/head index
+    arrays), plus per layout ``words`` (dense image), ``streams`` +
+    ``chunks`` (compact wire payloads), ``counts`` (nibble tensor)."""
+    out = {"meta": (_nbytes(ds.blk_seg) + _nbytes(ds.seg_ids)
+                    + _nbytes(ds.head_idx))}
+    if ds.words is not None:
+        out["words"] = _nbytes(ds.words)
+        return out
+    out["meta"] += sum(_nbytes(a) for a in (
+        ds._grp_seg, ds._dseg, ds._dseg_carry,
+        *ds._dmeta[:2], *ds._dmeta_carry[:2]))
+    if ds._chunks is not None:
+        out["chunks"] = (sum(_nbytes(a) for a in ds._chunks)
+                         + _nbytes(ds._row_live))
+    out["streams"] = sum(_nbytes(a) for a in ds._streams)
+    if ds.counts is not None:
+        out["counts"] = (_nbytes(ds.counts) + _nbytes(ds._grp_seg_counts)
+                         + _nbytes(ds._counts_head))
+    return out
+
+
+def predict_resident_bytes(sources: list, layout: str = "dense",
+                           block: int | None = None) -> dict:
+    """Device-free prediction of DeviceBitmapSet(sources, layout, block)'s
+    resident HBM: the same component breakdown ``resident_set_bytes``
+    measures, computed from the host pack metadata alone (the pack is pure
+    NumPy — nothing touches a device).  Parity with the measured bytes is
+    pinned in tests/test_memory_obs.py for the dense and counts layouts."""
+    from ..ops import dense as _dense
+    from ..ops import packing
+
+    packed = packing.pack_blocked_compact(
+        sources, block=block,
+        min_block=4 if (layout == "dense" and block is None) else 8)
+    s = packed.streams
+    k = packed.keys.size
+    seg_rows, head_idx, _ = packing.blocked_ragged_meta(
+        packed.blk_seg, packed.block, packed.n_blocks, k)
+    out = {"meta": (_nbytes(packed.blk_seg) + _nbytes(seg_rows)
+                    + _nbytes(head_idx))}
+    if layout == "dense":
+        out["words"] = dense_rows_bytes(s.n_rows)
+        return out
+    n_groups = s.n_rows // _dense.NIBBLE_GROUP
+    nd = s.dense_dest.size
+    # grp_seg + dseg + dseg_carry + (head, valid) x {plain, carry}
+    out["meta"] += ((n_groups + 1) * 4 + nd * 4 + (nd + 1) * 4
+                    + 2 * ((k + 1) * 4 + (k + 1) * 1))
+    cv, cr = packing.chunk_value_stream(
+        s.values, s.val_counts, s.val_dest, s.n_rows, pad_chunks_pow2=False)
+    out["chunks"] = _nbytes(cv) + _nbytes(cr) + (s.n_rows + 1) * 4
+    out["streams"] = sum(_nbytes(a) for a in (
+        s.dense_words, s.dense_dest, s.values, s.val_counts, s.val_dest))
+    if layout == "counts":
+        gps = packed.block // _dense.NIBBLE_GROUP
+        g_all = n_groups + 1
+        g_pad = g_all + (-g_all) % gps
+        out["counts"] = (g_pad * NIBBLE_GROUP_BYTES   # nibble tensor
+                         + g_pad * 4                  # grp_seg_counts
+                         + k * 4)                     # counts head map
+    return out
+
+
+def predict_batch_dispatch_bytes(bucket_sigs: list, kind: str,
+                                 n_rows: int, engine: str) -> dict:
+    """Transient device bytes of ONE BatchEngine dispatch — the
+    ``rb_hbm_predicted_bytes`` model, validated against
+    ``Compiled.memory_analysis()`` (temp + output) per dispatch.
+
+    ``bucket_sigs`` are _Bucket.signature tuples
+    (op, q, r_pad, k_pad, n_steps, needs_words); ``kind`` is the resident
+    source tag ("dense" gathers straight from the image, "streams"
+    rebuilds an n_rows image inside the program first).  Per bucket:
+
+    - the gathered operand block, q*r_pad rows;
+    - its doubling/accumulator scratch — the XLA doubling pass ping-pongs
+      two row blocks, the Pallas kernel accumulates in VMEM (no HBM
+      scratch), costed at one extra block for the XLA engines;
+    - the per-key heads, q*(k_pad+1) rows (+ the head gather for andnot);
+    - outputs: i32 cards always, the result rows when any query
+      materializes a bitmap.
+    """
+    gather = scratch = heads = outputs = 0
+    for op, q, r_pad, k_pad, _n_steps, needs_words in bucket_sigs:
+        block = q * r_pad * ROW_BYTES
+        gather += block
+        if engine != "pallas":
+            scratch += block          # doubling-pass ping-pong copy
+        heads += q * (k_pad + 1) * ROW_BYTES
+        if op == "andnot":
+            heads += q * k_pad * ROW_BYTES
+        outputs += q * k_pad * 4
+        if needs_words:
+            outputs += q * k_pad * ROW_BYTES
+    densify = dense_rows_bytes(n_rows + 1) if kind == "streams" else 0
+    total = gather + scratch + heads + outputs + densify
+    return {"gather_bytes": gather, "scratch_bytes": scratch,
+            "heads_bytes": heads, "output_bytes": outputs,
+            "densify_bytes": densify, "peak_bytes": total}
 
 
 def recommend_device_layout(bitmaps, hbm_budget_bytes: int = 512 << 20) -> dict:

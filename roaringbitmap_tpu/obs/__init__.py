@@ -12,13 +12,20 @@ Three pieces (docs/OBSERVABILITY.md is the operator reference):
   the runtime LRU ``cache_stats``), per-(site, engine) execute-latency
   histograms.
 - ``obs.export`` — Prometheus text renderer over the registry.
+- ``obs.memory`` — the live HBM ledger (``rb_hbm_resident_bytes`` per
+  resident set/layout, registered on device_put, released on free) plus
+  per-dispatch predicted-vs-measured accounting
+  (``rb_hbm_predicted_bytes`` / ``rb_hbm_measured_peak_bytes`` from
+  ``Compiled.memory_analysis()``; the ``batch.memory`` span event).
 
 ``snapshot()`` is the in-process JSON API: the full registry state plus
-the tracer's enablement — one dict a health endpoint can return verbatim.
+the tracer's enablement and the HBM ledger — one dict a health endpoint
+can return verbatim.
 """
 
-from . import export, metrics, trace
+from . import export, memory, metrics, trace
 from .export import render_prometheus
+from .memory import LEDGER
 from .metrics import (DEFAULT_LATENCY_BUCKETS, REGISTRY, counter, gauge,
                       histogram, snapshot_delta)
 from .trace import (current, disable, enable, enabled, refresh_from_env,
@@ -27,9 +34,11 @@ from .trace import (current, disable, enable, enabled, refresh_from_env,
 
 def snapshot() -> dict:
     """Process observability state as one plain-JSON dict: every counter,
-    gauge, and histogram in the registry, plus tracer status."""
+    gauge, and histogram in the registry, plus tracer status and the HBM
+    ledger's live residency breakdown."""
     doc = metrics.REGISTRY.snapshot()
     doc["trace"] = {"enabled": trace.enabled(), "path": trace.path()}
+    doc["hbm"] = memory.LEDGER.snapshot()
     return doc
 
 
@@ -40,8 +49,9 @@ def reset() -> None:
 
 
 __all__ = [
-    "trace", "metrics", "export",
+    "trace", "metrics", "export", "memory",
     "span", "current", "enable", "disable", "enabled", "refresh_from_env",
     "counter", "gauge", "histogram", "snapshot_delta", "REGISTRY",
-    "DEFAULT_LATENCY_BUCKETS", "render_prometheus", "snapshot", "reset",
+    "LEDGER", "DEFAULT_LATENCY_BUCKETS", "render_prometheus", "snapshot",
+    "reset",
 ]

@@ -1,0 +1,210 @@
+"""Device-memory observability: the live HBM ledger + dispatch measurement.
+
+PR 3 instrumented *time* (spans, latency histograms); this module
+instruments *memory* — the other axis a serving process runs out of.
+Three pieces (docs/OBSERVABILITY.md "Memory observability" is the
+operator reference):
+
+- **HBM ledger** (``LEDGER``): every resident device payload
+  (``DeviceBitmapSet``, ``DevicePairSet``) registers its bytes on
+  device_put and releases them on free (a ``weakref.finalize`` fires the
+  release when the owner is collected, so a leaked registration cannot
+  outlive its arrays).  Live totals export as
+  ``rb_hbm_resident_bytes{kind,layout}`` gauges through a registry
+  collector — pull-model, like ``rb_cache_size``, so the truth is
+  recomputed at every scrape and survives ``obs.reset()``.
+- **Compiled-program measurement** (``compiled_memory``):
+  ``jax.stages.Compiled.memory_analysis()`` gives the compiler's own
+  accounting of a cached batch program — temp + output bytes are the
+  transient device footprint of one dispatch, the quantity the
+  predictor in ``insights.analysis`` is validated against
+  (``rb_hbm_predicted_bytes`` vs ``rb_hbm_measured_peak_bytes``, and
+  the ``batch.memory`` span event ``tools/check_trace.py`` checks).
+- **Backend allocator stats** (``backend_memory_stats`` /
+  ``backend_free_bytes``): ``device.memory_stats()`` where the platform
+  supports it (TPU/GPU; the CPU backend returns nothing) — the source
+  of the default ``ROARING_TPU_HBM_BUDGET`` (free = limit - in_use) and
+  of per-dispatch peak deltas.
+
+The ledger is always on (a dict update per resident-set construction —
+invisible next to the device_put it accounts for); measurement is free
+(the compiler already computed it).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from . import metrics as _metrics
+
+
+class HbmLedger:
+    """Resident device bytes per (kind, layout), keyed by registration.
+
+    ``register`` returns an integer handle; ``release(handle)`` is
+    idempotent (a manual release followed by the owner's GC finalizer
+    must not double-subtract).  Passing ``owner`` arms a
+    ``weakref.finalize`` so collection releases automatically.
+    """
+
+    def __init__(self):
+        self._entries: dict = {}       # handle -> (kind, layout, bytes)
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def register(self, kind: str, layout: str, nbytes: int,
+                 owner=None) -> int:
+        handle = next(self._ids)
+        with self._lock:
+            self._entries[handle] = (kind, layout, int(nbytes))
+        if owner is not None:
+            import weakref
+
+            weakref.finalize(owner, self.release, handle)
+        self._push_gauges(kind, layout)
+        return handle
+
+    def release(self, handle: int) -> None:
+        with self._lock:
+            row = self._entries.pop(handle, None)
+        if row is not None:
+            # push the shrunk total immediately — a scrape between a free
+            # and the next collector run must not report freed bytes
+            self._push_gauges(row[0], row[1])
+
+    def _push_gauges(self, kind: str, layout: str) -> None:
+        _metrics.gauge("rb_hbm_resident_bytes", kind=kind,
+                       layout=layout).set(self.resident_bytes(kind, layout))
+
+    def resident_bytes(self, kind: str | None = None,
+                       layout: str | None = None) -> int:
+        with self._lock:
+            return sum(b for k, l, b in self._entries.values()
+                       if (kind is None or k == kind)
+                       and (layout is None or l == layout))
+
+    def snapshot(self) -> dict:
+        """{"total_bytes", "entries", "by_kind": {kind: {layout: bytes}}}
+        — plain JSON, the ledger half of a health endpoint."""
+        with self._lock:
+            rows = list(self._entries.values())
+        by_kind: dict = {}
+        for k, l, b in rows:
+            by_kind.setdefault(k, {})
+            by_kind[k][l] = by_kind[k].get(l, 0) + b
+        return {"total_bytes": sum(b for _, _, b in rows),
+                "entries": len(rows), "by_kind": by_kind}
+
+    def reset(self) -> None:
+        """Drop every registration: ``snapshot()`` afterwards equals a
+        fresh ledger's (the reset/snapshot symmetry contract; pending
+        finalizers release already-absent handles, a no-op).  The pushed
+        gauges of the cleared (kind, layout) pairs are zeroed too — the
+        collector only overwrites pairs that still exist, so without this
+        a scrape after reset would keep reporting the pre-reset bytes."""
+        with self._lock:
+            cleared = {(k, l) for k, l, _ in self._entries.values()}
+            self._entries.clear()
+        for kind, layout in cleared:
+            self._push_gauges(kind, layout)
+
+    def _collect(self, registry) -> None:
+        """Registry collector: recompute every live (kind, layout) gauge
+        at scrape time (pull model — survives ``obs.reset()``)."""
+        snap = self.snapshot()
+        for kind, layouts in snap["by_kind"].items():
+            for layout, b in layouts.items():
+                registry.gauge("rb_hbm_resident_bytes", kind=kind,
+                               layout=layout).set(b)
+
+
+#: the process-wide ledger every resident device payload registers with
+LEDGER = HbmLedger()
+
+_metrics.REGISTRY.register_collector(LEDGER._collect)
+
+
+# ----------------------------------------------------------- measurement
+
+def compiled_memory(compiled) -> dict | None:
+    """Transient-footprint accounting of a ``jax.stages.Compiled``:
+    ``{"temp_bytes", "output_bytes", "argument_bytes", "peak_bytes"}``
+    where peak = temp + output (arguments are the already-resident
+    operands the ledger accounts separately).  None when the backend
+    does not expose ``memory_analysis``."""
+    try:
+        ma = compiled.memory_analysis()
+        temp = int(ma.temp_size_in_bytes)
+        out = int(ma.output_size_in_bytes)
+        arg = int(ma.argument_size_in_bytes)
+    except Exception:
+        return None
+    return {"temp_bytes": temp, "output_bytes": out,
+            "argument_bytes": arg, "peak_bytes": temp + out}
+
+
+def backend_memory_stats(device=None) -> dict | None:
+    """``device.memory_stats()`` of the default (or given) device, or
+    None when the backend does not report (the CPU backend)."""
+    try:
+        import jax
+
+        d = device if device is not None else jax.devices()[0]
+        stats = d.memory_stats()
+    except Exception:
+        return None
+    return dict(stats) if stats else None
+
+
+def backend_free_bytes(device=None) -> int | None:
+    """Allocator headroom (limit - in_use) — the default
+    ``ROARING_TPU_HBM_BUDGET`` on backends that report memory stats."""
+    stats = backend_memory_stats(device)
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    in_use = stats.get("bytes_in_use")
+    if limit is None or in_use is None:
+        return None
+    return max(0, int(limit) - int(in_use))
+
+
+def dispatch_memory_cell(mem: dict | None) -> dict | None:
+    """Benchmark-cell view of a ``last_dispatch_memory`` payload:
+    ``{"q", "engine", "predicted_mb"[, "measured_mb", "residual_x"]}`` —
+    ONE shape for every artifact that stamps predicted-vs-measured HBM
+    next to latency (benchmarks/realdata.py batch cells, bench.py
+    batched_phase).  ``q``/``engine`` make the cell self-describing: the
+    payload reflects the LAST device dispatch, so a budget- or OOM-split
+    lane shows the final sub-batch's q (smaller than the lane's Q), and
+    a sequential-floor landing leaves the previous dispatch's stamp — a
+    q mismatch in the artifact IS that signal, not a predictor error."""
+    if not mem:
+        return None
+    cell = {"q": mem.get("q"), "engine": mem.get("engine"),
+            "predicted_mb": round(mem["predicted_bytes"] / 1e6, 2)}
+    if "measured_peak_bytes" in mem:
+        cell["measured_mb"] = round(mem["measured_peak_bytes"] / 1e6, 2)
+        cell["residual_x"] = mem.get("residual_x")
+    return cell
+
+
+def record_dispatch(site: str, predicted: int,
+                    measured: dict | None) -> dict:
+    """Per-dispatch predicted-vs-actual accounting: set the
+    ``rb_hbm_predicted_bytes`` / ``rb_hbm_measured_peak_bytes`` gauges
+    and return the ``batch.memory`` event payload (predicted, measured,
+    residual_x = measured/predicted) the caller attaches to its dispatch
+    span and keeps as ``last_dispatch_memory``."""
+    _metrics.gauge("rb_hbm_predicted_bytes", site=site).set(predicted)
+    doc: dict = {"predicted_bytes": int(predicted)}
+    if measured is not None:
+        peak = int(measured["peak_bytes"])
+        _metrics.gauge("rb_hbm_measured_peak_bytes", site=site).set(peak)
+        doc["measured_peak_bytes"] = peak
+        doc["measured_temp_bytes"] = int(measured["temp_bytes"])
+        doc["measured_output_bytes"] = int(measured["output_bytes"])
+        if predicted > 0:
+            doc["residual_x"] = round(peak / predicted, 4)
+    return doc

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.bitmap import RoaringBitmap
+from ..insights import analysis as insights
+from ..obs import memory as obs_memory
 from ..obs import trace as obs_trace
 from ..ops import dense, kernels, packing
 from ..runtime import faults, guard
@@ -335,6 +337,39 @@ def xor_cardinality(*bitmaps: RoaringBitmap, engine: str = "auto",
     return _wide_cardinality("xor", bitmaps, engine, fallback)
 
 
+def explain_wide(op: str, bitmaps, engine: str = "auto") -> dict:
+    """Thin plan report for one wide op (the BatchEngine.explain analog
+    for the ad-hoc aggregation.wide_* entry points): resolved engine +
+    fallback chain, the device payload the call would gather (unified
+    footprint model), and whether its prediction clears the HBM budget.
+    JSON-serializable; vocabulary in docs/OBSERVABILITY.md."""
+    if op not in ("or", "and", "xor"):
+        raise ValueError(f"unsupported wide op {op!r}")
+    bitmaps = _flatten([bitmaps] if hasattr(bitmaps, "keys") else bitmaps)
+    # the AND pipeline is hard-pinned to its single device engine (see
+    # and_): the report must name what actually runs, not the request
+    eng = "xla" if op == "and" else _engine(engine)
+    chain = (("xla",) if op == "and"
+             else guard.chain_from(eng, ENGINE_LADDER))
+    containers = sum(b.container_count() for b in bitmaps
+                     if hasattr(b, "container_count"))
+    rows = packing.blocked_block_count(bitmaps, BLOCK) * BLOCK \
+        if all(hasattr(b, "keys") for b in bitmaps) else containers
+    predicted = insights.dense_rows_bytes(rows)
+    budget = guard.resolve_hbm_budget()
+    return {
+        "site": "aggregation", "op": op, "n": len(bitmaps),
+        "engine_requested": engine, "engine": eng,
+        "engine_chain": list(chain) + ([guard.SEQUENTIAL]
+                                       if guard.SEQUENTIAL not in chain
+                                       else []),
+        "containers": int(containers), "device_rows": int(rows),
+        "predicted_hbm_bytes": int(predicted),
+        "hbm_budget_bytes": budget,
+        "within_budget": budget is None or predicted <= budget,
+    }
+
+
 def _materialize(b) -> RoaringBitmap:
     """Heap copy of a single input; buffer.ImmutableRoaringBitmap has no
     clone() (it is read-only), so it materializes via to_bitmap()."""
@@ -462,6 +497,8 @@ class DevicePairSet:
             p.a_streams = p.b_streams = None
         else:
             self.a_words = self.b_words = None
+        obs_memory.LEDGER.register("pair_set", layout, self.hbm_bytes(),
+                                   owner=self)
 
     def _sides(self):
         if self.a_words is not None:
@@ -681,6 +718,10 @@ class DeviceBitmapSet:
             self.keys.size)
         self.seg_ids = jax.device_put(seg_rows)
         self.head_idx = jax.device_put(head_idx)
+        # HBM ledger: resident bytes registered now, released when this
+        # set is collected (rb_hbm_resident_bytes{kind,layout} gauges)
+        obs_memory.LEDGER.register("bitmap_set", layout, self.hbm_bytes(),
+                                   owner=self)
 
     def _sort_dense_stream(self, s: packing.CompactStreams):
         """Dense-wire rows reordered by destination row so their segment ids
@@ -908,21 +949,11 @@ class DeviceBitmapSet:
                                      np.asarray(cards), out_cls=out_cls)
 
     def hbm_bytes(self) -> int:
-        meta = int(self.blk_seg.nbytes + self.seg_ids.nbytes
-                   + self.head_idx.nbytes)
-        if self.words is not None:
-            return int(self.words.nbytes) + meta
-        meta += sum(int(a.nbytes) for a in (
-            self._grp_seg, self._dseg, self._dseg_carry,
-            *self._dmeta[:2], *self._dmeta_carry[:2]))
-        if self._chunks is not None:
-            meta += sum(int(a.nbytes) for a in self._chunks)
-            meta += int(self._row_live.nbytes)
-        total = sum(int(a.nbytes) for a in self._streams) + meta
-        if self.counts is not None:
-            total += int(self.counts.nbytes + self._grp_seg_counts.nbytes
-                         + self._counts_head.nbytes)
-        return total
+        """Resident HBM bytes — the sum of the unified footprint model's
+        component walk (insights.analysis.resident_set_bytes; the same
+        model the obs ledger registers and predict_resident_bytes is
+        parity-pinned against)."""
+        return int(sum(insights.resident_set_bytes(self).values()))
 
     def chained_wide_or(self, reps: int, engine: str = "auto"):
         """Steady-state throughput probe: `reps` dependent wide-ORs in ONE jit.

@@ -67,6 +67,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.bitmap import RoaringBitmap
+from ..insights import analysis as insights
+from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..ops import dense, kernels, packing
@@ -158,6 +160,10 @@ class BatchEngine:
         self._plans = LRUCache(PLAN_CACHE_MAX, name="batch_plans")
         self._hosts = None        # lazy CPU-reference copies of the sources
         self.split_count = 0      # ResourceExhausted batch halvings served
+        self.proactive_split_count = 0  # pre-dispatch HBM-budget halvings
+        #: predicted-vs-measured bytes of the most recent device dispatch
+        #: (the batch.memory event payload) — benchmarks stamp cells with it
+        self.last_dispatch_memory: dict | None = None
 
     @classmethod
     def from_bitmaps(cls, bitmaps: list, layout: str = "dense",
@@ -346,10 +352,22 @@ class BatchEngine:
         return (heads if needs_words else None), cards
 
     def _program(self, plan, eng: str):
-        """Jitted (and eager) batch program for this plan's signature: ONE
-        call = one compiled XLA program = one device dispatch.  ``eng`` is
-        an already-resolved rung (the caller ran _bucket_engine): one
-        resolution per dispatch, shared with the faults hook."""
+        """AOT-compiled batch program for this plan's signature: ONE call =
+        one compiled XLA program = one device dispatch.  ``eng`` is an
+        already-resolved rung (the caller ran _bucket_engine): one
+        resolution per dispatch, shared with the faults hook.
+
+        Programs compile eagerly (jit -> lower -> compile) inside the
+        program_build span, which buys the memory ledger its measurement:
+        ``Compiled.memory_analysis()`` is the compiler's own accounting of
+        the dispatch's transient footprint (temp + output bytes), cached
+        here next to the predicted bytes from the unified footprint model
+        (insights.predict_batch_dispatch_bytes) so every dispatch can
+        report predicted-vs-actual for free.  An execute(jit=False) eager
+        caller (the tracing cross-check path) pays this compile without
+        calling the executable — accepted: the cost is once per program
+        signature, and any later jit dispatch of the same signature would
+        have paid it anyway."""
         src, kind = self._resident_src()
         sig = (eng, kind, tuple(b.signature for b in plan))
         cached = self._programs.get(sig)
@@ -357,18 +375,21 @@ class BatchEngine:
             return cached
         b_sigs = [b.signature for b in plan]
 
-        # named program_build, not compile: this builds + jit-wraps the
-        # program; XLA compiles it lazily on the first dispatch, which
-        # that dispatch's batch.dispatch span absorbs (sync_ms carries
-        # the compile)
         with obs_trace.span("batch.program_build", engine=eng, kind=kind,
-                            buckets=len(plan)):
+                            buckets=len(plan)) as sp:
             def run(src_in, barrays):
                 words = self._words_from_src(src_in, kind, eng)
                 return [self._bucket_body(words, s, a, eng)
                         for s, a in zip(b_sigs, barrays)]
 
-            cached = (run, jax.jit(run))
+            compiled = jax.jit(run).lower(
+                src, [b.arrays for b in plan]).compile()
+            predicted = insights.predict_batch_dispatch_bytes(
+                b_sigs, kind, self._ds._n_rows, eng)
+            measured = obs_memory.compiled_memory(compiled)
+            sp.tag(predicted_bytes=predicted["peak_bytes"],
+                   measured_peak_bytes=(measured or {}).get("peak_bytes"))
+            cached = (run, compiled, predicted, measured)
         self._programs.put(sig, cached)
         return cached
 
@@ -415,13 +436,41 @@ class BatchEngine:
                                           inject=False)
             policy = policy or guard.GuardPolicy.from_env()
             chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
+            # one budget resolution per execute (not per split recursion):
+            # the backend-free-memory default costs an allocator query,
+            # which must not multiply on the dispatch-floor hot path
             return self._dispatch(queries, chain, jit, policy,
-                                  guard.Deadline(policy.deadline))
+                                  guard.Deadline(policy.deadline),
+                                  guard.resolve_hbm_budget(policy))
 
-    def _dispatch(self, queries, chain, jit, policy, deadline):
+    def _dispatch(self, queries, chain, jit, policy, deadline,
+                  budget: int | None = None):
         """One guarded run of `queries` down `chain`; recurses on OOM
         splits (each half restarts at the failing rung, sharing the
-        deadline)."""
+        deadline).  Before touching the device, the predicted dispatch
+        peak is checked against the HBM budget (ROARING_TPU_HBM_BUDGET /
+        backend free memory): a batch predicted past it is halved HERE —
+        the proactive form of the reactive OOM split below, same halving
+        machinery, bit-exact by the same argument, counted separately
+        (rb_batch_proactive_splits_total) so operators can tell planning
+        from incident recovery apart.  ``budget`` is resolved ONCE by
+        execute() and threaded through every recursion."""
+        if budget is not None and len(queries) >= 2:
+            predicted = self.predict_dispatch_bytes(queries, chain[0])
+            if predicted > budget:
+                mid = (len(queries) + 1) // 2
+                self.proactive_split_count += 1
+                obs_metrics.counter("rb_batch_proactive_splits_total",
+                                    site="batch_engine").inc()
+                obs_trace.current().event(
+                    "proactive_split", site="batch_engine",
+                    q=len(queries), predicted_bytes=predicted,
+                    budget_bytes=budget,
+                    halves=(mid, len(queries) - mid))
+                return (self._dispatch(queries[:mid], chain, jit, policy,
+                                       deadline, budget)
+                        + self._dispatch(queries[mid:], chain, jit, policy,
+                                         deadline, budget))
 
         split = False
 
@@ -443,8 +492,10 @@ class BatchEngine:
                                                               len(queries)
                                                               - mid))
             split = True
-            return (self._dispatch(queries[:mid], sub, jit, policy, dl)
-                    + self._dispatch(queries[mid:], sub, jit, policy, dl))
+            return (self._dispatch(queries[:mid], sub, jit, policy, dl,
+                                   budget)
+                    + self._dispatch(queries[mid:], sub, jit, policy, dl,
+                                     budget))
 
         results, rung = guard.run_with_fallback(
             "batch_engine", chain, attempt, policy=policy,
@@ -466,14 +517,32 @@ class BatchEngine:
         eng = self._bucket_engine(plan, engine)
         if inject:
             faults.maybe_fail("batch_engine", eng)
-        run, run_jit = self._program(plan, eng)
+        run, compiled, predicted, measured = self._program(plan, eng)
         src, _ = self._resident_src()
         with obs_trace.span("batch.dispatch", engine=eng,
                             q=len(queries), buckets=len(plan)) as sp:
-            outs = (run_jit if jit else run)(src, [b.arrays for b in plan])
+            # allocator-stat deltas cost a backend query per side, so they
+            # ride only with the tracer on; the predicted/measured pair
+            # below is free (computed once at program compile)
+            stats0 = (obs_memory.backend_memory_stats()
+                      if obs_trace.enabled() else None)
+            outs = (compiled if jit else run)(src, [b.arrays for b in plan])
             # sync before readback: the span's wall time is host work +
             # queueing, sync_ms is the device-side remainder
             outs = sp.sync(outs)
+            # predicted-vs-actual memory accounting rides the dispatch
+            # span as a batch.memory event (tools/check_trace.py pins it)
+            mem = obs_memory.record_dispatch(
+                "batch_engine", predicted["peak_bytes"], measured)
+            if stats0:
+                stats1 = obs_memory.backend_memory_stats()
+                if stats1 and "peak_bytes_in_use" in stats1:
+                    mem["device_peak_delta_bytes"] = (
+                        int(stats1["peak_bytes_in_use"])
+                        - int(stats0.get("peak_bytes_in_use", 0)))
+            mem["engine"], mem["q"] = eng, len(queries)
+            self.last_dispatch_memory = mem
+            sp.event("batch.memory", **mem)
         with obs_trace.span("batch.readback", engine=eng, q=len(queries)):
             results: list = [None] * len(queries)
             for b, (heads, cards) in zip(plan, outs):
@@ -575,9 +644,120 @@ class BatchEngine:
                     f"{queries[i].operands}) diverged from the sequential "
                     f"reference: {detail}")
 
+    # ---------------------------------------------------------- explain
+
+    def predict_dispatch_bytes(self, queries, engine: str = "auto") -> int:
+        """Predicted transient device bytes of dispatching ``queries`` as
+        one batch (the unified footprint model,
+        insights.predict_batch_dispatch_bytes) — the quantity the
+        proactive HBM-budget split compares against the budget."""
+        plan = self.plan(list(queries))
+        eng = self._bucket_engine(plan, engine)
+        return insights.predict_batch_dispatch_bytes(
+            [b.signature for b in plan], self._resident_src()[1],
+            self._ds._n_rows, eng)["peak_bytes"]
+
+    def _split_layout(self, queries, eng: str, budget: int | None) -> list:
+        """Sub-batch sizes the proactive splitter would dispatch — the
+        same halving rule _dispatch applies, simulated without touching
+        the device (plans are cached, so a following execute() reuses
+        them)."""
+        queries = list(queries)
+        if (budget is None or len(queries) < 2
+                or self.predict_dispatch_bytes(queries, eng) <= budget):
+            return [len(queries)]
+        mid = (len(queries) + 1) // 2
+        return (self._split_layout(queries[:mid], eng, budget)
+                + self._split_layout(queries[mid:], eng, budget))
+
+    def explain(self, queries, engine: str = "auto",
+                policy: guard.GuardPolicy | None = None) -> dict:
+        """Structured, JSON-serializable plan report for a batch — the
+        dynamic counterpart of the reference's BitmapAnalyser: what
+        execute() WOULD do, without dispatching.
+
+        Per query: its shape bucket, pow2 operand rung, and result form.
+        Per bucket: the padded (q, r_pad, k_pad) shape and its share of
+        the predicted dispatch bytes.  Plus the resolved engine + fallback
+        chain, plan/program cache state (as observed BEFORE this call
+        plans — a repeated explain/execute of the same batch reports
+        hits), the resident set's footprint (unified model breakdown),
+        the predicted dispatch peak vs the HBM budget with the sub-batch
+        sizes a proactive split would produce, and the sequential-floor
+        estimate (host pairwise ops; seconds when the latency histogram
+        has observed sequential landings).  Vocabulary documented in
+        docs/OBSERVABILITY.md."""
+        queries = list(queries)
+        policy = policy or guard.GuardPolicy.from_env()
+        budget = guard.resolve_hbm_budget(policy)
+        plan_hit = tuple(queries) in self._plans
+        plan = self.plan(queries)
+        eng = self._bucket_engine(plan, engine)
+        kind = self._resident_src()[1]
+        prog_sig = (eng, kind, tuple(b.signature for b in plan))
+        predicted = insights.predict_batch_dispatch_bytes(
+            [b.signature for b in plan], kind, self._ds._n_rows, eng)
+        buckets, q_rows = [], [None] * len(queries)
+        for bi, b in enumerate(plan):
+            # per-bucket share excludes the in-program densify (kind
+            # "dense", n_rows 0): that cost is batch-wide, reported once
+            # in the top-level predicted breakdown as densify_bytes
+            share = insights.predict_batch_dispatch_bytes(
+                [b.signature], "dense", 0, eng)
+            buckets.append({
+                "op": b.op, "queries": [int(q) for q in b.qids],
+                "q_padded": b.q, "r_pad": b.r_pad, "k_pad": b.k_pad,
+                "n_steps": b.n_steps, "needs_words": b.needs_words,
+                "predicted_bytes": share["peak_bytes"]})
+            for qid in b.qids:
+                q = queries[qid]
+                q_rows[qid] = {
+                    "op": q.op, "form": q.form,
+                    "operands": len(set(q.operands)),
+                    "rung": packing.next_pow2(max(1, len(set(q.operands)))),
+                    "bucket": bi}
+        seq_ops = sum(max(0, len(set(q.operands)) - 1) for q in queries)
+        floor = {"host_pairwise_ops": seq_ops,
+                 "observed_mean_seconds": None}
+        for name, labels, inst in obs_metrics.REGISTRY.instruments():
+            # mean of observed sequential landings at this site, when any
+            # have happened — read-only scan so explain() never creates
+            # an empty instrument row
+            if (name == "rb_execute_latency_seconds"
+                    and labels.get("site") == "batch_engine"
+                    and labels.get("engine") == guard.SEQUENTIAL
+                    and inst.count):
+                floor["observed_mean_seconds"] = round(
+                    inst.sum / inst.count, 6)
+        split_sizes = self._split_layout(queries, eng, budget)
+        return {
+            "site": "batch_engine", "q": len(queries),
+            "engine_requested": engine, "engine": eng,
+            "engine_chain": list(guard.chain_from(_engine(engine),
+                                                  ENGINE_LADDER)),
+            "layout": self._ds.layout, "source_kind": kind,
+            "plan_cache_hit": plan_hit,
+            "program_cache_hit": prog_sig in self._programs,
+            "resident": {
+                "hbm_bytes": self.hbm_bytes(),
+                "components": {k: int(v) for k, v in
+                               insights.resident_set_bytes(
+                                   self._ds).items()}},
+            "buckets": buckets, "queries": q_rows,
+            "predicted": {k: int(v) for k, v in predicted.items()},
+            "hbm_budget_bytes": budget,
+            "proactive_split": {
+                "would_split": len(split_sizes) > 1,
+                "dispatches": split_sizes},
+            "sequential_floor": floor,
+        }
+
     def cache_stats(self) -> dict:
         """Observability for the bounded plan/program caches (size, cap,
-        hits, misses, evictions) plus the OOM split counter."""
+        hits, misses, evictions) plus the OOM split counter.  (The
+        proactive-split count rides separately in
+        ``proactive_split_count`` / rb_batch_proactive_splits_total —
+        this dict's exact shape is frozen by regression test.)"""
         return {"plans": self._plans.stats(),
                 "programs": self._programs.stats(),
                 "splits": self.split_count}

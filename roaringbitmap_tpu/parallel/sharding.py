@@ -352,6 +352,43 @@ def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,
         return res
 
 
+def explain_sharded(mesh: Mesh, op: str, bitmaps,
+                    ingest: str = "dense") -> dict:
+    """Thin plan report for wide_aggregate_sharded (the BatchEngine.explain
+    analog for the mesh path): key-chunk schedule under the per-device
+    accumulator ceiling and each pass's per-device dense-accumulator bytes
+    (the quantity MAX_KEYS_PER_SHARD_PASS bounds), from the unified
+    footprint model.  JSON-serializable; no device work."""
+    from ..insights import analysis as insights
+    from ..runtime import guard
+
+    bitmaps = _wrap_bytes(list(bitmaps))
+    keys = np.unique(np.concatenate([_keys_np(b) for b in bitmaps])) \
+        if bitmaps else np.empty(0, np.uint16)
+    chunks = _key_chunks(int(keys.size))
+    passes = [{"keys": [int(k0), int(k1)],
+               "per_device_accumulator_bytes":
+                   insights.dense_rows_bytes(k1 - k0 + 1)}
+              for k0, k1 in chunks] or [
+        {"keys": [0, 0], "per_device_accumulator_bytes": 0}]
+    peak = max(p["per_device_accumulator_bytes"] for p in passes)
+    budget = guard.resolve_hbm_budget()
+    return {
+        "site": "sharding", "op": op, "ingest": ingest,
+        "n": len(bitmaps), "devices": int(mesh.devices.size),
+        "num_keys": int(keys.size), "passes": passes,
+        "max_keys_per_pass": MAX_KEYS_PER_SHARD_PASS,
+        "predicted_hbm_bytes": int(peak),
+        "hbm_budget_bytes": budget,
+        "within_budget": budget is None or peak <= budget,
+        "engine_chain": ["sharded", guard.SEQUENTIAL],
+    }
+
+
+def _keys_np(b) -> np.ndarray:
+    return np.asarray(b.keys)
+
+
 def _sequential_sharded(op: str, bitmaps
                         ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """CPU sequential reference for the sharded wide ops, shaped like the

@@ -55,6 +55,22 @@ ENV_MAX_ATTEMPTS = "ROARING_TPU_MAX_ATTEMPTS"
 ENV_BACKOFF = "ROARING_TPU_BACKOFF_S"
 ENV_DEADLINE = "ROARING_TPU_DEADLINE_S"
 ENV_SHADOW = "ROARING_TPU_SHADOW"
+ENV_HBM_BUDGET = "ROARING_TPU_HBM_BUDGET"
+
+
+def parse_bytes(spec: str) -> int:
+    """``ROARING_TPU_HBM_BUDGET`` value: plain bytes or K/M/G-suffixed
+    (binary units — "64M" = 64 MiB).  0 or negative = unlimited."""
+    s = spec.strip()
+    mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}.get(s[-1:].lower())
+    if mult is not None:
+        s = s[:-1]
+    try:
+        return int(float(s) * (mult or 1))
+    except ValueError:
+        raise ValueError(
+            f"{ENV_HBM_BUDGET} must be bytes with an optional K/M/G "
+            f"suffix, got {spec!r}") from None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +84,11 @@ class GuardPolicy:
     deadline: float | None = None  # whole-dispatch wall budget, seconds
     shadow_rate: float = 0.0       # fraction of queries cross-checked
     shadow_seed: int = 0x5AD0
+    #: predicted-peak HBM ceiling per dispatch, bytes: a batch predicted
+    #: past it is halved BEFORE dispatch (proactive split).  None =
+    #: resolve from the backend (free memory where reported, else
+    #: unlimited); <= 0 = explicitly unlimited.
+    hbm_budget: int | None = None
     sleep: Callable[[float], None] = time.sleep
 
     @classmethod
@@ -85,6 +106,8 @@ class GuardPolicy:
             env["shadow_rate"] = float(rate)
             if seed:
                 env["shadow_seed"] = int(seed, 0)
+        if ENV_HBM_BUDGET in os.environ:
+            env["hbm_budget"] = parse_bytes(os.environ[ENV_HBM_BUDGET])
         env.update(overrides)
         return cls(**env)
 
@@ -106,6 +129,41 @@ class Deadline:
         if self.seconds is None:
             return float("inf")
         return max(0.0, self.seconds - (self._clock() - self._t0))
+
+
+#: backend free-memory budget cache: (monotonic deadline, value).  The
+#: default budget costs a device.memory_stats() allocator query, which
+#: must not ride every dispatch of a serving loop at the dispatch floor —
+#: free memory moves slowly next to query rate, so a short TTL is an
+#: honest planning input at none of the per-execute cost.
+_FREE_BUDGET_TTL_S = 1.0
+_free_budget_cache: tuple[float, int | None] | None = None
+
+
+def resolve_hbm_budget(policy: GuardPolicy | None = None) -> int | None:
+    """Effective per-dispatch HBM budget, bytes, or None for unlimited.
+
+    Order: an explicit policy/env value wins (``ROARING_TPU_HBM_BUDGET``,
+    <= 0 meaning unlimited); otherwise the backend's reported free memory
+    (``device.memory_stats()`` — TPU/GPU; cached for
+    ``_FREE_BUDGET_TTL_S`` so the allocator query never rides every
+    dispatch); otherwise unlimited (the CPU backend reports nothing, and
+    a proxy host has no HBM to protect).  The batch engine compares its
+    predicted dispatch peak (``insights.predict_batch_dispatch_bytes``)
+    against this and halves Q BEFORE dispatching — the proactive form of
+    the reactive OOM split."""
+    global _free_budget_cache
+    policy = policy or GuardPolicy.from_env()
+    if policy.hbm_budget is not None:
+        return policy.hbm_budget if policy.hbm_budget > 0 else None
+    now = time.monotonic()
+    if _free_budget_cache is not None and now < _free_budget_cache[0]:
+        return _free_budget_cache[1]
+    from ..obs import memory as obs_memory
+
+    free = obs_memory.backend_free_bytes()
+    _free_budget_cache = (now + _FREE_BUDGET_TTL_S, free)
+    return free
 
 
 def chain_from(engine: str, ladder: tuple) -> tuple:

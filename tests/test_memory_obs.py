@@ -1,0 +1,350 @@
+"""Device-memory observability (ISSUE 4) acceptance + contracts.
+
+Pins:
+- HBM ledger register/release/reset-snapshot symmetry, and the
+  rb_hbm_resident_bytes gauges tracking live DeviceBitmapSets;
+- the unified footprint model: predict_resident_bytes (host metadata
+  only, no device) equals the measured hbm_bytes() of the built set for
+  the dense and counts layouts (compact pinned too);
+- BatchEngine.explain(): deterministic, JSON-serializable, documented
+  schema, and its predicted dispatch peak equal to the predictor the
+  proactive splitter uses;
+- predicted dispatch HBM within 2x of Compiled.memory_analysis()
+  (temp + output) on a Q=64 CPU-proxy batch — the acceptance bound;
+- proactive HBM-budget split: a batch predicted past
+  ROARING_TPU_HBM_BUDGET is halved BEFORE dispatch (proactive counter
+  moves, reactive OOM counter does not), bit-exact vs the unsplit run,
+  and every dispatched sub-batch's prediction respects the budget;
+- the budget machinery composes with the fault harness's oom kind
+  (reactive splits still fire underneath, results stay bit-exact);
+- tools/bench_diff.py lane extraction, salvage, and regression logic.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from roaringbitmap_tpu import obs
+from roaringbitmap_tpu.insights import analysis as insights
+from roaringbitmap_tpu.obs import memory as obs_memory
+from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
+                                                     random_query_pool)
+from roaringbitmap_tpu.runtime import faults, guard
+from roaringbitmap_tpu.utils import datasets
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    yield
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+
+
+@pytest.fixture(scope="module")
+def bitmaps():
+    return datasets.synthetic_bitmaps(16, seed=11, universe=1 << 18,
+                                      density=0.01)
+
+
+@pytest.fixture(scope="module")
+def engine(bitmaps):
+    return BatchEngine.from_bitmaps(bitmaps)
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return random_query_pool(16, 64)
+
+
+# ----------------------------------------------------------------- ledger
+
+class TestLedger:
+    def test_register_release_symmetry(self):
+        led = obs_memory.HbmLedger()
+        baseline = led.snapshot()
+        assert baseline == {"total_bytes": 0, "entries": 0, "by_kind": {}}
+        h1 = led.register("bitmap_set", "dense", 1000)
+        h2 = led.register("bitmap_set", "counts", 500)
+        h3 = led.register("pair_set", "dense", 250)
+        snap = led.snapshot()
+        assert snap["total_bytes"] == 1750 and snap["entries"] == 3
+        assert snap["by_kind"]["bitmap_set"] == {"dense": 1000,
+                                                 "counts": 500}
+        assert led.resident_bytes("bitmap_set") == 1500
+        assert led.resident_bytes("bitmap_set", "counts") == 500
+        led.release(h2)
+        led.release(h2)   # idempotent: GC finalizer after manual release
+        assert led.snapshot()["total_bytes"] == 1250
+        led.release(h1)
+        led.release(h3)
+        assert led.snapshot() == baseline
+        led.register("bitmap_set", "dense", 1)
+        led.reset()
+        assert led.snapshot() == baseline
+
+    def test_owner_gc_releases(self, bitmaps):
+        led = obs_memory.LEDGER
+        before = led.resident_bytes("bitmap_set", "counts")
+        ds = DeviceBitmapSet(bitmaps[:4], layout="counts")
+        held = ds.hbm_bytes()
+        assert led.resident_bytes("bitmap_set", "counts") == before + held
+        del ds
+        import gc
+
+        gc.collect()
+        assert led.resident_bytes("bitmap_set", "counts") == before
+
+    def test_resident_gauges_exported(self, bitmaps):
+        ds = DeviceBitmapSet(bitmaps[:4])
+        rows = obs.snapshot()["gauges"]["rb_hbm_resident_bytes"]
+        dense = [r for r in rows if r["labels"] == {"kind": "bitmap_set",
+                                                    "layout": "dense"}]
+        assert dense and dense[0]["value"] >= ds.hbm_bytes()
+        assert "hbm" in obs.snapshot()
+        text = obs.render_prometheus()
+        assert "rb_hbm_resident_bytes" in text
+
+
+# ------------------------------------------------- unified footprint model
+
+class TestFootprintModel:
+    @pytest.mark.parametrize("layout", ["dense", "counts", "compact"])
+    def test_predictor_matches_measured(self, bitmaps, layout):
+        """predict_resident_bytes from host metadata alone equals the
+        measured bytes of the built set — the model parity pin."""
+        predicted = insights.predict_resident_bytes(bitmaps, layout=layout)
+        ds = DeviceBitmapSet(bitmaps, layout=layout)
+        measured = insights.resident_set_bytes(ds)
+        assert set(predicted) == set(measured)
+        assert predicted == {k: int(v) for k, v in measured.items()}
+        assert sum(predicted.values()) == ds.hbm_bytes()
+
+    def test_footprint_shares_row_constant(self, bitmaps):
+        rb = bitmaps[0]
+        assert insights.hbm_footprint_bytes(rb) == \
+            rb.container_count() * insights.ROW_BYTES
+        assert insights.dense_rows_bytes(3) == 3 * insights.ROW_BYTES
+
+
+# ----------------------------------------------------------------- explain
+
+class TestExplain:
+    def test_schema_and_determinism(self, engine, pool):
+        engine.explain(pool)              # warm the plan cache
+        a = engine.explain(pool)
+        b = engine.explain(pool)
+        assert a == b                     # deterministic
+        json.loads(json.dumps(a))         # JSON-serializable
+        assert {"site", "q", "engine", "engine_chain", "layout",
+                "plan_cache_hit", "program_cache_hit", "resident",
+                "buckets", "queries", "predicted", "hbm_budget_bytes",
+                "proactive_split", "sequential_floor"} <= set(a)
+        assert a["q"] == len(pool) and a["plan_cache_hit"]
+        assert a["resident"]["hbm_bytes"] == engine.hbm_bytes()
+        assert a["predicted"]["peak_bytes"] == \
+            engine.predict_dispatch_bytes(pool)
+        # every query maps to a real bucket, and buckets cover the batch
+        assert sorted(q for b_ in a["buckets"] for q in b_["queries"]) \
+            == list(range(len(pool)))
+        for row in a["queries"]:
+            assert row["bucket"] < len(a["buckets"])
+            assert row["rung"] >= 1 and row["op"] in (
+                "or", "xor", "and", "andnot")
+
+    def test_program_cache_hit_after_execute(self, engine, pool):
+        engine.execute(pool[:8])
+        rep = engine.explain(pool[:8])
+        assert rep["program_cache_hit"] and rep["plan_cache_hit"]
+
+    def test_explain_wide_and_sharded(self, bitmaps):
+        from roaringbitmap_tpu.parallel import aggregation, sharding
+
+        rep = aggregation.explain_wide("or", bitmaps)
+        json.loads(json.dumps(rep))
+        assert rep["n"] == len(bitmaps) and rep["engine_chain"][-1] == \
+            guard.SEQUENTIAL
+        assert rep["predicted_hbm_bytes"] > 0
+        import jax
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        mesh = Mesh(
+            __import__("numpy").array(devs).reshape(len(devs), 1),
+            ("rows", "lanes"))
+        srep = sharding.explain_sharded(mesh, "or", bitmaps)
+        json.loads(json.dumps(srep))
+        assert srep["num_keys"] > 0 and srep["passes"]
+        assert all(p["per_device_accumulator_bytes"]
+                   <= insights.dense_rows_bytes(
+                       sharding.MAX_KEYS_PER_SHARD_PASS + 1)
+                   for p in srep["passes"])
+
+
+# ---------------------------------------------------- predicted vs actual
+
+class TestDispatchMemory:
+    def test_predicted_within_2x_of_measured(self, engine, pool):
+        """Acceptance: Q=64 CPU-proxy batch — predicted dispatch HBM
+        within 2x of Compiled.memory_analysis() (temp + output)."""
+        engine.execute(pool)
+        mem = engine.last_dispatch_memory
+        assert mem is not None and mem["q"] == 64
+        assert mem["predicted_bytes"] > 0
+        measured = mem["measured_peak_bytes"]
+        assert measured > 0
+        ratio = mem["predicted_bytes"] / measured
+        assert 0.5 <= ratio <= 2.0, \
+            f"predicted {mem['predicted_bytes']} vs measured {measured}"
+        # the gauges moved with the dispatch
+        g = obs.snapshot()["gauges"]
+        assert g["rb_hbm_predicted_bytes"][0]["value"] == \
+            mem["predicted_bytes"]
+        assert g["rb_hbm_measured_peak_bytes"][0]["value"] == measured
+
+    def test_batch_memory_event_in_trace(self, engine, pool, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        obs.enable(path)
+        engine.execute(pool[:8])
+        obs.disable()
+        spans = [json.loads(l) for l in open(path)]
+        dispatches = [s for s in spans if s["name"] == "batch.dispatch"]
+        assert dispatches
+        evs = [ev for s in dispatches for ev in s["events"]
+               if ev["name"] == "batch.memory"]
+        assert evs and evs[0]["predicted_bytes"] > 0
+        assert evs[0]["residual_x"] > 0
+
+
+# ------------------------------------------------------- proactive splits
+
+class TestProactiveSplit:
+    def test_budget_splits_before_dispatch_bit_exact(self, bitmaps,
+                                                     tmp_path):
+        eng = BatchEngine.from_bitmaps(bitmaps)
+        pool = random_query_pool(16, 64, seed=0xB4)
+        clean = [r.cardinality for r in eng.execute(pool)]
+        assert eng.proactive_split_count == 0
+
+        budget = 16 << 20
+        path = str(tmp_path / "trace.jsonl")
+        obs.enable(path)
+        policy = guard.GuardPolicy(hbm_budget=budget)
+        split = [r.cardinality for r in eng.execute(pool, policy=policy)]
+        obs.disable()
+
+        assert split == clean                      # bit-exact
+        assert eng.proactive_split_count > 0       # split BEFORE dispatch
+        assert eng.split_count == 0                # zero reactive splits
+        snap = obs.snapshot()
+        pro = snap["counters"]["rb_batch_proactive_splits_total"]
+        assert pro[0]["value"] == eng.proactive_split_count
+        assert "rb_batch_oom_splits_total" not in snap["counters"]
+        # budget-respected property: every dispatched sub-batch's
+        # prediction fits the budget, and splits are traced
+        spans = [json.loads(l) for l in open(path)]
+        mems = [ev for s in spans if s["name"] == "batch.dispatch"
+                for ev in s["events"] if ev["name"] == "batch.memory"]
+        assert mems and all(ev["predicted_bytes"] <= budget for ev in mems)
+        splits = [ev for s in spans for ev in s["events"]
+                  if ev["name"] == "proactive_split"]
+        assert len(splits) == eng.proactive_split_count
+        assert all(ev["predicted_bytes"] > ev["budget_bytes"]
+                   for ev in splits)
+        # explain agrees with what execute just did
+        rep = eng.explain(pool, policy=policy)
+        assert rep["proactive_split"]["would_split"]
+        assert sum(rep["proactive_split"]["dispatches"]) == len(pool)
+
+    def test_budget_env_knob(self, bitmaps, monkeypatch):
+        eng = BatchEngine.from_bitmaps(bitmaps[:8])
+        pool = random_query_pool(8, 32, seed=0xE2)
+        clean = [r.cardinality for r in eng.execute(pool)]
+        monkeypatch.setenv(guard.ENV_HBM_BUDGET, "8M")
+        assert guard.resolve_hbm_budget() == 8 << 20
+        got = [r.cardinality for r in eng.execute(pool)]
+        assert got == clean and eng.proactive_split_count > 0
+
+    def test_budget_unlimited_values(self):
+        assert guard.parse_bytes("0") == 0
+        assert guard.parse_bytes("64M") == 64 << 20
+        assert guard.parse_bytes("2g") == 2 << 30
+        assert guard.parse_bytes("1024") == 1024
+        with pytest.raises(ValueError):
+            guard.parse_bytes("lots")
+        # <= 0 means explicitly unlimited
+        assert guard.resolve_hbm_budget(
+            guard.GuardPolicy(hbm_budget=0)) is None
+
+    def test_budget_composes_with_oom_faults(self, bitmaps):
+        """The proactive splitter and the reactive OOM machinery stack:
+        with a tiny budget AND injected allocator failures, both split
+        kinds fire and the results stay bit-exact."""
+        eng = BatchEngine.from_bitmaps(bitmaps)
+        pool = random_query_pool(16, 16, seed=0x00F)
+        clean = [r.cardinality for r in eng.execute(pool)]
+        assert eng.predict_dispatch_bytes(pool) > 8 << 20, \
+            "workload too small to exercise the budget"
+        policy = guard.GuardPolicy(hbm_budget=8 << 20)
+        with faults.inject("oom@xla=1.0:5"):
+            got = [r.cardinality for r in eng.execute(pool, policy=policy)]
+        assert got == clean
+        assert eng.proactive_split_count > 0
+        assert eng.split_count > 0      # reactive halvings underneath
+        # legacy stat shapes untouched by the new counter
+        assert set(eng.cache_stats()) == {"plans", "programs", "splits"}
+
+
+# -------------------------------------------------------- tools/bench_diff
+
+def _load_bench_diff():
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO, "tools", "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchDiff:
+    def test_lane_diff_and_regression(self, tmp_path):
+        bd = _load_bench_diff()
+        old = {"metric": "m", "value": 100.0, "detail": {
+            "q64_e2e_qps": 1000.0, "pack_ms": 5.0}}
+        new = {"metric": "m", "value": 50.0, "detail": {
+            "q64_e2e_qps": 1100.0, "pack_ms": 4.0}}
+        po, pn = tmp_path / "o.json", tmp_path / "n.json"
+        po.write_text(json.dumps(old))
+        pn.write_text(json.dumps(new))
+        rows, regressions = bd.diff_lanes(
+            bd.load_lanes(str(po)), bd.load_lanes(str(pn)), 0.15)
+        assert regressions == ["value"]       # -50% on higher-is-better
+        by_lane = {r[0]: r for r in rows}
+        assert not by_lane["detail.q64_e2e_qps"][5]   # +10% is fine
+        assert not by_lane["detail.pack_ms"][5]       # lower is better
+
+    def test_salvages_committed_trajectory_tails(self):
+        """The CI smoke case: the pre-cap driver captures (parsed: null,
+        truncated tail) must still yield lanes."""
+        bd = _load_bench_diff()
+        lanes4 = bd.load_lanes(os.path.join(REPO, "BENCH_r04.json"))
+        lanes2 = bd.load_lanes(os.path.join(REPO, "BENCH_r02.json"))
+        assert lanes4 and lanes2
+        rows, _ = bd.diff_lanes(lanes2, lanes4, 0.15)
+        assert rows, "suffix alignment found no shared lanes r02->r04"
+
+    def test_driver_capture_with_parsed(self, tmp_path):
+        bd = _load_bench_diff()
+        doc = {"n": 9, "cmd": "x", "rc": 0, "tail": "noise",
+               "parsed": {"value": 7.5, "vs_baseline": 12.0}}
+        p = tmp_path / "cap.json"
+        p.write_text(json.dumps(doc))
+        assert bd.load_lanes(str(p)) == {"value": 7.5, "vs_baseline": 12.0}

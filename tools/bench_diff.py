@@ -1,0 +1,210 @@
+"""Diff two bench.py result documents and report per-lane deltas.
+
+Usage::
+
+    python tools/bench_diff.py OLD.json NEW.json [--threshold 0.15] [--fail]
+
+Accepted document shapes (the loader walks a ladder):
+
+1. a **driver capture** (``BENCH_r0*.json``: ``{n, cmd, rc, tail,
+   parsed}``) — uses ``parsed`` when the driver managed to parse the
+   summary line, else salvages the truncated final JSON line from the
+   bounded ``tail`` (rounds before the SUMMARY_MAX_BYTES cap lost the
+   line's head; the tail's END is intact, so scanning forward for the
+   first parseable suffix recovers the trailing lanes);
+2. a **bench.py summary line** (``{metric, value, ...}``) or the full
+   ``benchmarks/bench_full.json`` document.
+
+Lanes are the numeric leaves of the recovered document, flattened to
+dotted paths.  Direction is inferred from the lane name: ``*qps*`` /
+``*ops_per_sec*`` / ``value`` / ``*vs_baseline*`` / ``*amortization*`` /
+``*speedup*`` are higher-is-better, ``*_us*`` / ``*_ms*`` /
+``*_seconds*`` / ``*bytes*`` lower-is-better; anything else is reported
+as informational and never gated — notably bare ``*_x`` ratio lanes
+(``demotion_overhead_x``, ``residual_x``), whose good direction depends
+on the lane, unless a directional token above also matches
+(``q64_vs_q1_amortization_x`` is gated upward via ``amortization``).  A directional lane that moved against its
+direction by more than ``--threshold`` (fractional, default 0.15) is a
+**regression**; with ``--fail`` the exit code is 1 when any lane
+regressed (without it the tool always exits 0 — the CI smoke lane diffs
+the committed trajectory files, whose rounds legitimately move).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+#: lane-name fragments -> direction (checked in order; first hit wins)
+HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup")
+LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes")
+
+
+def salvage_tail_json(tail: str) -> dict | None:
+    """Recover the truncated final JSON line of a bounded tail capture.
+
+    The summary is the LAST stdout line; the tail keeps its end but may
+    cut its head mid-token.  Scan forward over `", "` key boundaries,
+    re-open an object there, and trim unbalanced trailing braces until
+    something parses — the recovered suffix loses the leading lanes but
+    keeps every complete trailing one.
+    """
+    line = tail.strip().splitlines()[-1] if tail.strip() else ""
+    if not line:
+        return None
+    # candidate re-open points: the line head, every `{"` object start,
+    # and every `, "` key boundary (re-opened as an object there)
+    starts = sorted({0}
+                    | {m.start() for m in re.finditer(r'\{"', line)}
+                    | {m.start() + 2 for m in re.finditer(r', "', line)})
+    best: dict | None = None
+    for s in starts[:400]:
+        frag = line[s:].strip()
+        body = frag if frag.startswith("{") else "{" + frag
+        # a suffix cut inside nested objects carries unmatched trailing
+        # closers; trim them (or re-close an unterminated object)
+        for trim in range(8):
+            cand = (body[:-trim] if trim else body).rstrip().rstrip(",")
+            for close in range(4):
+                try:
+                    doc = json.loads(cand + "}" * close)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                if isinstance(doc, dict) and doc and (
+                        best is None or len(doc) > len(best)):
+                    best = doc
+                break
+        if best is not None and s == 0:
+            break
+    return best
+
+
+def load_lanes(path: str) -> dict:
+    """Path -> {dotted lane: float} via the document-shape ladder."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "tail" in doc and "cmd" in doc:
+        parsed = doc.get("parsed")
+        doc = parsed if isinstance(parsed, dict) \
+            else salvage_tail_json(doc.get("tail", ""))
+        if doc is None:
+            raise SystemExit(
+                f"bench_diff: {path}: driver capture has no parseable "
+                f"summary (parsed is null and the tail salvage failed)")
+    lanes: dict = {}
+    _flatten(doc, "", lanes)
+    return lanes
+
+
+def _flatten(node, prefix: str, out: dict) -> None:
+    if isinstance(node, bool):
+        return
+    if isinstance(node, (int, float)):
+        out[prefix] = float(node)
+    elif isinstance(node, dict):
+        for k, v in node.items():
+            _flatten(v, f"{prefix}.{k}" if prefix else str(k), out)
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            _flatten(v, f"{prefix}[{i}]", out)
+
+
+def direction(lane: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 informational."""
+    low = lane.lower()
+    if low == "value" or any(t in low for t in HIGHER):
+        return 1
+    if any(t in low for t in LOWER):
+        return -1
+    return 0
+
+
+def suffix_align(old: dict, new: dict) -> dict:
+    """{old lane: new lane} by longest unique dotted-path suffix (>= 2
+    components) — salvaged tails recover suffixes of the full document at
+    different depths, so ``detail.wikileaks-noquotes.pack_ms`` must pair
+    with ``wikileaks-noquotes.pack_ms``.  Ambiguous suffixes are skipped.
+    Lanes already paired exactly are passed over unchanged."""
+    pairs: dict = {}
+    for lo in old:
+        if lo in new:
+            pairs[lo] = lo      # exact path match always wins
+            continue
+        co = lo.split(".")
+        best, best_k, dup = None, 0, False
+        for ln in new:
+            cn = ln.split(".")
+            k = 0
+            while (k < min(len(co), len(cn))
+                   and co[-1 - k] == cn[-1 - k]):
+                k += 1
+            if k > best_k:
+                best, best_k, dup = ln, k, False
+            elif k == best_k and k and ln != best:
+                dup = True
+        if best is not None and best_k >= 2 and not dup:
+            pairs[lo] = best
+    return pairs
+
+
+def diff_lanes(old: dict, new: dict, threshold: float) -> tuple[list, list]:
+    """([(lane, old, new, delta_frac, direction, regressed)], regressions)
+    over lanes present in BOTH documents — exact dotted-path matches
+    first, depth-shifted salvaged lanes paired by unique path suffix —
+    sorted worst-first."""
+    aligned = suffix_align(old, new)
+    rows, regressions = [], []
+    for lane in sorted(aligned):
+        o, n = old[lane], new[aligned[lane]]
+        if o == 0 and n == 0:
+            continue
+        d = (n - o) / abs(o) if o else float("inf")
+        sgn = direction(lane)
+        regressed = sgn != 0 and sgn * d < -threshold
+        rows.append((lane, o, n, d, sgn, regressed))
+        if regressed:
+            regressions.append(lane)
+    rows.sort(key=lambda r: (not r[5], r[4] * r[3]))
+    return rows, regressions
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two bench.py result documents per lane")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="fractional move against a lane's direction that "
+                         "counts as a regression (default 0.15)")
+    ap.add_argument("--fail", action="store_true",
+                    help="exit 1 when any lane regressed past the "
+                         "threshold (default: report-only, exit 0)")
+    ap.add_argument("--lanes", default="",
+                    help="only report lanes whose dotted path contains "
+                         "this substring (e.g. 'qps')")
+    args = ap.parse_args()
+
+    old, new = load_lanes(args.old), load_lanes(args.new)
+    rows, regressions = diff_lanes(old, new, args.threshold)
+    if args.lanes:
+        rows = [r for r in rows if args.lanes in r[0]]
+    shared = len(rows)
+    if not shared:
+        print(f"bench_diff: no shared numeric lanes between {args.old} "
+              f"and {args.new}", file=sys.stderr)
+        return 2
+    arrow = {1: "^", -1: "v", 0: "-"}
+    for lane, o, n, d, sgn, bad in rows:
+        flag = " REGRESSION" if bad else ""
+        print(f"{arrow[sgn]} {lane}: {o:g} -> {n:g} "
+              f"({d:+.1%}){flag}")
+    print(f"bench_diff: {shared} shared lanes, {len(regressions)} "
+          f"regression(s) past {args.threshold:.0%} "
+          f"({args.old} -> {args.new})")
+    return 1 if (args.fail and regressions) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

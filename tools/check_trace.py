@@ -19,7 +19,13 @@ and a wide aggregation — then (2) validates every emitted line:
 - every event carries name + t_offset_ms;
 - in --workload mode, semantic checks: a ``guard.dispatch`` span exists,
   a ``demote`` event records the pallas->xla hop with its classified
-  error class, and the batch.execute -> guard.dispatch nesting holds.
+  error class, and the batch.execute -> guard.dispatch nesting holds;
+  every ``batch.dispatch`` span carries a ``batch.memory`` event whose
+  ``predicted_bytes`` is a positive number (``residual_x`` numeric when
+  measurement is available), and the workload's tiny
+  ``ROARING_TPU_HBM_BUDGET`` batch produced a ``proactive_split`` event
+  recording predicted vs budget bytes (docs/OBSERVABILITY.md, "Memory
+  observability").
 
 Validation-only mode (``python tools/check_trace.py <path>``) checks an
 existing dump, e.g. one captured from a serving process.
@@ -46,7 +52,8 @@ REQUIRED = {
 
 
 def validate(path: str, workload_semantics: bool = False,
-             strict_refs: bool | None = None) -> list[str]:
+             strict_refs: bool | None = None,
+             budget_semantics: bool = False) -> list[str]:
     """``strict_refs`` controls whether a parent_id/trace_id that resolves
     to no span in the file is a violation.  Defaults to
     ``workload_semantics``: the CI workload produces a COMPLETE dump, but
@@ -100,11 +107,13 @@ def validate(path: str, workload_semantics: bool = False,
                     errors.append(
                         f"line {i}: {ref} {v!r} not present in the dump")
     if workload_semantics:
-        errors += _workload_semantics([s for _, s in spans])
+        errors += _workload_semantics([s for _, s in spans],
+                                      budget_semantics)
     return errors
 
 
-def _workload_semantics(spans: list[dict]) -> list[str]:
+def _workload_semantics(spans: list[dict],
+                        budget_semantics: bool = False) -> list[str]:
     errors: list[str] = []
     by_id = {s["span_id"]: s for s in spans if "span_id" in s}
     dispatches = [s for s in spans if s.get("name") == "guard.dispatch"]
@@ -125,6 +134,41 @@ def _workload_semantics(spans: list[dict]) -> list[str]:
               == "batch.execute"]
     if not nested:
         errors.append("no guard.dispatch span nested under batch.execute")
+    # memory accounting: every device dispatch must report predicted (and,
+    # where the backend exposes memory_analysis, measured) bytes
+    batch_dispatches = [s for s in spans
+                        if s.get("name") == "batch.dispatch"]
+    mems = [ev for s in batch_dispatches for ev in s.get("events", [])
+            if ev.get("name") == "batch.memory"]
+    if not batch_dispatches:
+        errors.append("no batch.dispatch span — the batch path was not "
+                      "traced")
+    elif len(mems) < len(batch_dispatches):
+        errors.append(
+            f"{len(batch_dispatches) - len(mems)} batch.dispatch span(s) "
+            "lack a batch.memory event")
+    for ev in mems:
+        p = ev.get("predicted_bytes")
+        if not isinstance(p, (int, float)) or p <= 0:
+            errors.append(f"batch.memory event with non-positive "
+                          f"predicted_bytes: {ev!r}")
+        if ("residual_x" in ev
+                and not isinstance(ev["residual_x"], (int, float))):
+            errors.append(f"batch.memory residual_x not numeric: {ev!r}")
+    if budget_semantics:
+        # only the --workload run guarantees a budget case (it forces one
+        # with a tiny ROARING_TPU_HBM_BUDGET); arbitrary dumps need not
+        # contain a proactive split to be valid
+        splits = [ev for s in spans for ev in s.get("events", [])
+                  if ev.get("name") == "proactive_split"]
+        if not any(isinstance(ev.get("predicted_bytes"), (int, float))
+                   and isinstance(ev.get("budget_bytes"), (int, float))
+                   and ev["predicted_bytes"] > ev["budget_bytes"]
+                   for ev in splits):
+            errors.append(
+                "no proactive_split event with predicted_bytes > "
+                "budget_bytes (the ROARING_TPU_HBM_BUDGET workload case; "
+                f"saw: {splits!r})")
     return errors
 
 
@@ -155,6 +199,16 @@ def run_workload(path: str) -> None:
             demoted = [r.cardinality
                        for r in eng.execute(pool, engine="pallas")]
         assert demoted == clean, "demoted batch diverged from clean batch"
+        # proactive HBM-budget split: a budget far under the Q=64 batch's
+        # predicted dispatch peak must halve it BEFORE dispatch, bit-exact
+        os.environ["ROARING_TPU_HBM_BUDGET"] = "16M"
+        try:
+            budgeted = [r.cardinality for r in eng.execute(pool)]
+        finally:
+            del os.environ["ROARING_TPU_HBM_BUDGET"]
+        assert budgeted == clean, "budget-split batch diverged"
+        assert eng.proactive_split_count > 0, \
+            "tiny ROARING_TPU_HBM_BUDGET did not force a proactive split"
         aggregation.or_(*bms[:8])
     finally:
         obs.disable()
@@ -171,7 +225,8 @@ def main() -> int:
     path = args[0]
     if workload:
         run_workload(path)
-    errors = validate(path, workload_semantics=workload)
+    errors = validate(path, workload_semantics=workload,
+                      budget_semantics=workload)
     if errors:
         for e in errors:
             print(f"check_trace: {e}", file=sys.stderr)

@@ -75,6 +75,8 @@ R1, R2 = 100, 4100  # chained rep counts; marginal = (t2-t1)/(R2-R1)
 BENCH_DATASETS = ("census1881", "wikileaks-noquotes")
 BATCH_SIZES = (1, 8, 64, 256)   # batched multi-query lane (ISSUE 1)
 BATCH_R = (10, 110)             # chained rep pair for batch marginals
+MULTISET_S = (1, 4, 16)         # tenant counts of the multiset lane (ISSUE 5)
+MULTISET_Q = (8, 64)            # pooled query counts per cell
 
 
 def load_cpu_baseline(dataset: str) -> tuple[float | None, dict]:
@@ -96,10 +98,13 @@ def load_cpu_baseline(dataset: str) -> tuple[float | None, dict]:
 
 
 def _timed_pack(inputs, cls) -> tuple[float, object]:
+    # layout pinned dense: layout="auto" (the build-time default since
+    # ISSUE 5) flips counts-resident on inflation-heavy shapes, which has
+    # no `words` image and would break cross-round lane comparability
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        d = cls(inputs)
+        d = cls(inputs, layout="dense")
         d.words.block_until_ready()
         best = min(best, time.perf_counter() - t0)
     return best, d
@@ -138,9 +143,8 @@ def ingest_phase(name: str) -> dict:
     # shape per cache state — the persistent compilation cache set up in
     # main() makes this ~1s warm vs ~17s on a cold cache)
     t0 = time.perf_counter()
-    ds = DeviceBitmapSet(bitmaps)
-    if ds.words is not None:
-        ds.words.block_until_ready()
+    ds = DeviceBitmapSet(bitmaps, layout="dense")  # pinned, see _timed_pack
+    ds.words.block_until_ready()
     t_compile = time.perf_counter() - t0
 
     t_pack, _ = _timed_pack(bitmaps, DeviceBitmapSet)
@@ -281,6 +285,18 @@ def query_phase(state: dict, profile: bool) -> dict:
     }
 
 
+def best_of(fn, reps: int = 5) -> float:
+    """Min-of-reps wall time after one warm/compile call — the shared
+    timing policy of every QPS lane (batched, fault, multiset)."""
+    fn()  # warm / compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def batched_phase(state: dict) -> dict:
     """Batched multi-query lane: queries/sec at Q in BATCH_SIZES over the
     resident set — the dispatch-floor amortization the wide path was bound
@@ -306,15 +322,6 @@ def batched_phase(state: dict) -> dict:
     seq = [int(eng.cardinalities([q])[0]) for q in probe]
     got = eng.cardinalities(probe).tolist()
     assert got == seq, "batch/sequential cardinality divergence"
-
-    def best_of(fn, reps: int = 5) -> float:
-        fn()  # warm / compile
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            fn()
-            best = min(best, time.perf_counter() - t0)
-        return best
 
     out: dict = {"parity_checked_queries": len(probe),
                  "mixed_ops": ["or", "xor", "and", "andnot"]}
@@ -352,11 +359,11 @@ def batched_phase(state: dict) -> dict:
     amort = out.get("q64_e2e_qps", 0.0) / out["q1_seq_dispatch_qps"]
     out["q64_vs_q1_amortization_x"] = round(amort, 2)
     out["meets_5x"] = amort >= 5.0
-    out["fault_lane"] = fault_lane_phase(eng, pool, best_of)
+    out["fault_lane"] = fault_lane_phase(eng, pool)
     return out
 
 
-def fault_lane_phase(eng, pool, best_of) -> dict:
+def fault_lane_phase(eng, pool) -> dict:
     """Degraded-mode QPS probe (ISSUE 2): the same Q-query batch measured
     (a) clean, (b) with the top engine rung killed by an injected lowering
     fault (the guard demotes one rung down the chain), and (c) with EVERY
@@ -391,6 +398,76 @@ def fault_lane_phase(eng, pool, best_of) -> dict:
     }
 
 
+def multiset_phase() -> dict:
+    """Cross-tenant pooled lane (ISSUE 5): S resident tenant sets serving
+    Q mixed-op queries as ONE pooled launch (MultiSetBatchEngine) vs the
+    per-set sequential BatchEngine loop (S launches), at S in MULTISET_S
+    x Q in MULTISET_Q — the dispatch-floor amortization repeated one
+    level up.  Tenants are small synthetic sets (the serving-front-end
+    regime where the launch floor, not per-query work, dominates).  Every
+    cell asserts pooled results bit-equal to the per-set loop before any
+    timing.  The Q=64 pipelined cell streams 4 pools through the
+    double-buffered dispatcher and reports the host-overlap ratio from
+    MultiSetBatchEngine.last_pipeline, plus predicted-vs-measured pooled
+    dispatch HBM (multiset.memory accounting)."""
+    from roaringbitmap_tpu.obs import memory as obs_memory
+    from roaringbitmap_tpu.parallel.batch_engine import BatchEngine
+    from roaringbitmap_tpu.parallel.multiset import (MultiSetBatchEngine,
+                                                     random_multiset_pool)
+    from roaringbitmap_tpu.utils import datasets
+
+    out: dict = {"tenant_bitmaps": 8}
+    for s in MULTISET_S:
+        tenants = [datasets.synthetic_bitmaps(
+            8, seed=40 + i, universe=1 << 16, density=0.006)
+            for i in range(s)]
+        engines = [BatchEngine.from_bitmaps(t, layout="dense")
+                   for t in tenants]
+        eng = MultiSetBatchEngine(engines)
+        for q in MULTISET_Q:
+            pool = random_multiset_pool([8] * s, q, seed=0xACE,
+                                        max_operands=3)
+
+            def per_set_loop():
+                return [engines[g.set_id].execute(list(g.queries),
+                                                  engine="auto")
+                        for g in pool]
+
+            want = [[r.cardinality for r in rows]
+                    for rows in per_set_loop()]
+            got = [[r.cardinality for r in rows]
+                   for rows in eng.execute(pool)]
+            assert got == want, f"pooled/per-set divergence (S={s} Q={q})"
+            t_pool = best_of(lambda: eng.execute(pool))
+            t_loop = best_of(per_set_loop)
+            cell = {"pooled_qps": round(q / t_pool, 1),
+                    "per_set_qps": round(q / t_loop, 1),
+                    "pooled_vs_per_set_x": round(t_loop / t_pool, 2)}
+            if s > 1:
+                hbm = obs_memory.dispatch_memory_cell(
+                    eng.last_dispatch_memory)
+                if hbm:
+                    cell["hbm"] = hbm
+            out[f"s{s}_q{q}"] = cell
+        if s > 1:
+            # pipelined dispatcher: stream 4 pools (serving ticks)
+            # through one window; the overlap ratio is the hidden
+            # fraction of host plan+pack time (multiset.pipeline span)
+            pools = [random_multiset_pool([8] * s, max(MULTISET_Q),
+                                          seed=200 + i, max_operands=3)
+                     for i in range(4)]
+            eng.execute_pipelined(pools)          # warm compiles
+            best_of(lambda: eng.execute_pipelined(pools), reps=3)
+            out[f"s{s}_pipeline"] = dict(eng.last_pipeline)
+    s_max, q_max = max(MULTISET_S), max(MULTISET_Q)
+    head = out.get(f"s{s_max}_q{q_max}") or {}
+    pipe = out.get(f"s{s_max}_pipeline") or {}
+    out["headline"] = {
+        "pooled_vs_per_set_x": head.get("pooled_vs_per_set_x"),
+        "overlap_ratio": pipe.get("overlap_ratio")}
+    return out
+
+
 #: hard byte cap on the final stdout summary line.  The driver captures a
 #: BOUNDED tail of stdout (ADVICE r5: the r05 summary still came back
 #: "parsed": null with the JSON head truncated), so the line must fit a
@@ -403,7 +480,7 @@ SUMMARY_MAX_BYTES = 2048
 #: line fits SUMMARY_MAX_BYTES; the core (metric, value, vs_baseline,
 #: full_doc) is never dropped — north_star goes last and only under a
 #: pathological dataset count
-SUMMARY_DROP_ORDER = ("marginal_us_spread", "batched_qps",
+SUMMARY_DROP_ORDER = ("marginal_us_spread", "multiset", "batched_qps",
                       "marginal_us_median", "unit", "backend",
                       "north_star")
 
@@ -471,6 +548,17 @@ def build_summary(out: dict, full_path: str) -> dict:
                     fl["sequential_floor_cost_x"]]
     if batched:
         s["batched_qps"] = batched
+    ms = out.get("multiset") or {}
+    lanes = {}
+    for key, row in ms.items():
+        if isinstance(row, dict) and "pooled_qps" in row:
+            # pooled vs per-set QPS per (S, Q) cell, compact
+            lanes[key] = [row["pooled_qps"], row["per_set_qps"],
+                          row["pooled_vs_per_set_x"]]
+    if lanes:
+        lanes["overlap_ratio"] = (ms.get("headline") or {}).get(
+            "overlap_ratio")
+        s["multiset"] = lanes
     return s
 
 
@@ -617,6 +705,7 @@ def main() -> None:
     for name in BENCH_DATASETS:
         batched[results[name]["dataset"]] = batched_phase(states[name])
         results[name]["batched"] = batched[results[name]["dataset"]]
+    multiset = multiset_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
     # exactly once.  A single steady-state marginal at VMEM-resident
@@ -668,6 +757,7 @@ def main() -> None:
         out["detail"]["profile_kernel_us"] = parse_profile_trace(
             "/tmp/rb_tpu_trace")
     out["batched_by_dataset"] = batched
+    out["multiset"] = multiset
 
     # full document to disk; stdout gets ONLY the compact summary as its
     # final line (the driver's bounded tail capture must parse it)

@@ -154,7 +154,10 @@ def ingest_dataset(name: str) -> dict:
                 "serialized_mb": sum(len(x) for x in blobs) / 1e6}
 
     t0 = time.perf_counter()
-    ds = DeviceBitmapSet(bms)
+    # the main resident set is the DENSE rung (layout-pinned: the cell
+    # grid compares dense vs the explicit ds_compact/ds_counts builds);
+    # the adaptive default's decision is stamped in the diagnostics
+    ds = DeviceBitmapSet(bms, layout="dense")
     ds.words.block_until_ready()
     st["cold_build_ms"] = (time.perf_counter() - t0) * 1e3
 
@@ -176,14 +179,19 @@ def ingest_dataset(name: str) -> dict:
         "inflation_x_vs_serialized": round(
             p.n_rows * 8192 / max(sum(len(x) for x in blobs), 1), 1),
     }
+    # what DeviceBitmapSet(layout="auto") — the new build-time default —
+    # would pick for this shape (insights.choose_layout; on the
+    # uscensus2000 shape it flips to counts, docs/USCENSUS2000_CLIFF.md)
+    from roaringbitmap_tpu.insights import analysis as insights
+    st["layout"]["auto_layout"] = insights.choose_layout(bms)["layout"]
 
     t0 = time.perf_counter()
-    ds2 = DeviceBitmapSet(blobs)
+    ds2 = DeviceBitmapSet(blobs, layout="dense")
     ds2.words.block_until_ready()
     st["pack_bytes_ms"] = (time.perf_counter() - t0) * 1e3
     del ds2
     t0 = time.perf_counter()
-    ds3 = DeviceBitmapSet(bms)
+    ds3 = DeviceBitmapSet(bms, layout="dense")
     ds3.words.block_until_ready()
     st["pack_dense_ms"] = (time.perf_counter() - t0) * 1e3
     del ds3
@@ -648,6 +656,61 @@ def bench_batch(st: dict, cells: dict, reps: int) -> None:
             "note": "xla-scatter / pallas-chunks (target >= 5x)"}
 
 
+def bench_multiset_cross(states: dict, reps: int) -> dict:
+    """Cross-dataset pooled cell (ISSUE 5): the ingested datasets'
+    resident sets — heterogeneous tenants (census vs wikileaks vs
+    whatever else was loaded) — serve slices of ONE pooled
+    MultiSetBatchEngine launch, vs one BatchEngine launch per dataset.
+    Parity-asserted before timing; stamped with the pooled dispatch's
+    predicted-vs-measured HBM like the PR-4 batch cells."""
+    from roaringbitmap_tpu.obs import memory as obs_memory
+    from roaringbitmap_tpu.parallel.batch_engine import BatchEngine
+    from roaringbitmap_tpu.parallel.multiset import (MultiSetBatchEngine,
+                                                     random_multiset_pool)
+
+    names = [n for n, st in states.items() if "ds" in st][:4]
+    if len(names) < 2:
+        return {}
+    engines = [BatchEngine(states[n]["ds"]) for n in names]
+    eng = MultiSetBatchEngine(engines)
+    q = 16 * len(names)
+    pool = random_multiset_pool([states[n]["ds"].n for n in names], q,
+                                seed=0xC0DE, max_operands=4)
+
+    def per_set_loop():
+        return [engines[g.set_id].execute(list(g.queries)) for g in pool]
+
+    want = [[r.cardinality for r in rows] for rows in per_set_loop()]
+    # launches_saved from the engine's own accounting (a budget-split
+    # pool dispatches more than once, saving fewer than S-1)
+    from roaringbitmap_tpu.obs import metrics as obs_metrics
+    saved = obs_metrics.counter("rb_multiset_launches_saved_total",
+                                site="multiset")
+    launched = obs_metrics.counter("rb_multiset_launches_total",
+                                   site="multiset")
+    saved0, launched0 = saved.value, launched.value
+    got = [[r.cardinality for r in rows] for rows in eng.execute(pool)]
+    n_launched = int(launched.value - launched0)
+    n_saved = int(saved.value - saved0)
+    assert got == want, "cross-dataset pooled divergence"
+    t_pool = _timeit(lambda: eng.execute(pool), reps)
+    t_loop = _timeit(per_set_loop, reps)
+    cell = {"datasets": names, "q": q,
+            "pooled_qps": round(q / t_pool, 1),
+            "per_set_qps": round(q / t_loop, 1),
+            "pooled_vs_per_set_x": round(t_loop / t_pool, 2),
+            "pooled_launches": n_launched,
+            "launches_saved": n_saved,
+            "note": "pooled launches serving every dataset vs one "
+                    "launch per dataset (counted on one pooled execute)"}
+    hbm = obs_memory.dispatch_memory_cell(eng.last_dispatch_memory)
+    if hbm:
+        cell["hbm"] = {**hbm,
+                       "note": "pooled dispatch peak: unified-model "
+                               "prediction vs Compiled.memory_analysis"}
+    return cell
+
+
 def bench_cliff(st: dict, cells: dict, reps: int) -> None:
     """uscensus2000 853-us reconciliation sweep (VERDICT r5 weak #3): the
     same chained wide-OR at simple_benchmark's configuration (32768-rep
@@ -662,7 +725,7 @@ def bench_cliff(st: dict, cells: dict, reps: int) -> None:
     opt = [b.clone() for b in st["bms"]]
     for b in opt:
         b.run_optimize()
-    ds_opt = DeviceBitmapSet(opt)
+    ds_opt = DeviceBitmapSet(opt, layout="dense")
     for tag, ds in (("raw", st["ds"]), ("runopt", ds_opt)):
         for chain in (512, 32768):
             fn = ds.chained_wide_or(chain)
@@ -791,6 +854,21 @@ def main() -> None:
             "range_build_ms": round(st["range_build_ms"], 2),
             "cells": cells,
         }
+    if "batch" in args.groups and len(states) >= 2:
+        # cross-dataset pooled cell (ISSUE 5): all resident sets in one
+        # MultiSetBatchEngine pool, one launch instead of one per dataset
+        with obs.span("realdata.multiset_cross") as sp:
+            try:
+                cross = bench_multiset_cross(states, args.reps)
+            except AssertionError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                print(f"[realdata] multiset_cross failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                cross = {"ERROR": f"{e}"}
+                sp.tag(status="error", error_class=type(e).__name__)
+        if cross:
+            result["cross_dataset"] = {"multiset_pool": cross}
     merge_cpu_baseline(result)
 
     for name, data in result["datasets"].items():
@@ -811,6 +889,12 @@ def main() -> None:
                             if k in v)
             print(f"  {cell:46s} {val:>10} {unit}{extra}{note}",
                   file=sys.stderr)
+    cross = (result.get("cross_dataset") or {}).get("multiset_pool")
+    if cross and "pooled_qps" in cross:
+        print(f"\n### cross-dataset pool ({'+'.join(cross['datasets'])}, "
+              f"Q={cross['q']}): pooled {cross['pooled_qps']} qps vs "
+              f"per-set {cross['per_set_qps']} qps "
+              f"({cross['pooled_vs_per_set_x']}x)", file=sys.stderr)
     print(json.dumps(result))
 
 

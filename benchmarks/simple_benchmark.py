@@ -69,7 +69,10 @@ def bench_dataset(name: str, reps: int) -> None:
         return acc
 
     host_wide_ns = _time(host_wide, max(1, reps // 20))
-    ds = aggregation.DeviceBitmapSet(bitmaps)
+    # layout pinned: the chained probe reads ds.words directly, and the
+    # row must stay the dense rung across rounds regardless of what the
+    # "auto" default would pick for this dataset's shape
+    ds = aggregation.DeviceBitmapSet(bitmaps, layout="dense")
     expected = host_wide().cardinality
     # steady-state device number: the chained program must be long enough
     # to push the dev-tunnel dispatch RTT (~100 ms) residue below the

@@ -157,7 +157,9 @@ def bench_wide_shape(shape: str, kind: str, n: int, keys: int,
     from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
 
     bms = make_wide(shape, kind, n, keys)
-    ds = DeviceBitmapSet(bms)
+    # pinned dense: the chained lanes feed ds.words, which the "auto"
+    # default leaves None when a shape drifts into the counts flip
+    ds = DeviceBitmapSet(bms, layout="dense")
     tag = f"{shape}-{kind}"
     cells[f"{tag}/meta"] = {
         "n": n, "distinct_keys": int(ds.keys.size), "block": ds.block,

@@ -265,6 +265,136 @@ def predict_batch_dispatch_bytes(bucket_sigs: list, kind: str,
             "densify_bytes": densify, "peak_bytes": total}
 
 
+def predict_multiset_dispatch_bytes(bucket_sigs: list, sets: list,
+                                    engine: str,
+                                    pool_rows: int | None = None) -> dict:
+    """Transient device bytes of ONE pooled MultiSetBatchEngine launch —
+    the cross-tenant extension of ``predict_batch_dispatch_bytes``, and
+    the quantity the pooled proactive HBM-budget split compares against
+    ``ROARING_TPU_HBM_BUDGET`` (parallel.multiset).
+
+    ``bucket_sigs`` are the pooled plan's _Bucket.signature tuples;
+    ``sets`` is ``[(kind, n_rows)]`` for every resident set the launch
+    touches (kind "dense" selects rows from its resident image; kind
+    "streams" first rebuilds an n_rows image inside the program).  On
+    top of the single-set model's gather/scratch/heads/outputs:
+
+    - per "streams" set, its in-program densify (n_rows + 1 rows);
+    - the pooled row image the flat gather reads from (``concat_bytes``)
+      — ``pool_rows`` selected rows when the planner compacted the pool
+      (the normal path; proportional to the pool's true work), else the
+      conservative full concatenation of every set's image.
+    """
+    base = predict_batch_dispatch_bytes(bucket_sigs, "dense", 0, engine)
+    densify = sum(dense_rows_bytes(int(n) + 1)
+                  for kind, n in sets if kind == "streams")
+    if pool_rows is not None:
+        concat = dense_rows_bytes(int(pool_rows))
+    else:
+        concat = (dense_rows_bytes(sum(int(n) for _, n in sets))
+                  if len(sets) > 1 else 0)
+    out = dict(base)
+    out["densify_bytes"] = densify
+    out["concat_bytes"] = concat
+    out["peak_bytes"] = (base["gather_bytes"] + base["scratch_bytes"]
+                         + base["heads_bytes"] + base["output_bytes"]
+                         + densify + concat)
+    return out
+
+
+# ------------------------------------------------- adaptive layout default
+#
+# The uscensus2000 cliff (docs/USCENSUS2000_CLIFF.md) is a LAYOUT
+# pathology: ~4,800 mostly-singleton containers inflate 0.03 MB of
+# serialized bytes into a ~39 MB dense image the kernel must stream every
+# op.  The honest recommendation for that shape has been the counts
+# layout since round 5; ``choose_layout`` turns it into the build-time
+# default — DeviceBitmapSet(layout="auto") resolves through it, while an
+# explicit ``layout=`` keeps the old behavior verbatim.
+
+#: "auto" picks counts only for the uscensus2000 shape: mostly-singleton
+#: segments (median <= this) AND a dense image that inflates the
+#: serialized bytes past this factor.  Both must hold — singleton-heavy
+#: sets that are still small stay dense (they query ~2x faster), and
+#: inflation without singleton segments is ordinary bitmap-container
+#: density, which the dense image serves well.
+AUTO_COUNTS_MEDIAN_SEGMENT = 1.0
+AUTO_COUNTS_INFLATION_X = 100.0
+
+
+def _serialized_size_of(b) -> int | None:
+    if isinstance(b, (bytes, bytearray, memoryview)):
+        return len(b)
+    end = getattr(b, "serialized_end", None)
+    if end is not None:      # format.spec.SerializedView (parsed blob)
+        return int(end())
+    fn = getattr(b, "serialized_size_in_bytes", None)
+    if fn is not None:
+        try:
+            return int(fn())
+        except Exception:  # pragma: no cover - exotic source types
+            return None
+    return None
+
+
+def choose_layout(sources) -> dict:
+    """Resolve the adaptive DeviceBitmapSet layout for ``sources`` from
+    host metadata alone (key counts + serialized sizes — nothing is
+    packed or transferred).  Returns a JSON-able report::
+
+        {"layout": "dense"|"counts", "median_segment": float,
+         "inflation_x": float, "dense_bytes": int, "serialized_bytes": int,
+         "why": str}
+
+    The rule is deliberately narrow (see the module constants): only the
+    inflation-heavy mostly-singleton shape flips to counts; anything the
+    heuristic cannot size (no ``serialized_size_in_bytes``) keeps the
+    dense default.
+    """
+    from ..ops import packing
+
+    sources = list(sources)
+    if not sources:
+        return {"layout": "dense", "median_segment": 0.0,
+                "inflation_x": 1.0, "dense_bytes": 0, "serialized_bytes": 0,
+                "why": "empty input: dense default"}
+    # sizing is cheap (header metadata); the key scan below walks every
+    # source, so an unsizeable input exits before paying for it
+    ser_sizes = [_serialized_size_of(s) for s in sources]
+    if any(s is None for s in ser_sizes):
+        return {"layout": "dense", "median_segment": 0.0,
+                "inflation_x": 1.0, "dense_bytes": 0,
+                "serialized_bytes": 0,
+                "why": "unsizeable source: dense default kept"}
+    keys = [packing._keys_of(s) for s in sources]
+    flat = (np.concatenate(keys) if keys else np.empty(0, np.uint16))
+    _, seg_sizes = np.unique(flat, return_counts=True)
+    median = float(np.median(seg_sizes)) if seg_sizes.size else 0.0
+    dense_b = dense_rows_bytes(int(flat.size))
+    ser_b = int(sum(ser_sizes))
+    inflation = dense_b / ser_b if ser_b else 1.0
+    if (median <= AUTO_COUNTS_MEDIAN_SEGMENT
+            and inflation > AUTO_COUNTS_INFLATION_X):
+        layout, why = "counts", (
+            "mostly-singleton segments inflating the dense image "
+            f"{inflation:.0f}x past the serialized bytes (the "
+            "uscensus2000 shape, docs/USCENSUS2000_CLIFF.md): the "
+            "counts layout halves the streamed image")
+    else:
+        layout, why = "dense", "dense image inflation within bounds"
+    rep = {"layout": layout, "median_segment": median,
+           "inflation_x": round(inflation, 1), "dense_bytes": dense_b,
+           "serialized_bytes": ser_b, "why": why}
+    if layout == "dense":
+        # the key scan above already holds the per-segment sizes the
+        # packer's block chooser would recompute: hand the dense-resident
+        # block-4-rung recommendation to DeviceBitmapSet so the auto
+        # build path pays for ONE scan, not two
+        rep["dense_block"] = int(packing.choose_block(seg_sizes,
+                                                      min_block=4))
+    return rep
+
+
 def recommend_device_layout(bitmaps, hbm_budget_bytes: int = 512 << 20) -> dict:
     """Advise DeviceBitmapSet layout from dense blowup AND absolute HBM.
 
@@ -278,20 +408,37 @@ def recommend_device_layout(bitmaps, hbm_budget_bytes: int = 512 << 20) -> dict:
                queries at dataset size.  A capacity tier for sets queried
                rarely, not a fast path (round 3's us-scale figure for this
                rung was a measurement artifact).
-    The decision is a pure budget ladder — with compact queries at ms
+    The decision is a budget ladder — with compact queries at ms
     scale, nothing short of a budget overflow justifies leaving the fast
     rungs, and the dense blowup is reported as context, not used as a
     trigger (the old >= 32x rule dated from when the compact rung was
-    believed to cost 1.2-1.4x per query).
+    believed to cost 1.2-1.4x per query) — with ONE exception: the
+    inflation-heavy mostly-singleton shape that :func:`choose_layout`
+    (the ``DeviceBitmapSet(layout="auto")`` build default) flips to
+    counts is advised counts here too while its footprint fits the
+    budget, so the two advisers agree on the shape the adaptive default
+    exists for (docs/USCENSUS2000_CLIFF.md); past the budget the ladder
+    still falls to compact like any other overflow.
     """
-    dense_b = 0
-    ser_b = 0
-    for b in bitmaps:
-        dense_b += hbm_footprint_bytes(b)
-        ser_b += b.serialized_size_in_bytes()
+    # one metadata pass: choose_layout already sums the dense rows
+    # (hbm_footprint_bytes per source) and serialized sizes this ladder
+    # needs, alongside its inflation-shape verdict
+    auto = choose_layout(bitmaps)
+    dense_b = auto["dense_bytes"]
+    ser_b = auto["serialized_bytes"]
     ratio = dense_b / ser_b if ser_b else 1.0
     counts_b = dense_b // 2 + ser_b  # counts tensor + resident streams
-    if dense_b <= hbm_budget_bytes:
+    if auto["layout"] == "counts" and counts_b <= hbm_budget_bytes:
+        layout = "counts"
+        why = ("inflation-heavy mostly-singleton shape: the adaptive "
+               "build default (choose_layout) resolves counts — "
+               + auto["why"])
+    elif auto["layout"] == "counts":
+        layout = "compact"
+        why = ("inflation-heavy mostly-singleton shape whose counts "
+               "footprint still exceeds the budget: keep only the "
+               "streams (~serialized size) — capacity tier")
+    elif dense_b <= hbm_budget_bytes:
         layout = "dense"
         why = "dense image fits the budget — fastest repeated queries"
     elif counts_b < dense_b and counts_b <= hbm_budget_bytes:

@@ -184,6 +184,9 @@ def dispatch_memory_cell(mem: dict | None) -> dict | None:
         return None
     cell = {"q": mem.get("q"), "engine": mem.get("engine"),
             "predicted_mb": round(mem["predicted_bytes"] / 1e6, 2)}
+    if "sets" in mem:
+        # pooled multi-set dispatches carry the tenant count too
+        cell["sets"] = mem["sets"]
     if "measured_peak_bytes" in mem:
         cell["measured_mb"] = round(mem["measured_peak_bytes"] / 1e6, 2)
         cell["residual_x"] = mem.get("residual_x")

@@ -1,6 +1,8 @@
-from . import aggregation, batch_engine, sharding
+from . import aggregation, batch_engine, multiset, sharding
 from .aggregation import DeviceBitmapSet
 from .batch_engine import BatchEngine, BatchQuery, BatchResult
+from .multiset import BatchGroup, MultiSetBatchEngine
 
-__all__ = ["aggregation", "batch_engine", "sharding", "DeviceBitmapSet",
-           "BatchEngine", "BatchQuery", "BatchResult"]
+__all__ = ["aggregation", "batch_engine", "multiset", "sharding",
+           "DeviceBitmapSet", "BatchEngine", "BatchQuery", "BatchResult",
+           "BatchGroup", "MultiSetBatchEngine"]

@@ -629,8 +629,13 @@ class DeviceBitmapSet:
     Container objects, and the dense image is built on device.
 
     layout (three rungs of an HBM-residency / query-cost ladder; measured
-    census1881 wide-OR steady-state marginals on v5e in parentheses):
-      - "dense" (default): HBM holds the dense u32[rows, 2048] image —
+    census1881 wide-OR steady-state marginals on v5e in parentheses).
+    The default is "auto": ``insights.choose_layout`` picks counts for
+    inflation-heavy mostly-singleton sets (median segment <= 1 AND dense
+    image > 100x the serialized bytes — the uscensus2000 shape,
+    docs/USCENSUS2000_CLIFF.md) and dense for everything else; passing an
+    explicit ``layout=`` keeps the pre-adaptive behavior verbatim:
+      - "dense": HBM holds the dense u32[rows, 2048] image —
         fastest repeated queries (~16 us), rows x 8 KB resident.
       - "counts": HBM holds per-group 4-bit occurrence counts (rows x
         4 KB, half the dense image) PLUS the compact streams (kept for the
@@ -655,7 +660,31 @@ class DeviceBitmapSet:
     """
 
     def __init__(self, bitmaps: list, block: int | None = None,
-                 layout: str = "dense"):
+                 layout: str = "auto"):
+        if layout == "auto":
+            # adaptive default (insights.choose_layout): inflation-heavy
+            # mostly-singleton sets (the uscensus2000 shape) build counts-
+            # resident, everything else keeps the dense fast rung.  An
+            # explicit layout= keeps the old behavior verbatim, and an
+            # explicit block= pins dense too — block tuning targets the
+            # dense image (block-4 rung), and auto flipping it to counts
+            # would either reject the block or discard the caller's
+            # intent.  The heuristic walks SerializedViews of byte-backed
+            # sources, but the ORIGINAL sources go to the packer below —
+            # pure-bytes inputs must keep the native C++ ingest fast path
+            # (ops/packing gate), which views would bypass.
+            if block is not None:
+                layout = "dense"
+            else:
+                rep = insights.choose_layout(
+                    [v if (v := packing._as_view(b)) is not None else b
+                     for b in bitmaps])
+                layout = rep["layout"]
+                if layout == "dense" and rep.get("dense_block"):
+                    # reuse the heuristic's key scan: its block-4-rung
+                    # recommendation spares the packer an identical
+                    # choose_block pass over every source's keys
+                    block = rep["dense_block"]
         if layout not in ("dense", "compact", "counts"):
             raise ValueError(f"unknown layout {layout!r}")
         if (layout in ("compact", "counts") and block is not None

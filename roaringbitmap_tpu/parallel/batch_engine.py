@@ -118,8 +118,34 @@ class BatchResult:
     bitmap: RoaringBitmap | None = None
 
 
+class _DeviceOperandCache:
+    """host -> device operand upload discipline of _Bucket: the ``host``
+    NumPy dict uploads lazily into ``arrays`` on first use; ``fresh=True``
+    uploads new uncached buffers (required before a donating dispatch —
+    donation invalidates the cached arrays for every later launch).
+    ``fresh=True`` therefore needs ``host`` kept alive: multiset pool
+    plans keep it, but ``BatchEngine._plan_bucket`` drops it after the
+    cached upload (single-set dispatches never donate), so its buckets
+    are sync-only.  (The multiset _OpGroup implements its own
+    engine-keyed variant of this discipline — see its
+    ``device_arrays``.)"""
+
+    def device_arrays(self, fresh: bool = False) -> dict:
+        if fresh:
+            if self.host is None:
+                raise RuntimeError(
+                    "fresh=True needs the host operand dict, which this "
+                    "plan dropped after its cached upload (BatchEngine "
+                    "buckets are sync-only; donating dispatches must "
+                    "plan via parallel.multiset)")
+            return {k: jnp.asarray(v) for k, v in self.host.items()}
+        if self.arrays is None:
+            self.arrays = {k: jnp.asarray(v) for k, v in self.host.items()}
+        return self.arrays
+
+
 @dataclasses.dataclass
-class _Bucket:
+class _Bucket(_DeviceOperandCache):
     """One shape-specialized slice of a batch plan."""
 
     op: str
@@ -130,12 +156,124 @@ class _Bucket:
     k_pad: int            # padded key slots per query (pow2)
     n_steps: int
     needs_words: bool
-    arrays: dict          # device arrays, see _plan_bucket
+    host: dict            # NumPy operands — the donate-safe source the
+    #                       pipelined dispatcher re-uploads fresh scratch
+    #                       from (parallel.multiset; donated buffers die with
+    #                       their launch, cached device arrays must not)
+    arrays: dict = None   # device twins, uploaded lazily on first dispatch
+    #                       (the multiset planner remaps host gathers first,
+    #                       and budget-probed plans may never dispatch)
 
     @property
     def signature(self):
         return (self.op, self.q, self.r_pad, self.k_pad, self.n_steps,
                 self.needs_words)
+
+
+def plan_bucket(op: str, items) -> _Bucket:
+    """Build one shape-specialized bucket from ``items``: [(qid, query,
+    gather_rows, seg_local, keys_q, key_keep, head_rows)] sharing
+    (op, operand-count rung).  Row indices are whatever space the caller
+    planned in — set-local for BatchEngine, pooled (offset-remapped) for
+    MultiSetBatchEngine — the bucket just records them for the gather."""
+    qn = packing.next_pow2(len(items))
+    r_pad = packing.next_pow2(max(1, max(it[2].size for it in items)))
+    k_pad = packing.next_pow2(max(1, max(it[4].size for it in items)))
+    gather = np.zeros((qn, r_pad), np.int32)
+    valid = np.zeros((qn, r_pad), bool)
+    seg_local = np.full((qn, r_pad), k_pad, np.int32)
+    heads_ok = np.zeros((qn, k_pad), bool)
+    key_keep = np.ones((qn, k_pad), bool) if op == "and" else None
+    head_gather = (np.zeros((qn, k_pad), np.int32)
+                   if op == "andnot" else None)
+    head_ok = np.zeros((qn, k_pad), bool) if op == "andnot" else None
+    max_group = 1
+    for i, (qid, q, rows, segs, keys_q, keep, hrows) in enumerate(items):
+        gather[i, :rows.size] = rows
+        valid[i, :rows.size] = True
+        seg_local[i, :rows.size] = segs
+        present = np.unique(segs)
+        heads_ok[i, present] = True
+        if segs.size:
+            max_group = max(max_group,
+                            int(np.bincount(segs).max()))
+        if op == "and":
+            key_keep[i, :keep.size] = keep
+            key_keep[i, keep.size:] = False
+        if op == "andnot":
+            head_gather[i, :hrows.size] = hrows
+            head_ok[i, :hrows.size] = True
+    flat_seg = (seg_local
+                + (k_pad + 1) * np.arange(qn, dtype=np.int32)[:, None]
+                ).reshape(-1)
+    flat_head = np.searchsorted(
+        flat_seg, np.arange(qn * (k_pad + 1), dtype=np.int64)
+    ).astype(np.int32)
+    # per-query head index for the vmapped cross-check engine
+    head_local = np.empty((qn, k_pad + 1), np.int32)
+    for i in range(qn):
+        head_local[i] = np.searchsorted(seg_local[i],
+                                        np.arange(k_pad + 1))
+    host = {
+        "gather": gather, "valid": valid, "seg_local": seg_local,
+        "flat_seg": flat_seg, "flat_head": flat_head,
+        "head_local": head_local, "heads_ok": heads_ok,
+    }
+    if key_keep is not None:
+        host["key_keep"] = key_keep
+    if head_gather is not None:
+        host["head_gather"] = head_gather
+        host["head_ok"] = head_ok
+    return _Bucket(
+        op=op, qids=[it[0] for it in items],
+        keys=[it[4] for it in items], q=qn, r_pad=r_pad, k_pad=k_pad,
+        n_steps=dense.n_steps_for(max_group),
+        needs_words=any(it[1].form == "bitmap" for it in items),
+        host=host)
+
+
+def bucket_body(words, b_sig, arrays, eng: str):
+    """Traced body for one bucket: gather -> flat segmented reduce ->
+    per-op post pass.  Returns (heads or None, cards).  ``words`` is the
+    row image the gather indexes — a single resident set's image for
+    BatchEngine, the pooled concatenation for MultiSetBatchEngine."""
+    op, qn, r_pad, k_pad, n_steps, needs_words = b_sig
+    red = _RED_OP[op]
+    g = words[arrays["gather"].reshape(-1)]
+    ident = jnp.uint32(0xFFFFFFFF if op == "and" else 0)
+    g = jnp.where(arrays["valid"].reshape(-1, 1), g, ident)
+    nseg = qn * (k_pad + 1)
+    if eng == "pallas":
+        heads, _ = kernels.segmented_reduce_pallas(
+            red, g, arrays["flat_seg"], nseg)
+        heads = heads.reshape(qn, k_pad + 1, WORDS32)
+    elif eng == "xla-vmap":
+        g3 = g.reshape(qn, r_pad, WORDS32)
+        heads, _ = jax.vmap(
+            lambda w, s, h: dense.segmented_reduce(red, w, s, h,
+                                                   n_steps)
+        )(g3, arrays["seg_local"], arrays["head_local"])
+    else:
+        red_rows = dense.doubling_pass(dense.OPS[red], g,
+                                       arrays["flat_seg"], n_steps)
+        safe = jnp.minimum(arrays["flat_head"], g.shape[0] - 1)
+        heads = red_rows[safe].reshape(qn, k_pad + 1, WORDS32)
+    heads = heads[:, :k_pad]
+    # zero key slots with no contributing rows (untouched kernel output
+    # rows / clamped doubling heads are undefined, and an empty rest-
+    # union must read as 0)
+    heads = jnp.where(arrays["heads_ok"][:, :, None], heads,
+                      jnp.uint32(0))
+    if op == "and":
+        heads = jnp.where(arrays["key_keep"][:, :, None], heads,
+                          jnp.uint32(0))
+    elif op == "andnot":
+        hg = words[arrays["head_gather"].reshape(-1)].reshape(
+            qn, k_pad, WORDS32)
+        hg = jnp.where(arrays["head_ok"][:, :, None], hg, jnp.uint32(0))
+        heads = hg & ~heads
+    cards = dense.popcount(heads)
+    return (heads if needs_words else None), cards
 
 
 class BatchEngine:
@@ -166,7 +304,7 @@ class BatchEngine:
         self.last_dispatch_memory: dict | None = None
 
     @classmethod
-    def from_bitmaps(cls, bitmaps: list, layout: str = "dense",
+    def from_bitmaps(cls, bitmaps: list, layout: str = "auto",
                      **kw) -> "BatchEngine":
         return cls(DeviceBitmapSet(bitmaps, layout=layout, **kw))
 
@@ -204,65 +342,15 @@ class BatchEngine:
 
     def _plan_bucket(self, op: str, items) -> _Bucket:
         """items: [(qid, query, gather, seg_local, keys_q, key_keep,
-        head_rows)] sharing (op, operand-count rung)."""
-        qn = packing.next_pow2(len(items))
-        r_pad = packing.next_pow2(max(1, max(it[2].size for it in items)))
-        k_pad = packing.next_pow2(max(1, max(it[4].size for it in items)))
-        gather = np.zeros((qn, r_pad), np.int32)
-        valid = np.zeros((qn, r_pad), bool)
-        seg_local = np.full((qn, r_pad), k_pad, np.int32)
-        heads_ok = np.zeros((qn, k_pad), bool)
-        key_keep = np.ones((qn, k_pad), bool) if op == "and" else None
-        head_gather = (np.zeros((qn, k_pad), np.int32)
-                       if op == "andnot" else None)
-        head_ok = np.zeros((qn, k_pad), bool) if op == "andnot" else None
-        max_group = 1
-        for i, (qid, q, rows, segs, keys_q, keep, hrows) in enumerate(items):
-            gather[i, :rows.size] = rows
-            valid[i, :rows.size] = True
-            seg_local[i, :rows.size] = segs
-            present = np.unique(segs)
-            heads_ok[i, present] = True
-            if segs.size:
-                max_group = max(max_group,
-                                int(np.bincount(segs).max()))
-            if op == "and":
-                key_keep[i, :keep.size] = keep
-                key_keep[i, keep.size:] = False
-            if op == "andnot":
-                head_gather[i, :hrows.size] = hrows
-                head_ok[i, :hrows.size] = True
-        flat_seg = (seg_local
-                    + (k_pad + 1) * np.arange(qn, dtype=np.int32)[:, None]
-                    ).reshape(-1)
-        flat_head = np.searchsorted(
-            flat_seg, np.arange(qn * (k_pad + 1), dtype=np.int64)
-        ).astype(np.int32)
-        # per-query head index for the vmapped cross-check engine
-        head_local = np.empty((qn, k_pad + 1), np.int32)
-        for i in range(qn):
-            head_local[i] = np.searchsorted(seg_local[i],
-                                            np.arange(k_pad + 1))
-        arrays = {
-            "gather": jnp.asarray(gather),
-            "valid": jnp.asarray(valid),
-            "seg_local": jnp.asarray(seg_local),
-            "flat_seg": jnp.asarray(flat_seg),
-            "flat_head": jnp.asarray(flat_head),
-            "head_local": jnp.asarray(head_local),
-            "heads_ok": jnp.asarray(heads_ok),
-        }
-        if key_keep is not None:
-            arrays["key_keep"] = jnp.asarray(key_keep)
-        if head_gather is not None:
-            arrays["head_gather"] = jnp.asarray(head_gather)
-            arrays["head_ok"] = jnp.asarray(head_ok)
-        return _Bucket(
-            op=op, qids=[it[0] for it in items],
-            keys=[it[4] for it in items], q=qn, r_pad=r_pad, k_pad=k_pad,
-            n_steps=dense.n_steps_for(max_group),
-            needs_words=any(it[1].form == "bitmap" for it in items),
-            arrays=arrays)
+        head_rows)] sharing (op, operand-count rung) — the module-level
+        ``plan_bucket`` shared with parallel.multiset.  Single-set plans
+        dispatch straight from the cache (no remap, no donation), so the
+        device arrays upload here and the NumPy twins are dropped rather
+        than held for the plan's LRU lifetime."""
+        b = plan_bucket(op, items)
+        b.device_arrays()
+        b.host = None
+        return b
 
     def plan(self, queries) -> list:
         """Bucketed plan: group by (op, pow2 operand count), pad shapes.
@@ -311,45 +399,9 @@ class BatchEngine:
             streams, chunks if eng == "pallas" else None, eng)
 
     def _bucket_body(self, words, b_sig, arrays, eng: str):
-        """Traced body for one bucket: gather -> flat segmented reduce ->
-        per-op post pass.  Returns (heads or None, cards)."""
-        op, qn, r_pad, k_pad, n_steps, needs_words = b_sig
-        red = _RED_OP[op]
-        g = words[arrays["gather"].reshape(-1)]
-        ident = jnp.uint32(0xFFFFFFFF if op == "and" else 0)
-        g = jnp.where(arrays["valid"].reshape(-1, 1), g, ident)
-        nseg = qn * (k_pad + 1)
-        if eng == "pallas":
-            heads, _ = kernels.segmented_reduce_pallas(
-                red, g, arrays["flat_seg"], nseg)
-            heads = heads.reshape(qn, k_pad + 1, WORDS32)
-        elif eng == "xla-vmap":
-            g3 = g.reshape(qn, r_pad, WORDS32)
-            heads, _ = jax.vmap(
-                lambda w, s, h: dense.segmented_reduce(red, w, s, h,
-                                                       n_steps)
-            )(g3, arrays["seg_local"], arrays["head_local"])
-        else:
-            red_rows = dense.doubling_pass(dense.OPS[red], g,
-                                           arrays["flat_seg"], n_steps)
-            safe = jnp.minimum(arrays["flat_head"], g.shape[0] - 1)
-            heads = red_rows[safe].reshape(qn, k_pad + 1, WORDS32)
-        heads = heads[:, :k_pad]
-        # zero key slots with no contributing rows (untouched kernel output
-        # rows / clamped doubling heads are undefined, and an empty rest-
-        # union must read as 0)
-        heads = jnp.where(arrays["heads_ok"][:, :, None], heads,
-                          jnp.uint32(0))
-        if op == "and":
-            heads = jnp.where(arrays["key_keep"][:, :, None], heads,
-                              jnp.uint32(0))
-        elif op == "andnot":
-            hg = words[arrays["head_gather"].reshape(-1)].reshape(
-                qn, k_pad, WORDS32)
-            hg = jnp.where(arrays["head_ok"][:, :, None], hg, jnp.uint32(0))
-            heads = hg & ~heads
-        cards = dense.popcount(heads)
-        return (heads if needs_words else None), cards
+        """Traced body for one bucket — the module-level ``bucket_body``
+        shared with parallel.multiset."""
+        return bucket_body(words, b_sig, arrays, eng)
 
     def _program(self, plan, eng: str):
         """AOT-compiled batch program for this plan's signature: ONE call =
@@ -383,7 +435,7 @@ class BatchEngine:
                         for s, a in zip(b_sigs, barrays)]
 
             compiled = jax.jit(run).lower(
-                src, [b.arrays for b in plan]).compile()
+                src, [b.device_arrays() for b in plan]).compile()
             predicted = insights.predict_batch_dispatch_bytes(
                 b_sigs, kind, self._ds._n_rows, eng)
             measured = obs_memory.compiled_memory(compiled)
@@ -526,7 +578,9 @@ class BatchEngine:
             # below is free (computed once at program compile)
             stats0 = (obs_memory.backend_memory_stats()
                       if obs_trace.enabled() else None)
-            outs = (compiled if jit else run)(src, [b.arrays for b in plan])
+            outs = (compiled if jit else run)(src,
+                                              [b.device_arrays()
+                                               for b in plan])
             # sync before readback: the span's wall time is host work +
             # queueing, sync_ms is the device-side remainder
             outs = sp.sync(outs)
@@ -779,7 +833,7 @@ class BatchEngine:
         eng = self._bucket_engine(plan, engine)
         src, kind = self._resident_src()
         b_sigs = [b.signature for b in plan]
-        barrays = [b.arrays for b in plan]
+        barrays = [b.device_arrays() for b in plan]
 
         def run(src_in, arrs):
             def body(i, total):

@@ -1,0 +1,1095 @@
+"""Cross-tenant multi-set batching: Q queries over S resident sets, few
+device launches, pipelined dispatch.
+
+PR 1's ``BatchEngine`` amortized the device-dispatch floor across Q
+queries — but only within ONE resident ``DeviceBitmapSet``.  A serving
+front-end holds many tenants' sets resident at once and, per tick, pays
+one launch per tenant even when each tenant contributes a handful of
+queries; BENCH_r05's dispatch-floor numbers (35-81 us per launch against
+~10 us of work) make that the dominant cost of small-Q lanes.  This
+module repeats Roaring's own packing move one level up: just as the
+container layout packs heterogeneous containers behind one uniform
+algebra so aggregation amortizes (Chambi et al.; Lemire et al.), the
+pool planner packs heterogeneous *tenants* behind one device launch.
+
+Execution model
+---------------
+A pool is a list of :class:`BatchGroup` — each group Q_g mixed-op
+:class:`~.batch_engine.BatchQuery` requests addressed to one resident
+set.  The planner:
+
+1. plans every query against its own set (the per-set ``BatchEngine``
+   row selection, unchanged);
+2. **remaps row indices by per-set offsets** into one pooled row space —
+   the concatenation of the referenced sets' resident images — so one
+   flat gather feeds every tenant;
+3. buckets the POOLED queries by (op, pow2 operand rung) and pads
+   shapes over the pooled row-count distribution
+   (``batch_engine.plan_bucket`` — the same bucketing policy, applied
+   across tenants, so two tenants' lone OR queries share one padded
+   bucket instead of two launches);
+4. runs all buckets in ONE jitted program: per-set image rebuild (for
+   stream-resident tenants) + concat + the flat segmented reduce
+   (``batch_engine.bucket_body``).
+
+Pipelined (double-buffered) dispatch
+------------------------------------
+When a pool needs multiple launches — the proactive HBM-budget split, or
+``execute_pipelined`` streaming several ticks — launches flow through a
+depth-``GuardPolicy.pipeline_depth`` (default 2) window: launch k+1 is
+planned/packed/bucketized on the host *while launch k runs on device*
+(JAX async dispatch — nothing blocks until readback), and launch k-1's
+readback is drained as the window slides.  Host planning time spent
+while at least one launch was in flight is **hidden** behind device
+compute; the ``multiset.pipeline`` span reports
+``host_ms`` / ``host_overlapped_ms`` / ``overlap_ratio`` / ``drain_ms``
+and the ratio also lands on the
+``rb_multiset_pipeline_overlap_ratio`` gauge.  On backends that support
+buffer donation (TPU/GPU) the per-launch bucket scratch is uploaded
+fresh and *donated*, so the double buffer reuses the dead launch's
+arena instead of holding both generations live.
+
+Guard integration (docs/ROBUSTNESS.md): every launch rides
+``guard.run_with_fallback`` down the same ``pallas -> xla -> xla-vmap ->
+sequential`` ladder, so demotion is per-launch; ``ResourceExhausted``
+halves the launch's pooled queries (reactive split,
+``rb_multiset_oom_splits_total``); the predicted pooled footprint
+(``insights.predict_multiset_dispatch_bytes`` — gather + scratch +
+heads + outputs + per-tenant densify + the pooled concat) is checked
+against ``ROARING_TPU_HBM_BUDGET`` BEFORE dispatch and halves the pool
+proactively (``rb_multiset_proactive_splits_total``); a fault that only
+surfaces at drain time re-runs that launch synchronously down the
+ladder (``drain_retry``).  Every rung is bit-exact, so degradation and
+splitting change throughput only.
+
+An ``execute()`` pool referencing a single set routes through that
+set's ``BatchEngine.execute`` verbatim — zero pooled planning, zero
+extra device buffers (regression-pinned against the HBM ledger in
+tests/test_multiset.py).  ``execute_pipelined`` always builds pooled
+launches: a streamed single-tenant tick trades that zero-copy route for
+cross-tick overlap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..insights import analysis as insights
+from ..obs import memory as obs_memory
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..ops import kernels, packing
+from ..runtime import errors, faults, guard
+from ..runtime.cache import LRUCache
+from ..ops import dense
+from .aggregation import DeviceBitmapSet, _engine
+from .batch_engine import (ENGINE_LADDER, PLAN_CACHE_MAX, PROGRAM_CACHE_MAX,
+                           WORDS32, _RED_OP, BatchEngine, BatchQuery,
+                           BatchResult, bucket_body, plan_bucket)
+
+#: the guard/trace/metric site of every pooled dispatch
+SITE = "multiset"
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchGroup:
+    """Queries addressed to ONE resident set (tenant) of the pool.
+
+    ``set_id`` indexes the engine's resident-set list; ``queries`` are
+    ordinary :class:`~.batch_engine.BatchQuery` requests against that
+    set's operand space.
+    """
+
+    set_id: int
+    queries: tuple
+
+    def __init__(self, set_id: int, queries):
+        object.__setattr__(self, "set_id", int(set_id))
+        object.__setattr__(self, "queries", tuple(queries))
+
+
+@dataclasses.dataclass
+class _OpGroup:
+    """Same-op buckets merged for EXECUTION into one flat segmented
+    reduce (a "superbucket").  Rung bucketing still governs the plan's
+    shapes, padding, and cache signatures; the merge exists because a
+    pooled launch would otherwise pay one reduce chain per (op, rung)
+    cell — at S tenants x 4 ops x several rungs, fixed per-kernel
+    overhead starts to rival the dispatch floor the pool is amortizing.
+    Merging is exact: segment ids are globally offset per member bucket,
+    so the flat reduce never mixes two buckets' segments, and the
+    per-key post passes (presence mask, workShyAnd keep, andnot head
+    pass, popcount) act on the flat head axis with plan-time masks."""
+
+    op: str
+    bucket_idx: list      # indices into _PoolPlan.buckets, merge order
+    seg_offs: list        # per member bucket: its head-slot base in nseg
+    nseg: int             # total head slots (sum of q * (k_pad + 1))
+    n_rows: int           # total flat gather rows (sum of q * r_pad)
+    n_steps: int          # max doubling depth over members
+    needs_words: bool
+    host: dict            # merged NumPy operands
+    arrays: dict = None   # device twins, uploaded lazily on first dispatch
+    #                       (budget-probed plans for over-budget pools are
+    #                       halved away without ever dispatching)
+    #: per member bucket (merge order): (q, r_pad) — when every member
+    #: has k_pad == 1 (one key segment per query, the serving-front-end
+    #: shape) the reduce is REGULAR: each query's single segment is
+    #: exactly its r_pad padded gather rows, so the op body replaces the
+    #: doubling-pass segmented scan (n_steps full passes + a head
+    #: gather) with one lax.reduce over the row axis per member rung
+    member_shapes: tuple = ()
+    regular: bool = False
+
+    @property
+    def sig(self):
+        return (self.op, self.nseg, self.n_rows, self.n_steps,
+                self.needs_words,
+                self.member_shapes if self.regular else None)
+
+    def device_arrays(self, fresh: bool = False, keys=None) -> dict:
+        """Unlike a plain bucket, a group's upload set depends on the
+        resolved engine (``_op_group_keys``), so cached twins key by the
+        selected tuple — an engine demotion mid-plan gets its own subset
+        instead of another engine's mismatched pytree."""
+        sel = tuple(keys) if keys is not None else tuple(self.host)
+        if fresh:
+            return {k: jnp.asarray(self.host[k]) for k in sel}
+        if self.arrays is None:
+            self.arrays = {}
+        got = self.arrays.get(sel)
+        if got is None:
+            got = self.arrays[sel] = {k: jnp.asarray(self.host[k])
+                                      for k in sel}
+        return got
+
+
+@dataclasses.dataclass
+class _PoolPlan:
+    """One pooled batch plan: shape buckets over a COMPACTED pooled row
+    space.  Rather than concatenating whole resident images (whose
+    round_blocks padding would dominate the launch on small pools), the
+    planner computes the set of rows the pool actually references,
+    selects them per set (``row_sel[sid]``, set-local indices), and
+    remaps every bucket gather into that compact pool — the program's
+    transient image is ``n_pool_rows`` rows, proportional to the pool's
+    true work, not to the tenants' resident padding.  ``op_groups`` are
+    the per-op execution superbuckets (the xla-vmap cross-check engine
+    runs the unmerged per-bucket path instead, proving the merge
+    equivalent)."""
+
+    buckets: list
+    op_groups: list
+    sids: tuple
+    row_sel: dict         # sid -> i32 HOST array of set-local rows; the
+    #                       device twins upload lazily (row_sel_dev) so
+    #                       budget-probe plans that are halved away never
+    #                       touch the device
+    n_pool_rows: int      # total selected rows (the pooled image height)
+    #: per-bucket readback constants (operand counts + live-key masks),
+    #: computed once per plan — the readback loop runs per dispatch
+    rb_meta: dict = dataclasses.field(default_factory=dict)
+    _row_sel_dev: dict = dataclasses.field(default_factory=dict)
+
+    def row_sel_dev(self, sid: int):
+        dev = self._row_sel_dev.get(sid)
+        if dev is None:
+            dev = self._row_sel_dev[sid] = jnp.asarray(self.row_sel[sid])
+        return dev
+
+    @property
+    def signature(self):
+        return (self.sids,
+                tuple(int(self.row_sel[s].shape[0]) for s in self.sids),
+                tuple(b.signature for b in self.buckets))
+
+
+def _merge_op_groups(buckets) -> list:
+    """Build the per-op execution superbuckets from remapped plan
+    buckets (see _OpGroup)."""
+    by_op: dict = {}
+    for bi, b in enumerate(buckets):
+        by_op.setdefault(b.op, []).append((bi, b))
+    groups = []
+    for op in sorted(by_op):
+        members = by_op[op]
+        row_off = seg_off = 0
+        seg_offs: list = []
+        parts: dict = {k: [] for k in ("gather", "valid", "flat_seg",
+                                       "flat_head", "mask_ok")}
+        if op == "andnot":
+            parts["head_gather"] = []
+            parts["head_ok"] = []
+        n_steps = 1
+        regular = all(b.k_pad == 1 for _, b in members)
+        live: dict = {k: [] for k in (("mask_live", "head_gather_live",
+                                       "head_ok_live") if regular else ())}
+        for _bi, b in members:
+            qn, k_pad = b.q, b.k_pad
+            seg_offs.append(seg_off)
+            parts["gather"].append(b.host["gather"].reshape(-1))
+            parts["valid"].append(b.host["valid"].reshape(-1))
+            parts["flat_seg"].append(b.host["flat_seg"] + seg_off)
+            parts["flat_head"].append(b.host["flat_head"] + row_off)
+            # per-key masks, extended into the (k_pad + 1) padded slot
+            # space the flat head axis uses (slot k_pad is always dead)
+            mask = np.zeros((qn, k_pad + 1), bool)
+            mask[:, :k_pad] = (b.host["heads_ok"] & b.host["key_keep"]
+                               if op == "and" else b.host["heads_ok"])
+            parts["mask_ok"].append(mask.reshape(-1))
+            if op == "andnot":
+                hg = np.zeros((qn, k_pad + 1), np.int32)
+                hg[:, :k_pad] = b.host["head_gather"]
+                ho = np.zeros((qn, k_pad + 1), bool)
+                ho[:, :k_pad] = b.host["head_ok"]
+                parts["head_gather"].append(hg.reshape(-1))
+                parts["head_ok"].append(ho.reshape(-1))
+            if regular:
+                # live-layout twins for the regular fast path: one slot
+                # per query (k_pad == 1), no dead pad slots
+                live["mask_live"].append(mask[:, 0])
+                if op == "andnot":
+                    live["head_gather_live"].append(
+                        b.host["head_gather"][:, 0])
+                    live["head_ok_live"].append(b.host["head_ok"][:, 0])
+            row_off += qn * b.r_pad
+            seg_off += qn * (k_pad + 1)
+            n_steps = max(n_steps, b.n_steps)
+        host = {k: np.concatenate(v) for k, v in parts.items()}
+        host.update({k: np.concatenate(v) for k, v in live.items()
+                     if v})
+        groups.append(_OpGroup(
+            op=op, bucket_idx=[bi for bi, _ in members],
+            seg_offs=seg_offs, nseg=seg_off, n_rows=row_off,
+            n_steps=n_steps,
+            needs_words=any(b.needs_words for _, b in members),
+            host=host,
+            member_shapes=tuple((b.q, b.r_pad) for _, b in members),
+            regular=regular))
+    return groups
+
+
+def _op_group_keys(g: _OpGroup, eng: str) -> tuple:
+    """The operand keys ``_op_body`` actually reads for ``(eng, g)`` —
+    the upload set of per-launch (donating) dispatches.  A regular group
+    carries BOTH the padded flat-head operands (pallas / unmerged paths)
+    and their live-layout twins (the regular fast path); shipping the
+    unused half on every steady-state launch would roughly double the
+    host->device traffic the pipeline is trying to hide."""
+    if eng == "pallas" or not g.regular:
+        keys = ("gather", "valid", "flat_seg", "mask_ok")
+        if eng != "pallas":
+            keys += ("flat_head",)
+        if g.op == "andnot":
+            keys += ("head_gather", "head_ok")
+        return keys
+    keys = ("gather", "valid", "mask_live")
+    if g.op == "andnot":
+        keys += ("head_gather_live", "head_ok_live")
+    return keys
+
+
+def _fold_rows(fn, blk):
+    """Tree-reduce u32[q, r_pad, W] over axis 1 by halving — log2(r_pad)
+    elementwise ops that XLA vectorizes on every backend (lax.reduce
+    with a custom bitwise computation lowers to scalar loops on CPU)."""
+    while blk.shape[1] > 1:
+        half = blk.shape[1] // 2
+        blk = fn(blk[:, :half], blk[:, half:])
+    return blk[:, 0]
+
+
+def _op_body(words, g_sig, arrays, eng: str):
+    """Traced body for one op superbucket: ONE gather + ONE flat
+    segmented reduce for every same-op bucket of the pool, post passes
+    on the flat head axis.  Returns (heads_flat or None, cards_flat)."""
+    op, nseg, _n_rows, n_steps, needs_words, reg_shapes = g_sig
+    red = _RED_OP[op]
+    g = words[arrays["gather"]]
+    ident = jnp.uint32(0xFFFFFFFF if op == "and" else 0)
+    g = jnp.where(arrays["valid"][:, None], g, ident)
+    if eng == "pallas":
+        heads, _ = kernels.segmented_reduce_pallas(
+            red, g, arrays["flat_seg"], nseg)
+    elif reg_shapes is not None:
+        # regular fast path (_OpGroup.regular): every member query's one
+        # key segment is exactly its r_pad padded gather rows, so the
+        # per-segment reduction is a halving fold per member rung — no
+        # doubling passes, no head gather — and the outputs stay in the
+        # LIVE layout (one slot per query, no dead pad slots), halving
+        # every post pass.  _bucket_outputs knows this layout.
+        parts, row0 = [], 0
+        for qn, r_pad in reg_shapes:
+            blk = g[row0:row0 + qn * r_pad].reshape(qn, r_pad, -1)
+            parts.append(_fold_rows(dense.OPS[red], blk))
+            row0 += qn * r_pad
+        heads = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        heads = jnp.where(arrays["mask_live"][:, None], heads,
+                          jnp.uint32(0))
+        if op == "andnot":
+            hg = words[arrays["head_gather_live"]]
+            hg = jnp.where(arrays["head_ok_live"][:, None], hg,
+                           jnp.uint32(0))
+            heads = hg & ~heads
+        cards = dense.popcount(heads)
+        return (heads if needs_words else None), cards
+    else:
+        red_rows = dense.doubling_pass(dense.OPS[red], g,
+                                       arrays["flat_seg"], n_steps)
+        safe = jnp.minimum(arrays["flat_head"], g.shape[0] - 1)
+        heads = red_rows[safe]
+    heads = jnp.where(arrays["mask_ok"][:, None], heads, jnp.uint32(0))
+    if op == "andnot":
+        hg = words[arrays["head_gather"]]
+        hg = jnp.where(arrays["head_ok"][:, None], hg, jnp.uint32(0))
+        heads = hg & ~heads
+    cards = dense.popcount(heads)
+    return (heads if needs_words else None), cards
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """A dispatched-but-undrained launch of the pipelined dispatcher."""
+
+    plan: _PoolPlan
+    outs: list
+    queries: tuple
+    eng: str
+    inject: bool
+
+
+def _donation_supported() -> bool:
+    """Buffer donation is a TPU/GPU capability; the CPU backend ignores
+    it with a warning per compile, so the double buffer only requests it
+    where it does something."""
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+class MultiSetBatchEngine:
+    """Plan + execute mixed-op query pools over S resident sets.
+
+    ``sets`` may mix ``DeviceBitmapSet`` instances and already-built
+    ``BatchEngine`` instances (the latter are adopted, so a serving
+    process upgrades to pooled execution without re-packing anything).
+    """
+
+    def __init__(self, sets: list):
+        if not sets:
+            raise ValueError("multi-set engine needs at least one set")
+        self._engines = [s if isinstance(s, BatchEngine) else BatchEngine(s)
+                         for s in sets]
+        self.n_sets = len(self._engines)
+        #: pooled row base per set: set i's resident image occupies rows
+        #: [_row_base[i], _row_base[i+1]) of a full-pool concatenation;
+        #: per-plan offsets are recomputed over the referenced subset
+        self._rows = [int(e._row_src.size) for e in self._engines]
+        self._plans = LRUCache(PLAN_CACHE_MAX, name="multiset_plans")
+        self._programs = LRUCache(PROGRAM_CACHE_MAX,
+                                  name="multiset_programs")
+        self.split_count = 0            # reactive (ResourceExhausted) halvings
+        self.proactive_split_count = 0  # pre-dispatch HBM-budget halvings
+        #: predicted-vs-measured bytes of the most recent pooled dispatch
+        #: (the multiset.memory event payload)
+        self.last_dispatch_memory: dict | None = None
+        #: stats of the most recent pipelined run (the multiset.pipeline
+        #: span tags: launches, host_ms, host_overlapped_ms,
+        #: overlap_ratio, drain_ms)
+        self.last_pipeline: dict | None = None
+
+    @classmethod
+    def from_bitmap_sets(cls, bitmap_sets: list, layout: str = "auto",
+                         **kw) -> "MultiSetBatchEngine":
+        return cls([DeviceBitmapSet(b, layout=layout, **kw)
+                    for b in bitmap_sets])
+
+    @property
+    def sets(self) -> list:
+        return [e._ds for e in self._engines]
+
+    # ------------------------------------------------------------- planning
+
+    def _flatten(self, groups):
+        """[(set_id, query)] in group order + per-group lengths."""
+        pooled, lengths = [], []
+        for g in groups:
+            if not isinstance(g, BatchGroup):
+                g = BatchGroup(*g)
+            if g.set_id < 0 or g.set_id >= self.n_sets:
+                raise IndexError(
+                    f"set_id out of range 0..{self.n_sets - 1}: {g.set_id}")
+            pooled.extend((g.set_id, q) for q in g.queries)
+            lengths.append(len(g.queries))
+        return tuple(pooled), lengths
+
+    @staticmethod
+    def _regroup(flat, lengths):
+        out, i = [], 0
+        for n in lengths:
+            out.append(flat[i:i + n])
+            i += n
+        return out
+
+    def _plan_pool(self, pooled) -> _PoolPlan:
+        """Pooled plan: per-set row selection, offset remap into the
+        referenced-set concatenation, shared shape bucketing.  Cached by
+        the exact (set_id, query) tuple — the prepared-statement pattern
+        across tenants."""
+        key = tuple(pooled)
+        cached = self._plans.get(key)
+        if cached is not None:
+            return cached
+        sids = tuple(sorted({sid for sid, _ in pooled}))
+        offsets, base = {}, 0
+        for sid in sids:
+            offsets[sid] = base
+            base += self._rows[sid]
+        with obs_trace.span("multiset.plan", q=len(pooled),
+                            sets=len(sids)) as sp:
+            groups: dict = {}
+            for qid, (sid, q) in enumerate(pooled):
+                eng = self._engines[sid]
+                rows, segs, keys_q, keep, hrows = eng._plan_query(q)
+                off = offsets[sid]
+                rows = rows + off
+                if hrows is not None:
+                    hrows = hrows + off
+                rung = packing.next_pow2(max(1, len(set(q.operands))))
+                groups.setdefault((q.op, rung), []).append(
+                    (qid, q, rows, segs, keys_q, keep, hrows))
+            with obs_trace.span("multiset.pool", groups=len(groups)):
+                buckets = [plan_bucket(op, items)
+                           for (op, _), items in sorted(groups.items())]
+                # compact the pooled row space: every gather row the
+                # pool references, once, sorted — per-set selections
+                # concatenate to exactly this order, and the bucket
+                # gathers remap to positions in it
+                refs = [b.host["gather"].ravel() for b in buckets]
+                refs += [b.host["head_gather"].ravel() for b in buckets
+                         if "head_gather" in b.host]
+                pool_rows = (np.unique(np.concatenate(refs)) if refs
+                             else np.zeros(1, np.int64))
+                if pool_rows.size == 0:
+                    pool_rows = np.zeros(1, np.int64)
+                # remap the (host-only, not yet uploaded) bucket gathers
+                # into pooled positions — device twins materialize lazily
+                # at first dispatch, and only for the rung that needs
+                # them (xla-vmap reads buckets, every other rung reads
+                # the merged op groups)
+                for b in buckets:
+                    for k in ("gather", "head_gather"):
+                        if k in b.host:
+                            b.host[k] = np.searchsorted(
+                                pool_rows, b.host[k]).astype(np.int32)
+                row_sel = {}
+                for sid in sids:
+                    off = offsets[sid]
+                    in_set = pool_rows[(pool_rows >= off)
+                                       & (pool_rows < off
+                                          + self._rows[sid])]
+                    row_sel[sid] = (in_set - off).astype(np.int32)
+            occupancy = (len(pooled)
+                         / max(1, sum(b.q for b in buckets)))
+            obs_metrics.gauge("rb_multiset_pool_occupancy",
+                              site=SITE).set(occupancy)
+            sp.tag(buckets=len(buckets), occupancy=round(occupancy, 4),
+                   pool_rows=int(pool_rows.size))
+        plan = _PoolPlan(buckets=buckets,
+                         op_groups=_merge_op_groups(buckets),
+                         sids=sids, row_sel=row_sel,
+                         n_pool_rows=int(pool_rows.size))
+        self._plans.put(key, plan)
+        return plan
+
+    def _pool_engine(self, plan: _PoolPlan, engine: str) -> str:
+        """Engine resolution over the pooled shape: the flat_seg SMEM
+        prefetch bound applies to the pooled bucket sizes, and any
+        stream-resident tenant's chunk prefetch bound applies to its
+        in-program rebuild (same rules as BatchEngine._bucket_engine,
+        taken over every referenced set)."""
+        eng = _engine(engine)
+        if eng == "pallas":
+            longest = max((g.n_rows for g in plan.op_groups), default=0)
+            if longest > kernels.SMEM_PREFETCH_MAX:
+                eng = "xla"
+            for sid in plan.sids:
+                ds = self._engines[sid]._ds
+                if (ds.words is None and ds._chunks is not None
+                        and int(ds._chunks[1].size)
+                        > kernels.SMEM_PREFETCH_MAX):
+                    eng = "xla"
+        return eng
+
+    def predict_dispatch_bytes(self, pooled_or_groups,
+                               engine: str = "auto") -> int:
+        """Predicted transient device bytes of ONE pooled launch — the
+        quantity the proactive pool split compares against the HBM
+        budget (insights.predict_multiset_dispatch_bytes)."""
+        pooled = self._as_pooled(pooled_or_groups)
+        plan = self._plan_pool(pooled)
+        eng = self._pool_engine(plan, engine)
+        return self._predict(plan, eng)["peak_bytes"]
+
+    def _as_pooled(self, pooled_or_groups):
+        seq = list(pooled_or_groups)
+        if seq and isinstance(seq[0], (BatchGroup, tuple)) \
+                and not (isinstance(seq[0], tuple) and len(seq[0]) == 2
+                         and isinstance(seq[0][1], BatchQuery)):
+            return self._flatten(seq)[0]
+        return tuple(seq)
+
+    def _predict(self, plan: _PoolPlan, eng: str) -> dict:
+        sets = [(self._engines[s]._resident_src()[1],
+                 self._engines[s]._ds._n_rows) for s in plan.sids]
+        return insights.predict_multiset_dispatch_bytes(
+            [b.signature for b in plan.buckets], sets, eng,
+            pool_rows=plan.n_pool_rows)
+
+    # ------------------------------------------------------------ programs
+
+    def _program(self, plan: _PoolPlan, eng: str, donate: bool = False):
+        """AOT-compiled pooled program: per-tenant rebuild + concat + all
+        buckets, ONE device dispatch.  ``donate=True`` (pipelined path on
+        donation-capable backends) marks the bucket-scratch argument
+        donated, so launch k's dead arrays back launch k+1's buffers —
+        such a program must be fed FRESH uploads, never the cached plan
+        arrays."""
+        donate = donate and _donation_supported()
+        sig = (eng, plan.signature, donate)
+        cached = self._programs.get(sig)
+        if cached is not None:
+            return cached
+        engines = [self._engines[s] for s in plan.sids]
+        srcs = [e._resident_src() for e in engines]
+        kinds = [k for _, k in srcs]
+        b_sigs = [b.signature for b in plan.buckets]
+        g_sigs = [g.sig for g in plan.op_groups]
+
+        with obs_trace.span("multiset.program_build", engine=eng,
+                            sets=len(engines), buckets=len(b_sigs),
+                            donate=donate) as sp:
+            def pooled_words(src_list, sel_list):
+                # per-tenant image -> referenced-row selection -> pooled
+                # concat: the transient image is the pool's true row
+                # footprint, not the tenants' padded residents
+                rows = [e._words_from_src(s, k, eng)[sel]
+                        for e, s, k, sel in zip(engines, src_list, kinds,
+                                                sel_list)]
+                return (rows[0] if len(rows) == 1
+                        else jnp.concatenate(rows, axis=0))
+
+            if eng == "xla-vmap":
+                # unmerged per-bucket cross-check path: proves the op
+                # merge and the query-axis flattening equivalent
+                def run(src_list, sel_list, barrays):
+                    words = pooled_words(src_list, sel_list)
+                    return [bucket_body(words, s, a, eng)
+                            for s, a in zip(b_sigs, barrays)]
+            else:
+                def run(src_list, sel_list, garrays):
+                    words = pooled_words(src_list, sel_list)
+                    return [_op_body(words, s, a, eng)
+                            for s, a in zip(g_sigs, garrays)]
+
+            jit_kw = {"donate_argnums": (2,)} if donate else {}
+            # donate-variant lowering traces against avals only: caching
+            # operand arrays here would pin HBM that donating dispatches
+            # never read (they always re-upload), and uploading throwaway
+            # twins just to trace shapes would pay the transfer per
+            # program-cache miss
+            operands = (self._operand_avals(plan, eng) if donate
+                        else self._launch_operands(plan, eng))
+            compiled = jax.jit(run, **jit_kw).lower(
+                [s for s, _ in srcs],
+                [plan.row_sel_dev(s) for s in plan.sids],
+                operands).compile()
+            predicted = self._predict(plan, eng)
+            measured = obs_memory.compiled_memory(compiled)
+            sp.tag(predicted_bytes=predicted["peak_bytes"],
+                   measured_peak_bytes=(measured or {}).get("peak_bytes"))
+            cached = (run, compiled, predicted, measured)
+        self._programs.put(sig, cached)
+        return cached
+
+    # ------------------------------------------------------------ execution
+
+    def execute(self, groups, engine: str = "auto", jit: bool = True,
+                fallback: bool = True,
+                policy: guard.GuardPolicy | None = None) -> list:
+        """Run a pool of per-set query groups; returns per-group result
+        lists aligned with ``groups``.
+
+        One pooled device launch per budget-respecting sub-pool (usually
+        one total); multi-launch pools flow through the pipelined
+        dispatcher.  Guarded like ``BatchEngine.execute``: per-launch
+        engine demotion, reactive OOM halving, proactive HBM-budget
+        halving, optional shadow cross-check.  A pool referencing a
+        single set routes through that set's ``BatchEngine.execute``
+        with zero pooled overhead.
+        """
+        groups = list(groups)
+        pooled, lengths = self._flatten(groups)
+        if not pooled:
+            return [[] for _ in groups]
+        sids = sorted({sid for sid, _ in pooled})
+        with obs_trace.span("multiset.execute", site=SITE, q=len(pooled),
+                            sets=len(sids), engine=engine,
+                            fallback=fallback):
+            obs_metrics.counter("rb_multiset_queries_total",
+                                site=SITE).inc(len(pooled))
+            if len(sids) == 1:
+                # S=1 fast path: the single-set engine IS the pooled
+                # engine here — no pooled plan, no concat, no new device
+                # buffers (regression-pinned via the HBM ledger)
+                flat = self._engines[sids[0]].execute(
+                    [q for _, q in pooled], engine=engine, jit=jit,
+                    fallback=fallback, policy=policy)
+                return self._regroup(flat, lengths)
+            if not fallback:
+                flat = self._launch_once(pooled, engine, jit, inject=False)
+                return self._regroup(flat, lengths)
+            policy = policy or guard.GuardPolicy.from_env()
+            chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
+            budget = guard.resolve_hbm_budget(policy)
+            deadline = guard.Deadline(policy.deadline)
+            # one in-budget launch — the steady-state serving tick — is
+            # handed to _pipeline as a materialized single so it
+            # dispatches sync with the cached operand arrays; a pool the
+            # budget WILL split stays a live generator, so launch k+1's
+            # halving/planning runs while launch k is on device (the
+            # probe's plan is cached and needed either way)
+            if (budget is None or len(pooled) < 2
+                    or self.predict_dispatch_bytes(pooled, chain[0])
+                    <= budget):
+                launches = [(0, tuple(pooled))]
+            else:
+                launches = ((0, qs) for qs in
+                            self._launch_iter(pooled, chain[0], budget))
+            flat = self._pipeline(launches, chain, jit, policy, deadline,
+                                  budget)[0]
+            if policy.shadow_rate > 0.0:
+                self._shadow_check(pooled, flat, policy)
+            return self._regroup(flat, lengths)
+
+    def execute_pipelined(self, pools, engine: str = "auto",
+                          jit: bool = True,
+                          policy: guard.GuardPolicy | None = None) -> list:
+        """Stream several pools (serving ticks) through ONE pipeline
+        window: pool p+1's planning overlaps pool p's device execution
+        even when each pool is a single launch.  Returns per-pool lists
+        of per-group result lists (``execute``'s shape, one per pool)."""
+        pools = [list(p) for p in pools]
+        metas = [self._flatten(p) for p in pools]
+        policy = policy or guard.GuardPolicy.from_env()
+        chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
+        budget = guard.resolve_hbm_budget(policy)
+        deadline = guard.Deadline(policy.deadline)
+        n_sets = len({sid for pooled, _ in metas for sid, _ in pooled})
+        with obs_trace.span("multiset.execute", site=SITE,
+                            q=sum(len(p) for p, _ in metas),
+                            sets=n_sets, engine=engine, pools=len(pools)):
+            for pooled, _ in metas:
+                obs_metrics.counter("rb_multiset_queries_total",
+                                    site=SITE).inc(len(pooled))
+
+            def launches():
+                for pi, (pooled, _) in enumerate(metas):
+                    if not pooled:
+                        continue
+                    for qs in self._launch_iter(pooled, chain[0], budget):
+                        yield pi, qs
+
+            by_pool = self._pipeline(launches(), chain, jit, policy,
+                                     deadline, budget)
+            out = []
+            for pi, (pooled, lengths) in enumerate(metas):
+                flat = by_pool.get(pi, [])
+                if policy.shadow_rate > 0.0 and flat:
+                    self._shadow_check(pooled, flat, policy)
+                out.append(self._regroup(flat, lengths))
+            return out
+
+    def _launch_iter(self, pooled, engine: str, budget: int | None):
+        """Left-to-right launch partition of ``pooled``, computed LAZILY:
+        a sub-pool predicted past the HBM budget is halved here — the
+        proactive split, per-pool — and the halving/planning of launch
+        k+1 happens only when the pipeline pulls it, i.e. while launch k
+        is already on device."""
+        stack = [list(pooled)]
+        while stack:
+            qs = stack.pop()
+            while budget is not None and len(qs) >= 2:
+                predicted = self.predict_dispatch_bytes(qs, engine)
+                if predicted <= budget:
+                    break
+                mid = (len(qs) + 1) // 2
+                self.proactive_split_count += 1
+                obs_metrics.counter("rb_multiset_proactive_splits_total",
+                                    site=SITE).inc()
+                obs_trace.current().event(
+                    "proactive_split", site=SITE, q=len(qs),
+                    predicted_bytes=predicted, budget_bytes=budget,
+                    halves=(mid, len(qs) - mid))
+                stack.append(qs[mid:])
+                qs = qs[:mid]
+            yield tuple(qs)
+
+    def _pipeline(self, launches, chain, jit, policy, deadline,
+                  budget) -> dict:
+        """Depth-``policy.pipeline_depth`` double buffer over ``launches``
+        (an iterator of ``(tag, queries)``): plan/pack/dispatch launch
+        k+1 while up to ``depth`` earlier launches are in flight, then
+        drain the oldest.  Returns ``{tag: [BatchResult, ...]}`` with
+        per-tag pooled order preserved (drains are FIFO).  Host time
+        spent planning while >= 1 launch was in flight is the hidden
+        fraction the overlap ratio reports."""
+        depth = max(1, policy.pipeline_depth)
+        # a known single-launch window (plain execute() of an unsplit
+        # pool) has nothing to overlap: dispatch it sync with the cached
+        # operand arrays rather than paying the async path's donation
+        # discipline — fresh operand re-uploads per launch on TPU/GPU
+        single = isinstance(launches, (list, tuple)) and len(launches) == 1
+        inflight: deque = deque()
+        out: dict = {}
+        host_ms = overlapped_ms = drain_ms = 0.0
+        n_launches = 0      # window slots; device launches come from the
+        #                     counter delta (splits add, sequential lands 0)
+        launch_counter = obs_metrics.counter("rb_multiset_launches_total",
+                                             site=SITE)
+        launches0 = launch_counter.value
+        # launches-saved baseline: the per-set sequential loop pays one
+        # launch per referenced set PER POOL (tag), not per unique tenant
+        # across the stream — a 4-tick stream over the same 4 tenants
+        # saves 12 launches, not 0
+        tag_sids: dict = {}
+
+        def drain():
+            nonlocal drain_ms
+            tag, qs, payload = inflight.popleft()
+            t0 = time.perf_counter()
+            if isinstance(payload, list):   # sequential / split-recovered
+                res = payload
+            else:
+                try:
+                    res = self._readback(payload.plan, payload.outs,
+                                         payload.queries, payload.eng,
+                                         payload.inject)
+                except Exception as exc:
+                    fault = errors.classify(exc)
+                    if fault is None or isinstance(fault,
+                                                   errors.ShadowMismatch):
+                        raise
+                    # a deferred device fault surfaced only at drain
+                    # time: re-run this launch synchronously down the
+                    # guarded ladder (bit-exact on every rung)
+                    obs_metrics.counter("rb_multiset_drain_retries_total",
+                                        site=SITE).inc()
+                    obs_trace.current().event(
+                        "drain_retry", site=SITE, q=len(qs),
+                        error_class=type(fault).__name__)
+                    res, _ = self._launch_guarded(
+                        qs, chain, jit, policy, deadline, budget,
+                        sync=True)
+            drain_ms += (time.perf_counter() - t0) * 1e3
+            out.setdefault(tag, []).extend(res)
+
+        with obs_trace.span("multiset.pipeline", depth=depth) as sp:
+            it = iter(launches)
+            while True:
+                t0 = time.perf_counter()
+                # pulling the iterator runs the NEXT launch's budget
+                # halving + planning — host work the window hides
+                nxt = next(it, None)
+                if nxt is None:
+                    break
+                tag, qs = nxt
+                tag_sids.setdefault(tag, set()).update(
+                    sid for sid, _ in qs)
+                payload, _rung = self._launch_guarded(
+                    qs, chain, jit, policy, deadline, budget, sync=single)
+                h = (time.perf_counter() - t0) * 1e3
+                host_ms += h
+                # overlapped only when a DEVICE launch was actually in
+                # flight: a window full of sequential landings or split
+                # recoveries (finished lists) hid nothing, and reporting
+                # (n-1)/n overlap in a fully degraded process would make
+                # the >= 50% acceptance pin read healthy while the
+                # pipeline did no pipelining
+                if any(isinstance(p, _Inflight) for _, _, p in inflight):
+                    overlapped_ms += h
+                n_launches += 1
+                inflight.append((tag, qs, payload))
+                # drain until at most depth-1 stay undrained: depth=1 is
+                # strictly serial (dispatch -> immediate drain), depth=2
+                # keeps one launch computing while the next is planned
+                while len(inflight) >= depth:
+                    drain()
+            while inflight:
+                drain()
+            ratio = (overlapped_ms / host_ms) if host_ms else 0.0
+            stats = {"launches": n_launches, "depth": depth,
+                     "host_ms": round(host_ms, 3),
+                     "host_overlapped_ms": round(overlapped_ms, 3),
+                     "overlap_ratio": round(ratio, 4),
+                     "drain_ms": round(drain_ms, 3)}
+            sp.tag(**stats)
+        if n_launches > 1:
+            # a single-launch window (every plain execute() of an
+            # unsplit pool) has no overlap to measure — reporting it
+            # would clobber the last real pipelined measurement with ~0
+            obs_metrics.gauge("rb_multiset_pipeline_overlap_ratio",
+                              site=SITE).set(stats["overlap_ratio"])
+            self.last_pipeline = stats
+        device_launches = int(launch_counter.value - launches0)
+        per_set_baseline = sum(len(s) for s in tag_sids.values())
+        # a window that never reached the device (every slot landed on
+        # the sequential floor) amortized nothing — the per-set loop
+        # would have landed there too, so no launches were "saved"
+        obs_metrics.counter("rb_multiset_launches_saved_total",
+                            site=SITE).inc(
+                                max(0, per_set_baseline - device_launches)
+                                if device_launches else 0)
+        return out
+
+    def _launch_guarded(self, qs, chain, jit, policy, deadline, budget,
+                        sync: bool):
+        """One guarded launch of pooled queries ``qs`` down ``chain``.
+        ``sync=False`` returns an :class:`_Inflight` handle (async
+        dispatch, drained later); sequential landings and OOM-split
+        recoveries return finished result lists either way."""
+
+        def attempt(eng):
+            return self._launch_once(qs, eng, jit, sync=sync)
+
+        def on_oom(eng, fault, dl):
+            if len(qs) < 2:
+                return guard.NO_SPLIT
+            sub = chain[chain.index(eng):] if eng in chain else chain
+            mid = (len(qs) + 1) // 2
+            self.split_count += 1
+            obs_metrics.counter("rb_multiset_oom_splits_total",
+                                site=SITE).inc()
+            obs_trace.current().event(
+                "oom_split", site=SITE, engine_from=eng, engine_to=eng,
+                q=len(qs), halves=(mid, len(qs) - mid))
+            return (self._launch_guarded(qs[:mid], sub, jit, policy, dl,
+                                         budget, sync=True)[0]
+                    + self._launch_guarded(qs[mid:], sub, jit, policy, dl,
+                                           budget, sync=True)[0])
+
+        return guard.run_with_fallback(
+            SITE, chain, attempt, policy=policy,
+            sequential=lambda: self._sequential(qs),
+            on_resource_exhausted=on_oom, deadline=deadline)
+
+    def _launch_once(self, pooled, engine: str, jit: bool,
+                     inject: bool = True, sync: bool = True):
+        """Raw single-engine pooled launch: plan -> one compiled program
+        -> (host assembly | in-flight handle).  The faults hook sits at
+        the engine boundary like BatchEngine's."""
+        pooled = tuple(pooled)
+        plan = self._plan_pool(pooled)
+        eng = self._pool_engine(plan, engine)
+        if inject:
+            faults.maybe_fail(SITE, eng)
+        donate = (not sync) and _donation_supported()
+        run, compiled, predicted, measured = self._program(plan, eng,
+                                                           donate=donate)
+        srcs = [self._engines[s]._resident_src()[0] for s in plan.sids]
+        sels = [plan.row_sel_dev(s) for s in plan.sids]
+        barrays = self._launch_operands(plan, eng, fresh=donate)
+        with obs_trace.span("multiset.dispatch", engine=eng,
+                            q=len(pooled), sets=len(plan.sids),
+                            buckets=len(plan.buckets),
+                            pipelined=not sync) as sp:
+            outs = (compiled if jit else run)(srcs, sels, barrays)
+            # counted HERE, not per pipeline-window slot: an OOM-split
+            # slot dispatches 2+ real launches, a sequential landing
+            # dispatches none — the counter must track what actually
+            # reached the device (docs/OBSERVABILITY.md)
+            obs_metrics.counter("rb_multiset_launches_total",
+                                site=SITE).inc()
+            if sync:
+                outs = sp.sync(outs)
+            mem = obs_memory.record_dispatch(
+                SITE, predicted["peak_bytes"], measured)
+            mem["engine"], mem["q"] = eng, len(pooled)
+            mem["sets"] = len(plan.sids)
+            self.last_dispatch_memory = mem
+            sp.event("multiset.memory", **mem)
+        if not sync:
+            return _Inflight(plan=plan, outs=outs, queries=pooled,
+                             eng=eng, inject=inject)
+        return self._readback(plan, outs, pooled, eng, inject)
+
+    def _launch_operands(self, plan: _PoolPlan, eng: str,
+                         fresh: bool = False) -> list:
+        """The program's bucket-operand argument: per-op superbucket
+        arrays normally, per-bucket arrays on the unmerged xla-vmap
+        cross-check path.  Either way only the keys ``_op_body`` reads
+        for this engine ship (``_op_group_keys``): donating launches
+        upload the subset per launch, the sync path uploads it once and
+        caches it per keyset."""
+        if eng == "xla-vmap":
+            return [b.device_arrays(fresh=fresh) for b in plan.buckets]
+        return [g.device_arrays(fresh=fresh, keys=_op_group_keys(g, eng))
+                for g in plan.op_groups]
+
+    def _operand_avals(self, plan: _PoolPlan, eng: str) -> list:
+        """ShapeDtypeStruct pytree matching the DONATE-variant
+        ``_launch_operands(fresh=True)`` — what donate lowering traces
+        against, so no device array is uploaded just to be thrown away
+        after the trace (and the donated pytree carries only the keys
+        the program reads)."""
+        aval = lambda v: jax.ShapeDtypeStruct(
+            v.shape, jax.dtypes.canonicalize_dtype(v.dtype))
+        if eng == "xla-vmap":
+            return [{k: aval(v) for k, v in b.host.items()}
+                    for b in plan.buckets]
+        return [{k: aval(g.host[k]) for k in _op_group_keys(g, eng)}
+                for g in plan.op_groups]
+
+    def _bucket_outputs(self, plan: _PoolPlan, outs, eng: str):
+        """Normalize program outputs to per-bucket (bucket, heads,
+        cards) host arrays — op superbuckets slice their members out of
+        the flat head axis."""
+        if eng == "xla-vmap":
+            for b, (heads, cards) in zip(plan.buckets, outs):
+                yield (b, None if heads is None else np.asarray(heads),
+                       np.asarray(cards))
+            return
+        for grp, (heads_f, cards_f) in zip(plan.op_groups, outs):
+            heads_f = None if heads_f is None else np.asarray(heads_f)
+            cards_f = np.asarray(cards_f)
+            live = grp.regular and eng != "pallas"
+            for bi, s0 in zip(grp.bucket_idx, grp.seg_offs):
+                b = plan.buckets[bi]
+                if live:
+                    # regular-path outputs carry one LIVE slot per query
+                    # (k_pad == 1, no dead pad slots — see _op_body)
+                    s0, n = s0 // 2, b.q
+                    cards = cards_f[s0:s0 + n].reshape(b.q, 1)
+                    heads = (None if heads_f is None else
+                             heads_f[s0:s0 + n].reshape(b.q, 1, WORDS32))
+                else:
+                    n = b.q * (b.k_pad + 1)
+                    cards = cards_f[s0:s0 + n].reshape(
+                        b.q, b.k_pad + 1)[:, :b.k_pad]
+                    heads = (None if heads_f is None else
+                             heads_f[s0:s0 + n].reshape(
+                                 b.q, b.k_pad + 1, WORDS32)[:, :b.k_pad])
+                yield b, heads, cards
+
+    def _readback(self, plan: _PoolPlan, outs, pooled, eng: str,
+                  inject: bool) -> list:
+        """Device outputs -> per-query BatchResults in pooled order."""
+        with obs_trace.span("multiset.readback", engine=eng,
+                            q=len(pooled)):
+            results: list = [None] * len(pooled)
+            for b, heads, cards in self._bucket_outputs(plan, outs, eng):
+                # one vectorized masked sum per bucket (not per query):
+                # the pooled readback walks Q x S results, so per-query
+                # ndarray reductions would rival the launch itself; the
+                # mask constants are plan-static and cached on the plan
+                meta = plan.rb_meta.get(id(b))
+                if meta is None:
+                    kqs = np.fromiter((k.size for k in b.keys), np.int64,
+                                      len(b.keys))
+                    meta = kqs, (np.arange(b.k_pad)[None, :]
+                                 < kqs[:, None])
+                    plan.rb_meta[id(b)] = meta
+                kqs, live = meta
+                sums = np.where(live[:, :cards.shape[1]],
+                                cards[:len(b.keys)], 0).sum(axis=1)
+                for slot, (qid, keys_q) in enumerate(zip(b.qids, b.keys)):
+                    kq = keys_q.size
+                    bm = None
+                    if pooled[qid][1].form == "bitmap":
+                        bm = packing.unpack_result(
+                            keys_q,
+                            heads[slot, :kq] if kq else
+                            np.zeros((0, WORDS32), np.uint32),
+                            cards[slot, :kq])
+                    results[qid] = BatchResult(cardinality=int(sums[slot]),
+                                               bitmap=bm)
+        if inject and faults.should_corrupt(SITE, eng):
+            results[0] = BatchResult(
+                cardinality=results[0].cardinality + 1,
+                bitmap=results[0].bitmap)
+        return results
+
+    # ----------------------------------------------- CPU sequential rung
+
+    def _sequential(self, pooled) -> list:
+        """Terminal fallback: each query on its own set's host container
+        algebra — the bit-exact reference every pooled rung is pinned
+        against."""
+        out = []
+        for sid, q in pooled:
+            rb = self._engines[sid]._sequential_one(q)
+            out.append(BatchResult(
+                cardinality=rb.cardinality,
+                bitmap=rb if q.form == "bitmap" else None))
+        return out
+
+    def _shadow_check(self, pooled, results, policy) -> None:
+        idx = guard.shadow_sample(len(pooled), policy.shadow_rate,
+                                  policy.shadow_seed, SITE)
+        for i in idx:
+            sid, q = pooled[i]
+            ref = self._engines[sid]._sequential_one(q)
+            got = results[i]
+            bad = got.cardinality != ref.cardinality
+            if not bad and q.form == "bitmap":
+                bad = got.bitmap != ref
+            if bad:
+                raise errors.ShadowMismatch(
+                    f"multiset query {i} ({q.op} over {q.operands} on set "
+                    f"{sid}) diverged from the sequential reference: got "
+                    f"cardinality {got.cardinality}, want "
+                    f"{ref.cardinality}")
+
+    # --------------------------------------------------------- conveniences
+
+    def cardinalities(self, groups, engine: str = "auto") -> list:
+        """Per-group i64 arrays of result cardinalities."""
+        return [np.array([r.cardinality for r in rows], dtype=np.int64)
+                for rows in self.execute(groups, engine=engine)]
+
+    def cache_stats(self) -> dict:
+        """Pooled plan/program cache observability + the split counters
+        (same shape as ``BatchEngine.cache_stats``)."""
+        return {"plans": self._plans.stats(),
+                "programs": self._programs.stats(),
+                "splits": self.split_count}
+
+    def hbm_bytes(self) -> int:
+        return sum(e.hbm_bytes() for e in self._engines)
+
+
+def random_multiset_pool(set_sizes: list, q: int, seed: int = 0x5E75,
+                         max_operands: int = 8) -> list:
+    """Deterministic pooled workload: ``q`` mixed-op queries dealt
+    round-robin over ``len(set_sizes)`` tenants (set ``i`` holding
+    ``set_sizes[i]`` resident bitmaps) — the shared generator of the
+    bench multiset lane and the acceptance tests."""
+    rng = np.random.default_rng(seed)
+    per_set: list = [[] for _ in set_sizes]
+    for i in range(q):
+        sid = i % len(set_sizes)
+        n = set_sizes[sid]
+        # op drawn independently of the round-robin tenant index: i % 4
+        # would correlate with sid whenever gcd(S, 4) > 1, making every
+        # tenant's sub-batch op-homogeneous — the per-set baseline's
+        # cheapest case — instead of the mixed-op workload this claims
+        op = ("or", "xor", "and", "andnot")[int(rng.integers(4))]
+        hi = max(3, min(max_operands + 1, n))
+        k = int(rng.integers(2, hi)) if n >= 3 else 2
+        per_set[sid].append(BatchQuery(op=op, operands=tuple(
+            int(x) for x in rng.choice(n, size=min(k, n), replace=False))))
+    return [BatchGroup(sid, qs) for sid, qs in enumerate(per_set) if qs]

@@ -56,6 +56,7 @@ ENV_BACKOFF = "ROARING_TPU_BACKOFF_S"
 ENV_DEADLINE = "ROARING_TPU_DEADLINE_S"
 ENV_SHADOW = "ROARING_TPU_SHADOW"
 ENV_HBM_BUDGET = "ROARING_TPU_HBM_BUDGET"
+ENV_PIPELINE_DEPTH = "ROARING_TPU_PIPELINE_DEPTH"
 
 
 def parse_bytes(spec: str) -> int:
@@ -89,6 +90,12 @@ class GuardPolicy:
     #: resolve from the backend (free memory where reported, else
     #: unlimited); <= 0 = explicitly unlimited.
     hbm_budget: int | None = None
+    #: in-flight launch window of the multi-set pipelined dispatcher
+    #: (parallel.multiset): launch k+1 is planned/packed on the host while
+    #: up to this many launches run on device.  1 disables pipelining
+    #: (strictly serial plan -> dispatch -> drain); the default 2 is the
+    #: classic double buffer (one launch computing, one draining).
+    pipeline_depth: int = 2
     sleep: Callable[[float], None] = time.sleep
 
     @classmethod
@@ -108,6 +115,9 @@ class GuardPolicy:
                 env["shadow_seed"] = int(seed, 0)
         if ENV_HBM_BUDGET in os.environ:
             env["hbm_budget"] = parse_bytes(os.environ[ENV_HBM_BUDGET])
+        if ENV_PIPELINE_DEPTH in os.environ:
+            env["pipeline_depth"] = max(
+                1, int(os.environ[ENV_PIPELINE_DEPTH]))
         env.update(overrides)
         return cls(**env)
 

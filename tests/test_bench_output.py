@@ -78,6 +78,26 @@ def _timed(fn):
     return time.perf_counter() - t0
 
 
+def test_multiset_phase_small(monkeypatch):
+    """The cross-tenant lane (ISSUE 5) runs end-to-end at toy sizes:
+    pooled-vs-per-set cells per (S, Q), a pipelined cell with an overlap
+    ratio, and the compact headline the summary line carries."""
+    monkeypatch.setattr(bench, "MULTISET_S", (1, 2))
+    monkeypatch.setattr(bench, "MULTISET_Q", (4,))
+    row = bench.multiset_phase()
+    for cell in ("s1_q4", "s2_q4"):
+        assert row[cell]["pooled_qps"] > 0
+        assert row[cell]["per_set_qps"] > 0
+        assert row[cell]["pooled_vs_per_set_x"] > 0
+    assert "hbm" in row["s2_q4"]          # pooled predicted-vs-measured
+    assert row["s2_q4"]["hbm"]["sets"] == 2
+    pipe = row["s2_pipeline"]
+    assert pipe["launches"] == 4 and 0.0 <= pipe["overlap_ratio"] <= 1.0
+    assert row["headline"]["pooled_vs_per_set_x"] \
+        == row["s2_q4"]["pooled_vs_per_set_x"]
+    assert row["headline"]["overlap_ratio"] == pipe["overlap_ratio"]
+
+
 def test_summary_is_one_small_line(tmp_path):
     doc = {
         "metric": "wide_or_census1881_aggregations_per_sec",
@@ -106,6 +126,14 @@ def test_summary_is_one_small_line(tmp_path):
                            "q64_steady_qps": 900000.0,
                            "q64_vs_q1_amortization_x": 28.6,
                            "meets_5x": True}},
+        "multiset": {
+            "tenant_bitmaps": 8,
+            "s4_q64": {"pooled_qps": 60000.0, "per_set_qps": 18000.0,
+                       "pooled_vs_per_set_x": 3.3,
+                       "hbm": {"q": 64, "sets": 4, "predicted_mb": 1.2}},
+            "s4_pipeline": {"launches": 4, "overlap_ratio": 0.7},
+            "headline": {"pooled_vs_per_set_x": 3.3,
+                         "overlap_ratio": 0.7}},
     }
     s = bench.build_summary(doc, str(tmp_path / "bench_full.json"))
     line = json.dumps(s, separators=(",", ":"))
@@ -113,6 +141,9 @@ def test_summary_is_one_small_line(tmp_path):
     parsed = json.loads(line)
     assert parsed["north_star"]["census1881"]["met"] is True
     assert parsed["batched_qps"]["census1881"]["meets_5x"] is True
+    # multiset lane rides compactly: [pooled_qps, per_set_qps, ratio]
+    assert parsed["multiset"]["s4_q64"] == [60000.0, 18000.0, 3.3]
+    assert parsed["multiset"]["overlap_ratio"] == 0.7
     assert parsed["marginal_us_median"]["census1881"] == 13.05
     assert parsed["full_doc"].endswith("bench_full.json")
     # the emitted line is the capped form and keeps the optional fields

@@ -125,9 +125,14 @@ def test_dense_block4_rung_parity():
         (rng.choice(500, size=25, replace=False).astype(np.uint32) << 16)
         + rng.integers(0, 65536, 25).astype(np.uint32)))
         for _ in range(12)]
-    ds = aggregation.DeviceBitmapSet(bms)
+    # this shape is exactly what the adaptive default flips to counts —
+    # the block-4 rung under test is a property of the DENSE image, so
+    # pin the explicit override (and assert the auto flip while here)
+    from roaringbitmap_tpu.insights import analysis as insights
+    assert insights.choose_layout(bms)["layout"] == "counts"
+    ds = aggregation.DeviceBitmapSet(bms, layout="dense")
     assert ds.block == 4
-    ds8 = aggregation.DeviceBitmapSet(bms, block=8)
+    ds8 = aggregation.DeviceBitmapSet(bms, block=8, layout="dense")
     assert ds.words.nbytes < ds8.words.nbytes
     for op, fn in (("or", fast_aggregation.or_),
                    ("xor", fast_aggregation.xor)):

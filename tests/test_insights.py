@@ -12,11 +12,13 @@ def test_recommend_device_layout():
         np.arange(0, 60000, 2, dtype=np.uint32)) for _ in range(4)]
     rec = recommend_device_layout(dense_set)
     assert rec["layout"] == "dense" and rec["dense_blowup"] < 4
-    # extreme blowup alone no longer forces compact — 245 KB of dense rows
-    # trivially fits the default budget and queries ~1000x faster
+    # extreme blowup alone no longer forces compact — but the mostly-
+    # singleton inflation shape is advised counts, matching the
+    # DeviceBitmapSet layout="auto" build default (choose_layout, the
+    # uscensus2000 cliff shape) so the two advisers never contradict
     sparse_set = [RoaringBitmap.bitmap_of(i << 16) for i in range(30)]  # 8 KB rows for 1-bit containers
     rec2 = recommend_device_layout(sparse_set)
-    assert rec2["layout"] == "dense" and rec2["dense_blowup"] >= 32
+    assert rec2["layout"] == "counts" and rec2["dense_blowup"] >= 32
     # budget overflow walks the ladder down to compact
     rec3 = recommend_device_layout(dense_set, hbm_budget_bytes=16 << 10)
     assert rec3["layout"] == "compact"

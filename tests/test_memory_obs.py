@@ -348,3 +348,28 @@ class TestBenchDiff:
         p = tmp_path / "cap.json"
         p.write_text(json.dumps(doc))
         assert bd.load_lanes(str(p)) == {"value": 7.5, "vs_baseline": 12.0}
+
+    def test_multiset_lane_directions(self, tmp_path):
+        """ISSUE 5: the multiset lane's dotted paths gate in the right
+        direction — pooled/per-set QPS, pooled-vs-per-set ratio, overlap
+        ratio, and launches saved are higher-is-better."""
+        bd = _load_bench_diff()
+        for lane in ("multiset.s4_q64.pooled_qps",
+                     "multiset.s4_q64.per_set_qps",
+                     "multiset.s4_q64.pooled_vs_per_set_x",
+                     "multiset.overlap_ratio",
+                     "multiset.s16_pipeline.overlap_ratio",
+                     "rb_multiset_launches_saved_total"):
+            assert bd.direction(lane) == 1, lane
+        assert bd.direction("multiset.s4_pipeline.host_ms") == -1
+        # a halved pooled ratio past the threshold is a regression
+        old = {"multiset": {"s4_q64": {"pooled_vs_per_set_x": 3.2},
+                            "overlap_ratio": 0.8}}
+        new = {"multiset": {"s4_q64": {"pooled_vs_per_set_x": 1.4},
+                            "overlap_ratio": 0.82}}
+        po, pn = tmp_path / "o.json", tmp_path / "n.json"
+        po.write_text(json.dumps(old))
+        pn.write_text(json.dumps(new))
+        rows, regressions = bd.diff_lanes(
+            bd.load_lanes(str(po)), bd.load_lanes(str(pn)), 0.15)
+        assert regressions == ["multiset.s4_q64.pooled_vs_per_set_x"]

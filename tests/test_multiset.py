@@ -1,0 +1,404 @@
+"""Cross-tenant multi-set batch engine acceptance (ISSUE 5).
+
+Pins:
+- pooled execution bit-exact, query by query, against per-set sequential
+  ``BatchEngine`` loops across (op x layout x engine rung) — including
+  under injected oom/transient faults (pool splitting stays bit-exact);
+- the S=1 fast path: a pool referencing one set routes through that
+  set's ``BatchEngine.execute`` with zero pooled planning and zero new
+  device buffers (HBM-ledger regression);
+- proactive pool splitting respects ``ROARING_TPU_HBM_BUDGET``: splits
+  fire BEFORE dispatch, every dispatched launch's prediction fits the
+  budget (asserted from the ``multiset.memory`` trace events), counted
+  under ``rb_multiset_*``;
+- the ``multiset.*`` span vocabulary and pooled predicted-vs-measured
+  memory accounting;
+- CPU-proxy performance acceptance (slow lane): pooled Q=64 over S=8
+  sets >= 3x the per-set sequential loop's QPS, and the pipelined
+  dispatcher hides >= 50% of host plan+pack wall time at Q=64 (overlap
+  ratio read back from the ``multiset.pipeline`` span).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.obs import memory as obs_memory
+from roaringbitmap_tpu.parallel import (BatchEngine, BatchGroup, BatchQuery,
+                                        DeviceBitmapSet, MultiSetBatchEngine)
+from roaringbitmap_tpu.parallel.multiset import random_multiset_pool
+from roaringbitmap_tpu.runtime import faults, guard
+
+S_SIZES = (8, 6, 8)     # bitmaps per tenant set
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+@pytest.fixture(scope="module")
+def tenant_bitmaps():
+    """Three tenants with different shapes: sparse uniform, a shared
+    dense chunk (bitmap containers), and a run-heavy set."""
+    rng = np.random.default_rng(0x7E4A)
+    out = []
+    for s, n in enumerate(S_SIZES):
+        bms = []
+        for i in range(n):
+            vals = [rng.integers(0, 1 << 17, 2000).astype(np.uint32)]
+            if s == 1 and i % 2 == 0:
+                vals.append(np.arange(1 << 16, (1 << 16) + 9000,
+                                      dtype=np.uint32))
+            if s == 2:
+                start = int(rng.integers(0, 1 << 16))
+                vals.append(np.arange(start, start + 1500,
+                                      dtype=np.uint32))
+            bms.append(RoaringBitmap.from_values(
+                np.unique(np.concatenate(vals))))
+        out.append(bms)
+    return out
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return random_multiset_pool(list(S_SIZES), 18, seed=0xBEEF)
+
+
+def _per_set_reference(tenant_bitmaps, pool, engine="xla"):
+    """The per-set sequential BatchEngine loop the pooled engine must
+    match bit-exactly — one execute per tenant."""
+    out = []
+    for g in pool:
+        be = BatchEngine.from_bitmaps(tenant_bitmaps[g.set_id],
+                                      layout="dense")
+        out.append(be.execute(list(g.queries), engine=engine))
+    return out
+
+
+def _assert_bit_exact(got, want, tag):
+    for gi, (grows, wrows) in enumerate(zip(got, want)):
+        assert len(grows) == len(wrows)
+        for qi, (a, b) in enumerate(zip(grows, wrows)):
+            assert a.cardinality == b.cardinality, (tag, gi, qi)
+            if b.bitmap is not None:
+                assert a.bitmap == b.bitmap, (tag, gi, qi)
+
+
+@pytest.fixture(scope="module")
+def oracle(tenant_bitmaps, pool):
+    bm_pool = [BatchGroup(g.set_id, [
+        BatchQuery(q.op, q.operands, form="bitmap") for q in g.queries])
+        for g in pool]
+    return bm_pool, _per_set_reference(tenant_bitmaps, bm_pool)
+
+
+@pytest.mark.parametrize("layout,engines", [
+    ("dense", ("xla", "xla-vmap", "pallas")),
+    ("compact", ("xla", "pallas")),
+    ("counts", ("xla",)),
+])
+def test_pooled_matches_per_set_loops(tenant_bitmaps, oracle, layout,
+                                      engines):
+    """The (op x layout x engine) parity matrix: a mixed-op pool over
+    every tenant, materialized bitmaps, bit-exact against the per-set
+    sequential loop on every rung."""
+    bm_pool, want = oracle
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps,
+                                               layout=layout)
+    for e in engines:
+        got = eng.execute(bm_pool, engine=e)
+        _assert_bit_exact(got, want, (layout, e))
+
+
+def test_pool_splitting_bit_exact_under_faults(tenant_bitmaps, oracle):
+    """oom/transient injection: reactive pool halvings and retries fire
+    and the pooled results stay bit-exact (the CI fault lane re-runs the
+    whole module under a global schedule on top of this)."""
+    bm_pool, want = oracle
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    with faults.inject("oom=0.4,transient=0.1:0xAB"):
+        got = eng.execute(bm_pool, engine="xla")
+    _assert_bit_exact(got, want, "faults")
+    with faults.inject("lowering=1.0:0xAC"):     # every device rung dead
+        got = eng.execute(bm_pool, engine="xla")
+    _assert_bit_exact(got, want, "sequential-floor")
+
+
+def test_jit_vs_eager_and_raw(tenant_bitmaps, oracle):
+    bm_pool, want = oracle
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    _assert_bit_exact(eng.execute(bm_pool, engine="xla", jit=False),
+                      want, "eager")
+    _assert_bit_exact(eng.execute(bm_pool, engine="xla", fallback=False),
+                      want, "raw")
+
+
+def test_s1_pool_routes_through_single_set_path(tenant_bitmaps):
+    """Satellite: a pool referencing ONE set must ride the existing
+    single-set path — no pooled plan/program, no new device buffers
+    (the HBM ledger is the witness: only resident-set construction
+    registers bytes, so the snapshot must not move)."""
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    queries = [BatchQuery("or", (0, 1, 2)), BatchQuery("xor", (1, 3))]
+    ledger_before = obs_memory.LEDGER.snapshot()
+    got = eng.execute([BatchGroup(1, queries)], engine="xla")
+    assert obs_memory.LEDGER.snapshot() == ledger_before
+    # zero pooled machinery engaged
+    assert len(eng._plans) == 0 and len(eng._programs) == 0
+    # and the single-set engine's own caches served the call
+    be = eng._engines[1]
+    assert tuple(queries) in be._plans
+    want = be.execute(queries, engine="xla")
+    assert [r.cardinality for r in got[0]] == \
+        [r.cardinality for r in want]
+
+
+def test_budget_pool_split_proactive_and_bit_exact(tenant_bitmaps, oracle,
+                                                   tmp_path):
+    """ROARING_TPU_HBM_BUDGET respected per-pool: the pool halves BEFORE
+    dispatch, every dispatched launch's prediction fits the budget
+    (multiset.memory events), results stay bit-exact, and the splits are
+    counted under rb_multiset_*."""
+    bm_pool, want = oracle
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    full = eng.predict_dispatch_bytes(bm_pool)
+    assert full > 0
+    budget = max(1, full // 3)
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    policy = guard.GuardPolicy(hbm_budget=budget)
+    got = eng.execute(bm_pool, engine="xla", policy=policy)
+    obs.disable()
+    _assert_bit_exact(got, want, "budget")
+    assert eng.proactive_split_count > 0
+
+    spans = [json.loads(line) for line in open(path)]
+    mems = [ev for s in spans if s["name"] == "multiset.dispatch"
+            for ev in s["events"] if ev["name"] == "multiset.memory"]
+    assert mems and all(ev["predicted_bytes"] <= budget for ev in mems)
+    splits = [ev for s in spans for ev in s["events"]
+              if ev["name"] == "proactive_split"
+              and ev.get("site") == "multiset"]
+    assert len(splits) == eng.proactive_split_count
+    assert all(ev["predicted_bytes"] > ev["budget_bytes"]
+               for ev in splits)
+    pipes = [s for s in spans if s["name"] == "multiset.pipeline"]
+    assert pipes and pipes[0]["tags"]["launches"] > 1
+    snap = obs.snapshot()
+    pro = snap["counters"]["rb_multiset_proactive_splits_total"]
+    assert pro[0]["value"] == eng.proactive_split_count
+
+
+def test_memory_event_and_pool_metrics(tenant_bitmaps, pool, tmp_path):
+    """Pooled dispatches report predicted-vs-measured HBM (the
+    batch.memory-equivalent multiset.memory event) and the pool gauges
+    move."""
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    eng.execute(pool, engine="xla")
+    obs.disable()
+    mem = eng.last_dispatch_memory
+    assert mem["predicted_bytes"] > 0 and mem["sets"] == len(S_SIZES)
+    assert mem["measured_peak_bytes"] > 0      # AOT-compiled accounting
+    spans = [json.loads(line) for line in open(path)]
+    names = {s["name"] for s in spans}
+    assert {"multiset.execute", "multiset.plan", "multiset.pool",
+            "multiset.dispatch", "multiset.readback",
+            "multiset.pipeline"} <= names
+    snap = obs.snapshot()
+    occ = snap["gauges"]["rb_multiset_pool_occupancy"][0]["value"]
+    assert 0.0 < occ <= 1.0
+    assert snap["counters"]["rb_multiset_queries_total"][0]["value"] \
+        == sum(len(g.queries) for g in pool)
+    # one pooled launch served 3 tenants: 2 launches saved
+    saved = snap["counters"]["rb_multiset_launches_saved_total"]
+    assert saved[0]["value"] == len(S_SIZES) - 1
+    cell = obs_memory.dispatch_memory_cell(mem)
+    assert cell["sets"] == len(S_SIZES) and cell["predicted_mb"] > 0
+
+
+def test_execute_pipelined_streams_pools(tenant_bitmaps):
+    """The serving-tick shape: several pools through one pipeline
+    window, per-pool results bit-exact and order-preserved."""
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    pools = [random_multiset_pool(list(S_SIZES), 9, seed=s)
+             for s in (21, 22, 23)]
+    obs.reset()
+    got = eng.execute_pipelined(pools, engine="xla")
+    for p, rows in zip(pools, got):
+        _assert_bit_exact(rows, _per_set_reference(tenant_bitmaps, p),
+                          "pipelined")
+    assert eng.last_pipeline["launches"] == len(pools)
+    # launches-saved baseline is one-launch-per-referenced-set PER POOL:
+    # a stream over the same tenants still amortizes every tick
+    baseline = sum(len({g.set_id for g in p if g.queries}) for p in pools)
+    saved = obs.snapshot()["counters"]["rb_multiset_launches_saved_total"]
+    assert saved[0]["value"] == baseline - len(pools)
+
+
+def test_shadow_check_catches_silent_corruption(tenant_bitmaps, pool):
+    from roaringbitmap_tpu.runtime import errors
+
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    policy = guard.GuardPolicy(shadow_rate=1.0)
+    # clean run passes the full-rate shadow
+    eng.execute(pool, engine="xla", policy=policy)
+    with faults.inject("silent@multiset=1.0:3"):
+        with pytest.raises(errors.ShadowMismatch):
+            eng.execute(pool, engine="xla", policy=policy)
+
+
+def test_group_validation(tenant_bitmaps):
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    with pytest.raises(IndexError):
+        eng.execute([BatchGroup(9, [BatchQuery("or", (0, 1))])])
+    assert eng.execute([]) == []
+    assert eng.execute([BatchGroup(0, [])]) == [[]]
+    with pytest.raises(ValueError):
+        MultiSetBatchEngine([])
+
+
+def test_pool_program_cache_bounds_recompiles(tenant_bitmaps):
+    """Same pooled bucket signatures must reuse the compiled program."""
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps)
+    p1 = [BatchGroup(0, [BatchQuery("or", (0, 1))]),
+          BatchGroup(1, [BatchQuery("or", (2, 3))])]
+    eng.execute(p1, engine="xla")
+    n1 = len(eng._programs)
+    p2 = [BatchGroup(0, [BatchQuery("or", (4, 5))]),
+          BatchGroup(1, [BatchQuery("or", (0, 5))])]
+    eng.execute(p2, engine="xla")
+    assert len(eng._programs) == n1      # same signature -> cache hit
+
+
+# ------------------------------------------------ adaptive layout default
+
+def _uscensus_shaped(n: int = 10):
+    """Mostly-singleton containers across many keys: ~1 value per 2^16
+    segment, so the dense image inflates the serialized bytes by far
+    more than 100x (the uscensus2000 shape, docs/USCENSUS2000_CLIFF.md)."""
+    rng = np.random.default_rng(7)
+    return [RoaringBitmap.from_values(np.unique(
+        (rng.choice(400, size=20, replace=False).astype(np.uint32) << 16)
+        + rng.integers(0, 1 << 16, 20).astype(np.uint32)))
+        for _ in range(n)]
+
+
+def test_choose_layout_flips_only_the_inflation_shape():
+    from roaringbitmap_tpu.insights import analysis as insights
+
+    rep = insights.choose_layout(_uscensus_shaped())
+    assert rep["layout"] == "counts"
+    assert rep["median_segment"] <= insights.AUTO_COUNTS_MEDIAN_SEGMENT
+    assert rep["inflation_x"] > insights.AUTO_COUNTS_INFLATION_X
+    # a dense-friendly shape (many values per segment) keeps the default
+    rng = np.random.default_rng(8)
+    normal = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 17, 3000).astype(np.uint32))
+        for _ in range(6)]
+    assert insights.choose_layout(normal)["layout"] == "dense"
+    assert insights.choose_layout([])["layout"] == "dense"
+
+
+def test_auto_layout_default_and_explicit_override():
+    """DeviceBitmapSet's default is now layout="auto": the inflation
+    shape builds counts-resident, an explicit layout= keeps the old
+    behavior verbatim, and auto stays bit-exact with the dense build."""
+    from roaringbitmap_tpu.parallel import aggregation
+
+    bms = _uscensus_shaped()
+    ds_auto = DeviceBitmapSet(bms)
+    assert ds_auto.layout == "counts"
+    ds_dense = DeviceBitmapSet(bms, layout="dense")
+    assert ds_dense.layout == "dense" and ds_dense.words is not None
+    # parity: the auto (counts) build answers every wide op exactly as
+    # the explicit dense build does
+    for op in ("or", "xor", "and"):
+        assert ds_auto.aggregate(op) == ds_dense.aggregate(op), op
+    want = aggregation.or_(*bms)
+    assert ds_auto.aggregate("or") == want
+
+
+# ---------------------------------------------------- CPU-proxy acceptance
+
+def _tiny_tenants(s: int, n: int = 8):
+    """Dispatch-floor-dominated tenants (the regime pooling exists for):
+    tiny bitmaps make per-launch overhead, not per-query work, the
+    cost."""
+    rng = np.random.default_rng(s)
+    return [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 16, 400).astype(np.uint32))
+        for _ in range(n)]
+
+
+def _timed(fn):
+    import time
+
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+@pytest.mark.slow
+def test_pooled_3x_vs_per_set_loop():
+    """Acceptance: Q=64 spread over S=8 sets pooled into one launch runs
+    >= 3x the QPS of the per-set sequential BatchEngine loop (8
+    launches), bit-exact on every result."""
+    s = 8
+    tenants = [_tiny_tenants(40 + i) for i in range(s)]
+    engines = [BatchEngine.from_bitmaps(t, layout="dense")
+               for t in tenants]
+    eng = MultiSetBatchEngine(engines)
+    pool = random_multiset_pool([8] * s, 64, seed=0xACE, max_operands=3)
+    assert sum(len(g.queries) for g in pool) == 64
+
+    def per_set_loop():
+        return [engines[g.set_id].execute(list(g.queries), engine="xla")
+                for g in pool]
+
+    want = per_set_loop()
+    got = eng.execute(pool, engine="xla")
+    _assert_bit_exact(got, want, "3x-parity")
+
+    t_pool = min(_timed(lambda: eng.execute(pool, engine="xla"))
+                 for _ in range(5))
+    t_loop = min(_timed(per_set_loop) for _ in range(5))
+    assert t_loop >= 3.0 * t_pool, (t_loop, t_pool, t_loop / t_pool)
+
+
+@pytest.mark.slow
+def test_pipeline_hides_half_the_host_time(tmp_path):
+    """Acceptance: at Q=64 forced into multiple launches, the pipelined
+    dispatcher hides >= 50% of host plan+pack wall time (overlap ratio
+    from the multiset.pipeline span timings)."""
+    s = 4
+    tenants = [_tiny_tenants(60 + i) for i in range(s)]
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    # warm the compiled programs with same-shaped pools so the measured
+    # pipeline pays planning/packing, not one-time compiles
+    warm = [random_multiset_pool([8] * s, 16, seed=100 + i,
+                                 max_operands=3) for i in range(4)]
+    eng.execute_pipelined(warm, engine="xla")
+    pools = [random_multiset_pool([8] * s, 16, seed=200 + i,
+                                  max_operands=3) for i in range(4)]
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    eng.execute_pipelined(pools, engine="xla")
+    obs.disable()
+    spans = [json.loads(line) for line in open(path)]
+    pipes = [s_ for s_ in spans if s_["name"] == "multiset.pipeline"]
+    assert pipes
+    tags = pipes[-1]["tags"]
+    assert tags["launches"] == 4
+    assert tags["host_ms"] > 0
+    assert tags["overlap_ratio"] >= 0.5, tags
+    assert eng.last_pipeline["overlap_ratio"] == tags["overlap_ratio"]

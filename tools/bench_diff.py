@@ -37,9 +37,21 @@ import json
 import re
 import sys
 
-#: lane-name fragments -> direction (checked in order; first hit wins)
-HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup")
+#: lane-name fragments -> direction (checked in order; first hit wins).
+#: The multiset lane (bench.py multiset_phase) adds pooled-vs-per-set
+#: ratio and pipeline-overlap paths: ``*_qps`` matches via ``qps``,
+#: ``pooled_vs_per_set_x`` via ``pooled_vs``, ``overlap_ratio`` and
+#: ``launches_saved`` explicitly.
+HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
+          "overlap_ratio", "launches_saved", "pooled_vs")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes")
+#: checked before HIGHER/LOWER: lanes whose good direction is genuinely
+#: ambiguous.  host_overlapped_ms scales with total host time in BOTH
+#: directions (more overlap at fixed host_ms is good, but so is less
+#: host work overall) — overlap_ratio is the gated pipelining signal,
+#: so the raw overlapped milliseconds stay informational instead of
+#: being caught by the ``_ms`` lower-is-better fragment.
+NEUTRAL = ("host_overlapped",)
 
 
 def salvage_tail_json(tail: str) -> dict | None:
@@ -114,6 +126,8 @@ def _flatten(node, prefix: str, out: dict) -> None:
 def direction(lane: str) -> int:
     """+1 higher-is-better, -1 lower-is-better, 0 informational."""
     low = lane.lower()
+    if any(t in low for t in NEUTRAL):
+        return 0
     if low == "value" or any(t in low for t in HIGHER):
         return 1
     if any(t in low for t in LOWER):

@@ -25,7 +25,15 @@ and a wide aggregation — then (2) validates every emitted line:
   measurement is available), and the workload's tiny
   ``ROARING_TPU_HBM_BUDGET`` batch produced a ``proactive_split`` event
   recording predicted vs budget bytes (docs/OBSERVABILITY.md, "Memory
-  observability").
+  observability");
+- multiset semantics (same --workload run): the pooled cross-tenant
+  lane emits the ``multiset.execute`` / ``multiset.plan`` /
+  ``multiset.pool`` / ``multiset.dispatch`` / ``multiset.pipeline``
+  span vocabulary, every ``multiset.dispatch`` carries a
+  ``multiset.memory`` event with positive ``predicted_bytes``, the
+  pipeline span reports its ``launches`` / ``overlap_ratio`` tags, and
+  the tiny-budget pool produced a ``site="multiset"``
+  ``proactive_split`` (the forced POOL split).
 
 Validation-only mode (``python tools/check_trace.py <path>``) checks an
 existing dump, e.g. one captured from a serving process.
@@ -109,7 +117,30 @@ def validate(path: str, workload_semantics: bool = False,
     if workload_semantics:
         errors += _workload_semantics([s for _, s in spans],
                                       budget_semantics)
+    else:
+        # arbitrary dumps still get per-EVENT schema checks for whatever
+        # pooled spans they happen to contain (an existing
+        # multiset.memory event must be well-formed); completeness and
+        # span presence are only demanded of the --workload run
+        errors += _multiset_semantics([s for _, s in spans])
     return errors
+
+
+def _require_proactive_split(spans: list[dict], site: str,
+                             case: str) -> list[str]:
+    """One site's forced-budget-split contract: some ``proactive_split``
+    event at ``site`` must carry numeric predicted_bytes > budget_bytes."""
+    splits = [ev for s in spans for ev in s.get("events", [])
+              if ev.get("name") == "proactive_split"
+              and ev.get("site") == site]
+    if not any(isinstance(ev.get("predicted_bytes"), (int, float))
+               and isinstance(ev.get("budget_bytes"), (int, float))
+               and ev["predicted_bytes"] > ev["budget_bytes"]
+               for ev in splits):
+        return [f"no site={site} proactive_split event with "
+                f"predicted_bytes > budget_bytes ({case}; "
+                f"saw: {splits!r})"]
+    return []
 
 
 def _workload_semantics(spans: list[dict],
@@ -159,16 +190,59 @@ def _workload_semantics(spans: list[dict],
         # only the --workload run guarantees a budget case (it forces one
         # with a tiny ROARING_TPU_HBM_BUDGET); arbitrary dumps need not
         # contain a proactive split to be valid
-        splits = [ev for s in spans for ev in s.get("events", [])
-                  if ev.get("name") == "proactive_split"]
-        if not any(isinstance(ev.get("predicted_bytes"), (int, float))
-                   and isinstance(ev.get("budget_bytes"), (int, float))
-                   and ev["predicted_bytes"] > ev["budget_bytes"]
-                   for ev in splits):
+        errors += _require_proactive_split(
+            spans, "batch_engine", "the ROARING_TPU_HBM_BUDGET workload "
+            "case")
+    errors += _multiset_semantics(spans, budget_semantics,
+                                  complete=True)
+    return errors
+
+
+def _multiset_semantics(spans: list[dict],
+                        budget_semantics: bool = False,
+                        complete: bool = False) -> list[str]:
+    """The pooled cross-tenant lane's span vocabulary (parallel.multiset,
+    docs/BATCH_ENGINE.md "Multi-set pooling & pipelining")."""
+    errors: list[str] = []
+    if budget_semantics:
+        # only the --workload run guarantees the pooled lane ran;
+        # arbitrary dumps validate multiset span SCHEMAS where present
+        for required in ("multiset.execute", "multiset.plan",
+                         "multiset.pool", "multiset.dispatch",
+                         "multiset.pipeline"):
+            if not any(s.get("name") == required for s in spans):
+                errors.append(f"no {required} span — the pooled "
+                              "multi-set path was not traced")
+    dispatches = [s for s in spans if s.get("name") == "multiset.dispatch"]
+    mems = [ev for s in dispatches for ev in s.get("events", [])
+            if ev.get("name") == "multiset.memory"]
+    if complete:
+        # completeness is a workload-dump guarantee only: a production
+        # dispatch aborted by a real device fault is written (status=
+        # error) before its memory event by design, and a pipeline span
+        # unwound by an exception closes without its stat tags — neither
+        # makes an arbitrary dump invalid
+        if dispatches and len(mems) < len(dispatches):
             errors.append(
-                "no proactive_split event with predicted_bytes > "
-                "budget_bytes (the ROARING_TPU_HBM_BUDGET workload case; "
-                f"saw: {splits!r})")
+                f"{len(dispatches) - len(mems)} multiset.dispatch "
+                "span(s) lack a multiset.memory event")
+        for s in spans:
+            if s.get("name") != "multiset.pipeline":
+                continue
+            tags = s.get("tags") or {}
+            if not isinstance(tags.get("launches"), int) \
+                    or not isinstance(tags.get("overlap_ratio"),
+                                      (int, float)):
+                errors.append("multiset.pipeline span lacks launches / "
+                              f"overlap_ratio tags: {tags!r}")
+    for ev in mems:
+        p = ev.get("predicted_bytes")
+        if not isinstance(p, (int, float)) or p <= 0:
+            errors.append(f"multiset.memory event with non-positive "
+                          f"predicted_bytes: {ev!r}")
+    if budget_semantics:
+        errors += _require_proactive_split(
+            spans, "multiset", "the forced POOL split workload case")
     return errors
 
 
@@ -184,6 +258,8 @@ def run_workload(path: str) -> None:
     from roaringbitmap_tpu.parallel import aggregation
     from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
                                                          random_query_pool)
+    from roaringbitmap_tpu.parallel.multiset import (MultiSetBatchEngine,
+                                                     random_multiset_pool)
     from roaringbitmap_tpu.runtime import faults
     from roaringbitmap_tpu.utils import datasets
 
@@ -210,6 +286,26 @@ def run_workload(path: str) -> None:
         assert eng.proactive_split_count > 0, \
             "tiny ROARING_TPU_HBM_BUDGET did not force a proactive split"
         aggregation.or_(*bms[:8])
+
+        # pooled cross-tenant lane: 3 tenants, one pooled launch
+        # (multiset.* spans), then a tiny budget forcing a POOL split
+        tenants = [datasets.synthetic_bitmaps(
+            8, seed=30 + i, universe=1 << 17, density=0.01)
+            for i in range(3)]
+        ms = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+        ms_pool = random_multiset_pool([8] * 3, 24, seed=11)
+        ms_clean = [[r.cardinality for r in rows]
+                    for rows in ms.execute(ms_pool)]
+        budget = max(1, ms.predict_dispatch_bytes(ms_pool) // 3)
+        os.environ["ROARING_TPU_HBM_BUDGET"] = str(budget)
+        try:
+            ms_budgeted = [[r.cardinality for r in rows]
+                           for rows in ms.execute(ms_pool)]
+        finally:
+            del os.environ["ROARING_TPU_HBM_BUDGET"]
+        assert ms_budgeted == ms_clean, "budget-split pool diverged"
+        assert ms.proactive_split_count > 0, \
+            "tiny budget did not force a proactive POOL split"
     finally:
         obs.disable()
 

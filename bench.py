@@ -360,6 +360,32 @@ def batched_phase(state: dict) -> dict:
     out["q64_vs_q1_amortization_x"] = round(amort, 2)
     out["meets_5x"] = amort >= 5.0
     out["fault_lane"] = fault_lane_phase(eng, pool)
+    out.update(cost_slo_cell(eng, pool))
+    return out
+
+
+def cost_slo_cell(eng, pool) -> dict:
+    """Cost/SLO lane (ISSUE 6): one phase-attributed execute of the max-Q
+    batch — per-phase wall breakdown (obs.slo) and the dispatch's
+    roofline position (obs.cost) — so the round artifact records WHERE
+    the batched lane's time goes and how close the launch runs to the
+    peak table, and the sentry can trend both."""
+    from roaringbitmap_tpu.obs import slo as obs_slo
+
+    q = min(max(BATCH_SIZES), len(pool))
+    with obs_slo.attribution():
+        eng.cardinalities(pool[:q])
+    out: dict = {}
+    lq = obs_slo.last_query
+    if lq and lq.get("phases_ms"):
+        out["phase_ms"] = {ph: v for ph, v in lq["phases_ms"].items()
+                           if v >= 0.005 or ph in ("dispatch", "sync")}
+    cost = eng.last_dispatch_cost or {}
+    if "roofline_fraction" in cost:
+        out["cost"] = {
+            "roofline_fraction": cost["roofline_fraction"],
+            "achieved_gbps": round(cost["achieved_bytes_per_s"] / 1e9, 3),
+            "device_ms": cost["device_ms"]}
     return out
 
 
@@ -479,10 +505,12 @@ SUMMARY_MAX_BYTES = 2048
 #: summary fields shed in order (least driver-critical first) until the
 #: line fits SUMMARY_MAX_BYTES; the core (metric, value, vs_baseline,
 #: full_doc) is never dropped — north_star goes last and only under a
-#: pathological dataset count
-SUMMARY_DROP_ORDER = ("marginal_us_spread", "multiset", "batched_qps",
-                      "marginal_us_median", "unit", "backend",
-                      "north_star")
+#: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
+#: they are trend inputs for the sentry, not driver-gate fields, and the
+#: full doc always keeps them
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "marginal_us_spread",
+                      "multiset", "batched_qps", "marginal_us_median",
+                      "unit", "backend", "north_star")
 
 
 def summary_line(out: dict, full_path: str,
@@ -548,6 +576,18 @@ def build_summary(out: dict, full_path: str) -> dict:
                     fl["sequential_floor_cost_x"]]
     if batched:
         s["batched_qps"] = batched
+    # cost/SLO lanes, compact: roofline fraction + per-phase wall of the
+    # max-Q batched execute per dataset (first shed under pressure)
+    cost, phases = {}, {}
+    for name, row in (out.get("batched_by_dataset") or {}).items():
+        if isinstance(row, dict) and "cost" in row:
+            cost[name] = row["cost"].get("roofline_fraction")
+        if isinstance(row, dict) and row.get("phase_ms"):
+            phases[name] = row["phase_ms"]
+    if cost:
+        s["cost"] = cost
+    if phases:
+        s["phase_ms"] = phases
     ms = out.get("multiset") or {}
     lanes = {}
     for key, row in ms.items():

@@ -625,6 +625,17 @@ def bench_batch(st: dict, cells: dict, reps: int) -> None:
                 **hbm,
                 "note": "dispatch peak: unified-model prediction vs "
                         "Compiled.memory_analysis (temp+output)"}
+        cost = eng.last_dispatch_cost or {}
+        if "roofline_fraction" in cost:
+            # cost/roofline twin (ISSUE 6): how close the dispatch runs
+            # to the peak-table ceiling, per dataset per Q
+            cells[f"batch_q{q}/cost"] = {
+                "roofline_fraction": cost["roofline_fraction"],
+                "achieved_gbps": round(
+                    cost["achieved_bytes_per_s"] / 1e9, 3),
+                "device_ms": cost["device_ms"],
+                "note": "Compiled.cost_analysis over measured launch "
+                        "wall vs the obs.cost peak table"}
         expected = sum(int(c) for c in eng.cardinalities(pool[:q]))
         per = _marginal(
             lambda r, q=q: eng.chained_cardinality(pool[:q], r),

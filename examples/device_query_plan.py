@@ -65,12 +65,20 @@ def main() -> None:
           f"predicted_dispatch={plan['predicted']['peak_bytes'] / 1e6:.1f}MB "
           f"budget={plan['hbm_budget_bytes']} "
           f"split={plan['proactive_split']['dispatches']}")
+    print(f"explain cost: est_device_total="
+          f"{plan['cost']['est_device_total_ms']}ms over "
+          f"{len(plan['cost']['per_bucket_est_device_ms'])} buckets "
+          f"(peaks: {plan['cost']['peaks']['kind']})")
     cards = eng.cardinalities(pool)
     mem = eng.last_dispatch_memory
     print(f"dispatched {len(cards)} queries: predicted "
           f"{mem['predicted_bytes'] / 1e6:.1f}MB, measured "
           f"{mem.get('measured_peak_bytes', 0) / 1e6:.1f}MB "
           f"(residual {mem.get('residual_x', 'n/a')}x)")
+    cost = eng.last_dispatch_cost
+    print(f"dispatch cost: {cost['device_ms']}ms, "
+          f"{cost.get('bytes_accessed', 0) / 1e6:.1f}MB accessed, "
+          f"roofline {cost.get('roofline_fraction', 'n/a')}")
 
     # parity against the host tier
     host_t, host_v = RoaringBitmap(), RoaringBitmap()

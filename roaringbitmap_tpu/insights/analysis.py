@@ -265,6 +265,36 @@ def predict_batch_dispatch_bytes(bucket_sigs: list, kind: str,
             "densify_bytes": densify, "peak_bytes": total}
 
 
+def predict_batch_dispatch_word_ops(bucket_sigs: list, kind: str,
+                                    n_rows: int, engine: str) -> int:
+    """Word-op count of ONE batch dispatch — the flops-proxy half of the
+    roofline cost model (``obs.cost``; bytes come from
+    :func:`predict_batch_dispatch_bytes`).  A "word op" is one u32
+    bitwise/popcount lane operation, the unit XLA's ``cost_analysis``
+    counts as a flop for this integer workload.  Per bucket:
+
+    - the segmented reduce: the XLA doubling pass sweeps the q*r_pad
+      gathered rows ``n_steps`` times; the Pallas kernel (and the
+      vmapped cross-check) accumulate in one pass;
+    - the per-key post passes (presence/keep masks, andnot head pass)
+      and the popcount, one sweep of the q*(k_pad+1) head rows each;
+    - plus the in-program densify of a streams-resident source
+      (one write per rebuilt row word).
+    """
+    words = 2048           # u32 lanes per container row
+    total = 0
+    for op, q, r_pad, k_pad, n_steps, needs_words in bucket_sigs:
+        passes = 1 if engine == "pallas" else max(1, int(n_steps))
+        total += q * r_pad * words * passes          # segmented reduce
+        head_rows = q * (k_pad + 1)
+        total += head_rows * words                   # mask + popcount pass
+        if op == "andnot":
+            total += head_rows * words               # head & ~rest pass
+    if kind == "streams":
+        total += (int(n_rows) + 1) * words           # in-program densify
+    return int(total)
+
+
 def predict_multiset_dispatch_bytes(bucket_sigs: list, sets: list,
                                     engine: str,
                                     pool_rows: int | None = None) -> dict:

@@ -3,7 +3,7 @@
 Three pieces (docs/OBSERVABILITY.md is the operator reference):
 
 - ``obs.trace`` — structured spans over the full query path
-  (``batch.execute`` → plan/bucket/program_build/dispatch/readback,
+  (``batch.execute`` → plan/bucket/program_build/dispatch(+sync_ms)/readback,
   ``guard.dispatch`` with retry/demote/split events, ``aggregation.wide``,
   ``sharding.wide_aggregate``, ``multihost.initialize``), dumped as JSONL
   via ``ROARING_TPU_TRACE=<path>``; near-zero overhead when disabled.
@@ -17,41 +17,63 @@ Three pieces (docs/OBSERVABILITY.md is the operator reference):
   per-dispatch predicted-vs-measured accounting
   (``rb_hbm_predicted_bytes`` / ``rb_hbm_measured_peak_bytes`` from
   ``Compiled.memory_analysis()``; the ``batch.memory`` span event).
+- ``obs.cost`` — device-time and cost accounting:
+  ``Compiled.cost_analysis()`` captured at program build, per-dispatch
+  achieved flops/bytes rates and roofline-fraction gauges against a
+  per-backend peak table (the ``batch.cost`` / ``multiset.cost`` span
+  events).
+- ``obs.slo`` — per-query latency attribution (phase breakdown into
+  ``rb_phase_seconds``) and deadline/SLO accounting
+  (``rb_slo_attained_total`` / ``rb_slo_missed_total``; the ``slo``
+  span event on a miss), plus the profile-on-miss capture window.
 
 ``snapshot()`` is the in-process JSON API: the full registry state plus
-the tracer's enablement and the HBM ledger — one dict a health endpoint
-can return verbatim.
+the tracer's enablement, the HBM ledger, and the cost tracker — one dict
+a health endpoint can return verbatim.
 """
 
-from . import export, memory, metrics, trace
+from . import cost, export, memory, metrics, slo, trace
+from .cost import TRACKER
 from .export import render_prometheus
 from .memory import LEDGER
 from .metrics import (DEFAULT_LATENCY_BUCKETS, REGISTRY, counter, gauge,
                       histogram, snapshot_delta)
-from .trace import (current, disable, enable, enabled, refresh_from_env,
-                    span)
+from .slo import SloPolicy
+from .trace import current, disable, enable, enabled, span
+
+
+def refresh_from_env() -> None:
+    """Re-read every obs env knob (``ROARING_TPU_TRACE[_XPROF]``,
+    ``ROARING_TPU_PROFILE_ON_SLO_MISS``) after an in-process environment
+    change."""
+    trace.refresh_from_env()
+    slo.refresh_from_env()
 
 
 def snapshot() -> dict:
     """Process observability state as one plain-JSON dict: every counter,
-    gauge, and histogram in the registry, plus tracer status and the HBM
-    ledger's live residency breakdown."""
+    gauge, and histogram in the registry, plus tracer status, the HBM
+    ledger's live residency breakdown, and the per-(site, engine) cost /
+    roofline tracker."""
     doc = metrics.REGISTRY.snapshot()
     doc["trace"] = {"enabled": trace.enabled(), "path": trace.path()}
     doc["hbm"] = memory.LEDGER.snapshot()
+    doc["cost"] = cost.TRACKER.snapshot()
     return doc
 
 
 def reset() -> None:
-    """Drop all registry instruments (tracer state untouched); symmetric
-    with ``snapshot()`` — see tests/test_obs.py."""
+    """Drop all registry instruments and the cost tracker's accumulation
+    (tracer state untouched); symmetric with ``snapshot()`` — see
+    tests/test_obs.py."""
     metrics.REGISTRY.reset()
+    cost.TRACKER.reset()
 
 
 __all__ = [
-    "trace", "metrics", "export", "memory",
+    "trace", "metrics", "export", "memory", "cost", "slo",
     "span", "current", "enable", "disable", "enabled", "refresh_from_env",
     "counter", "gauge", "histogram", "snapshot_delta", "REGISTRY",
-    "LEDGER", "DEFAULT_LATENCY_BUCKETS", "render_prometheus", "snapshot",
-    "reset",
+    "LEDGER", "TRACKER", "SloPolicy", "DEFAULT_LATENCY_BUCKETS",
+    "render_prometheus", "snapshot", "reset",
 ]

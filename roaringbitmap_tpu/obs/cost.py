@@ -1,0 +1,272 @@
+"""Cost & device-time observability: XLA cost analysis + roofline gauges.
+
+PR 4 made device *memory* first-class (``Compiled.memory_analysis()``
+captured at program build, predicted-vs-actual per dispatch); this module
+does the same for device *time*.  Every AOT-compiled batch / multiset
+program also carries ``Compiled.cost_analysis()`` — the compiler's own
+flop and byte accounting — captured once at ``program_build`` next to the
+memory analysis.  Each dispatch then combines that static cost with the
+measured launch wall time (host call + device completion, the same wait
+``Span.sync()`` tags as ``sync_ms``) into achieved rates and a roofline
+position:
+
+- ``rb_achieved_flops_per_s{site,engine}`` — flops / device seconds;
+- ``rb_achieved_bytes_per_s{site,engine}`` — bytes accessed / device
+  seconds (the bandwidth the launch actually sustained);
+- ``rb_roofline_fraction{site,engine}`` — measured time vs the roofline
+  bound ``max(flops / peak_flops, bytes / peak_bw)`` (equivalently
+  achieved flops over ``min(peak_flops, peak_bw * intensity)``; the max
+  form is robust to the flops→0 limit of bitwise workloads, where it
+  degrades to the bandwidth fraction).  Clamped to (0, 1]: a raw value
+  past 1 means the peak table *underestimates* this machine (caches,
+  VMEM residency) and is kept as ``roofline_fraction_raw``.
+- ``rb_device_time_seconds_total{site,engine}`` — cumulative attributed
+  launch time, the per-(site, engine) device-time ledger.
+
+Peaks come from a small per-backend table (:data:`PEAKS`) resolved from
+the default device's kind, with a deliberately conservative **CPU proxy**
+fallback so the CI lane exercises the full pipeline; the table is a
+planning input, not a datasheet — override via :func:`set_peaks`.
+
+``TRACKER`` accumulates per-(site, engine) totals and the last dispatch's
+gauges; ``obs.snapshot()["cost"]`` is its JSON view and ``obs.reset()``
+clears it (reset/snapshot symmetric, like the registry).  All of this is
+always on: the marginal cost per dispatch is one perf_counter pair and a
+few dict updates, invisible next to the launch it accounts for.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import metrics as _metrics
+
+#: per-backend peak table: ordered (device-kind substring, lowercased) ->
+#: (peak_flops_per_s, peak_bytes_per_s).  First match wins; the entries
+#: are roofline *ceilings* for planning, not datasheet claims — the TPU
+#: rows use bf16 peak FLOPs and HBM bandwidth, the CPU row is a
+#: deliberately conservative single-socket proxy (a few vector lanes at a
+#: few GHz, ~20 GB/s of main-memory bandwidth) so the CI proxy lane
+#: produces meaningful, stable fractions.
+PEAKS = (
+    ("v5 lite", (1.97e14, 8.19e11)),
+    ("v5e", (1.97e14, 8.19e11)),
+    ("v5p", (4.59e14, 2.77e12)),
+    ("v4", (2.75e14, 1.23e12)),
+    ("tpu", (1.97e14, 8.19e11)),      # unknown TPU generation: v5e-class
+    ("gpu", (1.0e14, 2.0e12)),        # generic accelerator fallback
+    ("cpu", (5.0e10, 2.0e10)),        # CPU proxy (see note above)
+)
+
+#: the fallback when nothing matches (an exotic plugin backend): the CPU
+#: proxy — conservative ceilings overestimate the fraction, which clamps
+CPU_PROXY = ("cpu-proxy", 5.0e10, 2.0e10)
+
+_peaks_override: tuple | None = None
+_peaks_cache: tuple | None = None
+
+
+def set_peaks(peak_flops_per_s: float | None,
+              peak_bytes_per_s: float | None = None,
+              label: str = "override") -> None:
+    """Override the resolved peak table (both rates, ``None`` to clear) —
+    the seam for operators with measured machine ceilings."""
+    global _peaks_override, _peaks_cache
+    _peaks_cache = None
+    if peak_flops_per_s is None:
+        _peaks_override = None
+    else:
+        _peaks_override = (label, float(peak_flops_per_s),
+                           float(peak_bytes_per_s))
+
+
+def device_peaks() -> dict:
+    """Resolved ``{"kind", "peak_flops_per_s", "peak_bytes_per_s"}`` for
+    the default device (cached; the CPU proxy when jax is unavailable or
+    the kind is unknown)."""
+    global _peaks_cache
+    if _peaks_override is not None:
+        label, pf, pb = _peaks_override
+        return {"kind": label, "peak_flops_per_s": pf,
+                "peak_bytes_per_s": pb}
+    if _peaks_cache is None:
+        label, pf, pb = CPU_PROXY
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            kind = str(dev.device_kind).lower()
+            # GPU device_kind is the model name ("NVIDIA A100-..."), so
+            # the platform tag is matched too — it is what actually hits
+            # the generic gpu/tpu rows for kinds the table doesn't name
+            platform = str(getattr(dev, "platform", "")).lower()
+            for frag, (f, b) in PEAKS:
+                if frag in kind or frag == platform:
+                    label, pf, pb = kind, f, b
+                    break
+        except Exception:  # pragma: no cover - no backend at all
+            pass
+        _peaks_cache = (label, pf, pb)
+    label, pf, pb = _peaks_cache
+    return {"kind": label, "peak_flops_per_s": pf, "peak_bytes_per_s": pb}
+
+
+def observe_compile(site: str, cache: str, seconds: float) -> None:
+    """One ``rb_compile_seconds{site,cache}`` observation — the shared
+    accounting of every program cache (batch/multiset program LRUs, the
+    sharded-densify lru_cache): ``cache="miss"`` records a real compile
+    wall, ``cache="hit"`` the lookup, so the histogram is the
+    amortization view ROADMAP item 3's cold-path work is judged
+    against."""
+    _metrics.histogram("rb_compile_seconds", site=site,
+                       cache=cache).observe(max(0.0, seconds))
+
+
+def compiled_cost(compiled) -> dict | None:
+    """Static cost accounting of a ``jax.stages.Compiled``:
+    ``{"flops", "bytes_accessed", "transcendentals"}`` from
+    ``cost_analysis()`` (a list of one dict on current jaxlibs, a plain
+    dict on older ones).  None when the backend does not report."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    return {
+        "flops": float(ca.get("flops") or 0.0),
+        "bytes_accessed": float(ca.get("bytes accessed") or 0.0),
+        "transcendentals": float(ca.get("transcendentals") or 0.0),
+    }
+
+
+class CostTracker:
+    """Per-(site, engine) device-time and cost accumulation — the
+    ``obs.snapshot()["cost"]`` source.  Cleared by ``obs.reset()``."""
+
+    def __init__(self):
+        self._rows: dict = {}      # (site, engine) -> accum dict
+        self._lock = threading.Lock()
+
+    def record(self, site: str, engine: str, doc: dict) -> None:
+        key = (site, engine)
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                row = self._rows[key] = {
+                    "dispatches": 0, "device_seconds_total": 0.0,
+                    "flops_total": 0.0, "bytes_total": 0.0, "last": None}
+            row["dispatches"] += 1
+            row["device_seconds_total"] += doc.get("device_ms", 0.0) / 1e3
+            row["flops_total"] += doc.get("flops", 0.0)
+            row["bytes_total"] += doc.get("bytes_accessed", 0.0)
+            row["last"] = dict(doc)
+
+    def observed_rates(self, site: str, engine: str) -> dict | None:
+        """Cumulative achieved rates for (site, engine), or None before
+        any recorded dispatch — the calibration input of
+        :func:`estimate_seconds`."""
+        with self._lock:
+            row = self._rows.get((site, engine))
+            if not row or row["device_seconds_total"] <= 0.0 \
+                    or row["bytes_total"] <= 0.0:
+                return None
+            t = row["device_seconds_total"]
+            return {"achieved_flops_per_s": row["flops_total"] / t,
+                    "achieved_bytes_per_s": row["bytes_total"] / t,
+                    "dispatches": row["dispatches"]}
+
+    def snapshot(self) -> dict:
+        """{"peaks": ..., "sites": {site: {engine: {...}}}} — plain JSON,
+        deterministic ordering."""
+        with self._lock:
+            items = sorted(self._rows.items())
+        sites: dict = {}
+        for (site, engine), row in items:
+            t = row["device_seconds_total"]
+            out = {
+                "dispatches": row["dispatches"],
+                "device_seconds_total": round(t, 6),
+                "flops_total": row["flops_total"],
+                "bytes_total": row["bytes_total"],
+            }
+            if t > 0:
+                out["achieved_flops_per_s"] = round(
+                    row["flops_total"] / t, 3)
+                out["achieved_bytes_per_s"] = round(
+                    row["bytes_total"] / t, 3)
+            if row["last"] is not None:
+                out["last"] = row["last"]
+                if "roofline_fraction" in row["last"]:
+                    out["roofline_fraction"] = \
+                        row["last"]["roofline_fraction"]
+            sites.setdefault(site, {})[engine] = out
+        return {"peaks": device_peaks(), "sites": sites}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rows.clear()
+
+
+#: the process-wide tracker every dispatch site reports into
+TRACKER = CostTracker()
+
+
+def record_dispatch(site: str, engine: str, cost: dict | None,
+                    device_s: float, **extra) -> dict:
+    """Per-dispatch cost accounting: combine the program's static cost
+    analysis with the measured launch time into achieved rates + the
+    roofline fraction, push the gauges, feed the tracker, and return the
+    ``batch.cost`` / ``multiset.cost`` span-event payload."""
+    doc: dict = {"device_ms": round(max(0.0, device_s) * 1e3, 4), **extra}
+    _metrics.counter("rb_device_time_seconds_total", site=site,
+                     engine=engine).inc(max(0.0, device_s))
+    if cost is not None:
+        doc["flops"] = cost["flops"]
+        doc["bytes_accessed"] = cost["bytes_accessed"]
+        if cost.get("transcendentals"):
+            doc["transcendentals"] = cost["transcendentals"]
+        if device_s > 0.0:
+            peaks = device_peaks()
+            af = cost["flops"] / device_s
+            ab = cost["bytes_accessed"] / device_s
+            # roofline time bound: the launch cannot legally finish before
+            # its flops at peak compute AND its bytes at peak bandwidth
+            bound_s = max(cost["flops"] / peaks["peak_flops_per_s"],
+                          cost["bytes_accessed"] / peaks["peak_bytes_per_s"])
+            raw = bound_s / device_s if bound_s > 0.0 else 0.0
+            doc["achieved_flops_per_s"] = round(af, 3)
+            doc["achieved_bytes_per_s"] = round(ab, 3)
+            doc["roofline_fraction"] = round(min(1.0, raw), 6)
+            doc["roofline_fraction_raw"] = round(raw, 6)
+            _metrics.gauge("rb_achieved_flops_per_s", site=site,
+                           engine=engine).set(af)
+            _metrics.gauge("rb_achieved_bytes_per_s", site=site,
+                           engine=engine).set(ab)
+            _metrics.gauge("rb_roofline_fraction", site=site,
+                           engine=engine).set(doc["roofline_fraction"])
+    TRACKER.record(site, engine, doc)
+    return doc
+
+
+def estimate_seconds(flops: float, bytes_accessed: float,
+                     site: str | None = None,
+                     engine: str | None = None) -> float:
+    """Roofline device-time estimate for a (flops, bytes) workload:
+    ``max(flops / rate_f, bytes / rate_b)`` — at the peak-table ceilings
+    by default, or at the (site, engine)'s *observed* cumulative achieved
+    rates when the tracker has seen dispatches there (the calibrated
+    estimate ``BatchEngine.explain()`` reports)."""
+    peaks = device_peaks()
+    rate_f = peaks["peak_flops_per_s"]
+    rate_b = peaks["peak_bytes_per_s"]
+    if site is not None and engine is not None:
+        obs = TRACKER.observed_rates(site, engine)
+        if obs is not None:
+            if obs["achieved_flops_per_s"] > 0:
+                rate_f = obs["achieved_flops_per_s"]
+            rate_b = obs["achieved_bytes_per_s"]
+    return max(flops / rate_f if rate_f > 0 else 0.0,
+               bytes_accessed / rate_b if rate_b > 0 else 0.0)

@@ -61,6 +61,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import operator
+import time
 
 import jax
 import jax.numpy as jnp
@@ -68,8 +69,10 @@ import numpy as np
 
 from ..core.bitmap import RoaringBitmap
 from ..insights import analysis as insights
+from ..obs import cost as obs_cost
 from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..ops import dense, kernels, packing
 from ..runtime import faults, guard
@@ -302,6 +305,11 @@ class BatchEngine:
         #: predicted-vs-measured bytes of the most recent device dispatch
         #: (the batch.memory event payload) — benchmarks stamp cells with it
         self.last_dispatch_memory: dict | None = None
+        #: cost/roofline accounting of the most recent device dispatch
+        #: (the batch.cost event payload: flops, bytes_accessed, achieved
+        #: rates, roofline_fraction) — benchmarks stamp cells with it
+        self.last_dispatch_cost: dict | None = None
+        self._first_query_done = False  # rb_first_query_seconds, once
 
     @classmethod
     def from_bitmaps(cls, bitmaps: list, layout: str = "auto",
@@ -366,7 +374,8 @@ class BatchEngine:
         cached = self._plans.get(key)
         if cached is not None:
             return cached
-        with obs_trace.span("batch.plan", q=len(queries)) as sp:
+        with obs_slo.phase("plan"), \
+                obs_trace.span("batch.plan", q=len(queries)) as sp:
             groups: dict = {}
             for qid, q in enumerate(queries):
                 rows, segs, keys_q, keep, hrows = self._plan_query(q)
@@ -422,26 +431,37 @@ class BatchEngine:
         have paid it anyway."""
         src, kind = self._resident_src()
         sig = (eng, kind, tuple(b.signature for b in plan))
+        t_get = time.perf_counter()
         cached = self._programs.get(sig)
         if cached is not None:
+            obs_cost.observe_compile("batch_engine", "hit",
+                                     time.perf_counter() - t_get)
             return cached
         b_sigs = [b.signature for b in plan]
 
-        with obs_trace.span("batch.program_build", engine=eng, kind=kind,
-                            buckets=len(plan)) as sp:
+        with obs_slo.phase("program_build"), \
+                obs_trace.span("batch.program_build", engine=eng, kind=kind,
+                               buckets=len(plan)) as sp:
             def run(src_in, barrays):
                 words = self._words_from_src(src_in, kind, eng)
                 return [self._bucket_body(words, s, a, eng)
                         for s, a in zip(b_sigs, barrays)]
 
+            t0 = time.perf_counter()
             compiled = jax.jit(run).lower(
                 src, [b.device_arrays() for b in plan]).compile()
+            compile_s = time.perf_counter() - t0
+            obs_cost.observe_compile("batch_engine", "miss", compile_s)
             predicted = insights.predict_batch_dispatch_bytes(
                 b_sigs, kind, self._ds._n_rows, eng)
             measured = obs_memory.compiled_memory(compiled)
+            cost = obs_cost.compiled_cost(compiled)
             sp.tag(predicted_bytes=predicted["peak_bytes"],
-                   measured_peak_bytes=(measured or {}).get("peak_bytes"))
-            cached = (run, compiled, predicted, measured)
+                   measured_peak_bytes=(measured or {}).get("peak_bytes"),
+                   compile_ms=round(compile_s * 1e3, 2),
+                   flops=(cost or {}).get("flops"),
+                   bytes_accessed=(cost or {}).get("bytes_accessed"))
+            cached = (run, compiled, predicted, measured, cost)
         self._programs.put(sig, cached)
         return cached
 
@@ -477,6 +497,7 @@ class BatchEngine:
         queries = list(queries)
         if not queries:
             return []
+        t_exec0 = time.perf_counter()
         with obs_trace.span("batch.execute", site="batch_engine",
                             q=len(queries), engine=engine,
                             fallback=fallback):
@@ -488,12 +509,26 @@ class BatchEngine:
                                           inject=False)
             policy = policy or guard.GuardPolicy.from_env()
             chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
-            # one budget resolution per execute (not per split recursion):
-            # the backend-free-memory default costs an allocator query,
-            # which must not multiply on the dispatch-floor hot path
-            return self._dispatch(queries, chain, jit, policy,
-                                  guard.Deadline(policy.deadline),
-                                  guard.resolve_hbm_budget(policy))
+            # SLO accounting + per-phase attribution for the whole execute
+            # (splits and demotions included; the guard's own per-dispatch
+            # context is suppressed under this one)
+            with obs_slo.query("batch_engine",
+                               deadline_ms=policy.slo_deadline_ms):
+                # one budget resolution per execute (not per split
+                # recursion): the backend-free-memory default costs an
+                # allocator query, which must not multiply on the
+                # dispatch-floor hot path
+                results = self._dispatch(queries, chain, jit, policy,
+                                         guard.Deadline(policy.deadline),
+                                         guard.resolve_hbm_budget(policy))
+            if not self._first_query_done:
+                # the cold path, first-class (ROADMAP item 3's baseline):
+                # this engine's first execute pays plan + program compile
+                self._first_query_done = True
+                obs_metrics.histogram(
+                    "rb_first_query_seconds", site="batch_engine").observe(
+                        time.perf_counter() - t_exec0)
+            return results
 
     def _dispatch(self, queries, chain, jit, policy, deadline,
                   budget: int | None = None):
@@ -567,9 +602,10 @@ class BatchEngine:
         ``inject=False`` (the fallback=False path) skips it entirely."""
         plan = self.plan(queries)
         eng = self._bucket_engine(plan, engine)
+        obs_slo.note_engine(eng)
         if inject:
             faults.maybe_fail("batch_engine", eng)
-        run, compiled, predicted, measured = self._program(plan, eng)
+        run, compiled, predicted, measured, cost = self._program(plan, eng)
         src, _ = self._resident_src()
         with obs_trace.span("batch.dispatch", engine=eng,
                             q=len(queries), buckets=len(plan)) as sp:
@@ -578,12 +614,20 @@ class BatchEngine:
             # below is free (computed once at program compile)
             stats0 = (obs_memory.backend_memory_stats()
                       if obs_trace.enabled() else None)
-            outs = (compiled if jit else run)(src,
-                                              [b.device_arrays()
-                                               for b in plan])
+            t_launch = time.perf_counter()
+            with obs_slo.phase("dispatch"):
+                outs = (compiled if jit else run)(src,
+                                                  [b.device_arrays()
+                                                   for b in plan])
             # sync before readback: the span's wall time is host work +
-            # queueing, sync_ms is the device-side remainder
-            outs = sp.sync(outs)
+            # queueing, sync_ms is the device-side remainder.  The block
+            # also runs untraced (the readback would wait anyway) so the
+            # launch wall below is an honest device-completion time — the
+            # denominator of the roofline gauges.
+            with obs_slo.phase("sync"):
+                outs = sp.sync(outs)
+                outs = jax.block_until_ready(outs)
+            launch_s = time.perf_counter() - t_launch
             # predicted-vs-actual memory accounting rides the dispatch
             # span as a batch.memory event (tools/check_trace.py pins it)
             mem = obs_memory.record_dispatch(
@@ -597,7 +641,15 @@ class BatchEngine:
             mem["engine"], mem["q"] = eng, len(queries)
             self.last_dispatch_memory = mem
             sp.event("batch.memory", **mem)
-        with obs_trace.span("batch.readback", engine=eng, q=len(queries)):
+            # cost/roofline accounting: the program's static cost analysis
+            # against the measured launch wall (tools/check_trace.py pins
+            # the batch.cost event schema)
+            cost_ev = obs_cost.record_dispatch(
+                "batch_engine", eng, cost, launch_s, q=len(queries))
+            self.last_dispatch_cost = cost_ev
+            sp.event("batch.cost", **cost_ev)
+        with obs_slo.phase("readback"), \
+                obs_trace.span("batch.readback", engine=eng, q=len(queries)):
             results: list = [None] * len(queries)
             for b, (heads, cards) in zip(plan, outs):
                 cards = np.asarray(cards)
@@ -752,17 +804,30 @@ class BatchEngine:
         predicted = insights.predict_batch_dispatch_bytes(
             [b.signature for b in plan], kind, self._ds._n_rows, eng)
         buckets, q_rows = [], [None] * len(queries)
+        est_total_s = 0.0
         for bi, b in enumerate(plan):
             # per-bucket share excludes the in-program densify (kind
             # "dense", n_rows 0): that cost is batch-wide, reported once
             # in the top-level predicted breakdown as densify_bytes
             share = insights.predict_batch_dispatch_bytes(
                 [b.signature], "dense", 0, eng)
+            # per-bucket estimated device time: the roofline model over
+            # the bucket's predicted bytes + word-op count, calibrated to
+            # this (site, engine)'s observed achieved rates when any
+            # dispatches have been recorded — EXPLAIN's answer to WHY a
+            # plan is slow, bucket by bucket
+            word_ops = insights.predict_batch_dispatch_word_ops(
+                [b.signature], "dense", 0, eng)
+            est_s = obs_cost.estimate_seconds(
+                word_ops, share["peak_bytes"], "batch_engine", eng)
+            est_total_s += est_s
             buckets.append({
                 "op": b.op, "queries": [int(q) for q in b.qids],
                 "q_padded": b.q, "r_pad": b.r_pad, "k_pad": b.k_pad,
                 "n_steps": b.n_steps, "needs_words": b.needs_words,
-                "predicted_bytes": share["peak_bytes"]})
+                "predicted_bytes": share["peak_bytes"],
+                "est_word_ops": word_ops,
+                "est_device_ms": round(est_s * 1e3, 4)})
             for qid in b.qids:
                 q = queries[qid]
                 q_rows[qid] = {
@@ -784,6 +849,24 @@ class BatchEngine:
                 floor["observed_mean_seconds"] = round(
                     inst.sum / inst.count, 6)
         split_sizes = self._split_layout(queries, eng, budget)
+        # whole-dispatch cost model: densify rides once, batch-wide (the
+        # bucket rows above exclude it, like the byte shares)
+        densify_ops = insights.predict_batch_dispatch_word_ops(
+            [], kind, self._ds._n_rows, eng)
+        densify_s = obs_cost.estimate_seconds(
+            densify_ops, predicted["densify_bytes"], "batch_engine", eng)
+        cost_section = {
+            "peaks": obs_cost.device_peaks(),
+            "per_bucket_est_device_ms": [b["est_device_ms"]
+                                         for b in buckets],
+            "densify_est_device_ms": round(densify_s * 1e3, 4),
+            "est_device_total_ms": round(
+                (est_total_s + densify_s) * 1e3, 4),
+            # observed cumulative achieved rates at this (site, engine),
+            # when any dispatches have calibrated the estimate
+            "observed": obs_cost.TRACKER.observed_rates("batch_engine",
+                                                        eng),
+        }
         return {
             "site": "batch_engine", "q": len(queries),
             "engine_requested": engine, "engine": eng,
@@ -804,6 +887,7 @@ class BatchEngine:
                 "would_split": len(split_sizes) > 1,
                 "dispatches": split_sizes},
             "sequential_floor": floor,
+            "cost": cost_section,
         }
 
     def cache_stats(self) -> dict:

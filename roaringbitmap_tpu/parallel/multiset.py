@@ -81,8 +81,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..insights import analysis as insights
+from ..obs import cost as obs_cost
 from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..ops import kernels, packing
 from ..runtime import errors, faults, guard
@@ -397,6 +399,12 @@ class MultiSetBatchEngine:
         #: predicted-vs-measured bytes of the most recent pooled dispatch
         #: (the multiset.memory event payload)
         self.last_dispatch_memory: dict | None = None
+        #: cost/roofline accounting of the most recent SYNC pooled
+        #: dispatch (the multiset.cost event payload; pipelined launches
+        #: complete at drain time, so their wall cannot be attributed to
+        #: one launch and they do not stamp this)
+        self.last_dispatch_cost: dict | None = None
+        self._first_query_done = False  # rb_first_query_seconds, once
         #: stats of the most recent pipelined run (the multiset.pipeline
         #: span tags: launches, host_ms, host_overlapped_ms,
         #: overlap_ratio, drain_ms)
@@ -449,8 +457,9 @@ class MultiSetBatchEngine:
         for sid in sids:
             offsets[sid] = base
             base += self._rows[sid]
-        with obs_trace.span("multiset.plan", q=len(pooled),
-                            sets=len(sids)) as sp:
+        with obs_slo.phase("plan"), \
+                obs_trace.span("multiset.plan", q=len(pooled),
+                               sets=len(sids)) as sp:
             groups: dict = {}
             for qid, (sid, q) in enumerate(pooled):
                 eng = self._engines[sid]
@@ -561,8 +570,11 @@ class MultiSetBatchEngine:
         arrays."""
         donate = donate and _donation_supported()
         sig = (eng, plan.signature, donate)
+        t_get = time.perf_counter()
         cached = self._programs.get(sig)
         if cached is not None:
+            obs_cost.observe_compile(SITE, "hit",
+                                     time.perf_counter() - t_get)
             return cached
         engines = [self._engines[s] for s in plan.sids]
         srcs = [e._resident_src() for e in engines]
@@ -570,9 +582,10 @@ class MultiSetBatchEngine:
         b_sigs = [b.signature for b in plan.buckets]
         g_sigs = [g.sig for g in plan.op_groups]
 
-        with obs_trace.span("multiset.program_build", engine=eng,
-                            sets=len(engines), buckets=len(b_sigs),
-                            donate=donate) as sp:
+        with obs_slo.phase("program_build"), \
+                obs_trace.span("multiset.program_build", engine=eng,
+                               sets=len(engines), buckets=len(b_sigs),
+                               donate=donate) as sp:
             def pooled_words(src_list, sel_list):
                 # per-tenant image -> referenced-row selection -> pooled
                 # concat: the transient image is the pool's true row
@@ -604,15 +617,22 @@ class MultiSetBatchEngine:
             # program-cache miss
             operands = (self._operand_avals(plan, eng) if donate
                         else self._launch_operands(plan, eng))
+            t0 = time.perf_counter()
             compiled = jax.jit(run, **jit_kw).lower(
                 [s for s, _ in srcs],
                 [plan.row_sel_dev(s) for s in plan.sids],
                 operands).compile()
+            compile_s = time.perf_counter() - t0
+            obs_cost.observe_compile(SITE, "miss", compile_s)
             predicted = self._predict(plan, eng)
             measured = obs_memory.compiled_memory(compiled)
+            cost = obs_cost.compiled_cost(compiled)
             sp.tag(predicted_bytes=predicted["peak_bytes"],
-                   measured_peak_bytes=(measured or {}).get("peak_bytes"))
-            cached = (run, compiled, predicted, measured)
+                   measured_peak_bytes=(measured or {}).get("peak_bytes"),
+                   compile_ms=round(compile_s * 1e3, 2),
+                   flops=(cost or {}).get("flops"),
+                   bytes_accessed=(cost or {}).get("bytes_accessed"))
+            cached = (run, compiled, predicted, measured, cost)
         self._programs.put(sig, cached)
         return cached
 
@@ -653,6 +673,7 @@ class MultiSetBatchEngine:
             if not fallback:
                 flat = self._launch_once(pooled, engine, jit, inject=False)
                 return self._regroup(flat, lengths)
+            t_exec0 = time.perf_counter()
             policy = policy or guard.GuardPolicy.from_env()
             chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
             budget = guard.resolve_hbm_budget(policy)
@@ -670,8 +691,14 @@ class MultiSetBatchEngine:
             else:
                 launches = ((0, qs) for qs in
                             self._launch_iter(pooled, chain[0], budget))
-            flat = self._pipeline(launches, chain, jit, policy, deadline,
-                                  budget)[0]
+            with obs_slo.query(SITE, deadline_ms=policy.slo_deadline_ms):
+                flat = self._pipeline(launches, chain, jit, policy,
+                                      deadline, budget)[0]
+            if not self._first_query_done:
+                self._first_query_done = True
+                obs_metrics.histogram(
+                    "rb_first_query_seconds", site=SITE).observe(
+                        time.perf_counter() - t_exec0)
             if policy.shadow_rate > 0.0:
                 self._shadow_check(pooled, flat, policy)
             return self._regroup(flat, lengths)
@@ -704,8 +731,11 @@ class MultiSetBatchEngine:
                     for qs in self._launch_iter(pooled, chain[0], budget):
                         yield pi, qs
 
-            by_pool = self._pipeline(launches(), chain, jit, policy,
-                                     deadline, budget)
+            # one attribution context over the whole streamed window (a
+            # per-pool wall cannot be separated once launches overlap)
+            with obs_slo.query(SITE, deadline_ms=policy.slo_deadline_ms):
+                by_pool = self._pipeline(launches(), chain, jit, policy,
+                                         deadline, budget)
             out = []
             for pi, (pooled, lengths) in enumerate(metas):
                 flat = by_pool.get(pi, [])
@@ -895,11 +925,12 @@ class MultiSetBatchEngine:
         pooled = tuple(pooled)
         plan = self._plan_pool(pooled)
         eng = self._pool_engine(plan, engine)
+        obs_slo.note_engine(eng)
         if inject:
             faults.maybe_fail(SITE, eng)
         donate = (not sync) and _donation_supported()
-        run, compiled, predicted, measured = self._program(plan, eng,
-                                                           donate=donate)
+        run, compiled, predicted, measured, cost = self._program(
+            plan, eng, donate=donate)
         srcs = [self._engines[s]._resident_src()[0] for s in plan.sids]
         sels = [plan.row_sel_dev(s) for s in plan.sids]
         barrays = self._launch_operands(plan, eng, fresh=donate)
@@ -907,7 +938,9 @@ class MultiSetBatchEngine:
                             q=len(pooled), sets=len(plan.sids),
                             buckets=len(plan.buckets),
                             pipelined=not sync) as sp:
-            outs = (compiled if jit else run)(srcs, sels, barrays)
+            t_launch = time.perf_counter()
+            with obs_slo.phase("dispatch"):
+                outs = (compiled if jit else run)(srcs, sels, barrays)
             # counted HERE, not per pipeline-window slot: an OOM-split
             # slot dispatches 2+ real launches, a sequential landing
             # dispatches none — the counter must track what actually
@@ -915,13 +948,24 @@ class MultiSetBatchEngine:
             obs_metrics.counter("rb_multiset_launches_total",
                                 site=SITE).inc()
             if sync:
-                outs = sp.sync(outs)
+                with obs_slo.phase("sync"):
+                    outs = sp.sync(outs)
+                    outs = jax.block_until_ready(outs)
             mem = obs_memory.record_dispatch(
                 SITE, predicted["peak_bytes"], measured)
             mem["engine"], mem["q"] = eng, len(pooled)
             mem["sets"] = len(plan.sids)
             self.last_dispatch_memory = mem
             sp.event("multiset.memory", **mem)
+            if sync:
+                # roofline accounting needs a device-complete wall; an
+                # async (pipelined) launch finishes at drain time, where
+                # its share of the window cannot be attributed honestly
+                cost_ev = obs_cost.record_dispatch(
+                    SITE, eng, cost, time.perf_counter() - t_launch,
+                    q=len(pooled), sets=len(plan.sids))
+                self.last_dispatch_cost = cost_ev
+                sp.event("multiset.cost", **cost_ev)
         if not sync:
             return _Inflight(plan=plan, outs=outs, queries=pooled,
                              eng=eng, inject=inject)
@@ -988,8 +1032,9 @@ class MultiSetBatchEngine:
     def _readback(self, plan: _PoolPlan, outs, pooled, eng: str,
                   inject: bool) -> list:
         """Device outputs -> per-query BatchResults in pooled order."""
-        with obs_trace.span("multiset.readback", engine=eng,
-                            q=len(pooled)):
+        with obs_slo.phase("readback"), \
+                obs_trace.span("multiset.readback", engine=eng,
+                               q=len(pooled)):
             results: list = [None] * len(pooled)
             for b, heads, cards in self._bucket_outputs(plan, outs, eng):
                 # one vectorized masked sum per bucket (not per query):

@@ -18,6 +18,7 @@ semantics for the downstream popcount).
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,7 @@ except AttributeError:        # top-level alias at all — seed suite red)
             kw["check_rep"] = kw.pop("check_vma")
         return _shard_map_04x(f, **kw)
 
+from ..obs import cost as obs_cost
 from ..ops import dense, packing
 
 WORDS32 = packing.WORDS32
@@ -302,8 +304,17 @@ def _sharded_densify_cached(mesh: Mesh, row_axis: str, rows_per_shard: int,
 
 def _sharded_densify(mesh: Mesh, row_axis: str, rows_per_shard: int,
                      total_values: int):
-    return _sharded_densify_cached(_intern_mesh(mesh), row_axis,
-                                   rows_per_shard, total_values)
+    # hit/miss compile accounting like the batch/multiset program caches
+    # (rb_compile_seconds — the sharded lane's cold-path gauge); a miss
+    # here only pays the trace, XLA compiles lazily at first call
+    before = _sharded_densify_cached.cache_info().hits
+    t0 = time.perf_counter()
+    fn = _sharded_densify_cached(_intern_mesh(mesh), row_axis,
+                                 rows_per_shard, total_values)
+    hit = _sharded_densify_cached.cache_info().hits > before
+    obs_cost.observe_compile("sharding", "hit" if hit else "miss",
+                             time.perf_counter() - t0)
+    return fn
 
 
 def wide_aggregate_sharded(mesh: Mesh, op: str, bitmaps,

@@ -40,6 +40,7 @@ import numpy as np
 
 from . import errors
 from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 
 _log = logging.getLogger("roaringbitmap_tpu.runtime")
@@ -57,6 +58,7 @@ ENV_DEADLINE = "ROARING_TPU_DEADLINE_S"
 ENV_SHADOW = "ROARING_TPU_SHADOW"
 ENV_HBM_BUDGET = "ROARING_TPU_HBM_BUDGET"
 ENV_PIPELINE_DEPTH = "ROARING_TPU_PIPELINE_DEPTH"
+ENV_SLO_MS = obs_slo.ENV_SLO_MS
 
 
 def parse_bytes(spec: str) -> int:
@@ -96,6 +98,12 @@ class GuardPolicy:
     #: (strictly serial plan -> dispatch -> drain); the default 2 is the
     #: classic double buffer (one launch computing, one draining).
     pipeline_depth: int = 2
+    #: per-query latency objective, milliseconds (obs.slo.SloPolicy /
+    #: ROARING_TPU_SLO_MS): every guarded execute is attributed per phase
+    #: and counted attained/missed against it; None disables SLO
+    #: accounting (the guard's own hard ``deadline`` is a separate,
+    #: enforcement-side knob — an SLO miss is recorded, not raised).
+    slo_deadline_ms: float | None = None
     sleep: Callable[[float], None] = time.sleep
 
     @classmethod
@@ -118,6 +126,8 @@ class GuardPolicy:
         if ENV_PIPELINE_DEPTH in os.environ:
             env["pipeline_depth"] = max(
                 1, int(os.environ[ENV_PIPELINE_DEPTH]))
+        if ENV_SLO_MS in os.environ:
+            env["slo_deadline_ms"] = float(os.environ[ENV_SLO_MS])
         env.update(overrides)
         return cls(**env)
 
@@ -275,11 +285,19 @@ def run_with_fallback(site: str, chain, attempt, *, policy=None,
     if not rungs:
         raise ValueError(f"{site}: empty fallback chain")
     last = None
-    with obs_trace.span("guard.dispatch", site=site) as sp:
+    # SLO accounting per guarded dispatch: a no-op when the engines'
+    # execute() already opened the per-query context (the outermost owns
+    # attribution), the covering context for the sites that have no
+    # execute() wrapper (aggregation, sharding).  The span is the OUTER
+    # context manager so the query context closes first and its miss
+    # event lands on the still-open guard.dispatch span.
+    with obs_trace.span("guard.dispatch", site=site) as sp, \
+            obs_slo.query(site, deadline_ms=policy.slo_deadline_ms):
         demotion_chain: list = []   # "pallas->xla"-style hops, in order
         retries = 0
 
         def _done(res, rung, **tags):
+            obs_slo.note_engine(rung)
             sp.tag(rung_used=rung, retries=retries,
                    demotions=len(demotion_chain),
                    demotion_chain=demotion_chain, **tags)

@@ -153,6 +153,36 @@ def test_summary_is_one_small_line(tmp_path):
     assert len(capped.encode()) <= bench.SUMMARY_MAX_BYTES
 
 
+def test_cost_slo_fields_ride_summary_and_shed_first(tmp_path):
+    """ISSUE 6: the batched lane's roofline fraction + per-phase
+    breakdown ride the capped summary when it fits, and are the FIRST
+    fields the byte-cap ladder sheds — the driver-gate core and the
+    older lanes must survive them under adversarial bloat."""
+    full = str(tmp_path / "bench_full.json")
+    doc = _bloated_doc(2)
+    for row in doc["batched_by_dataset"].values():
+        row["cost"] = {"roofline_fraction": 0.42, "achieved_gbps": 3.1,
+                       "device_ms": 1.9}
+        row["phase_ms"] = {"plan": 0.4, "dispatch": 1.1, "sync": 0.7,
+                           "readback": 0.3, "other": 0.1}
+    line = bench.summary_line(doc, full)
+    parsed = json.loads(line)
+    assert parsed["cost"]["dataset-000"] == 0.42
+    assert parsed["phase_ms"]["dataset-000"]["dispatch"] == 1.1
+    assert bench.SUMMARY_DROP_ORDER[:2] == ("phase_ms", "cost")
+    # adversarial: enough datasets that the cap forces shedding — the
+    # cost/phase fields go first, the core survives, the cap holds
+    doc = _bloated_doc(40)
+    for row in doc["batched_by_dataset"].values():
+        row["cost"] = {"roofline_fraction": 0.42}
+        row["phase_ms"] = {"dispatch": 1.1, "other": 0.1}
+    line = bench.summary_line(doc, full)
+    assert len(line.encode("utf-8")) <= bench.SUMMARY_MAX_BYTES
+    parsed = json.loads(line)
+    assert "cost" not in parsed and "phase_ms" not in parsed
+    assert parsed["value"] == 1.0 and parsed["vs_baseline"] == 2.0
+
+
 def _bloated_doc(n_datasets: int) -> dict:
     """A document whose naive summary would overflow any bounded tail
     capture: many datasets, each with full spread + batched rows."""

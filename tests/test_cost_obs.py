@@ -1,0 +1,508 @@
+"""Cost & time observability (ISSUE 6) acceptance + contracts.
+
+- ``Compiled.cost_analysis()`` captured at program build for every
+  engine rung (pallas / xla / xla-vmap — pallas runs interpreted on the
+  CPU proxy) and for the pooled multiset engine;
+- roofline fraction in (0, 1] on a CPU-proxy Q=64 batch, and
+  ``obs.snapshot()["cost"]`` populated per (site, engine) after a batch
+  execute and a 3-tenant pooled execute;
+- snapshot/reset symmetry + Prometheus render for the new families;
+- ``BatchEngine.explain()`` reports per-bucket estimated device time
+  from the same roofline model;
+- SLO accounting: per-phase breakdown sums to within 5% of the query's
+  wall, attained/missed counters (incl. under an injected
+  ``ROARING_TPU_FAULTS`` slowdown) reconcile with the guard's dispatch
+  stats, and a missed query's trace carries the phase-attributed
+  ``slo`` event;
+- compile-time export: ``rb_compile_seconds{site,cache}`` hit/miss and
+  ``rb_first_query_seconds``;
+- tools: bench_diff added/removed lanes, bench_sentry trajectories
+  (clean / 20% step / monotone drift / removed lane).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from roaringbitmap_tpu import obs
+from roaringbitmap_tpu.obs import cost as obs_cost
+from roaringbitmap_tpu.obs import slo as obs_slo
+from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
+                                                     random_query_pool)
+from roaringbitmap_tpu.parallel.multiset import (MultiSetBatchEngine,
+                                                 random_multiset_pool)
+from roaringbitmap_tpu.runtime import faults, guard
+from roaringbitmap_tpu.utils import datasets
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    obs_slo.set_attribution(False)
+    yield
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    obs_slo.set_attribution(False)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    bms = datasets.synthetic_bitmaps(16, seed=21, universe=1 << 18,
+                                     density=0.01)
+    return BatchEngine.from_bitmaps(bms)
+
+
+@pytest.fixture(scope="module")
+def pool():
+    return random_query_pool(16, 64)
+
+
+def _read_trace(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+# --------------------------------------------------------- cost capture
+
+@pytest.mark.parametrize("eng_name", ["pallas", "xla", "xla-vmap"])
+def test_cost_analysis_captured_per_engine(engine, pool, eng_name):
+    """Every engine rung's AOT program carries cost_analysis, and its
+    dispatch records achieved rates + a clamped roofline fraction."""
+    engine.execute(pool[:8], engine=eng_name, fallback=False)
+    cost = engine.last_dispatch_cost
+    assert cost is not None and cost["device_ms"] >= 0
+    assert cost["flops"] >= 0 and cost["bytes_accessed"] > 0
+    assert 0.0 < cost["roofline_fraction"] <= 1.0
+    assert cost["achieved_bytes_per_s"] > 0
+
+
+def test_roofline_fraction_q64_and_snapshot_cost_section(engine, pool):
+    """Acceptance: after a Q=64 batch and a 3-tenant pooled execute on
+    the CPU proxy, obs.snapshot()["cost"] carries per-(site, engine)
+    flops / bytes / roofline-fraction rows."""
+    engine.execute(pool)                       # Q=64, auto -> xla on CPU
+    tenants = [datasets.synthetic_bitmaps(8, seed=50 + i,
+                                          universe=1 << 16, density=0.01)
+               for i in range(3)]
+    ms = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    ms.execute(random_multiset_pool([8] * 3, 24, seed=7))
+    snap = obs.snapshot()["cost"]
+    assert snap["peaks"]["peak_bytes_per_s"] > 0
+    for site in ("batch_engine", "multiset"):
+        assert site in snap["sites"], snap["sites"].keys()
+        rows = snap["sites"][site]
+        assert rows, site
+        for row in rows.values():
+            assert row["dispatches"] >= 1
+            assert row["bytes_total"] > 0 and row["flops_total"] >= 0
+            assert 0.0 < row["roofline_fraction"] <= 1.0
+    # the gauges rode along
+    gauges = obs.snapshot()["gauges"]
+    assert any(r["labels"]["site"] == "batch_engine"
+               for r in gauges["rb_roofline_fraction"])
+    assert any(r["labels"]["site"] == "multiset"
+               for r in gauges["rb_achieved_bytes_per_s"])
+
+
+def test_cost_reset_snapshot_symmetry_and_prometheus():
+    baseline = obs.snapshot()
+    assert baseline["cost"]["sites"] == {}
+    # fresh engine: its compile + first execute land after the reset, so
+    # every new family (compile, first-query) is present in the render
+    bms = datasets.synthetic_bitmaps(8, seed=44, universe=1 << 16,
+                                     density=0.02)
+    BatchEngine.from_bitmaps(bms).execute(random_query_pool(8, 8))
+    snap = obs.snapshot()
+    assert snap["cost"]["sites"]
+    text = obs.render_prometheus()
+    for family in ("rb_roofline_fraction", "rb_achieved_bytes_per_s",
+                   "rb_device_time_seconds_total", "rb_compile_seconds",
+                   "rb_first_query_seconds"):
+        assert family in text, family
+    obs.reset()
+    after = obs.snapshot()
+    # symmetric for everything reset() owns; the pull-model collectors
+    # (live HBM ledger, cache sizes) keep reporting the still-resident
+    # engine by design
+    assert after["cost"] == baseline["cost"]
+    assert after["counters"] == {} and after["histograms"] == {}
+
+
+def test_cost_event_rides_dispatch_span(engine, pool, tmp_path):
+    obs.enable(str(tmp_path / "t.jsonl"))
+    try:
+        engine.execute(pool[:8])
+    finally:
+        obs.disable()
+    spans = _read_trace(tmp_path / "t.jsonl")
+    evs = [ev for s in spans if s["name"] == "batch.dispatch"
+           for ev in s["events"] if ev["name"] == "batch.cost"]
+    assert evs and evs[0]["bytes_accessed"] > 0
+    assert 0.0 < evs[0]["roofline_fraction"] <= 1.0
+
+
+def test_estimate_seconds_calibrates_to_observed(engine, pool):
+    peaks = obs_cost.device_peaks()
+    est_peak = obs_cost.estimate_seconds(0.0, peaks["peak_bytes_per_s"])
+    assert est_peak == pytest.approx(1.0)
+    engine.execute(pool[:8])             # records achieved rates
+    rates = obs_cost.TRACKER.observed_rates("batch_engine", "xla")
+    assert rates is not None and rates["achieved_bytes_per_s"] > 0
+    est = obs_cost.estimate_seconds(0.0, rates["achieved_bytes_per_s"],
+                                    "batch_engine", "xla")
+    assert est == pytest.approx(1.0)     # calibrated to the observed rate
+
+
+def test_explain_reports_per_bucket_device_time(engine, pool):
+    """Acceptance: explain() carries per-bucket estimated device time
+    from the roofline model (and stays deterministic + serializable)."""
+    rep = engine.explain(pool)
+    assert "cost" in rep
+    cost = rep["cost"]
+    assert len(cost["per_bucket_est_device_ms"]) == len(rep["buckets"])
+    assert all(b["est_device_ms"] > 0 for b in rep["buckets"])
+    assert all(b["est_word_ops"] > 0 for b in rep["buckets"])
+    assert cost["est_device_total_ms"] >= sum(
+        cost["per_bucket_est_device_ms"]) - 1e-6
+    json.loads(json.dumps(rep))
+    assert rep == engine.explain(pool)
+
+
+# ----------------------------------------------------------- SLO / phases
+
+def test_phase_breakdown_sums_to_wall(engine, pool):
+    """Acceptance: the per-phase breakdown (residual included) sums to
+    within 5% of the query's wall time."""
+    with obs_slo.attribution():
+        engine.execute(pool)
+    lq = obs_slo.last_query
+    assert lq["site"] == "batch_engine" and lq["engine"] != "unresolved"
+    total = sum(lq["phases_ms"].values())
+    assert abs(total - lq["wall_ms"]) <= 0.05 * lq["wall_ms"] + 0.5, lq
+    assert {"dispatch", "sync", "readback", "other"} <= set(
+        lq["phases_ms"])
+    # phase histograms populated per (site, engine, phase)
+    rows = obs.snapshot()["histograms"]["rb_phase_seconds"]
+    keys = {(r["labels"]["site"], r["labels"]["phase"]) for r in rows}
+    assert ("batch_engine", "dispatch") in keys
+    assert ("batch_engine", "other") in keys
+
+
+def test_slo_miss_counted_and_traced(engine, pool, tmp_path):
+    """A deadline no execute can make -> rb_slo_missed_total and a
+    phase-attributed slo event on the batch.execute span."""
+    policy = guard.GuardPolicy(slo_deadline_ms=1e-4)
+    obs.enable(str(tmp_path / "slo.jsonl"))
+    try:
+        engine.execute(pool[:8], policy=policy)
+    finally:
+        obs.disable()
+    snap = obs.snapshot()
+    missed = snap["counters"]["rb_slo_missed_total"]
+    assert missed[0]["labels"]["site"] == "batch_engine"
+    assert missed[0]["value"] == 1
+    assert "rb_slo_attained_total" not in snap["counters"]
+    spans = _read_trace(tmp_path / "slo.jsonl")
+    evs = [ev for s in spans if s["name"] == "batch.execute"
+           for ev in s["events"] if ev["name"] == "slo"]
+    assert evs and evs[0]["missed"] is True
+    total = sum(evs[0]["phases_ms"].values())
+    assert abs(total - evs[0]["wall_ms"]) \
+        <= 0.05 * evs[0]["wall_ms"] + 0.5
+
+
+def test_slo_attained_and_reconciles_with_guard_stats(engine, pool):
+    """Attained + missed == guarded executes, also under an injected
+    fault schedule whose retries slow the query past its deadline."""
+    generous = guard.GuardPolicy(slo_deadline_ms=1e7)
+    engine.execute(pool[:4], policy=generous)
+    engine.execute(pool[:4], policy=generous)
+    # injected transient faults: retries + backoff blow a tight deadline
+    tight = guard.GuardPolicy(slo_deadline_ms=1e-4)
+    with faults.inject("transient@xla=1.0:0xD1"):
+        engine.execute(pool[:4], policy=tight)
+    snap = obs.snapshot()["counters"]
+
+    def total(name):
+        return sum(r["value"] for r in snap.get(name, [])
+                   if r["labels"].get("site") == "batch_engine")
+
+    assert total("rb_slo_attained_total") == 2
+    assert total("rb_slo_missed_total") == 1
+    # reconciliation: every SLO-accounted execute is a guarded dispatch,
+    # and the injected run's retries/demotions are visible in the same
+    # stats the counters must agree with
+    stats = guard.dispatch_stats("batch_engine")
+    assert stats["retries"] > 0 or stats["demotions"] > 0
+    ev = {(r["labels"]["site"], r["labels"]["event"]): r["value"]
+          for r in snap["rb_dispatch_events_total"]}
+    assert ev[("batch_engine", "retries")] == stats["retries"]
+    assert ev[("batch_engine", "demotions")] == stats["demotions"]
+
+
+def test_multiset_slo_and_env_knob(monkeypatch):
+    """ROARING_TPU_SLO_MS reaches the pooled engine through
+    GuardPolicy.from_env, counted at the multiset site."""
+    tenants = [datasets.synthetic_bitmaps(8, seed=60 + i,
+                                          universe=1 << 16, density=0.01)
+               for i in range(2)]
+    ms = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    pool = random_multiset_pool([8] * 2, 8, seed=3)
+    monkeypatch.setenv(guard.ENV_SLO_MS, "1e-4")
+    ms.execute(pool)
+    monkeypatch.delenv(guard.ENV_SLO_MS)
+    missed = obs.snapshot()["counters"]["rb_slo_missed_total"]
+    assert any(r["labels"]["site"] == "multiset" and r["value"] >= 1
+               for r in missed)
+
+
+def test_queue_phase_from_enqueued_at():
+    """A serving loop passing arrival time gets the queue wait attributed
+    (the ROADMAP item 2 vocabulary)."""
+    import time
+
+    t_arrival = time.perf_counter()
+    time.sleep(0.02)
+    with obs_slo.query("batch_engine", deadline_ms=1e7,
+                       enqueued_at=t_arrival):
+        pass
+    lq = obs_slo.last_query
+    assert lq["phases_ms"]["queue"] >= 15.0
+    assert lq["wall_ms"] >= lq["phases_ms"]["queue"]
+
+
+def test_nested_query_contexts_suppressed():
+    with obs_slo.attribution():
+        with obs_slo.query("multiset") as outer:
+            inner = obs_slo.query("batch_engine")
+            assert inner is obs_slo._NOOP
+            assert outer is not obs_slo._NOOP
+    assert obs_slo.last_query["site"] == "multiset"
+
+
+def test_profile_on_slo_miss_env_parsing(monkeypatch):
+    monkeypatch.setenv(obs_slo.ENV_PROFILE, "/tmp/x:3")
+    obs_slo.refresh_from_env()
+    assert obs_slo._profile_dir == "/tmp/x"
+    assert obs_slo._profile_budget == 3
+    monkeypatch.setenv(obs_slo.ENV_PROFILE, "/tmp/y")
+    obs_slo.refresh_from_env()
+    assert obs_slo._profile_dir == "/tmp/y"
+    assert obs_slo._profile_budget == 1
+    monkeypatch.delenv(obs_slo.ENV_PROFILE)
+    obs_slo.refresh_from_env()
+    assert obs_slo._profile_dir is None
+
+
+# ------------------------------------------------------ cold-path export
+
+def test_compile_seconds_hit_miss_and_first_query():
+    bms = datasets.synthetic_bitmaps(8, seed=33, universe=1 << 16,
+                                     density=0.02)
+    eng = BatchEngine.from_bitmaps(bms)
+    qs = random_query_pool(8, 8)
+    eng.execute(qs)                    # miss: compiles
+    eng.execute(qs)                    # hit: cached program
+    snap = obs.snapshot()["histograms"]
+    rows = {(r["labels"]["site"], r["labels"]["cache"]): r
+            for r in snap["rb_compile_seconds"]}
+    assert rows[("batch_engine", "miss")]["count"] >= 1
+    assert rows[("batch_engine", "hit")]["count"] >= 1
+    # the miss paid a real compile; the hit is a cache lookup
+    miss = rows[("batch_engine", "miss")]
+    hit = rows[("batch_engine", "hit")]
+    assert miss["sum"] / miss["count"] > hit["sum"] / hit["count"]
+    fq = snap["rb_first_query_seconds"]
+    assert any(r["labels"]["site"] == "batch_engine" and r["count"] == 1
+               for r in fq)
+    # ingest build exported too (the set construction above)
+    assert any(r["count"] >= 1
+               for r in snap["rb_ingest_build_seconds"])
+
+
+# ------------------------------------------------------------- the tools
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchDiffLaneChanges:
+    def test_added_removed_lanes(self):
+        bd = _load_tool("bench_diff")
+        old = {"a.qps": 1.0, "gone.pack_ms": 2.0, "shared.val": 3.0}
+        new = {"a.qps": 1.1, "shared.val": 3.0, "fresh.qps": 9.0}
+        added, removed = bd.lane_changes(old, new)
+        assert added == ["fresh.qps"]
+        assert removed == ["gone.pack_ms"]
+
+    def test_phase_ms_lanes_are_neutral(self):
+        """Single-sample phase attribution must never gate: a residual
+        phase doubling between rounds is noise, and time moving between
+        phases is not a regression."""
+        bd = _load_tool("bench_diff")
+        assert bd.direction("phase_ms.census1881.other") == 0
+        assert bd.direction("phase_ms.census1881.dispatch") == 0
+        # the roofline fraction trends but is not *_x-ambiguous either:
+        # informational (no directional token matches)
+        assert bd.direction("cost.census1881") == 0
+
+
+class TestBenchSentry:
+    def _rounds(self, lanes_by_round):
+        return [(f"r{i:02d}", lanes)
+                for i, lanes in enumerate(lanes_by_round, 1)]
+
+    def test_clean_trajectory(self):
+        bs = _load_tool("bench_sentry")
+        rounds = self._rounds([
+            {"q64_e2e_qps": 1000.0, "pack_ms": 5.0},
+            {"q64_e2e_qps": 1050.0, "pack_ms": 4.8},
+            {"q64_e2e_qps": 1100.0, "pack_ms": 4.9},
+        ])
+        series = bs.build_series(rounds)
+        a = bs.analyze(series, [n for n, _ in rounds], 0.15, 0.15)
+        assert a["step_regressions"] == []
+        assert a["drift_regressions"] == []
+
+    def test_flags_20pct_qps_step(self):
+        """Acceptance: a synthetic 20% QPS step regression in the newest
+        round is flagged (and a historical step is not gated)."""
+        bs = _load_tool("bench_sentry")
+        rounds = self._rounds([
+            {"q64_e2e_qps": 1000.0}, {"q64_e2e_qps": 1010.0},
+            {"q64_e2e_qps": 808.0},          # -20% step
+        ])
+        series = bs.build_series(rounds)
+        a = bs.analyze(series, [n for n, _ in rounds], 0.15, 0.15)
+        assert a["step_regressions"] == ["q64_e2e_qps"]
+        # same step one round earlier, recovered since: history, not gate
+        rounds = self._rounds([
+            {"q64_e2e_qps": 1000.0}, {"q64_e2e_qps": 800.0},
+            {"q64_e2e_qps": 1000.0},
+        ])
+        series = bs.build_series(rounds)
+        a = bs.analyze(series, [n for n, _ in rounds], 0.15, 0.15)
+        assert a["step_regressions"] == []
+        assert a["lanes"]["q64_e2e_qps"]["steps"]   # recorded as history
+
+    def test_flags_monotone_drift(self):
+        """Four rounds each -8% (under any per-step threshold) gate as
+        drift: the slow bleed a pairwise diff never fires on."""
+        bs = _load_tool("bench_sentry")
+        vals = [1000.0, 920.0, 846.0, 778.0, 716.0]
+        rounds = self._rounds([{"q64_e2e_qps": v} for v in vals])
+        series = bs.build_series(rounds)
+        a = bs.analyze(series, [n for n, _ in rounds], 0.15, 0.15)
+        assert a["step_regressions"] == []
+        assert a["drift_regressions"] == ["q64_e2e_qps"]
+        assert a["lanes"]["q64_e2e_qps"]["drift"] < -0.15
+
+    def test_removed_lane_noticed(self, tmp_path):
+        bs = _load_tool("bench_sentry")
+        bd = _load_tool("bench_diff")
+        old = {"q64_e2e_qps": 1000.0, "fault_lane.qps_clean": 500.0}
+        new = {"q64_e2e_qps": 1001.0}
+        added, removed = bd.lane_changes(old, new)
+        assert removed == ["fault_lane.qps_clean"] and added == []
+        # end to end through main(): verdict lists it; --fail stays 0,
+        # --fail-removed gates
+        import sys
+
+        p1, p2 = tmp_path / "r1.json", tmp_path / "r2.json"
+        p1.write_text(json.dumps(old))
+        p2.write_text(json.dumps(new))
+        argv = sys.argv
+        try:
+            sys.argv = ["bench_sentry", str(p1), str(p2), "--fail"]
+            assert bs.main() == 0
+            sys.argv = ["bench_sentry", str(p1), str(p2), "--fail",
+                        "--fail-removed"]
+            assert bs.main() == 1
+        finally:
+            sys.argv = argv
+
+    def test_unusable_round_skipped(self, tmp_path):
+        """An r01-class driver capture (traceback tail, parsed null) is
+        recorded unusable, not fatal."""
+        bs = _load_tool("bench_sentry")
+        bad = {"n": 1, "cmd": "x", "rc": 1, "tail": "Traceback ...\n",
+               "parsed": None}
+        good = {"q64_e2e_qps": 1000.0}
+        paths = []
+        for i, doc in enumerate([bad, good, good]):
+            p = tmp_path / f"BENCH_r{i + 1:02d}.json"
+            p.write_text(json.dumps(doc))
+            paths.append(str(p))
+        rounds, unusable = bs.load_rounds(paths)
+        assert unusable == ["BENCH_r01"]
+        assert [n for n, _ in rounds] == ["BENCH_r02", "BENCH_r03"]
+
+    def test_committed_trajectory_passes_clean(self):
+        """Acceptance: the sentry gate over the checked-in r01..r05
+        files is clean (r01 unusable by design)."""
+        import glob
+
+        bs = _load_tool("bench_sentry")
+        paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r[0-9]*.json")))
+        assert len(paths) >= 5
+        rounds, unusable = bs.load_rounds(paths)
+        assert "BENCH_r01" in unusable
+        series = bs.build_series(rounds)
+        a = bs.analyze(series, [n for n, _ in rounds], 0.25, 0.25)
+        assert a["step_regressions"] == []
+        assert a["drift_regressions"] == []
+
+    def test_markdown_table_renders(self):
+        bs = _load_tool("bench_sentry")
+        rounds = self._rounds([
+            {"q64_e2e_qps": 1000.0}, {"q64_e2e_qps": 700.0}])
+        series = bs.build_series(rounds)
+        names = [n for n, _ in rounds]
+        a = bs.analyze(series, names, 0.15, 0.15)
+        md = bs.markdown_table(series, names, a)
+        assert "q64_e2e_qps" in md and "STEP" in md
+        assert md.splitlines()[0].startswith("| lane |")
+
+
+# --------------------------------------------------- check_trace schemas
+
+class TestCheckTraceCostSlo:
+    def test_validates_cost_and_slo_events(self, engine, pool, tmp_path):
+        path = tmp_path / "dump.jsonl"
+        obs.enable(str(path))
+        try:
+            engine.execute(pool[:8],
+                           policy=guard.GuardPolicy(slo_deadline_ms=1e-4))
+        finally:
+            obs.disable()
+        ct = _load_tool("check_trace")
+        assert ct.validate(str(path)) == []
+
+    def test_rejects_bad_cost_and_slo_events(self, tmp_path):
+        ct = _load_tool("check_trace")
+        bad = tmp_path / "bad.jsonl"
+        span = {"name": "batch.dispatch", "span_id": "a-1",
+                "parent_id": None, "trace_id": "a-1", "pid": 1,
+                "t_start": 0.0, "dur_ms": 1.0, "tags": {},
+                "events": [
+                    {"name": "batch.cost", "t_offset_ms": 0.1,
+                     "device_ms": -1, "roofline_fraction": 1.7},
+                    {"name": "slo", "t_offset_ms": 0.2, "wall_ms": 100.0,
+                     "phases_ms": {"dispatch": 10.0}},
+                ]}
+        bad.write_text(json.dumps(span) + "\n")
+        errs = ct.validate(str(bad))
+        assert any("device_ms" in e for e in errs)
+        assert any("roofline_fraction" in e for e in errs)
+        assert any("not within 5%" in e for e in errs)

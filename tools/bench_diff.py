@@ -28,6 +28,14 @@ direction by more than ``--threshold`` (fractional, default 0.15) is a
 **regression**; with ``--fail`` the exit code is 1 when any lane
 regressed (without it the tool always exits 0 — the CI smoke lane diffs
 the committed trajectory files, whose rounds legitimately move).
+
+Lanes present in only ONE document are no longer silently absent: the
+verdict reports them as ``added`` (new-only) / ``removed`` (old-only)
+after suffix alignment, so a lane that disappears between rounds — a
+bench phase that stopped emitting — is visible (tools/bench_sentry.py
+relies on this to notice vanished lanes across a trajectory).  They are
+informational, never gated: salvaged truncated tails legitimately
+recover different lane subsets per round.
 """
 
 from __future__ import annotations
@@ -50,8 +58,13 @@ LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes")
 #: directions (more overlap at fixed host_ms is good, but so is less
 #: host work overall) — overlap_ratio is the gated pipelining signal,
 #: so the raw overlapped milliseconds stay informational instead of
-#: being caught by the ``_ms`` lower-is-better fragment.
-NEUTRAL = ("host_overlapped",)
+#: being caught by the ``_ms`` lower-is-better fragment.  phase_ms
+#: breakdowns (ISSUE 6) are single-sample attribution of ONE execute —
+#: trend inputs for the sentry's table, not gate fields; a
+#: sub-millisecond residual phase swinging 2x between rounds is noise,
+#: and time moving BETWEEN phases (more dispatch, less other) is not a
+#: regression at all.
+NEUTRAL = ("host_overlapped", "phase_ms")
 
 
 def salvage_tail_json(tail: str) -> dict | None:
@@ -163,6 +176,18 @@ def suffix_align(old: dict, new: dict) -> dict:
     return pairs
 
 
+def lane_changes(old: dict, new: dict) -> tuple[list, list]:
+    """(added, removed) lane paths after suffix alignment: ``added`` are
+    new-document lanes no old lane mapped onto, ``removed`` are old
+    lanes that found no partner — a lane that stopped (or started) being
+    emitted between the two documents."""
+    aligned = suffix_align(old, new)
+    matched_new = set(aligned.values())
+    added = sorted(ln for ln in new if ln not in matched_new)
+    removed = sorted(lo for lo in old if lo not in aligned)
+    return added, removed
+
+
 def diff_lanes(old: dict, new: dict, threshold: float) -> tuple[list, list]:
     """([(lane, old, new, delta_frac, direction, regressed)], regressions)
     over lanes present in BOTH documents — exact dotted-path matches
@@ -214,8 +239,14 @@ def main() -> int:
         flag = " REGRESSION" if bad else ""
         print(f"{arrow[sgn]} {lane}: {o:g} -> {n:g} "
               f"({d:+.1%}){flag}")
+    added, removed = lane_changes(old, new)
+    for lane in removed:
+        print(f"! removed lane: {lane} (was {old[lane]:g})")
+    for lane in added:
+        print(f"+ added lane: {lane} ({new[lane]:g})")
     print(f"bench_diff: {shared} shared lanes, {len(regressions)} "
-          f"regression(s) past {args.threshold:.0%} "
+          f"regression(s) past {args.threshold:.0%}, "
+          f"{len(added)} added, {len(removed)} removed "
           f"({args.old} -> {args.new})")
     return 1 if (args.fail and regressions) else 0
 

@@ -1,0 +1,293 @@
+"""Bench-trajectory regression sentry: gate the WHOLE round history.
+
+``tools/bench_diff.py`` compares two bench documents; a reviewer still
+had to run it by hand and eyeball which pair to compare.  This sentry
+generalizes it to the committed trajectory::
+
+    python tools/bench_sentry.py                      # BENCH_r0*.json, report
+    python tools/bench_sentry.py --fail               # CI gate
+    python tools/bench_sentry.py r01.json r02.json …  # explicit rounds
+
+It loads every round document (driver captures with ``parsed: null``
+get bench_diff's truncated-tail salvage; rounds with no recoverable
+summary — e.g. a failed run whose tail is a traceback — are recorded as
+unusable and skipped), aligns lanes across rounds by dotted-path suffix
+(salvaged tails recover different depths per round), and fits each
+directional lane's trajectory:
+
+- **step**: the newest transition moved against the lane's direction by
+  more than ``--threshold`` (fractional, default 0.25) — the "this round
+  regressed it" signal;
+- **drift**: the lane moved against its direction on every one of the
+  last >= 3 transitions and the cumulative move exceeds
+  ``--drift-threshold`` (default 0.25) — the slow-bleed signal a
+  pairwise diff's per-step threshold never fires on;
+- **removed**: a lane the previous round emitted that the newest round
+  lost (bench_diff.lane_changes) — a bench phase that stopped reporting
+  looks exactly like a regression that hid itself.  Reported always;
+  gated only under ``--fail-removed`` (salvaged tails legitimately
+  recover different lane subsets, so removal alone is a warning).
+
+Only the NEWEST round is gated — historical steps between committed
+rounds already shipped and are reported as context, not failures.
+
+Output: a markdown trajectory table (stdout, or ``--md PATH``) and a
+one-line JSON verdict as the final stdout line (the driver-parsable
+shape bench.py's summary established).  ``--fail`` exits 1 when any
+gated lane regressed.  The CI observability lane runs this over the
+committed BENCH_r01..r05 files, so the next round's regression is
+caught by the suite, not a reviewer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+
+def _load_bench_diff():
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(_HERE, "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_diff = _load_bench_diff()
+
+
+def load_rounds(paths: list) -> tuple[list, list]:
+    """([(name, lanes)] usable rounds in input order, [unusable names]).
+    A round whose document yields no lanes (bench_diff's loader raises
+    on a driver capture with neither ``parsed`` nor a salvageable tail)
+    is skipped, not fatal — r01-class failed rounds are part of real
+    trajectories."""
+    rounds, unusable = [], []
+    for p in paths:
+        name = os.path.splitext(os.path.basename(p))[0]
+        try:
+            lanes = bench_diff.load_lanes(p)
+        except (SystemExit, OSError, ValueError, KeyError):
+            unusable.append(name)
+            continue
+        if not lanes:
+            unusable.append(name)
+            continue
+        rounds.append((name, lanes))
+    return rounds, unusable
+
+
+def build_series(rounds: list) -> dict:
+    """{canonical lane: {round name: value}} — lanes keyed by the NEWEST
+    round's dotted paths, earlier rounds mapped onto them by
+    bench_diff.suffix_align (depth-shifted salvage tails pair by unique
+    path suffix).  A lane only the newest round emits still appears,
+    with a single point."""
+    if not rounds:
+        return {}
+    canonical = rounds[-1][1]
+    series: dict = {lane: {} for lane in canonical}
+    for name, lanes in rounds[:-1]:
+        aligned = bench_diff.suffix_align(lanes, canonical)
+        for old_lane, new_lane in aligned.items():
+            series[new_lane][name] = lanes[old_lane]
+    last_name = rounds[-1][0]
+    for lane, v in canonical.items():
+        series[lane][last_name] = v
+    return series
+
+
+def fit_trend(values: list) -> float | None:
+    """Least-squares relative slope per round (fraction of the mean) —
+    the direction-aware trend figure the table reports.  None when
+    under 2 points or the mean is 0."""
+    n = len(values)
+    if n < 2:
+        return None
+    mean = sum(values) / n
+    if mean == 0:
+        return None
+    xs = range(n)
+    x_mean = (n - 1) / 2.0
+    denom = sum((x - x_mean) ** 2 for x in xs)
+    slope = sum((x - x_mean) * (v - mean)
+                for x, v in zip(xs, values)) / denom
+    return slope / abs(mean)
+
+
+def analyze_lane(points: list, direction: int, threshold: float,
+                 drift_threshold: float) -> dict:
+    """One lane's trajectory verdict over ``points`` (round-ordered
+    values; the last is the newest round).
+
+    Returns {"trend", "steps": [(i, frac)], "step_latest": frac|None,
+    "drift": frac|None} where ``steps`` are ALL against-direction
+    transitions past the threshold (history, informational),
+    ``step_latest`` is set only when the newest transition is one (the
+    gated case), and ``drift`` is the cumulative against-direction move
+    when the last >= 3 transitions were all monotone against the lane
+    (gated)."""
+    out = {"trend": fit_trend(points), "steps": [], "step_latest": None,
+           "drift": None}
+    if direction == 0 or len(points) < 2:
+        return out
+    deltas = []
+    for i in range(1, len(points)):
+        prev, cur = points[i - 1], points[i]
+        d = (cur - prev) / abs(prev) if prev else (
+            0.0 if cur == prev else float("inf"))
+        deltas.append(d)
+        if direction * d < -threshold:
+            out["steps"].append((i, round(d, 4)))
+    if out["steps"] and out["steps"][-1][0] == len(points) - 1:
+        out["step_latest"] = out["steps"][-1][1]
+    # monotone drift ending at the newest round: every one of the last
+    # >= 3 transitions moved against the direction
+    run = 0
+    for d in reversed(deltas):
+        if direction * d < 0:
+            run += 1
+        else:
+            break
+    if run >= 3:
+        base = points[-1 - run]
+        cum = ((points[-1] - base) / abs(base)) if base else float("inf")
+        if direction * cum < -drift_threshold:
+            out["drift"] = round(cum, 4)
+    return out
+
+
+def analyze(series: dict, round_names: list, threshold: float,
+            drift_threshold: float) -> dict:
+    """Full-trajectory analysis: per-lane verdicts + the gated lists."""
+    lanes: dict = {}
+    steps, drifts = [], []
+    for lane in sorted(series):
+        by_round = series[lane]
+        points = [by_round[r] for r in round_names if r in by_round]
+        direction = bench_diff.direction(lane)
+        row = analyze_lane(points, direction, threshold, drift_threshold)
+        row["direction"] = direction
+        row["points"] = len(points)
+        lanes[lane] = row
+        if row["step_latest"] is not None:
+            steps.append(lane)
+        if row["drift"] is not None:
+            drifts.append(lane)
+    return {"lanes": lanes, "step_regressions": steps,
+            "drift_regressions": drifts}
+
+
+def markdown_table(series: dict, round_names: list, analysis: dict,
+                   top: int = 40) -> str:
+    """Lane x round trajectory table, flagged lanes first."""
+    arrow = {1: "^", -1: "v", 0: "-"}
+    flagged = set(analysis["step_regressions"]) \
+        | set(analysis["drift_regressions"])
+
+    def fmt(v):
+        if v is None:
+            return ""
+        return f"{v:g}" if abs(v) < 1e6 else f"{v:.3e}"
+
+    ordered = sorted(series, key=lambda ln: (ln not in flagged, ln))
+    rows = []
+    for lane in ordered[:max(top, len(flagged))]:
+        a = analysis["lanes"][lane]
+        flags = []
+        if a["step_latest"] is not None:
+            flags.append(f"STEP {a['step_latest']:+.0%}")
+        if a["drift"] is not None:
+            flags.append(f"DRIFT {a['drift']:+.0%}")
+        trend = ("" if a["trend"] is None
+                 else f"{a['trend']:+.1%}/round")
+        cells = [fmt(series[lane].get(r)) for r in round_names]
+        rows.append("| " + " | ".join(
+            [f"{arrow[a['direction']]} {lane}", *cells, trend,
+             " ".join(flags)]) + " |")
+    header = ("| lane | " + " | ".join(round_names)
+              + " | trend | flags |")
+    sep = "|" + "---|" * (len(round_names) + 3)
+    note = (f"\n({len(series) - len(rows)} more lanes not shown)"
+            if len(series) > len(rows) else "")
+    return "\n".join([header, sep, *rows]) + note
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="trajectory regression sentry over bench round files")
+    ap.add_argument("files", nargs="*",
+                    help="round documents, oldest first (default: "
+                         "BENCH_r[0-9]*.json in the repo root, sorted)")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="fractional newest-round step against a lane's "
+                         "direction that gates (default 0.25)")
+    ap.add_argument("--drift-threshold", type=float, default=0.25,
+                    help="cumulative monotone move against direction over "
+                         "the last >= 3 transitions that gates "
+                         "(default 0.25)")
+    ap.add_argument("--fail", action="store_true",
+                    help="exit 1 when any gated lane regressed")
+    ap.add_argument("--fail-removed", action="store_true",
+                    help="also exit 1 when the newest round lost lanes")
+    ap.add_argument("--md", default="",
+                    help="write the trajectory table to this path instead "
+                         "of stdout")
+    ap.add_argument("--lanes", default="",
+                    help="only analyze lanes whose dotted path contains "
+                         "this substring")
+    ap.add_argument("--top", type=int, default=40,
+                    help="max table rows (flagged lanes always shown)")
+    args = ap.parse_args()
+
+    paths = args.files or sorted(glob.glob(os.path.join(
+        os.path.dirname(_HERE), "BENCH_r[0-9]*.json")))
+    if not paths:
+        print("bench_sentry: no round files found", file=sys.stderr)
+        return 2
+    rounds, unusable = load_rounds(paths)
+    if len(rounds) < 2:
+        print(f"bench_sentry: need >= 2 usable rounds, got {len(rounds)} "
+              f"(unusable: {unusable})", file=sys.stderr)
+        return 2
+    series = build_series(rounds)
+    if args.lanes:
+        series = {ln: v for ln, v in series.items() if args.lanes in ln}
+    round_names = [name for name, _ in rounds]
+    analysis = analyze(series, round_names, args.threshold,
+                       args.drift_threshold)
+    added, removed = bench_diff.lane_changes(rounds[-2][1], rounds[-1][1])
+
+    table = markdown_table(series, round_names, analysis, args.top)
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(table + "\n")
+    else:
+        print(table)
+    regressed = (analysis["step_regressions"]
+                 + analysis["drift_regressions"])
+    verdict = {
+        "rounds": round_names, "unusable": unusable,
+        "lanes": len(series),
+        "step_regressions": analysis["step_regressions"],
+        "drift_regressions": analysis["drift_regressions"],
+        "added_lanes": added, "removed_lanes": removed,
+        "thresholds": {"step": args.threshold,
+                       "drift": args.drift_threshold},
+        "ok": not regressed and not (args.fail_removed and removed),
+    }
+    print(json.dumps(verdict, separators=(",", ":")))
+    if args.fail and not verdict["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,13 +10,18 @@ This check measures that claim against a real Q=64 batch execute:
    execute to a scratch file and counting lines);
 3. the per-call cost of a disabled ``span(name, **tags)`` (measured over
    200k calls, kwargs included — the full price an instrumentation site
-   pays).
+   pays);
+4. the per-call cost of a disabled ``slo.phase(name)`` and a suppressed
+   ``slo.query(site)`` (ISSUE 6: the cost/SLO instrumentation is
+   compiled in but must stay no-op without an SLO configured — the
+   phase sites ride the same bound as the spans).
 
-overhead_fraction = spans_per_execute * cost_per_disabled_span * SAFETY
-                    / median_execute_seconds        (SAFETY = 3x, which
-also covers the no-op tag/event/sync calls riding each span site).  The
-check fails when the fraction reaches 2% — i.e. someone made the
-disabled path allocate, take a lock, or read the environment per call.
+overhead_fraction = (spans * span_cost + PHASE_SITES * phase_cost
+                     + query_cost) * SAFETY / median_execute_seconds
+(SAFETY = 3x, which also covers the no-op tag/event/sync calls riding
+each span site).  The check fails when the fraction reaches 2% — i.e.
+someone made a disabled path allocate, take a lock, or read the
+environment per call.
 
 Timing-dependence note: both numerator and denominator are measured on
 the same loaded CI host, and the 3x safety margin plus the ~two orders
@@ -36,12 +41,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 MAX_OVERHEAD_FRACTION = 0.02
 SAFETY = 3.0
+#: slo.phase() sites one execute touches (plan, program_build, dispatch,
+#: sync, readback + headroom for future phases)
+PHASE_SITES = 8
 
 
 def main() -> int:
     os.environ.pop("ROARING_TPU_TRACE", None)
+    os.environ.pop("ROARING_TPU_SLO_MS", None)
 
     from roaringbitmap_tpu import obs
+    from roaringbitmap_tpu.obs import slo as obs_slo
     from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
                                                          random_query_pool)
     from roaringbitmap_tpu.utils import datasets
@@ -50,6 +60,11 @@ def main() -> int:
     assert not obs.enabled()
     assert obs.span("probe", q=1) is obs.trace._NOOP, \
         "disabled span() must return the shared no-op"
+    assert obs_slo.phase("dispatch") is obs_slo._NOOP, \
+        "inactive slo.phase() must return the shared no-op"
+    assert obs_slo.query("batch_engine") is obs_slo._NOOP, \
+        "slo.query() without a deadline or forced attribution must be "\
+        "the shared no-op"
 
     bms = datasets.synthetic_bitmaps(16, seed=3, universe=1 << 18,
                                      density=0.01)
@@ -81,11 +96,23 @@ def main() -> int:
                  engine="auto", fallback=True)
     per_span_s = (time.perf_counter() - t0) / n
 
-    overhead = spans_per_execute * per_span_s * SAFETY
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs_slo.phase("dispatch")
+    per_phase_s = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs_slo.query("batch_engine")
+    per_query_s = (time.perf_counter() - t0) / n
+
+    overhead = (spans_per_execute * per_span_s
+                + PHASE_SITES * per_phase_s + per_query_s) * SAFETY
     frac = overhead / execute_s
     print(f"check_obs_overhead: execute={execute_s * 1e3:.2f} ms, "
           f"{spans_per_execute} spans/execute, "
           f"{per_span_s * 1e9:.0f} ns/disabled-span, "
+          f"{per_phase_s * 1e9:.0f} ns/disabled-phase, "
+          f"{per_query_s * 1e9:.0f} ns/suppressed-query, "
           f"overhead({SAFETY:g}x safety)={overhead * 1e6:.1f} us "
           f"= {frac * 100:.3f}% (limit "
           f"{MAX_OVERHEAD_FRACTION * 100:.0f}%)")

@@ -33,7 +33,15 @@ and a wide aggregation — then (2) validates every emitted line:
   ``multiset.memory`` event with positive ``predicted_bytes``, the
   pipeline span reports its ``launches`` / ``overlap_ratio`` tags, and
   the tiny-budget pool produced a ``site="multiset"``
-  ``proactive_split`` (the forced POOL split).
+  ``proactive_split`` (the forced POOL split);
+- cost/SLO semantics (ISSUE 6): every ``batch.dispatch`` additionally
+  carries a ``batch.cost`` event (``device_ms``, and where the backend
+  reports cost analysis, ``flops`` / ``bytes_accessed`` / the
+  ``roofline_fraction`` in (0, 1]); sync pooled dispatches carry the
+  ``multiset.cost`` twin; and the workload's forced tiny
+  ``ROARING_TPU_SLO_MS`` produced an ``slo`` event whose ``phases_ms``
+  breakdown sums to within 5% of its ``wall_ms``.  On arbitrary dumps
+  these event schemas are validated wherever the events appear.
 
 Validation-only mode (``python tools/check_trace.py <path>``) checks an
 existing dump, e.g. one captured from a serving process.
@@ -123,6 +131,7 @@ def validate(path: str, workload_semantics: bool = False,
         # multiset.memory event must be well-formed); completeness and
         # span presence are only demanded of the --workload run
         errors += _multiset_semantics([s for _, s in spans])
+        errors += _cost_slo_semantics([s for _, s in spans])
     return errors
 
 
@@ -195,6 +204,8 @@ def _workload_semantics(spans: list[dict],
             "case")
     errors += _multiset_semantics(spans, budget_semantics,
                                   complete=True)
+    errors += _cost_slo_semantics(spans, complete=True,
+                                  require_miss=budget_semantics)
     return errors
 
 
@@ -246,6 +257,76 @@ def _multiset_semantics(spans: list[dict],
     return errors
 
 
+def _cost_slo_semantics(spans: list[dict], complete: bool = False,
+                        require_miss: bool = False) -> list[str]:
+    """Cost/SLO event schemas (obs.cost / obs.slo, ISSUE 6).  Arbitrary
+    dumps validate whatever ``batch.cost`` / ``multiset.cost`` / ``slo``
+    events they contain; ``complete`` additionally demands a cost event
+    on every batch dispatch and (with ``require_miss``) the forced
+    SLO-miss case the --workload run produces."""
+    errors: list[str] = []
+    costs = [ev for s in spans for ev in s.get("events", [])
+             if ev.get("name") in ("batch.cost", "multiset.cost")]
+    for ev in costs:
+        if not isinstance(ev.get("device_ms"), (int, float)) \
+                or ev["device_ms"] < 0:
+            errors.append(f"{ev.get('name')} event without a "
+                          f"non-negative device_ms: {ev!r}")
+        for field in ("flops", "bytes_accessed", "achieved_flops_per_s",
+                      "achieved_bytes_per_s"):
+            if field in ev and (not isinstance(ev[field], (int, float))
+                                or ev[field] < 0):
+                errors.append(
+                    f"{ev.get('name')} {field} not a non-negative "
+                    f"number: {ev!r}")
+        rf = ev.get("roofline_fraction")
+        if rf is not None and (not isinstance(rf, (int, float))
+                               or not 0.0 < rf <= 1.0):
+            errors.append(f"{ev.get('name')} roofline_fraction not in "
+                          f"(0, 1]: {ev!r}")
+    slos = [ev for s in spans for ev in s.get("events", [])
+            if ev.get("name") == "slo"]
+    for ev in slos:
+        wall = ev.get("wall_ms")
+        if not isinstance(wall, (int, float)) or wall <= 0:
+            errors.append(f"slo event without positive wall_ms: {ev!r}")
+            continue
+        phases = ev.get("phases_ms")
+        if not isinstance(phases, dict) or not phases \
+                or not all(isinstance(v, (int, float)) and v >= 0
+                           for v in phases.values()):
+            errors.append(f"slo event phases_ms malformed: {ev!r}")
+            continue
+        total = sum(phases.values())
+        if abs(total - wall) > 0.05 * wall + 0.5:
+            errors.append(
+                f"slo event phases_ms sum {total:.3f} not within 5% of "
+                f"wall_ms {wall:.3f}: {ev!r}")
+    if complete:
+        dispatches = [s for s in spans if s.get("name") == "batch.dispatch"]
+        with_cost = [s for s in dispatches
+                     if any(ev.get("name") == "batch.cost"
+                            for ev in s.get("events", []))]
+        if dispatches and len(with_cost) < len(dispatches):
+            errors.append(
+                f"{len(dispatches) - len(with_cost)} batch.dispatch "
+                "span(s) lack a batch.cost event")
+        sync_ms_dispatches = [
+            s for s in spans if s.get("name") == "multiset.dispatch"
+            and not (s.get("tags") or {}).get("pipelined")]
+        if sync_ms_dispatches and not any(
+                ev.get("name") == "multiset.cost"
+                for s in sync_ms_dispatches
+                for ev in s.get("events", [])):
+            errors.append("no sync multiset.dispatch span carries a "
+                          "multiset.cost event")
+    if require_miss and not any(ev.get("missed") is True for ev in slos):
+        errors.append("no missed slo event — the forced tiny "
+                      "ROARING_TPU_SLO_MS workload case did not record "
+                      f"(saw: {slos!r})")
+    return errors
+
+
 def run_workload(path: str) -> None:
     """Small batch workload with the tracer on via the env knob (the
     activation path production uses), including one fault-injected
@@ -285,6 +366,16 @@ def run_workload(path: str) -> None:
         assert budgeted == clean, "budget-split batch diverged"
         assert eng.proactive_split_count > 0, \
             "tiny ROARING_TPU_HBM_BUDGET did not force a proactive split"
+        # forced SLO miss: a microsecond deadline no real execute can
+        # make — the slo event (phase breakdown included) must ride the
+        # batch.execute span (obs.slo, ISSUE 6)
+        os.environ["ROARING_TPU_SLO_MS"] = "0.001"
+        try:
+            missed = [r.cardinality for r in eng.execute(pool)]
+        finally:
+            del os.environ["ROARING_TPU_SLO_MS"]
+        assert missed == clean, "SLO-missing batch diverged (accounting "\
+            "must never change results)"
         aggregation.or_(*bms[:8])
 
         # pooled cross-tenant lane: 3 tenants, one pooled launch

@@ -63,8 +63,10 @@ import argparse
 import contextlib
 import json
 import os
+import re
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -77,6 +79,8 @@ BATCH_SIZES = (1, 8, 64, 256)   # batched multi-query lane (ISSUE 1)
 BATCH_R = (10, 110)             # chained rep pair for batch marginals
 MULTISET_S = (1, 4, 16)         # tenant counts of the multiset lane (ISSUE 5)
 MULTISET_Q = (8, 64)            # pooled query counts per cell
+SHARDED_MESH_ROWS = (1, 2, 4, 8)  # sharded lane mesh row-axis sweep (ISSUE 7)
+SHARDED_Q = (8, 64)               # pooled query counts per sharded cell
 
 
 def load_cpu_baseline(dataset: str) -> tuple[float | None, dict]:
@@ -494,6 +498,168 @@ def multiset_phase() -> dict:
     return out
 
 
+def _dryrun_env(n_devices: int = 8) -> dict:
+    """A CPU dry-run environment for subprocess cells: forced host
+    platform device count, TPU plugin never initialised (the
+    dryrun_multichip pattern — REPLACE, never append, JAX_PLATFORMS)."""
+    env = os.environ.copy()
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags
+                        + f" --xla_force_host_platform_device_count="
+                          f"{n_devices}")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def sharded_phase() -> dict:
+    """Mesh-sharded pooled lane (ISSUE 7): ShardedBatchEngine over
+    {1,2,4,8}x1 CPU dry-run meshes x Q in SHARDED_Q, pooled QPS +
+    per-shard balance vs the single-device MultiSetBatchEngine, plus the
+    warm-restart cold-path probe (persistent compile cache, ROADMAP
+    item 3).  Runs in a SUBPROCESS with 8 forced host-platform devices —
+    the parent process's backend (a real TPU, or a 1-device CPU) cannot
+    host the mesh sweep.  CPU-proxy caveat rides in the cell: virtual
+    devices share the host cores, so dry-run mesh QPS measures collective
+    overhead, not the scaling a real slice shows; parity and balance are
+    the gated signals."""
+    try:
+        # outer budget must dominate the cell's own worst case: the mesh
+        # sweep's compiles PLUS warm_restart_probe's two nested 600s
+        # subprocesses — a tighter cap would discard the whole lane
+        # (sentry-gated) on a slow machine
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sharded-cell"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            timeout=2400, env=_dryrun_env(max(SHARDED_MESH_ROWS)),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        return {"error": f"sharded cell failed: {type(e).__name__}: {e}"}
+
+
+def sharded_cell_main() -> None:
+    """Subprocess body for sharded_phase (8 forced CPU devices)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.parallel import (BatchEngine,
+                                            MultiSetBatchEngine,
+                                            ShardedBatchEngine)
+    from roaringbitmap_tpu.parallel.multiset import random_multiset_pool
+
+    rng = np.random.default_rng(0x5AAD)
+    s = 4
+    tenants = [[RoaringBitmap.from_values(
+        np.unique(rng.integers(0, 1 << 17, 2000).astype(np.uint32)))
+        for _ in range(8)] for _ in range(s)]
+    engines = [BatchEngine.from_bitmaps(t, layout="dense")
+               for t in tenants]
+    single = MultiSetBatchEngine(engines)
+    pools = {q: random_multiset_pool([8] * s, q, seed=0xACE,
+                                     max_operands=4) for q in SHARDED_Q}
+    out: dict = {"tenants": s,
+                 "note": ("dry-run mesh: virtual devices share host "
+                          "cores, QPS measures collective overhead")}
+    single_qps = {}
+    for q, pool in pools.items():
+        t = best_of(lambda pool=pool: single.execute(pool, engine="xla"))
+        single_qps[q] = round(q / t, 1)
+        out[f"single_q{q}_qps"] = single_qps[q]
+    want = {q: [[r.cardinality for r in rows]
+                for rows in single.execute(pools[q], engine="xla")]
+            for q in SHARDED_Q}
+    for rows in SHARDED_MESH_ROWS:
+        mesh = Mesh(np.array(jax.devices()[:rows]).reshape(rows, 1),
+                    ("rows", "data"))
+        eng = ShardedBatchEngine(engines, mesh=mesh, placement="sharded")
+        for q, pool in pools.items():
+            got = [[r.cardinality for r in rws]
+                   for rws in eng.execute(pool)]
+            assert got == want[q], f"sharded parity m{rows}x1 q{q}"
+            t = best_of(lambda pool=pool: eng.execute(pool))
+            out[f"m{rows}x1_q{q}"] = {
+                "pooled_qps": round(q / t, 1),
+                "shard_balance": round(eng.shard_balance, 4)}
+    q_max = max(SHARDED_Q)
+    best_mesh = max((out[f"m{r}x1_q{q_max}"]["pooled_qps"], r)
+                    for r in SHARDED_MESH_ROWS)
+    out["headline"] = {
+        "sharded_vs_single_x": round(
+            best_mesh[0] / max(single_qps[q_max], 1e-9), 3),
+        "best_mesh_rows": best_mesh[1]}
+    out["warm_restart"] = warm_restart_probe()
+    print(json.dumps(out))
+
+
+def warm_restart_probe() -> dict:
+    """Cold vs warm process boot against one persistent compile cache
+    (ROARING_TPU_COMPILE_CACHE): two fresh subprocesses share a new
+    cache dir; the second replays the first's compiles from disk.
+    ``warm_restart_x`` = the warm process's first-query wall over its
+    steady per-query wall — the ROADMAP item 3 acceptance ratio."""
+    cache = tempfile.mkdtemp(prefix="rb_warm_cache_")
+    env = _dryrun_env(1)
+    env["ROARING_TPU_COMPILE_CACHE"] = cache
+    rows = []
+    for tag in ("cold", "warm"):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--warm-restart-cell"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                timeout=600, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            rows.append(json.loads(
+                proc.stdout.decode().strip().splitlines()[-1]))
+        except Exception as e:
+            return {"error": f"{tag} run failed: {type(e).__name__}"}
+    cold, warm = rows
+    return {
+        "cold_warmup_ms": cold["warmup_ms"],
+        "warm_warmup_ms": warm["warmup_ms"],
+        "cold_first_query_ms": cold["first_query_ms"],
+        "warm_first_query_ms": warm["first_query_ms"],
+        "steady_query_ms": warm["steady_query_ms"],
+        "warm_restart_x": round(
+            warm["first_query_ms"] / max(warm["steady_query_ms"], 1e-9),
+            2),
+        "cache_entries": cold.get("cache_entries"),
+    }
+
+
+def warm_restart_cell_main() -> None:
+    """Subprocess body for warm_restart_probe: build a small engine,
+    warmup(rungs) through the persistent cache, then time the first real
+    query and the steady state."""
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.parallel import BatchEngine
+    from roaringbitmap_tpu.runtime import warmup as rt_warmup
+
+    rng = np.random.default_rng(3)
+    bms = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 16, 800).astype(np.uint32))
+        for _ in range(8)]
+    t0 = time.perf_counter()
+    eng = BatchEngine.from_bitmaps(bms, layout="dense")
+    eng.warmup(rungs=(4,))
+    warmup_ms = (time.perf_counter() - t0) * 1e3
+    queries = eng._rung_queries(4, ("or", "and", "xor", "andnot"))
+    t0 = time.perf_counter()
+    eng.cardinalities(queries)
+    first_ms = (time.perf_counter() - t0) * 1e3
+    steady = best_of(lambda: eng.cardinalities(queries))
+    cache_dir = rt_warmup.compile_cache_dir()
+    n_entries = (len(os.listdir(cache_dir))
+                 if cache_dir and os.path.isdir(cache_dir) else 0)
+    print(json.dumps({
+        "warmup_ms": round(warmup_ms, 1),
+        "first_query_ms": round(first_ms, 3),
+        "steady_query_ms": round(steady * 1e3, 3),
+        "cache_entries": n_entries}))
+
+
 #: hard byte cap on the final stdout summary line.  The driver captures a
 #: BOUNDED tail of stdout (ADVICE r5: the r05 summary still came back
 #: "parsed": null with the JSON head truncated), so the line must fit a
@@ -508,7 +674,7 @@ SUMMARY_MAX_BYTES = 2048
 #: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
 #: they are trend inputs for the sentry, not driver-gate fields, and the
 #: full doc always keeps them
-SUMMARY_DROP_ORDER = ("phase_ms", "cost", "marginal_us_spread",
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "sharded", "marginal_us_spread",
                       "multiset", "batched_qps", "marginal_us_median",
                       "unit", "backend", "north_star")
 
@@ -599,6 +765,21 @@ def build_summary(out: dict, full_path: str) -> dict:
         lanes["overlap_ratio"] = (ms.get("headline") or {}).get(
             "overlap_ratio")
         s["multiset"] = lanes
+    # sharded lane, compact: [pooled_qps, shard_balance] per (mesh, Q)
+    # cell + the mesh-vs-single headline ratio and the warm-restart
+    # cold-path ratio (full cell detail stays in the full doc)
+    sh = out.get("sharded") or {}
+    sh_lanes = {}
+    for key, row in sh.items():
+        if isinstance(row, dict) and "pooled_qps" in row:
+            sh_lanes[key] = [row["pooled_qps"], row["shard_balance"]]
+    if sh_lanes:
+        head = sh.get("headline") or {}
+        sh_lanes["sharded_vs_single_x"] = head.get("sharded_vs_single_x")
+        wr = sh.get("warm_restart") or {}
+        if "warm_restart_x" in wr:
+            sh_lanes["warm_restart_x"] = wr["warm_restart_x"]
+        s["sharded"] = sh_lanes
     return s
 
 
@@ -706,10 +887,21 @@ def main() -> None:
                          "marginals (0/1 disables the extra processes)")
     ap.add_argument("--spread-cell", action="store_true",
                     help="internal: emit one spread sample and exit")
+    ap.add_argument("--sharded-cell", action="store_true",
+                    help="internal: run the sharded mesh sweep in a CPU "
+                         "dry-run subprocess and exit")
+    ap.add_argument("--warm-restart-cell", action="store_true",
+                    help="internal: one warm-restart probe run and exit")
     args = ap.parse_args()
 
     if args.spread_cell:
         spread_cell_main()
+        return
+    if args.sharded_cell:
+        sharded_cell_main()
+        return
+    if args.warm_restart_cell:
+        warm_restart_cell_main()
         return
 
     # stdout hygiene: everything during the run (library prints, warnings
@@ -746,6 +938,7 @@ def main() -> None:
         batched[results[name]["dataset"]] = batched_phase(states[name])
         results[name]["batched"] = batched[results[name]["dataset"]]
     multiset = multiset_phase()
+    sharded = sharded_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
     # exactly once.  A single steady-state marginal at VMEM-resident
@@ -798,6 +991,7 @@ def main() -> None:
             "/tmp/rb_tpu_trace")
     out["batched_by_dataset"] = batched
     out["multiset"] = multiset
+    out["sharded"] = sharded
 
     # full document to disk; stdout gets ONLY the compact summary as its
     # final line (the driver's bounded tail capture must parse it)

@@ -332,6 +332,59 @@ def predict_multiset_dispatch_bytes(bucket_sigs: list, sets: list,
     return out
 
 
+def predict_sharded_dispatch_bytes(bucket_sigs: list, pool_rows: int,
+                                   mesh_devices: int,
+                                   mesh_rows: int | None = None,
+                                   engine: str = "mesh") -> dict:
+    """Transient device bytes of ONE mesh-sharded pooled launch
+    (parallel.sharded_engine) — the **per-shard** extension of
+    :func:`predict_batch_dispatch_bytes`, and the quantity the sharded
+    proactive split compares against ``ROARING_TPU_HBM_BUDGET``.
+
+    The budget is per-DEVICE HBM (each chip protects its own allocator),
+    so the split rule is ``per_shard_bytes > budget`` — a D-device mesh
+    admits ~D× the pooled transient bytes the single-device engine
+    would, which is the scaling the sharded engine exists for.  The
+    components, per launch:
+
+    - the gathered operand block and its doubling scratch shard over
+      ALL ``mesh_devices`` (rows x data jointly —
+      ``SpecLayout.gather_rows``): each device carries a 1/D slice;
+    - the per-key head accumulator (q*(k_pad+1) rows per bucket, + the
+      andnot head gather) is REPLICATED per device — every shard holds
+      the full accumulator through the butterfly combine;
+    - outputs (cards + materialized heads) are replicated per device;
+    - the resident pooled image is NOT part of the launch transient: it
+      is placed once at engine build (``SpecLayout.pooled_rows``ed over
+      the ``mesh_rows`` row-axis size only — replicated along data) and
+      accounted by the HBM ledger; ``resident_per_shard_bytes`` reports
+      its per-device share for context.
+
+    ``peak_bytes`` is the mesh-total transient
+    (= sharded parts + D × replicated parts); ``per_shard_bytes`` is one
+    device's peak, the budget-relevant figure.
+    """
+    d = max(1, int(mesh_devices))
+    rows_d = max(1, int(mesh_rows if mesh_rows is not None
+                        else mesh_devices))
+    base = predict_batch_dispatch_bytes(bucket_sigs, "dense", 0,
+                                        "xla" if engine == "mesh"
+                                        else engine)
+    sharded = base["gather_bytes"] + base["scratch_bytes"]
+    replicated = base["heads_bytes"] + base["output_bytes"]
+    per_shard = -(-sharded // d) + replicated
+    return {
+        "gather_bytes": base["gather_bytes"],
+        "scratch_bytes": base["scratch_bytes"],
+        "heads_bytes": base["heads_bytes"],
+        "output_bytes": base["output_bytes"],
+        "resident_per_shard_bytes": dense_rows_bytes(
+            -(-int(pool_rows) // rows_d)),
+        "per_shard_bytes": int(per_shard),
+        "peak_bytes": int(sharded + d * replicated),
+    }
+
+
 # ------------------------------------------------- adaptive layout default
 #
 # The uscensus2000 cliff (docs/USCENSUS2000_CLIFF.md) is a LAYOUT

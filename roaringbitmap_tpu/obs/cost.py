@@ -215,12 +215,18 @@ TRACKER = CostTracker()
 
 
 def record_dispatch(site: str, engine: str, cost: dict | None,
-                    device_s: float, **extra) -> dict:
+                    device_s: float, devices: int = 1, **extra) -> dict:
     """Per-dispatch cost accounting: combine the program's static cost
     analysis with the measured launch time into achieved rates + the
     roofline fraction, push the gauges, feed the tracker, and return the
-    ``batch.cost`` / ``multiset.cost`` span-event payload."""
+    ``batch.cost`` / ``multiset.cost`` / ``sharded.cost`` span-event
+    payload.  ``devices`` scales the roofline ceilings for mesh-sharded
+    launches: the peak table is per-device, and an SPMD program's static
+    cost analysis counts the WHOLE mesh's flops/bytes, so its legal time
+    bound divides by the device count."""
     doc: dict = {"device_ms": round(max(0.0, device_s) * 1e3, 4), **extra}
+    if devices > 1:
+        doc["devices"] = int(devices)
     _metrics.counter("rb_device_time_seconds_total", site=site,
                      engine=engine).inc(max(0.0, device_s))
     if cost is not None:
@@ -230,12 +236,14 @@ def record_dispatch(site: str, engine: str, cost: dict | None,
             doc["transcendentals"] = cost["transcendentals"]
         if device_s > 0.0:
             peaks = device_peaks()
+            d = max(1, int(devices))
             af = cost["flops"] / device_s
             ab = cost["bytes_accessed"] / device_s
             # roofline time bound: the launch cannot legally finish before
             # its flops at peak compute AND its bytes at peak bandwidth
-            bound_s = max(cost["flops"] / peaks["peak_flops_per_s"],
-                          cost["bytes_accessed"] / peaks["peak_bytes_per_s"])
+            bound_s = max(
+                cost["flops"] / (peaks["peak_flops_per_s"] * d),
+                cost["bytes_accessed"] / (peaks["peak_bytes_per_s"] * d))
             raw = bound_s / device_s if bound_s > 0.0 else 0.0
             doc["achieved_flops_per_s"] = round(af, 3)
             doc["achieved_bytes_per_s"] = round(ab, 3)

@@ -187,6 +187,13 @@ def dispatch_memory_cell(mem: dict | None) -> dict | None:
     if "sets" in mem:
         # pooled multi-set dispatches carry the tenant count too
         cell["sets"] = mem["sets"]
+    if "mesh" in mem:
+        # mesh-sharded dispatches stamp the mesh shape and the per-shard
+        # prediction (the HBM-budget-relevant figure on a mesh)
+        cell["mesh"] = mem["mesh"]
+        if "per_shard_predicted_bytes" in mem:
+            cell["per_shard_predicted_mb"] = round(
+                mem["per_shard_predicted_bytes"] / 1e6, 2)
     if "measured_peak_bytes" in mem:
         cell["measured_mb"] = round(mem["measured_peak_bytes"] / 1e6, 2)
         cell["residual_x"] = mem.get("residual_x")

@@ -1,8 +1,11 @@
-from . import aggregation, batch_engine, multiset, sharding
+from . import aggregation, batch_engine, multiset, sharded_engine, sharding
 from .aggregation import DeviceBitmapSet
 from .batch_engine import BatchEngine, BatchQuery, BatchResult
 from .multiset import BatchGroup, MultiSetBatchEngine
+from .sharded_engine import ShardedBatchEngine, default_mesh
+from .sharding import SPECS, SpecLayout
 
-__all__ = ["aggregation", "batch_engine", "multiset", "sharding",
-           "DeviceBitmapSet", "BatchEngine", "BatchQuery", "BatchResult",
-           "BatchGroup", "MultiSetBatchEngine"]
+__all__ = ["aggregation", "batch_engine", "multiset", "sharded_engine",
+           "sharding", "DeviceBitmapSet", "BatchEngine", "BatchQuery",
+           "BatchResult", "BatchGroup", "MultiSetBatchEngine",
+           "ShardedBatchEngine", "default_mesh", "SPECS", "SpecLayout"]

@@ -664,6 +664,13 @@ class DeviceBitmapSet:
     def __init__(self, bitmaps: list, block: int | None = None,
                  layout: str = "auto"):
         t_build0 = time.perf_counter()
+        # persistent-compile-cache opt-in (ROARING_TPU_COMPILE_CACHE) must
+        # land BEFORE this build's pack/densify compiles — jax initializes
+        # its cache object at the first compile, so an engine enabling it
+        # later would miss the ingest programs (runtime/warmup.py)
+        from ..runtime import warmup as rt_warmup
+
+        rt_warmup.enable_compile_cache()
         if layout == "auto":
             # adaptive default (insights.choose_layout): inflation-heavy
             # mostly-singleton sets (the uscensus2000 shape) build counts-

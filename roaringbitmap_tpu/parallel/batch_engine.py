@@ -76,6 +76,7 @@ from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..ops import dense, kernels, packing
 from ..runtime import faults, guard
+from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
 from .aggregation import DeviceBitmapSet, _engine
 
@@ -291,6 +292,10 @@ class BatchEngine:
         if ds._packed.row_src is None:
             raise ValueError(
                 "resident set lacks row_src metadata (repack required)")
+        # cold-path opt-in (ROADMAP item 3): every engine build routes
+        # compiles through the persistent cache when
+        # ROARING_TPU_COMPILE_CACHE is set (no-op otherwise)
+        rt_warmup.enable_compile_cache()
         self._ds = ds
         self.n = ds.n
         self.keys = ds.keys
@@ -889,6 +894,47 @@ class BatchEngine:
             "sequential_floor": floor,
             "cost": cost_section,
         }
+
+    # ---------------------------------------------------------- warmup
+
+    def _rung_queries(self, rung: int, ops) -> list:
+        """Representative queries for one pow2 operand rung: each op over
+        the first ``rung`` residents — the shapes a workload whose subset
+        sizes occupy that rung compiles."""
+        k = max(1, min(int(rung), self.n))
+        return [BatchQuery(op, tuple(range(k))) for op in ops]
+
+    def warmup(self, rungs=(1, 2, 4, 8),
+               ops=("or", "and", "xor", "andnot"),
+               engine: str = "auto", queries=None) -> dict:
+        """Pre-compile the batch programs a known workload will hit, so a
+        process boots hot (ROADMAP item 3's rung-warmup half; the other
+        half is the ``ROARING_TPU_COMPILE_CACHE`` persistent cache this
+        call also enables).  ``rungs`` drives one plan + AOT compile per
+        pow2 operand rung over every op; pass ``queries=`` instead to
+        warm the EXACT batch a serving loop will reissue (the
+        prepared-statement shape, which then hits both the plan and
+        program caches on its first real execute).  No device dispatch
+        happens; the cost is compile-only and measured by
+        ``rb_compile_seconds{site,cache}``.  Returns a JSON-able report
+        of what compiled."""
+        cache_dir = rt_warmup.enable_compile_cache()
+        t0 = time.perf_counter()
+        batches = ([list(queries)] if queries is not None else
+                   [self._rung_queries(r, ops) for r in rungs])
+        programs = []
+        for batch in batches:
+            if not batch:
+                continue
+            plan = self.plan(batch)
+            eng = self._bucket_engine(plan, engine)
+            self._program(plan, eng)
+            programs.append({"q": len(batch), "buckets": len(plan),
+                             "engine": eng})
+        return {"site": "batch_engine",
+                "compile_cache_dir": cache_dir,
+                "programs": programs,
+                "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}
 
     def cache_stats(self) -> dict:
         """Observability for the bounded plan/program caches (size, cap,

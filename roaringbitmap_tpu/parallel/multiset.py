@@ -88,6 +88,7 @@ from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..ops import kernels, packing
 from ..runtime import errors, faults, guard
+from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
 from ..ops import dense
 from .aggregation import DeviceBitmapSet, _engine
@@ -355,6 +356,40 @@ def _op_body(words, g_sig, arrays, eng: str):
     return (heads if needs_words else None), cards
 
 
+def assemble_pooled_results(bucket_outputs, pooled, rb_meta: dict) -> list:
+    """Normalized per-bucket device outputs -> per-query BatchResults in
+    pooled order — the readback assembly shared by
+    :class:`MultiSetBatchEngine` and ``parallel.sharded_engine``.  One
+    vectorized masked sum per bucket (not per query): a pooled readback
+    walks Q x S results, so per-query ndarray reductions would rival the
+    launch itself; the mask constants are plan-static and cached in
+    ``rb_meta`` keyed by bucket identity."""
+    pooled = list(pooled)
+    results: list = [None] * len(pooled)
+    for b, heads, cards in bucket_outputs:
+        meta = rb_meta.get(id(b))
+        if meta is None:
+            kqs = np.fromiter((k.size for k in b.keys), np.int64,
+                              len(b.keys))
+            meta = kqs, (np.arange(b.k_pad)[None, :] < kqs[:, None])
+            rb_meta[id(b)] = meta
+        kqs, live = meta
+        sums = np.where(live[:, :cards.shape[1]],
+                        cards[:len(b.keys)], 0).sum(axis=1)
+        for slot, (qid, keys_q) in enumerate(zip(b.qids, b.keys)):
+            kq = keys_q.size
+            bm = None
+            if pooled[qid][1].form == "bitmap":
+                bm = packing.unpack_result(
+                    keys_q,
+                    heads[slot, :kq] if kq else
+                    np.zeros((0, WORDS32), np.uint32),
+                    cards[slot, :kq])
+            results[qid] = BatchResult(cardinality=int(sums[slot]),
+                                       bitmap=bm)
+    return results
+
+
 @dataclasses.dataclass
 class _Inflight:
     """A dispatched-but-undrained launch of the pipelined dispatcher."""
@@ -384,6 +419,7 @@ class MultiSetBatchEngine:
     def __init__(self, sets: list):
         if not sets:
             raise ValueError("multi-set engine needs at least one set")
+        rt_warmup.enable_compile_cache()   # ROARING_TPU_COMPILE_CACHE
         self._engines = [s if isinstance(s, BatchEngine) else BatchEngine(s)
                          for s in sets]
         self.n_sets = len(self._engines)
@@ -1035,33 +1071,9 @@ class MultiSetBatchEngine:
         with obs_slo.phase("readback"), \
                 obs_trace.span("multiset.readback", engine=eng,
                                q=len(pooled)):
-            results: list = [None] * len(pooled)
-            for b, heads, cards in self._bucket_outputs(plan, outs, eng):
-                # one vectorized masked sum per bucket (not per query):
-                # the pooled readback walks Q x S results, so per-query
-                # ndarray reductions would rival the launch itself; the
-                # mask constants are plan-static and cached on the plan
-                meta = plan.rb_meta.get(id(b))
-                if meta is None:
-                    kqs = np.fromiter((k.size for k in b.keys), np.int64,
-                                      len(b.keys))
-                    meta = kqs, (np.arange(b.k_pad)[None, :]
-                                 < kqs[:, None])
-                    plan.rb_meta[id(b)] = meta
-                kqs, live = meta
-                sums = np.where(live[:, :cards.shape[1]],
-                                cards[:len(b.keys)], 0).sum(axis=1)
-                for slot, (qid, keys_q) in enumerate(zip(b.qids, b.keys)):
-                    kq = keys_q.size
-                    bm = None
-                    if pooled[qid][1].form == "bitmap":
-                        bm = packing.unpack_result(
-                            keys_q,
-                            heads[slot, :kq] if kq else
-                            np.zeros((0, WORDS32), np.uint32),
-                            cards[slot, :kq])
-                    results[qid] = BatchResult(cardinality=int(sums[slot]),
-                                               bitmap=bm)
+            results = assemble_pooled_results(
+                self._bucket_outputs(plan, outs, eng), pooled,
+                plan.rb_meta)
         if inject and faults.should_corrupt(SITE, eng):
             results[0] = BatchResult(
                 cardinality=results[0].cardinality + 1,
@@ -1105,6 +1117,48 @@ class MultiSetBatchEngine:
         """Per-group i64 arrays of result cardinalities."""
         return [np.array([r.cardinality for r in rows], dtype=np.int64)
                 for rows in self.execute(groups, engine=engine)]
+
+    def warmup(self, rungs=(1, 2, 4, 8),
+               ops=("or", "and", "xor", "andnot"),
+               engine: str = "auto", pools=None) -> dict:
+        """Pre-compile pooled programs for known pow2 operand rungs (one
+        pool per rung: every tenant contributes each op over its first
+        ``rung`` residents), or for explicit ``pools=`` (the exact
+        serving shapes — those then hit the plan AND program caches on
+        their first real execute).  A pool referencing one set warms
+        that set's single-set engine instead, matching the S=1 execute
+        route.  Compile-only; see ``BatchEngine.warmup``."""
+        cache_dir = rt_warmup.enable_compile_cache()
+        t0 = time.perf_counter()
+        if pools is None:
+            pools = [[BatchGroup(sid, e._rung_queries(r, ops))
+                      for sid, e in enumerate(self._engines)]
+                     for r in rungs]
+        programs = []
+        for pool in pools:
+            pooled, _ = self._flatten(list(pool))
+            if not pooled:
+                continue
+            sids = sorted({sid for sid, _ in pooled})
+            if len(sids) == 1:
+                rep = self._engines[sids[0]].warmup(
+                    queries=[q for _, q in pooled], engine=engine)
+                programs.extend(rep["programs"])
+                continue
+            plan = self._plan_pool(pooled)
+            eng = self._pool_engine(plan, engine)
+            self._program(plan, eng)
+            if _donation_supported():
+                # the pipelined dispatcher compiles the DONATE variant
+                # (a distinct program-cache key): warm it too, or the
+                # first serving tick pays the compile warmup exists to
+                # remove
+                self._program(plan, eng, donate=True)
+            programs.append({"q": len(pooled), "sets": len(sids),
+                             "buckets": len(plan.buckets), "engine": eng})
+        return {"site": SITE, "compile_cache_dir": cache_dir,
+                "programs": programs,
+                "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}
 
     def cache_stats(self) -> dict:
         """Pooled plan/program cache observability + the split counters

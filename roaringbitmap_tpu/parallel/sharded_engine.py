@@ -1,0 +1,756 @@
+"""Mesh-sharded pooled batch serving: the Batch/MultiSet engines over a
+device mesh (ROADMAP item 1).
+
+``wide_aggregate_sharded`` sharded ONE wide op; the production path —
+``BatchEngine`` / ``MultiSetBatchEngine`` pooled mixed-op query batches —
+stayed single-chip.  This module closes that gap: the pooled packed-row
+tensors are placed with ``jax.sharding.NamedSharding`` over a 2-D mesh and
+one pooled launch spans the whole slice, buying the two scalings the
+single-device engines cannot reach:
+
+- **tenants bigger than one chip's HBM**: the pooled resident image
+  shards over the ``rows`` axis (``SpecLayout.pooled_rows``), so a
+  resident set's 8 KiB/container rows divide across devices;
+- **near-linear QPS on replicated small pools**: a launch's transient
+  gathered rows spread over ``rows x data`` jointly
+  (``SpecLayout.gather_rows``), so every device carries a slice of the
+  pool's row work — and because each query's rows are contiguous in the
+  flat gather, sharding the row axis effectively partitions *queries*
+  across devices.
+
+Execution model
+---------------
+Planning is the pooled planner unchanged one level down: per-set row
+selection (``BatchEngine._plan_query``), global pooled-row offsets,
+``plan_bucket`` shape bucketing, and the per-op superbucket merge
+(``multiset._merge_op_groups``) — the sharded engine adds only a flat-row
+pad to a device-count multiple (padding rows carry the per-op identity
+and a dead segment id).  Each op group then runs as:
+
+1. ONE gather from the rows-sharded pooled image (cross-shard, GSPMD);
+2. a ``shard_map`` shard-local segmented reduce: the flat rows are
+   globally sorted by segment, so each shard's doubling pass reduces its
+   contiguous runs and scatters per-segment heads into a full
+   identity-initialized accumulator — segments absent from a shard hold
+   the identity, segments straddling a shard boundary hold partials;
+3. the cross-shard combine: a log2(D) ``ppermute`` butterfly per mesh
+   axis (bitwise ops are outside XLA's psum vocabulary — same reasoning
+   as ``parallel.sharding``), after which every device holds the exact
+   reduction;
+4. the per-op post passes (presence/keep masks, andnot head pass,
+   popcount) on the replicated head axis.
+
+Everything compiles AOT under the mesh (``jit -> lower -> compile``), so
+every cached program carries ``memory_analysis()`` / ``cost_analysis()``
+like the PR 4/6 engines; on donation-capable backends the per-launch
+bucket scratch uploads fresh and is donated (the PR 5 discipline — CPU
+ignores donation, so the dry-run path keeps cached uploads).
+
+Guard & budget integration
+--------------------------
+Every launch rides ``guard.run_with_fallback`` down the
+``mesh -> single -> sequential`` ladder: a classified mesh fault demotes
+to the un-sharded pooled engine (``MultiSetBatchEngine`` over the same
+adopted ``BatchEngine`` instances — zero re-packing), and from there to
+the host sequential reference; every rung is bit-exact.  The HBM budget
+is per-DEVICE (each chip protects its own allocator): the proactive
+split halves the pool while the **per-shard** predicted transient
+(``insights.predict_sharded_dispatch_bytes``) exceeds the budget, so a
+D-row mesh admits ~D× the pooled bytes before splitting — the
+single-device engine at the same budget proactively splits several times
+more (tests/test_sharded_engine.py pins the ratio).
+
+Observability: ``sharded.*`` spans mirror the multiset vocabulary;
+every dispatch span carries a ``batch.shard`` event keyed by the mesh
+shape (tools/check_trace.py pins the schema), ``sharded.memory`` /
+``sharded.cost`` events carry per-shard predictions and a mesh-scaled
+roofline, and ``rb_shard_balance{site,mesh}`` gauges max/mean per-shard
+resident bytes (1.0 = perfectly balanced row distribution).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ..insights import analysis as insights
+from ..obs import cost as obs_cost
+from ..obs import memory as obs_memory
+from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
+from ..obs import trace as obs_trace
+from ..ops import dense, packing
+from ..runtime import faults, guard
+from ..runtime import warmup as rt_warmup
+from ..runtime.cache import LRUCache
+from .aggregation import DeviceBitmapSet
+from .batch_engine import (PLAN_CACHE_MAX, PROGRAM_CACHE_MAX, WORDS32,
+                           _RED_OP, BatchEngine, BatchQuery, plan_bucket)
+from .multiset import (BatchGroup, MultiSetBatchEngine, _donation_supported,
+                       _merge_op_groups, assemble_pooled_results)
+from .sharding import SPECS, SpecLayout, _butterfly_combine, _intern_mesh, \
+    shard_map
+
+#: the guard/trace/metric site of every mesh-sharded dispatch
+SITE = "sharded_engine"
+
+#: the sharded fallback ladder (guard appends the sequential reference):
+#: a mesh fault demotes to the un-sharded pooled engine, never to a
+#: half-dead mesh
+ENGINE_LADDER = (guard.MESH, guard.SINGLE_DEVICE)
+
+
+def default_mesh(devices=None, data: int = 1,
+                 specs: SpecLayout = SPECS) -> Mesh:
+    """A (rows x data) mesh over the largest power-of-two prefix of the
+    available devices: the ppermute butterfly pairs partners by XOR, so
+    both axis sizes must be powers of two (same constraint as
+    ``dryrun_multichip``)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if data < 1 or data & (data - 1):
+        raise ValueError(f"data axis size must be a power of two: {data}")
+    if len(devices) < data:
+        raise ValueError(
+            f"data axis size {data} needs at least {data} devices, got "
+            f"{len(devices)}")
+    rows = 1
+    while rows * 2 * data <= len(devices):
+        rows *= 2
+    use = np.array(devices[:rows * data]).reshape(rows, data)
+    return _intern_mesh(Mesh(use, (specs.row_axis, specs.data_axis)))
+
+
+def _check_mesh(mesh: Mesh, specs: SpecLayout) -> Mesh:
+    for axis in (specs.row_axis, specs.data_axis):
+        if axis not in mesh.axis_names:
+            raise ValueError(
+                f"sharded engine mesh needs a {axis!r} axis, got "
+                f"{mesh.axis_names}")
+        n = mesh.shape[axis]
+        if n & (n - 1):
+            raise ValueError(
+                f"mesh axis {axis!r} size must be a power of two for the "
+                f"ppermute butterfly combine, got {n}")
+    return _intern_mesh(mesh)
+
+
+@dataclasses.dataclass
+class _ShardedPlan:
+    """One mesh-sharded pooled plan: the multiset shape buckets + per-op
+    superbuckets, plus each group's device-count-padded flat operands
+    (padding rows index pool row 0, are masked invalid, and carry the
+    group's dead segment id ``nseg``)."""
+
+    buckets: list
+    op_groups: list
+    sids: tuple
+    padded: list          # per group: {key: np array} device-pad layout
+    n_pads: tuple         # per group: padded flat row count
+    rb_meta: dict = dataclasses.field(default_factory=dict)
+    _arrays: list | None = None   # device twins, uploaded lazily
+
+    @property
+    def signature(self):
+        return (self.sids, self.n_pads,
+                tuple(g.sig for g in self.op_groups))
+
+
+class ShardedBatchEngine:
+    """Plan + execute mixed-op query pools over S resident sets, one
+    pooled launch spanning a device mesh.
+
+    ``sets`` may mix ``DeviceBitmapSet`` and ``BatchEngine`` instances
+    (adopted, like ``MultiSetBatchEngine``); a bare single set is
+    accepted too.  ``mesh`` defaults to :func:`default_mesh` over every
+    visible device; both axes must be power-of-two sized.  The pooled
+    resident image is placed ONCE at construction, sharded over the
+    ``rows`` axis — compact/counts tenants are densified through their
+    own engines' resident path first (the sharded pool is a dense row
+    image; their host-side sets keep their layouts for the fallback
+    rungs).
+    """
+
+    def __init__(self, sets, mesh: Mesh | None = None,
+                 placement: str = "auto", specs: SpecLayout = SPECS):
+        rt_warmup.enable_compile_cache()   # ROARING_TPU_COMPILE_CACHE
+        if isinstance(sets, (DeviceBitmapSet, BatchEngine)):
+            sets = [sets]
+        if placement not in ("auto", "sharded", "replicated"):
+            raise ValueError(f"unknown pool placement {placement!r}")
+        self._specs = specs
+        self._mesh = (_check_mesh(mesh, specs) if mesh is not None
+                      else default_mesh(specs=specs))
+        self.mesh_shape = (int(self._mesh.shape[specs.row_axis]),
+                           int(self._mesh.shape[specs.data_axis]))
+        self.mesh_devices = self.mesh_shape[0] * self.mesh_shape[1]
+        self._mesh_label = f"{self.mesh_shape[0]}x{self.mesh_shape[1]}"
+        #: the single-device demotion rung AND the sequential/shadow
+        #: reference: the un-sharded pooled engine over the SAME adopted
+        #: BatchEngine instances (shared caches, zero re-packing)
+        self._single = MultiSetBatchEngine(sets)
+        self._engines = self._single._engines
+        self.n_sets = len(self._engines)
+        self._rows = [int(e._row_src.size) for e in self._engines]
+        self._base = np.concatenate(
+            ([0], np.cumsum(self._rows))).astype(np.int64)
+        self._place_pool(placement)
+        self._plans = LRUCache(PLAN_CACHE_MAX, name="sharded_plans")
+        self._programs = LRUCache(PROGRAM_CACHE_MAX,
+                                  name="sharded_programs")
+        self.split_count = 0            # reactive (ResourceExhausted)
+        self.proactive_split_count = 0  # per-shard HBM-budget halvings
+        self.last_dispatch_memory: dict | None = None
+        self.last_dispatch_cost: dict | None = None
+        self._first_query_done = False
+
+    @classmethod
+    def from_bitmap_sets(cls, bitmap_sets: list, mesh: Mesh | None = None,
+                         layout: str = "auto", **kw) -> "ShardedBatchEngine":
+        return cls([DeviceBitmapSet(b, layout=layout, **kw)
+                    for b in bitmap_sets], mesh=mesh)
+
+    # -------------------------------------------------------- pool placement
+
+    #: "auto" placement replicates the pooled image while its per-device
+    #: copy stays under this many bytes (64 MiB): a replicated pool makes
+    #: every launch's gather SHARD-LOCAL (the only collective left is the
+    #: butterfly combine), which is the throughput-replication regime —
+    #: row-sharding is the capacity regime for pools past one chip's HBM,
+    #: where the cross-shard gather is the price of residency at all.
+    REPLICATE_MAX_BYTES = 64 << 20
+
+    def _place_pool(self, placement: str) -> None:
+        """Concatenate every tenant's dense row image and place it over
+        the mesh: ``sharded`` = rows over the ``rows`` axis (replicated
+        along ``data``) — per-device residency 1/mesh_rows of the pool;
+        ``replicated`` = full copy per device — shard-local gathers;
+        ``auto`` = replicate small pools (REPLICATE_MAX_BYTES), shard
+        big ones.  One-time ingest cost, accounted by the HBM ledger
+        (kind="sharded_pool") at mesh-total bytes; ``shard_balance`` =
+        max/mean live rows per row-shard (1.0 when replicated)."""
+        rows_axis = self.mesh_shape[0]
+        total = int(self._base[-1])
+        padded = max(rows_axis, -(-total // rows_axis) * rows_axis)
+        if placement == "auto":
+            placement = ("replicated"
+                         if total * insights.ROW_BYTES
+                         <= self.REPLICATE_MAX_BYTES else "sharded")
+        self.placement = placement
+        img = np.zeros((padded, WORDS32), np.uint32)
+        for e, b in zip(self._engines, self._base[:-1]):
+            n = int(e._row_src.size)
+            if n:
+                img[int(b):int(b) + n] = np.asarray(
+                    e._ds._resident_words("xla"), dtype=np.uint32)
+        self.pool_rows_live = total
+        self.pool_rows = padded
+        spec = (self._specs.pooled_rows() if placement == "sharded"
+                else self._specs.combined_heads())
+        self.pool_words = jax.device_put(
+            img, NamedSharding(self._mesh, spec))
+        if placement == "sharded":
+            per_shard = np.clip(
+                total - np.arange(rows_axis) * (padded // rows_axis),
+                0, padded // rows_axis)
+            mean = float(per_shard.mean()) if total else 1.0
+            self.shard_balance = (float(per_shard.max()) / mean
+                                  if mean > 0 else 1.0)
+            # pooled_rows() = P(rows, None): each row-shard REPLICATES
+            # along the data axis, so the mesh holds data_size copies of
+            # the pool — the ledger must count what the devices hold
+            ledger_bytes = (padded * insights.ROW_BYTES
+                            * self.mesh_shape[1])
+        else:
+            self.shard_balance = 1.0
+            ledger_bytes = padded * insights.ROW_BYTES * self.mesh_devices
+        obs_metrics.gauge("rb_shard_balance", site=SITE,
+                          mesh=self._mesh_label).set(self.shard_balance)
+        self._ledger_handle = obs_memory.LEDGER.register(
+            "sharded_pool", "dense", ledger_bytes, owner=self)
+
+    @property
+    def sets(self) -> list:
+        return [e._ds for e in self._engines]
+
+    def hbm_bytes(self) -> int:
+        """Mesh-total resident bytes of the pooled image: sharded
+        placement holds 1/mesh_rows per row-shard, replicated along the
+        data axis (mesh-total = data_size copies); replicated placement
+        holds a full copy per device."""
+        per = self.pool_rows * insights.ROW_BYTES
+        return per * (self.mesh_devices
+                      if self.placement == "replicated"
+                      else self.mesh_shape[1])
+
+    # ------------------------------------------------------------- planning
+
+    def _normalize(self, groups_or_queries):
+        """Accept MultiSet-style groups OR a bare BatchQuery list (single
+        tenant sugar).  Returns (groups, bare) where bare=True means the
+        caller gets a flat result list back."""
+        seq = list(groups_or_queries)
+        if seq and isinstance(seq[0], BatchQuery):
+            return [BatchGroup(0, seq)], True
+        return seq, False
+
+    def _plan(self, pooled) -> _ShardedPlan:
+        key = tuple(pooled)
+        cached = self._plans.get(key)
+        if cached is not None:
+            return cached
+        sids = tuple(sorted({sid for sid, _ in pooled}))
+        with obs_slo.phase("plan"), \
+                obs_trace.span("sharded.plan", q=len(pooled),
+                               sets=len(sids), mesh=self._mesh_label) as sp:
+            groups: dict = {}
+            for qid, (sid, q) in enumerate(pooled):
+                eng = self._engines[sid]
+                rows, segs, keys_q, keep, hrows = eng._plan_query(q)
+                off = int(self._base[sid])
+                rows = rows + off
+                if hrows is not None:
+                    hrows = hrows + off
+                rung = packing.next_pow2(max(1, len(set(q.operands))))
+                groups.setdefault((q.op, rung), []).append(
+                    (qid, q, rows, segs, keys_q, keep, hrows))
+            with obs_trace.span("sharded.pool", groups=len(groups)):
+                buckets = [plan_bucket(op, items)
+                           for (op, _), items in sorted(groups.items())]
+                op_groups = _merge_op_groups(buckets)
+                padded, n_pads = [], []
+                d = self.mesh_devices
+                for g in op_groups:
+                    n = int(g.n_rows)
+                    n_pad = max(d, -(-n // d) * d)
+                    gather = np.zeros(n_pad, np.int32)
+                    gather[:n] = g.host["gather"]
+                    valid = np.zeros(n_pad, bool)
+                    valid[:n] = g.host["valid"]
+                    flat_seg = np.full(n_pad, g.nseg, np.int32)
+                    flat_seg[:n] = g.host["flat_seg"]
+                    host = {"gather": gather, "valid": valid,
+                            "flat_seg": flat_seg,
+                            "mask_ok": g.host["mask_ok"]}
+                    if g.op == "andnot":
+                        host["head_gather"] = g.host["head_gather"]
+                        host["head_ok"] = g.host["head_ok"]
+                    padded.append(host)
+                    n_pads.append(n_pad)
+            sp.tag(buckets=len(buckets), op_groups=len(op_groups),
+                   flat_rows=int(sum(n_pads)))
+        plan = _ShardedPlan(buckets=buckets, op_groups=op_groups,
+                            sids=sids, padded=padded,
+                            n_pads=tuple(n_pads))
+        self._plans.put(key, plan)
+        return plan
+
+    def _operands(self, plan: _ShardedPlan, fresh: bool = False) -> list:
+        """Per-group device operands with their canonical placements:
+        gather/valid/flat_seg shard with the transient rows
+        (``SpecLayout.gather_vec``), per-key masks replicate.
+        ``fresh=True`` uploads uncached twins for a donating dispatch."""
+        shard_v = NamedSharding(self._mesh, self._specs.gather_vec())
+        repl = NamedSharding(self._mesh, self._specs.replicated())
+
+        def upload(host):
+            return {k: jax.device_put(
+                v, shard_v if k in ("gather", "valid", "flat_seg")
+                else repl) for k, v in host.items()}
+
+        if fresh:
+            return [upload(h) for h in plan.padded]
+        if plan._arrays is None:
+            plan._arrays = [upload(h) for h in plan.padded]
+        return plan._arrays
+
+    def _operand_avals(self, plan: _ShardedPlan) -> list:
+        """Sharding-carrying avals matching ``_operands(fresh=True)`` —
+        what the donate-variant lowering traces against (no throwaway
+        uploads, same discipline as the multiset donate path)."""
+        shard_v = NamedSharding(self._mesh, self._specs.gather_vec())
+        repl = NamedSharding(self._mesh, self._specs.replicated())
+
+        def aval(k, v):
+            return jax.ShapeDtypeStruct(
+                v.shape, jax.dtypes.canonicalize_dtype(v.dtype),
+                sharding=(shard_v if k in ("gather", "valid", "flat_seg")
+                          else repl))
+
+        return [{k: aval(k, v) for k, v in h.items()}
+                for h in plan.padded]
+
+    def predict_dispatch_bytes(self, groups_or_queries) -> dict:
+        """Per-shard + mesh-total transient prediction of ONE sharded
+        launch (``insights.predict_sharded_dispatch_bytes``) — the
+        ``per_shard_bytes`` entry is what the proactive split compares
+        against the per-device HBM budget."""
+        groups, _ = self._normalize(groups_or_queries)
+        pooled, _ = self._single._flatten(groups)
+        return self._predict(self._plan(tuple(pooled)))
+
+    def _predict(self, plan: _ShardedPlan) -> dict:
+        return insights.predict_sharded_dispatch_bytes(
+            [b.signature for b in plan.buckets], self.pool_rows,
+            self.mesh_devices,
+            self.mesh_shape[0] if self.placement == "sharded" else 1)
+
+    # ------------------------------------------------------------- programs
+
+    def _group_body(self, g_sig, n_pad: int, arrs, pool_words):
+        """Traced body for one op superbucket on the mesh: gather from
+        the rows-sharded pool, shard-local segmented reduce, butterfly
+        combine per mesh axis, replicated post passes."""
+        op, nseg, _n_rows, n_steps, needs_words, _reg = g_sig
+        red = _RED_OP[op]
+        mesh, specs = self._mesh, self._specs
+        ident = jnp.uint32(0xFFFFFFFF if op == "and" else 0)
+        g = pool_words[arrs["gather"]]
+        g = jnp.where(arrs["valid"][:, None], g, ident)
+        g = jax.lax.with_sharding_constraint(
+            g, NamedSharding(mesh, specs.gather_rows()))
+
+        def local(g_shard, seg_shard):
+            # rows are globally sorted by flat segment, so a shard's rows
+            # for one segment are contiguous: reduce local runs, scatter
+            # each run's head into an IDENTITY-initialized full
+            # accumulator (a segment with no rows on this shard must
+            # contribute the identity to the cross-shard combine — zeros
+            # would annihilate AND), then butterfly per mesh axis
+            rows = dense.doubling_pass(dense.OPS[red], g_shard,
+                                       seg_shard, n_steps)
+            prev = jnp.concatenate(
+                [jnp.full((1,), -1, seg_shard.dtype), seg_shard[:-1]])
+            is_head = seg_shard != prev
+            dest = jnp.where(is_head, seg_shard, nseg)
+            acc = jnp.full((nseg + 1, rows.shape[1]), ident)
+            acc = acc.at[dest].set(rows)
+            for axis in (specs.row_axis, specs.data_axis):
+                if mesh.shape[axis] > 1:
+                    acc = _butterfly_combine(red, acc, axis,
+                                             mesh.shape[axis])
+            return acc
+
+        heads = shard_map(
+            local, mesh=mesh,
+            in_specs=(specs.gather_rows(), specs.gather_vec()),
+            out_specs=specs.combined_heads(),
+            check_vma=False)(g, arrs["flat_seg"])
+        heads = heads[:nseg]
+        heads = jnp.where(arrs["mask_ok"][:, None], heads, jnp.uint32(0))
+        if op == "andnot":
+            hg = pool_words[arrs["head_gather"]]
+            hg = jnp.where(arrs["head_ok"][:, None], hg, jnp.uint32(0))
+            heads = hg & ~heads
+        cards = dense.popcount(heads)
+        return (heads if needs_words else None), cards
+
+    def _program(self, plan: _ShardedPlan, donate: bool = False):
+        """AOT-compiled mesh program for this plan's signature — one call
+        = one SPMD dispatch over the whole mesh, with memory/cost
+        analysis captured per the PR 4/6 contract.  ``donate=True``
+        (donation-capable backends only) donates the per-launch group
+        scratch like the PR 5 pipelined dispatcher."""
+        donate = donate and _donation_supported()
+        sig = (guard.MESH, plan.signature, donate)
+        t_get = time.perf_counter()
+        cached = self._programs.get(sig)
+        if cached is not None:
+            obs_cost.observe_compile(SITE, "hit",
+                                     time.perf_counter() - t_get)
+            return cached
+        g_sigs = [g.sig for g in plan.op_groups]
+        n_pads = plan.n_pads
+
+        with obs_slo.phase("program_build"), \
+                obs_trace.span("sharded.program_build", mesh=self._mesh_label,
+                               groups=len(g_sigs), donate=donate) as sp:
+            def run(pool_words, garrays):
+                return [self._group_body(s, n, a, pool_words)
+                        for s, n, a in zip(g_sigs, n_pads, garrays)]
+
+            jit_kw = {"donate_argnums": (1,)} if donate else {}
+            operands = (self._operand_avals(plan) if donate
+                        else self._operands(plan))
+            t0 = time.perf_counter()
+            compiled = jax.jit(run, **jit_kw).lower(
+                self.pool_words, operands).compile()
+            compile_s = time.perf_counter() - t0
+            obs_cost.observe_compile(SITE, "miss", compile_s)
+            predicted = self._predict(plan)
+            measured = obs_memory.compiled_memory(compiled)
+            cost = obs_cost.compiled_cost(compiled)
+            sp.tag(per_shard_predicted_bytes=predicted["per_shard_bytes"],
+                   measured_peak_bytes=(measured or {}).get("peak_bytes"),
+                   compile_ms=round(compile_s * 1e3, 2),
+                   flops=(cost or {}).get("flops"))
+            cached = (run, compiled, predicted, measured, cost)
+        self._programs.put(sig, cached)
+        return cached
+
+    # ------------------------------------------------------------ execution
+
+    def execute(self, groups, engine: str = "auto", jit: bool = True,
+                fallback: bool = True,
+                policy: guard.GuardPolicy | None = None) -> list:
+        """Run a pool of per-set query groups as mesh-sharded launches;
+        returns per-group result lists (``MultiSetBatchEngine.execute``'s
+        shape), or a flat list when called with bare ``BatchQuery``
+        sugar.  ``engine`` is accepted for interface parity; the mesh
+        rung's reduce engine is the XLA doubling pass (the shard-local
+        form), with demotion handling everything else.
+
+        Guarded per launch down ``mesh -> single -> sequential``;
+        ``ResourceExhausted`` halves the pool reactively, and the
+        proactive split halves it BEFORE dispatch while the per-shard
+        predicted transient exceeds the per-device HBM budget."""
+        groups, bare = self._normalize(groups)
+        pooled, lengths = self._single._flatten(groups)
+        if not pooled:
+            return [] if bare else [[] for _ in groups]
+        t_exec0 = time.perf_counter()
+        with obs_trace.span("sharded.execute", site=SITE, q=len(pooled),
+                            sets=len({s for s, _ in pooled}),
+                            mesh=self._mesh_label, fallback=fallback):
+            obs_metrics.counter("rb_sharded_queries_total", site=SITE,
+                                mesh=self._mesh_label).inc(len(pooled))
+            if not fallback:
+                flat = self._launch_once(pooled, jit, inject=False)
+                return flat if bare else self._single._regroup(flat,
+                                                               lengths)
+            policy = policy or guard.GuardPolicy.from_env()
+            budget = guard.resolve_hbm_budget(policy)
+            deadline = guard.Deadline(policy.deadline)
+            with obs_slo.query(SITE, deadline_ms=policy.slo_deadline_ms):
+                flat = []
+                for qs in self._launch_iter(pooled, budget):
+                    res, _rung = self._launch_guarded(
+                        qs, jit, policy, deadline, budget)
+                    flat.extend(res)
+            if not self._first_query_done:
+                self._first_query_done = True
+                obs_metrics.histogram(
+                    "rb_first_query_seconds", site=SITE).observe(
+                        time.perf_counter() - t_exec0)
+            if policy.shadow_rate > 0.0:
+                self._shadow_check(pooled, flat, policy)
+            return flat if bare else self._single._regroup(flat, lengths)
+
+    def _launch_iter(self, pooled, budget: int | None):
+        """Left-to-right launch partition: a sub-pool whose PER-SHARD
+        predicted transient exceeds the per-device budget is halved
+        before dispatch (the mesh form of the proactive split — a D-row
+        mesh admits ~D× what the single-device engine would)."""
+        stack = [list(pooled)]
+        while stack:
+            qs = stack.pop()
+            while budget is not None and len(qs) >= 2:
+                per_shard = self._predict(
+                    self._plan(tuple(qs)))["per_shard_bytes"]
+                if per_shard <= budget:
+                    break
+                mid = (len(qs) + 1) // 2
+                self.proactive_split_count += 1
+                obs_metrics.counter("rb_sharded_proactive_splits_total",
+                                    site=SITE,
+                                    mesh=self._mesh_label).inc()
+                obs_trace.current().event(
+                    "proactive_split", site=SITE, q=len(qs),
+                    predicted_bytes=per_shard, budget_bytes=budget,
+                    mesh=list(self.mesh_shape),
+                    halves=(mid, len(qs) - mid))
+                stack.append(qs[mid:])
+                qs = qs[:mid]
+            yield tuple(qs)
+
+    def _launch_guarded(self, qs, jit, policy, deadline, budget):
+        """One guarded launch down the mesh -> single -> sequential
+        ladder.  The single rung is the un-sharded pooled engine's raw
+        xla launch over the SAME resident sets (bit-exact by the PR 5
+        parity contract); its own finer ladder is not re-entered — a
+        process that lost the mesh should degrade predictably, not
+        explore."""
+
+        def attempt(rung):
+            if rung == guard.MESH:
+                return self._launch_once(qs, jit)
+            faults.maybe_fail(SITE, guard.SINGLE_DEVICE)
+            obs_slo.note_engine(guard.SINGLE_DEVICE)
+            return self._single._launch_once(qs, "xla", jit)
+
+        def on_oom(rung, fault, dl):
+            if len(qs) < 2:
+                return guard.NO_SPLIT
+            mid = (len(qs) + 1) // 2
+            self.split_count += 1
+            obs_metrics.counter("rb_sharded_oom_splits_total", site=SITE,
+                                mesh=self._mesh_label).inc()
+            obs_trace.current().event(
+                "oom_split", site=SITE, engine_from=rung, engine_to=rung,
+                q=len(qs), halves=(mid, len(qs) - mid))
+            return (self._launch_guarded(qs[:mid], jit, policy, dl,
+                                         budget)[0]
+                    + self._launch_guarded(qs[mid:], jit, policy, dl,
+                                           budget)[0])
+
+        return guard.run_with_fallback(
+            SITE, ENGINE_LADDER, attempt, policy=policy,
+            sequential=lambda: self._single._sequential(qs),
+            on_resource_exhausted=on_oom, deadline=deadline)
+
+    def _launch_once(self, pooled, jit: bool, inject: bool = True) -> list:
+        """Raw mesh launch: plan -> one compiled SPMD program -> host
+        assembly.  The faults hook sits at the engine boundary."""
+        pooled = tuple(pooled)
+        plan = self._plan(pooled)
+        obs_slo.note_engine(guard.MESH)
+        if inject:
+            faults.maybe_fail(SITE, guard.MESH)
+        donate = _donation_supported()
+        run, compiled, predicted, measured, cost = self._program(
+            plan, donate=donate)
+        operands = self._operands(plan, fresh=donate)
+        with obs_trace.span("sharded.dispatch", engine=guard.MESH,
+                            q=len(pooled), sets=len(plan.sids),
+                            mesh=self._mesh_label) as sp:
+            t_launch = time.perf_counter()
+            with obs_slo.phase("dispatch"):
+                outs = (compiled if jit else run)(self.pool_words,
+                                                  operands)
+            obs_metrics.counter("rb_sharded_launches_total", site=SITE,
+                                mesh=self._mesh_label).inc()
+            with obs_slo.phase("sync"):
+                outs = sp.sync(outs)
+                outs = jax.block_until_ready(outs)
+            launch_s = time.perf_counter() - t_launch
+            mem = obs_memory.record_dispatch(
+                SITE, predicted["per_shard_bytes"], measured)
+            mem["engine"], mem["q"] = guard.MESH, len(pooled)
+            mem["sets"] = len(plan.sids)
+            mem["mesh"] = list(self.mesh_shape)
+            mem["per_shard_predicted_bytes"] = predicted["per_shard_bytes"]
+            mem["mesh_total_predicted_bytes"] = predicted["peak_bytes"]
+            self.last_dispatch_memory = mem
+            sp.event("sharded.memory", **mem)
+            cost_ev = obs_cost.record_dispatch(
+                SITE, guard.MESH, cost, launch_s,
+                devices=self.mesh_devices, q=len(pooled))
+            self.last_dispatch_cost = cost_ev
+            sp.event("sharded.cost", **cost_ev)
+            # the mesh-keyed shard event (tools/check_trace.py schema):
+            # where this launch's rows lived and how balanced the
+            # resident row distribution is (replicated placement holds
+            # ALL pool rows on every device — report what is resident)
+            rows_per_shard = (self.pool_rows // self.mesh_shape[0]
+                              if self.placement == "sharded"
+                              else self.pool_rows)
+            sp.event("batch.shard", site=SITE, mesh=list(self.mesh_shape),
+                     placement=self.placement,
+                     rows_per_shard=rows_per_shard,
+                     flat_rows=int(sum(plan.n_pads)),
+                     shard_balance=round(self.shard_balance, 4),
+                     per_shard_predicted_bytes=predicted[
+                         "per_shard_bytes"])
+        return self._readback(plan, outs, pooled, inject)
+
+    def _group_outputs(self, plan: _ShardedPlan, outs):
+        """Slice each op superbucket's flat heads/cards back into
+        per-bucket (bucket, heads, cards) host arrays — the padded flat
+        layout (one dead slot per query's k_pad+1 stride), like the
+        multiset pallas path."""
+        for grp, (heads_f, cards_f) in zip(plan.op_groups, outs):
+            heads_f = None if heads_f is None else np.asarray(heads_f)
+            cards_f = np.asarray(cards_f)
+            for bi, s0 in zip(grp.bucket_idx, grp.seg_offs):
+                b = plan.buckets[bi]
+                n = b.q * (b.k_pad + 1)
+                cards = cards_f[s0:s0 + n].reshape(
+                    b.q, b.k_pad + 1)[:, :b.k_pad]
+                heads = (None if heads_f is None else
+                         heads_f[s0:s0 + n].reshape(
+                             b.q, b.k_pad + 1, WORDS32)[:, :b.k_pad])
+                yield b, heads, cards
+
+    def _readback(self, plan: _ShardedPlan, outs, pooled,
+                  inject: bool) -> list:
+        with obs_slo.phase("readback"), \
+                obs_trace.span("sharded.readback", q=len(pooled),
+                               mesh=self._mesh_label):
+            results = assemble_pooled_results(
+                self._group_outputs(plan, outs), pooled, plan.rb_meta)
+        if inject and faults.should_corrupt(SITE, guard.MESH):
+            from .batch_engine import BatchResult
+
+            results[0] = BatchResult(
+                cardinality=results[0].cardinality + 1,
+                bitmap=results[0].bitmap)
+        return results
+
+    def _shadow_check(self, pooled, results, policy) -> None:
+        from ..runtime import errors
+
+        idx = guard.shadow_sample(len(pooled), policy.shadow_rate,
+                                  policy.shadow_seed, SITE)
+        for i in idx:
+            sid, q = pooled[i]
+            ref = self._engines[sid]._sequential_one(q)
+            got = results[i]
+            bad = got.cardinality != ref.cardinality
+            if not bad and q.form == "bitmap":
+                bad = got.bitmap != ref
+            if bad:
+                raise errors.ShadowMismatch(
+                    f"sharded query {i} ({q.op} over {q.operands} on set "
+                    f"{sid}) diverged from the sequential reference: got "
+                    f"cardinality {got.cardinality}, want "
+                    f"{ref.cardinality}")
+
+    # --------------------------------------------------------- conveniences
+
+    def warmup(self, rungs=(1, 2, 4, 8),
+               ops=("or", "and", "xor", "andnot"),
+               pools=None) -> dict:
+        """Pre-compile mesh programs for known pow2 operand rungs (or
+        explicit ``pools=``) — ``BatchEngine.warmup`` one level up; the
+        persistent compile cache (``ROARING_TPU_COMPILE_CACHE``) makes
+        the compiles survive restarts, so a re-booted serving process
+        replays them from disk."""
+        cache_dir = rt_warmup.enable_compile_cache()
+        t0 = time.perf_counter()
+        if pools is None:
+            pools = [[BatchGroup(sid, e._rung_queries(r, ops))
+                      for sid, e in enumerate(self._engines)]
+                     for r in rungs]
+        programs = []
+        for pool in pools:
+            groups, _ = self._normalize(pool)
+            pooled, _ = self._single._flatten(groups)
+            if not pooled:
+                continue
+            plan = self._plan(tuple(pooled))
+            self._program(plan, donate=_donation_supported())
+            programs.append({"q": len(pooled), "sets": len(plan.sids),
+                             "groups": len(plan.op_groups),
+                             "mesh": self._mesh_label})
+        return {"site": SITE, "compile_cache_dir": cache_dir,
+                "mesh": list(self.mesh_shape), "programs": programs,
+                "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+
+    def cardinalities(self, groups, engine: str = "auto"):
+        """Flat/per-group i64 cardinalities, matching the input shape."""
+        out = self.execute(groups, engine=engine)
+        if out and not isinstance(out[0], list):
+            return np.array([r.cardinality for r in out], np.int64)
+        return [np.array([r.cardinality for r in rows], np.int64)
+                for rows in out]
+
+    def cache_stats(self) -> dict:
+        """Sharded plan/program cache observability + the split counter
+        (``BatchEngine.cache_stats``'s frozen shape)."""
+        return {"plans": self._plans.stats(),
+                "programs": self._programs.stats(),
+                "splits": self.split_count}

@@ -17,6 +17,7 @@ semantics for the downstream popcount).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 
@@ -40,6 +41,83 @@ from ..obs import cost as obs_cost
 from ..ops import dense, packing
 
 WORDS32 = packing.WORDS32
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs for the mesh axes every sharded plan path
+    shares (the SNIPPETS [3] pattern: one frozen vocabulary instead of
+    hand-rolled ``P(...)`` literals scattered across call sites).
+
+    Axis semantics (docs/BATCH_ENGINE.md "Mesh-sharded execution"):
+
+    - ``row_axis`` ("rows"): the container-row / pooled-row axis — the
+      data-parallel direction.  Resident pool images shard here.
+    - ``data_axis`` ("data"): query/pool replication.  The sharded batch
+      engine spreads a launch's *transient* gathered rows over
+      ``(rows, data)`` jointly, so every device carries row work while
+      the resident pool stays replicated along data.
+    - ``lane_axis`` ("lanes"): the 2048-word lane axis — the
+      tensor-parallel direction of the wide-aggregation path.
+    """
+
+    row_axis: str = "rows"
+    data_axis: str = "data"
+    lane_axis: str = "lanes"
+
+    # ---- resident placements
+    def pooled_rows(self) -> P:
+        """Pooled resident image u32[rows, 2048]: rows data-parallel,
+        lanes local, replicated along data (parallel.sharded_engine)."""
+        return P(self.row_axis, None)
+
+    def packed_rows(self) -> P:
+        """Wide-aggregation pack u32[rows, 2048]: rows x lanes (the
+        original shard_packed placement)."""
+        return P(self.row_axis, self.lane_axis)
+
+    def row_vec(self) -> P:
+        """Per-row metadata (seg_ids, stream parts) sharded with rows."""
+        return P(self.row_axis)
+
+    # ---- per-launch transients (sharded batch engine)
+    def gather_rows(self) -> P:
+        """A launch's gathered operand block: flat rows over EVERY device
+        (rows x data jointly), lanes local."""
+        return P((self.row_axis, self.data_axis), None)
+
+    def gather_vec(self) -> P:
+        """Flat per-gather-row metadata (flat_seg, valid), sharded like
+        gather_rows."""
+        return P((self.row_axis, self.data_axis))
+
+    # ---- outputs / broadcast operands
+    def replicated(self) -> P:
+        return P()
+
+    def combined_heads(self) -> P:
+        """The sharded batch engine's head accumulator AFTER the
+        butterfly combine: every device holds the full reduction."""
+        return P(None, None)
+
+    def heads(self) -> P:
+        """Wide-aggregation per-key result: replicated rows, lanes
+        tensor-parallel."""
+        return P(None, self.lane_axis)
+
+    def index_rows(self) -> P:
+        """BSI/RangeBitmap (ebm, per-slice) tensors: key rows
+        data-parallel, lanes tensor-parallel."""
+        return P(self.row_axis, self.lane_axis)
+
+    def sliced_index(self) -> P:
+        """Stacked slice planes u32[S, K, 2048]: slice axis local."""
+        return P(None, self.row_axis, self.lane_axis)
+
+
+#: the default axis vocabulary; call sites needing renamed axes build
+#: their own SpecLayout(row_axis=..., ...) instead of hand-rolling specs
+SPECS = SpecLayout()
 
 #: Per-device dense-accumulator ceiling, in keys.  Each device materializes
 #: u32[K+1, 2048] (8 KiB/key) before the butterfly, so K is a direct HBM
@@ -133,10 +211,11 @@ def _make_sharded_aggregator(mesh: Mesh, op: str, num_keys: int, n_steps: int,
 
     # check_vma=False: after the ppermute butterfly every device holds the
     # full reduction, but JAX cannot prove ppermute outputs replicated.
+    sp = SpecLayout(row_axis=row_axis, lane_axis=lane_axis)
     mapped = shard_map(
         step, mesh=mesh,
-        in_specs=(P(row_axis, lane_axis), P(row_axis)),
-        out_specs=(P(None, lane_axis), P()),
+        in_specs=(sp.packed_rows(), sp.row_vec()),
+        out_specs=(sp.heads(), sp.replicated()),
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -169,8 +248,9 @@ def _shard_rows(mesh: Mesh, words: np.ndarray, seg_ids: np.ndarray,
         words = np.concatenate([words, np.zeros((extra, WORDS32), np.uint32)])
         seg_ids = np.concatenate(
             [seg_ids, np.full(extra, scratch_seg, np.int32)])
-    words_d = jax.device_put(words, NamedSharding(mesh, P(row_axis, lane_axis)))
-    segs_d = jax.device_put(seg_ids, NamedSharding(mesh, P(row_axis)))
+    sp = SpecLayout(row_axis=row_axis, lane_axis=lane_axis)
+    words_d = jax.device_put(words, NamedSharding(mesh, sp.packed_rows()))
+    segs_d = jax.device_put(seg_ids, NamedSharding(mesh, sp.row_vec()))
     return words_d, segs_d
 
 
@@ -272,7 +352,7 @@ def shard_streams(mesh: Mesh, blocked: packing.PackedBlockedCompact,
     total_values = int(parts[2].shape[1])
 
     mapped = _sharded_densify(mesh, row_axis, rows_per_shard, total_values)
-    sharding = NamedSharding(mesh, P(row_axis))
+    sharding = NamedSharding(mesh, SpecLayout(row_axis=row_axis).row_vec())
     dev = [jax.device_put(a, sharding) for a in parts]
     words = mapped(*dev)
     seg_ids = jax.device_put(
@@ -517,10 +597,11 @@ def make_sharded_and(mesh: Mesh,
         cards = jax.lax.psum(cards, lane_axis)
         return acc, cards
 
+    sp = SpecLayout(row_axis=row_axis, lane_axis=lane_axis)
     mapped = shard_map(
         step, mesh=mesh,
-        in_specs=(P(None, row_axis, lane_axis),),
-        out_specs=(P(None, lane_axis), P()),
+        in_specs=(sp.sliced_index(),),
+        out_specs=(sp.heads(), sp.replicated()),
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -565,7 +646,9 @@ def wide_and_sharded(mesh: Mesh, bitmaps,
     words = _pad_to_multiple(packed.words, mesh.shape[row_axis],
                              np.uint32(0xFFFFFFFF), axis=1)
     words_d = jax.device_put(
-        words, NamedSharding(mesh, P(None, row_axis, lane_axis)))
+        words, NamedSharding(
+            mesh, SpecLayout(row_axis=row_axis,
+                             lane_axis=lane_axis).sliced_index()))
     step = make_sharded_and(mesh, row_axis, lane_axis)
     acc, cards = step(words_d)
     return packed.keys, np.asarray(acc), np.asarray(cards)
@@ -590,11 +673,12 @@ def _make_sharded_bsi_compare(mesh: Mesh, op: str, row_axis: str,
         card = jnp.sum(jax.lax.population_count(res).astype(jnp.int32))
         return jax.lax.psum(card, (row_axis, lane_axis))
 
+    sp = SpecLayout(row_axis=row_axis, lane_axis=lane_axis)
     return jax.jit(shard_map(
         step, mesh=mesh,
-        in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis),
-                  P(), P()),
-        out_specs=P(),
+        in_specs=(sp.sliced_index(), sp.index_rows(),
+                  sp.replicated(), sp.replicated()),
+        out_specs=sp.replicated(),
         check_vma=False,
     ))
 
@@ -625,10 +709,11 @@ def _make_sharded_bsi_topk(mesh: Mesh, row_axis: str, lane_axis: str):
         card = jnp.sum(bsi_dev.popcount(g | e).astype(jnp.int32))
         return jax.lax.psum(card, (row_axis, lane_axis))
 
+    sp = SpecLayout(row_axis=row_axis, lane_axis=lane_axis)
     return jax.jit(shard_map(
         step_fn, mesh=mesh,
-        in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis), P()),
-        out_specs=P(),
+        in_specs=(sp.sliced_index(), sp.index_rows(), sp.replicated()),
+        out_specs=sp.replicated(),
         check_vma=False,
     ))
 
@@ -647,11 +732,12 @@ def _make_sharded_range_compare(mesh: Mesh, op: str, row_axis: str,
         card = jnp.sum(jax.lax.population_count(res).astype(jnp.int32))
         return jax.lax.psum(card, (row_axis, lane_axis))
 
+    sp = SpecLayout(row_axis=row_axis, lane_axis=lane_axis)
     return jax.jit(shard_map(
         step, mesh=mesh,
-        in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis),
-                  P(), P()),
-        out_specs=P(),
+        in_specs=(sp.sliced_index(), sp.index_rows(),
+                  sp.replicated(), sp.replicated()),
+        out_specs=sp.replicated(),
         check_vma=False,
     ))
 
@@ -672,10 +758,11 @@ def _shard_index_arrays(mesh: Mesh, ebm_np: np.ndarray,
             [slices_np,
              np.zeros((depth, kpad - k, WORDS32), np.uint32)],
             axis=1) if depth else slices_np
+    sp = SpecLayout(row_axis=row_axis, lane_axis=lane_axis)
     ebm = jax.device_put(
-        ebm_np, NamedSharding(mesh, P(row_axis, lane_axis)))
+        ebm_np, NamedSharding(mesh, sp.index_rows()))
     slices = jax.device_put(
-        slices_np, NamedSharding(mesh, P(None, row_axis, lane_axis)))
+        slices_np, NamedSharding(mesh, sp.sliced_index()))
     return ebm, slices
 
 
@@ -689,10 +776,11 @@ def _make_sharded_bsi_slice_cards(mesh: Mesh, row_axis: str, lane_axis: str):
         return (jax.lax.psum(cards, (row_axis, lane_axis)),
                 jax.lax.psum(count, (row_axis, lane_axis)))
 
+    sp = SpecLayout(row_axis=row_axis, lane_axis=lane_axis)
     return jax.jit(shard_map(
         step, mesh=mesh,
-        in_specs=(P(None, row_axis, lane_axis), P(row_axis, lane_axis)),
-        out_specs=(P(), P()),
+        in_specs=(sp.sliced_index(), sp.index_rows()),
+        out_specs=(sp.replicated(), sp.replicated()),
         check_vma=False,
     ))
 

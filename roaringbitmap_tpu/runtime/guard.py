@@ -48,6 +48,15 @@ _log = logging.getLogger("roaringbitmap_tpu.runtime")
 #: the terminal rung of every chain: the CPU sequential reference path
 SEQUENTIAL = "sequential"
 
+#: the mesh-sharded engine's fallback vocabulary (parallel.sharded_engine,
+#: docs/BATCH_ENGINE.md "Mesh-sharded execution"): a sharded dispatch
+#: demotes MESH -> SINGLE_DEVICE (the un-sharded pooled engine, which owns
+#: its own pallas->xla->xla-vmap ladder internals) -> SEQUENTIAL, each
+#: rung bit-exact — losing the mesh costs throughput, never availability
+#: or bits, the same contract as every other chain here
+MESH = "mesh"
+SINGLE_DEVICE = "single"
+
 #: sentinel a ResourceExhausted splitter returns to decline (fall through
 #: to demotion)
 NO_SPLIT = object()

@@ -1,0 +1,85 @@
+"""Cold-path controls: the persistent compilation cache (ROADMAP item 3).
+
+BENCH_r05 still shows ``first_query_ms`` ≈ 258 ms and
+``ingest_compile_ms_one_time`` ≈ 1.07 s against a ~10 µs marginal op — a
+restart pays five orders of magnitude over steady state, almost all of it
+XLA compilation.  JAX ships a persistent on-disk compilation cache that
+removes the recompile on every later process; this module wires it behind
+one environment variable so a serving deployment opts in without code::
+
+    ROARING_TPU_COMPILE_CACHE=/var/cache/rb_xla  python serve.py
+
+``enable_compile_cache()`` is called lazily by every engine constructor
+(``BatchEngine`` / ``MultiSetBatchEngine`` / ``ShardedBatchEngine``), so
+the first resident-set build already compiles through the cache.  The
+explicit ``warmup(rungs=...)`` API on those engines is the other half of
+the cold-path story: it drives the plan -> AOT-compile pipeline for the
+known pow2 query rungs ahead of the first real query, so a process boots
+hot — ``rb_compile_seconds{cache="hit"|"miss"}`` and
+``rb_first_query_seconds`` (obs.cost, PR 6) are the measurement.
+
+The knob is deliberately idempotent and racy-safe: repeated calls with an
+unchanged environment are a dict lookup; an explicit ``path=`` argument
+overrides the environment (tests point it at a tmpdir).
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_COMPILE_CACHE = "ROARING_TPU_COMPILE_CACHE"
+
+#: last applied cache dir (None = not enabled); keyed against the spec it
+#: came from so an env change between engine constructions re-applies
+_applied: tuple[str | None, str | None] = (None, None)
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` (or at
+    ``$ROARING_TPU_COMPILE_CACHE`` when ``path`` is None).  Returns the
+    resolved directory, or None when the knob is unset — in which case
+    any process-level cache configuration (e.g. bench.py's own
+    ``jax_compilation_cache_dir``) is left untouched.
+
+    The min-compile-time floor is dropped to 0 so even the small pooled
+    programs (~100 ms compiles on CPU) persist: the cold path this exists
+    to kill is exactly many small compiles, not one big one.
+    """
+    global _applied
+    spec = path if path is not None else os.environ.get(ENV_COMPILE_CACHE)
+    if not spec:
+        return None
+    if _applied[0] == spec:
+        return _applied[1]
+    import jax
+
+    resolved = os.path.abspath(os.path.expanduser(spec))
+    os.makedirs(resolved, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", resolved)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except AttributeError:  # pragma: no cover - very old jax
+        pass
+    try:
+        # cache every entry regardless of size (jax >= 0.4.16 knob)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:  # pragma: no cover
+        pass
+    try:
+        # jax initializes its cache object once, at the first compile —
+        # a dir configured after that (engines are often built after the
+        # resident set already compiled its pack programs) would be
+        # silently ignored for the rest of the process; reset forces the
+        # next compile to re-read the config
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - private-API drift
+        pass
+    _applied = (spec, resolved)
+    return resolved
+
+
+def compile_cache_dir() -> str | None:
+    """The directory the cache was last enabled with, or None."""
+    return _applied[1]

@@ -1,0 +1,471 @@
+"""Mesh-sharded batch engine acceptance (ISSUE 7).
+
+Pins:
+- sharded pooled execution bit-exact, query by query, against the
+  single-device per-set ``BatchEngine`` loop across (op x mesh shape x
+  placement) — including through the mesh -> single -> sequential guard
+  ladder under injected faults;
+- the per-shard HBM-budget property: proactive splits fire BEFORE
+  dispatch while the PER-SHARD predicted transient exceeds the budget,
+  every dispatched launch's per-shard prediction fits it, and at the
+  same budget the single-device pooled engine proactively splits >= 2x
+  more (the capacity scaling the mesh buys);
+- resident capacity: sharded placement puts exactly 1/mesh_rows of the
+  pooled row image on each row-shard (verified from the placed array's
+  addressable shards) and the HBM ledger carries the pool;
+- the S=1 ledger pin: dispatches register no new resident buffers;
+- the ``batch.shard`` mesh-keyed event / ``sharded.*`` span vocabulary
+  and the ``rb_shard_balance`` / ``rb_sharded_*`` metrics;
+- warmup + persistent compile cache (ROADMAP item 3): ``warmup()``
+  pre-compiles the programs a matching execute then cache-hits, and
+  ``ROARING_TPU_COMPILE_CACHE`` points JAX's persistent cache at the
+  requested directory.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.insights import analysis as insights
+from roaringbitmap_tpu.obs import memory as obs_memory
+from roaringbitmap_tpu.parallel import (BatchEngine, BatchGroup, BatchQuery,
+                                        MultiSetBatchEngine,
+                                        ShardedBatchEngine, SpecLayout,
+                                        default_mesh)
+from roaringbitmap_tpu.runtime import faults, guard
+from roaringbitmap_tpu.runtime import warmup as rt_warmup
+
+S_SIZES = (8, 6, 8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _mesh(rows: int, data: int = 1) -> Mesh:
+    devs = np.array(jax.devices()[:rows * data]).reshape(rows, data)
+    return Mesh(devs, ("rows", "data"))
+
+
+@pytest.fixture(scope="module")
+def tenant_bitmaps():
+    """Three tenants with different shapes (sparse uniform / dense chunk
+    / run-heavy) — the multiset acceptance fixture's recipe."""
+    rng = np.random.default_rng(0x5AAD)
+    out = []
+    for s, n in enumerate(S_SIZES):
+        bms = []
+        for i in range(n):
+            vals = [rng.integers(0, 1 << 17, 2000).astype(np.uint32)]
+            if s == 1 and i % 2 == 0:
+                vals.append(np.arange(1 << 16, (1 << 16) + 9000,
+                                      dtype=np.uint32))
+            if s == 2:
+                start = int(rng.integers(0, 1 << 16))
+                vals.append(np.arange(start, start + 1500,
+                                      dtype=np.uint32))
+            bms.append(RoaringBitmap.from_values(
+                np.unique(np.concatenate(vals))))
+        out.append(bms)
+    return out
+
+
+@pytest.fixture(scope="module")
+def engines(tenant_bitmaps):
+    return [BatchEngine.from_bitmaps(t, layout="dense")
+            for t in tenant_bitmaps]
+
+
+@pytest.fixture(scope="module")
+def pool():
+    """Every op on every tenant, materialized bitmaps — the (op x set)
+    coverage matrix as one pool."""
+    groups = []
+    for sid, n in enumerate(S_SIZES):
+        groups.append(BatchGroup(sid, [
+            BatchQuery("or", (0, 1, 2), form="bitmap"),
+            BatchQuery("and", (1, 2, 3), form="bitmap"),
+            BatchQuery("xor", (0, 2, 4), form="bitmap"),
+            BatchQuery("andnot", (0, 1, 3), form="bitmap"),
+            BatchQuery("or", tuple(range(n)), form="bitmap"),
+        ]))
+    return groups
+
+
+@pytest.fixture(scope="module")
+def oracle(engines, pool):
+    """The single-device per-set BatchEngine loop every mesh shape must
+    match bit-exactly."""
+    return [engines[g.set_id].execute(list(g.queries), engine="xla")
+            for g in pool]
+
+
+def _assert_bit_exact(got, want, tag):
+    for gi, (grows, wrows) in enumerate(zip(got, want)):
+        assert len(grows) == len(wrows)
+        for qi, (a, b) in enumerate(zip(grows, wrows)):
+            assert a.cardinality == b.cardinality, (tag, gi, qi)
+            if b.bitmap is not None:
+                assert a.bitmap == b.bitmap, (tag, gi, qi)
+
+
+@pytest.mark.parametrize("shape,placement", [
+    ((1, 1), "sharded"),
+    ((2, 1), "sharded"),
+    ((4, 1), "sharded"),
+    ((8, 1), "sharded"),
+    ((2, 2), "sharded"),
+    ((4, 1), "replicated"),
+])
+def test_sharded_matches_single_device(engines, pool, oracle, shape,
+                                       placement):
+    """The (op x mesh shape x placement) parity matrix: pooled launches
+    over the mesh bit-exact against the single-device per-set loop."""
+    eng = ShardedBatchEngine(engines, mesh=_mesh(*shape),
+                             placement=placement)
+    got = eng.execute(pool)
+    _assert_bit_exact(got, oracle, (shape, placement))
+    # raw mesh rung too (no guard, no injection)
+    got = eng.execute(pool, fallback=False)
+    _assert_bit_exact(got, oracle, (shape, placement, "raw"))
+
+
+def test_single_set_query_sugar(engines):
+    """A bare BatchQuery list runs as a one-tenant pool and returns a
+    flat list, bit-exact vs that set's BatchEngine."""
+    eng = ShardedBatchEngine(engines[0], mesh=_mesh(4))
+    qs = [BatchQuery("or", (0, 1, 2), form="bitmap"),
+          BatchQuery("andnot", (0, 3, 4)),
+          BatchQuery("and", (1, 2)),
+          BatchQuery("xor", (0, 5), form="bitmap")]
+    got = eng.execute(qs)
+    want = engines[0].execute(qs, engine="xla")
+    assert [r.cardinality for r in got] == [r.cardinality for r in want]
+    assert got[0].bitmap == want[0].bitmap
+    assert got[3].bitmap == want[3].bitmap
+
+
+def test_mesh_demotes_to_single_device_then_sequential(engines, pool,
+                                                       oracle):
+    """The mesh -> single -> sequential ladder under ROARING_TPU_FAULTS:
+    a dead mesh rung lands on the un-sharded pooled engine, a dead
+    everything lands on the host sequential reference — bit-exact each
+    way, demotions counted."""
+    eng = ShardedBatchEngine(engines, mesh=_mesh(4))
+    with faults.inject("lowering@mesh=1.0:0xD1"):
+        got = eng.execute(pool)
+    _assert_bit_exact(got, oracle, "mesh->single")
+    stats = guard.dispatch_stats("sharded_engine")
+    assert stats["demotions"] >= 1 and stats["sequential"] == 0
+    guard.reset_dispatch_stats()
+    with faults.inject("lowering=1.0:0xD2"):   # every device rung dead
+        got = eng.execute(pool)
+    _assert_bit_exact(got, oracle, "sequential-floor")
+    assert guard.dispatch_stats("sharded_engine")["sequential"] >= 1
+    # oom injection: reactive pool halving stays bit-exact
+    with faults.inject("oom@mesh=0.5:0xD3"):
+        got = eng.execute(pool)
+    _assert_bit_exact(got, oracle, "oom")
+
+
+def test_per_shard_budget_split_property(engines, pool, oracle, tmp_path):
+    """The per-shard proactive split: splits fire BEFORE dispatch, every
+    dispatched launch's PER-SHARD prediction fits the budget (from the
+    sharded.memory trace events), results stay bit-exact, counted under
+    rb_sharded_*."""
+    eng = ShardedBatchEngine(engines, mesh=_mesh(4))
+    full = eng.predict_dispatch_bytes(pool)
+    assert full["per_shard_bytes"] > 0
+    budget = max(1, full["per_shard_bytes"] // 2)
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    policy = guard.GuardPolicy(hbm_budget=budget)
+    got = eng.execute(pool, policy=policy)
+    obs.disable()
+    _assert_bit_exact(got, oracle, "budget")
+    assert eng.proactive_split_count > 0
+
+    spans = [json.loads(line) for line in open(path)]
+    mems = [ev for s in spans if s["name"] == "sharded.dispatch"
+            for ev in s["events"] if ev["name"] == "sharded.memory"]
+    assert mems and all(ev["per_shard_predicted_bytes"] <= budget
+                        for ev in mems)
+    splits = [ev for s in spans for ev in s["events"]
+              if ev["name"] == "proactive_split"
+              and ev.get("site") == "sharded_engine"]
+    assert len(splits) == eng.proactive_split_count
+    assert all(ev["predicted_bytes"] > ev["budget_bytes"]
+               for ev in splits)
+    snap = obs.snapshot()
+    pro = snap["counters"]["rb_sharded_proactive_splits_total"]
+    assert pro[0]["value"] == eng.proactive_split_count
+
+
+def test_sharded_splits_at_least_2x_less_than_single_device(engines,
+                                                            pool):
+    """The capacity acceptance: at the SAME per-device HBM budget, the
+    4-row mesh executes a pool the single-device engine must proactively
+    split >= 2x more — per-shard transients are ~1/4 of the pooled
+    total, so the mesh admits what one chip cannot."""
+    sh = ShardedBatchEngine(engines, mesh=_mesh(4))
+    single = MultiSetBatchEngine(engines)
+    budget = max(1, sh.predict_dispatch_bytes(pool)["per_shard_bytes"]
+                 // 2)
+    policy = guard.GuardPolicy(hbm_budget=budget)
+    got_sh = sh.execute(pool, policy=policy)
+    got_single = single.execute(pool, engine="xla", policy=policy)
+    _assert_bit_exact(got_sh, got_single, "split-parity")
+    assert sh.proactive_split_count >= 1
+    assert single.proactive_split_count >= 2 * sh.proactive_split_count, (
+        single.proactive_split_count, sh.proactive_split_count)
+
+
+def test_resident_capacity_per_shard(engines):
+    """Sharded placement puts exactly 1/mesh_rows of the (padded) pooled
+    row image on each row-shard; replicated placement a full copy per
+    device.  The HBM ledger carries the pool either way."""
+    before = obs_memory.LEDGER.resident_bytes("sharded_pool")
+    eng = ShardedBatchEngine(engines, mesh=_mesh(4),
+                             placement="sharded")
+    per_shard_rows = eng.pool_rows // 4
+    for shard in eng.pool_words.addressable_shards:
+        assert shard.data.shape == (per_shard_rows, 2048)
+    assert eng.hbm_bytes() == eng.pool_rows * insights.ROW_BYTES
+    assert (obs_memory.LEDGER.resident_bytes("sharded_pool") - before
+            == eng.hbm_bytes())
+    repl = ShardedBatchEngine(engines, mesh=_mesh(2),
+                              placement="replicated")
+    for shard in repl.pool_words.addressable_shards:
+        assert shard.data.shape == (repl.pool_rows, 2048)
+    assert repl.hbm_bytes() == repl.pool_rows * insights.ROW_BYTES * 2
+    assert repl.shard_balance == 1.0
+    # sharded placement on a data>1 mesh: each row-shard replicates
+    # along the data axis, so the mesh holds data_size copies and the
+    # ledger/hbm_bytes must count them
+    sq = ShardedBatchEngine(engines, mesh=_mesh(2, 2),
+                            placement="sharded")
+    for shard in sq.pool_words.addressable_shards:
+        assert shard.data.shape == (sq.pool_rows // 2, 2048)
+    assert sq.hbm_bytes() == sq.pool_rows * insights.ROW_BYTES * 2
+    assert (obs_memory.LEDGER.resident_bytes("sharded_pool") - before
+            == eng.hbm_bytes() + repl.hbm_bytes() + sq.hbm_bytes())
+
+
+def test_dispatch_registers_no_new_resident_buffers(engines):
+    """The S=1 ledger pin: the pooled image registers once at build;
+    executing (twice — plan/program cache warm and cold) moves nothing
+    on the HBM ledger."""
+    eng = ShardedBatchEngine(engines[0], mesh=_mesh(2))
+    qs = [BatchQuery("or", (0, 1, 2)), BatchQuery("xor", (1, 3))]
+    ledger_before = obs_memory.LEDGER.snapshot()
+    eng.execute(qs)
+    n_programs = len(eng._programs)
+    eng.execute(qs)
+    assert obs_memory.LEDGER.snapshot() == ledger_before
+    assert len(eng._programs) == n_programs    # cache hit, no recompile
+
+
+def test_batch_shard_event_and_mesh_metrics(engines, pool, tmp_path):
+    """The mesh-keyed observability contract: sharded.* span vocabulary,
+    a batch.shard event on every dispatch naming the mesh shape and the
+    shard balance, per-shard memory accounting, mesh-labelled gauges."""
+    eng = ShardedBatchEngine(engines, mesh=_mesh(2, 2))
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    eng.execute(pool)
+    obs.disable()
+    spans = [json.loads(line) for line in open(path)]
+    names = {s["name"] for s in spans}
+    assert {"sharded.execute", "sharded.plan", "sharded.pool",
+            "sharded.dispatch", "sharded.readback"} <= names
+    dispatches = [s for s in spans if s["name"] == "sharded.dispatch"]
+    assert dispatches
+    for s in dispatches:
+        shard_evs = [ev for ev in s["events"]
+                     if ev["name"] == "batch.shard"]
+        assert shard_evs, "sharded.dispatch without a batch.shard event"
+        ev = shard_evs[0]
+        assert ev["mesh"] == [2, 2]
+        assert ev["rows_per_shard"] > 0
+        assert ev["shard_balance"] >= 1.0
+        assert ev["per_shard_predicted_bytes"] > 0
+        mems = [e for e in s["events"] if e["name"] == "sharded.memory"]
+        assert mems and mems[0]["predicted_bytes"] > 0
+        assert mems[0]["mesh"] == [2, 2]
+        costs = [e for e in s["events"] if e["name"] == "sharded.cost"]
+        assert costs and costs[0]["device_ms"] >= 0
+        assert costs[0].get("devices") == 4
+    mem_cell = obs_memory.dispatch_memory_cell(eng.last_dispatch_memory)
+    assert mem_cell["mesh"] == [2, 2]
+    assert mem_cell["per_shard_predicted_mb"] > 0
+    snap = obs.snapshot()
+    bal = snap["gauges"]["rb_shard_balance"]
+    assert any(row["labels"].get("mesh") == "2x2" and row["value"] >= 1.0
+               for row in bal)
+    launches = snap["counters"]["rb_sharded_launches_total"]
+    assert any(row["labels"].get("mesh") == "2x2" and row["value"] >= 1
+               for row in launches)
+
+
+def test_shadow_check_catches_silent_corruption(engines, pool):
+    from roaringbitmap_tpu.runtime import errors
+
+    eng = ShardedBatchEngine(engines, mesh=_mesh(2))
+    policy = guard.GuardPolicy(shadow_rate=1.0)
+    eng.execute(pool, policy=policy)          # clean full-rate shadow
+    with faults.inject("silent@sharded_engine=1.0:3"):
+        with pytest.raises(errors.ShadowMismatch):
+            eng.execute(pool, policy=policy)
+
+
+def test_validation_and_empty(engines):
+    eng = ShardedBatchEngine(engines, mesh=_mesh(2))
+    with pytest.raises(IndexError):
+        eng.execute([BatchGroup(9, [BatchQuery("or", (0, 1))])])
+    assert eng.execute([]) == []
+    assert eng.execute([BatchGroup(0, [])]) == [[]]
+    with pytest.raises(ValueError):
+        ShardedBatchEngine(engines, mesh=_mesh(2), placement="bogus")
+    with pytest.raises(ValueError):
+        # a 3-device row axis cannot run the XOR-paired butterfly
+        devs = np.array(jax.devices()[:3]).reshape(3, 1)
+        ShardedBatchEngine(engines,
+                           mesh=Mesh(devs, ("rows", "data")))
+    with pytest.raises(ValueError):
+        # missing the data axis entirely
+        devs = np.array(jax.devices()[:2]).reshape(2, 1)
+        ShardedBatchEngine(engines, mesh=Mesh(devs, ("rows", "lanes")))
+
+
+# ------------------------------------------------ warmup + compile cache
+
+def test_warmup_precompiles_and_execute_cache_hits(engines):
+    """warmup(rungs) compiles the programs a matching execute then
+    cache-hits: no new program entries, and the plan cache serves the
+    exact warmed pool."""
+    eng = ShardedBatchEngine(engines, mesh=_mesh(2))
+    rep = eng.warmup(rungs=(2, 4))
+    assert rep["programs"] and rep["mesh"] == [2, 1]
+    n_programs = len(eng._programs)
+    hits0 = eng._programs.stats()["hits"]
+    # the exact rung-2 pool warmup built
+    pool = [BatchGroup(sid, e._rung_queries(2,
+                       ("or", "and", "xor", "andnot")))
+            for sid, e in enumerate(eng._engines)]
+    eng.execute(pool)
+    assert len(eng._programs) == n_programs
+    assert eng._programs.stats()["hits"] > hits0
+
+
+def test_batch_and_multiset_warmup(engines, tenant_bitmaps):
+    """The single-set and multiset engines grew the same API: programs
+    compile at warmup, the matching execute hits the program cache."""
+    be = BatchEngine.from_bitmaps(tenant_bitmaps[0], layout="dense")
+    rep = be.warmup(rungs=(2,), ops=("or", "xor"))
+    assert rep["programs"]
+    n = len(be._programs)
+    be.execute(be._rung_queries(2, ("or", "xor")))
+    assert len(be._programs) == n
+    ms = MultiSetBatchEngine(engines)
+    rep = ms.warmup(rungs=(2,))
+    assert rep["programs"]
+    n = len(ms._programs)
+    pool = [BatchGroup(sid, e._rung_queries(2,
+                       ("or", "and", "xor", "andnot")))
+            for sid, e in enumerate(ms._engines)]
+    ms.execute(pool, engine="auto")
+    assert len(ms._programs) == n
+
+
+def test_compile_cache_env_knob(engines, tmp_path, monkeypatch):
+    """ROARING_TPU_COMPILE_CACHE points JAX's persistent compilation
+    cache at the directory (the env half of ROADMAP item 3)."""
+    cache_dir = str(tmp_path / "xla_cache")
+    monkeypatch.setattr(rt_warmup, "_applied", (None, None))
+    monkeypatch.setenv(rt_warmup.ENV_COMPILE_CACHE, cache_dir)
+    eng = ShardedBatchEngine(engines[0], mesh=_mesh(2))
+    import jax as _jax
+
+    assert _jax.config.jax_compilation_cache_dir == \
+        rt_warmup.compile_cache_dir()
+    assert rt_warmup.compile_cache_dir().endswith("xla_cache")
+    rep = eng.warmup(rungs=(2,))
+    assert rep["compile_cache_dir"] == rt_warmup.compile_cache_dir()
+    # unset -> no-op, the applied dir survives (idempotent knob)
+    monkeypatch.delenv(rt_warmup.ENV_COMPILE_CACHE)
+    assert rt_warmup.enable_compile_cache() is None
+
+
+def test_spec_layout_vocabulary():
+    """The frozen SpecLayout vocabulary the three plan paths share."""
+    sp = SpecLayout()
+    assert sp.row_axis == "rows" and sp.data_axis == "data" \
+        and sp.lane_axis == "lanes"
+    assert sp.pooled_rows() == P("rows", None)
+    assert sp.gather_rows() == P(("rows", "data"), None)
+    assert sp.gather_vec() == P(("rows", "data"))
+    assert sp.packed_rows() == P("rows", "lanes")
+    assert sp.combined_heads() == P(None, None)
+    assert sp.heads() == P(None, "lanes")
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        sp.row_axis = "x"
+
+
+def test_predict_sharded_dispatch_bytes_model():
+    sigs = [("or", 4, 8, 2, 2, False)]
+    one = insights.predict_sharded_dispatch_bytes(sigs, 100, 1, 1)
+    four = insights.predict_sharded_dispatch_bytes(sigs, 100, 4, 4)
+    # sharded parts divide by D, replicated parts do not
+    assert four["per_shard_bytes"] < one["per_shard_bytes"]
+    assert four["gather_bytes"] == one["gather_bytes"]
+    shard_part = four["gather_bytes"] + four["scratch_bytes"]
+    repl_part = four["heads_bytes"] + four["output_bytes"]
+    assert four["per_shard_bytes"] == -(-shard_part // 4) + repl_part
+    assert four["peak_bytes"] == shard_part + 4 * repl_part
+    assert four["resident_per_shard_bytes"] == \
+        insights.dense_rows_bytes(25)
+
+
+# ---------------------------------------------------- CPU-proxy acceptance
+
+@pytest.mark.slow
+def test_warm_boot_first_query_near_steady_state():
+    """Acceptance (ROADMAP item 3 half): after warmup(rungs) a process's
+    first real execute pays no compile — within 10x of the steady-state
+    wall (it IS a plan+program cache hit)."""
+    import time
+
+    rng = np.random.default_rng(5)
+    bms = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 16, 500).astype(np.uint32))
+        for _ in range(8)]
+    eng = ShardedBatchEngine(BatchEngine.from_bitmaps(bms,
+                                                      layout="dense"),
+                             mesh=_mesh(2))
+    qs = eng._engines[0]._rung_queries(4, ("or", "and", "xor", "andnot"))
+    eng.warmup(pools=[[BatchGroup(0, qs)]])
+    t0 = time.perf_counter()
+    eng.execute([BatchGroup(0, qs)])
+    first = time.perf_counter() - t0
+    steady = min(_timed(lambda: eng.execute([BatchGroup(0, qs)]))
+                 for _ in range(5))
+    assert first <= 10 * steady + 0.05, (first, steady)
+
+
+def _timed(fn):
+    import time
+
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0

@@ -50,9 +50,17 @@ import sys
 #: ratio and pipeline-overlap paths: ``*_qps`` matches via ``qps``,
 #: ``pooled_vs_per_set_x`` via ``pooled_vs``, ``overlap_ratio`` and
 #: ``launches_saved`` explicitly.
+#: The sharded lane (bench.py sharded_phase, ISSUE 7) adds
+#: ``sharded.m{R}x1_q{Q}.pooled_qps`` (via ``qps``),
+#: ``sharded_vs_single_x`` (the mesh-vs-single-device throughput ratio,
+#: explicit), ``shard_balance`` (max/mean per-shard resident rows — 1.0
+#: is perfect, so lower is better) and ``warm_restart_x`` (warm
+#: first-query over steady marginal — the cold-path ratio ROADMAP item 3
+#: drives down).
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
-          "overlap_ratio", "launches_saved", "pooled_vs")
-LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes")
+          "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs")
+LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
+         "shard_balance", "warm_restart")
 #: checked before HIGHER/LOWER: lanes whose good direction is genuinely
 #: ambiguous.  host_overlapped_ms scales with total host time in BOTH
 #: directions (more overlap at fixed host_ms is good, but so is less

@@ -37,6 +37,17 @@ shape bench.py's summary established).  ``--fail`` exits 1 when any
 gated lane regressed.  The CI observability lane runs this over the
 committed BENCH_r01..r05 files, so the next round's regression is
 caught by the suite, not a reviewer.
+
+``--smoke-sharded`` (ISSUE 7) prepends a mesh-sharded engine smoke to
+the trajectory run: a pooled workload executed on 4x1 and 2x2 dry-run
+meshes must match the single-device pooled engine bit-exactly
+(cardinalities AND materialized bitmaps).  It needs >= 4 devices — the
+CI observability lane forces an 8-device CPU host platform for the
+whole step, which also puts check_trace / check_obs_overhead on the
+same virtual mesh the test suite runs on.  The sharded bench lanes the
+smoke guards (``sharded.m{R}x1_q{Q}.pooled_qps``, ``shard_balance``,
+``warm_restart_x``) feed the sentry's direction table through
+bench_diff's lane vocabulary.
 """
 
 from __future__ import annotations
@@ -220,6 +231,56 @@ def markdown_table(series: dict, round_names: list, analysis: dict,
     return "\n".join([header, sep, *rows]) + note
 
 
+def sharded_smoke() -> int:
+    """Mesh-sharded engine parity smoke (see module docstring): pooled
+    execution on 4x1 and 2x2 meshes bit-exact vs the single-device
+    pooled engine.  Returns 0 on parity, 1 on divergence, 2 when the
+    environment cannot host a 4-device mesh."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.parallel import (BatchEngine, BatchGroup,
+                                            BatchQuery,
+                                            MultiSetBatchEngine,
+                                            ShardedBatchEngine)
+
+    if len(jax.devices()) < 4:
+        print("bench_sentry: --smoke-sharded needs >= 4 devices (set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+              file=sys.stderr)
+        return 2
+    rng = np.random.default_rng(0x57A8)
+    tenants = [[RoaringBitmap.from_values(
+        np.unique(rng.integers(0, 1 << 17, 1500).astype(np.uint32)))
+        for _ in range(6)] for _ in range(3)]
+    engines = [BatchEngine.from_bitmaps(t, layout="dense")
+               for t in tenants]
+    pool = [BatchGroup(sid, [
+        BatchQuery("or", (0, 1, 2), form="bitmap"),
+        BatchQuery("and", (1, 2, 3), form="bitmap"),
+        BatchQuery("xor", (0, 2, 4), form="bitmap"),
+        BatchQuery("andnot", (0, 1, 3), form="bitmap"),
+    ]) for sid in range(3)]
+    want = MultiSetBatchEngine(engines).execute(pool, engine="xla")
+    shapes, mismatches = [], 0
+    for rows, data in ((4, 1), (2, 2)):
+        mesh = Mesh(np.array(jax.devices()[:rows * data]).reshape(
+            rows, data), ("rows", "data"))
+        got = ShardedBatchEngine(engines, mesh=mesh).execute(pool)
+        ok = all(a.cardinality == b.cardinality and a.bitmap == b.bitmap
+                 for grows, wrows in zip(got, want)
+                 for a, b in zip(grows, wrows))
+        shapes.append({"mesh": [rows, data], "ok": ok})
+        mismatches += not ok
+    print(json.dumps({"smoke_sharded": shapes,
+                      "ok": mismatches == 0}))
+    return 1 if mismatches else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -245,7 +306,15 @@ def main() -> int:
                          "this substring")
     ap.add_argument("--top", type=int, default=40,
                     help="max table rows (flagged lanes always shown)")
+    ap.add_argument("--smoke-sharded", action="store_true",
+                    help="first run the mesh-sharded parity smoke "
+                         "(needs >= 4 devices; exit 1 on divergence)")
     args = ap.parse_args()
+
+    if args.smoke_sharded:
+        rc = sharded_smoke()
+        if rc:
+            return rc
 
     paths = args.files or sorted(glob.glob(os.path.join(
         os.path.dirname(_HERE), "BENCH_r[0-9]*.json")))

@@ -42,6 +42,16 @@ and a wide aggregation — then (2) validates every emitted line:
   ``ROARING_TPU_SLO_MS`` produced an ``slo`` event whose ``phases_ms``
   breakdown sums to within 5% of its ``wall_ms``.  On arbitrary dumps
   these event schemas are validated wherever the events appear.
+- mesh-sharded semantics (ISSUE 7): the --workload run drives a 2x2
+  dry-run mesh dispatch (the workload forces an 8-device CPU host
+  platform for exactly this) — the ``sharded.*`` span vocabulary must
+  appear, every ``sharded.dispatch`` must carry a ``batch.shard`` event
+  naming the mesh shape (``mesh=[2,2]``, ``rows_per_shard``,
+  ``shard_balance >= 1``) plus ``sharded.memory`` / ``sharded.cost``
+  twins with per-shard predicted bytes.  On arbitrary dumps the
+  ``batch.shard`` / ``sharded.memory`` event schemas are validated
+  wherever they appear (presence is a --workload-only demand, the PR 5
+  convention).
 
 Validation-only mode (``python tools/check_trace.py <path>``) checks an
 existing dump, e.g. one captured from a serving process.
@@ -132,6 +142,7 @@ def validate(path: str, workload_semantics: bool = False,
         # span presence are only demanded of the --workload run
         errors += _multiset_semantics([s for _, s in spans])
         errors += _cost_slo_semantics([s for _, s in spans])
+        errors += _sharded_semantics([s for _, s in spans])
     return errors
 
 
@@ -206,6 +217,8 @@ def _workload_semantics(spans: list[dict],
                                   complete=True)
     errors += _cost_slo_semantics(spans, complete=True,
                                   require_miss=budget_semantics)
+    errors += _sharded_semantics(spans, require=budget_semantics,
+                                 complete=True)
     return errors
 
 
@@ -254,6 +267,67 @@ def _multiset_semantics(spans: list[dict],
     if budget_semantics:
         errors += _require_proactive_split(
             spans, "multiset", "the forced POOL split workload case")
+    return errors
+
+
+def _sharded_semantics(spans: list[dict], require: bool = False,
+                       complete: bool = False) -> list[str]:
+    """The mesh-sharded engine's span/event vocabulary
+    (parallel.sharded_engine, docs/BATCH_ENGINE.md "Mesh-sharded
+    execution").  Arbitrary dumps validate the ``batch.shard`` /
+    ``sharded.memory`` event SCHEMAS wherever they appear; ``complete``
+    additionally demands a shard event on every ``sharded.dispatch``
+    present; ``require`` (only the full --workload run, which drives a
+    2x2 dry-run mesh) demands the span vocabulary and the 2x2 mesh
+    shape — matching the multiset presence convention, so batch-only
+    dumps validated with workload semantics stay valid."""
+    errors: list[str] = []
+    dispatches = [s for s in spans if s.get("name") == "sharded.dispatch"]
+    shard_evs = [ev for s in spans for ev in s.get("events", [])
+                 if ev.get("name") == "batch.shard"]
+    for ev in shard_evs:
+        mesh = ev.get("mesh")
+        if not (isinstance(mesh, list) and mesh
+                and all(isinstance(m, int) and m >= 1 for m in mesh)):
+            errors.append(f"batch.shard event without a mesh shape "
+                          f"list: {ev!r}")
+        rps = ev.get("rows_per_shard")
+        if not isinstance(rps, (int, float)) or rps <= 0:
+            errors.append(f"batch.shard event without positive "
+                          f"rows_per_shard: {ev!r}")
+        bal = ev.get("shard_balance")
+        if not isinstance(bal, (int, float)) or bal < 1.0:
+            errors.append(f"batch.shard shard_balance not >= 1: {ev!r}")
+        psb = ev.get("per_shard_predicted_bytes")
+        if psb is not None and (not isinstance(psb, (int, float))
+                                or psb <= 0):
+            errors.append(f"batch.shard per_shard_predicted_bytes not "
+                          f"positive: {ev!r}")
+    mems = [ev for s in dispatches for ev in s.get("events", [])
+            if ev.get("name") == "sharded.memory"]
+    for ev in mems:
+        p = ev.get("predicted_bytes")
+        if not isinstance(p, (int, float)) or p <= 0:
+            errors.append(f"sharded.memory event with non-positive "
+                          f"predicted_bytes: {ev!r}")
+    if require:
+        for required in ("sharded.execute", "sharded.plan",
+                         "sharded.dispatch", "sharded.readback"):
+            if not any(s.get("name") == required for s in spans):
+                errors.append(f"no {required} span — the mesh-sharded "
+                              "path was not traced")
+        if not any(ev.get("mesh") == [2, 2] for ev in shard_evs):
+            errors.append("no batch.shard event from the 2x2 dry-run "
+                          f"mesh dispatch (saw meshes: "
+                          f"{[ev.get('mesh') for ev in shard_evs]!r})")
+    if complete:
+        for s in dispatches:
+            names = {ev.get("name") for ev in s.get("events", [])}
+            for needed in ("batch.shard", "sharded.memory",
+                           "sharded.cost"):
+                if needed not in names:
+                    errors.append(
+                        f"sharded.dispatch span lacks a {needed} event")
     return errors
 
 
@@ -330,10 +404,34 @@ def _cost_slo_semantics(spans: list[dict], complete: bool = False,
 def run_workload(path: str) -> None:
     """Small batch workload with the tracer on via the env knob (the
     activation path production uses), including one fault-injected
-    demotion so the trace carries a demotion chain."""
+    demotion so the trace carries a demotion chain.
+
+    The workload is a CPU-proxy validation harness: it forces an
+    8-device CPU host platform BEFORE the first jax import (the
+    ``dryrun_multichip`` pattern — REPLACE, never append) so the
+    mesh-sharded section can drive a real 2x2 mesh dispatch on any
+    machine."""
     if os.path.exists(path):
         os.unlink(path)
     os.environ["ROARING_TPU_TRACE"] = path
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 4:
+        raise RuntimeError(
+            "check_trace --workload needs a fresh process: the "
+            f"{jax.default_backend()!r} backend was initialised before "
+            "the CPU dry-run environment could take effect")
+    from jax.sharding import Mesh
+
+    import numpy as np
 
     from roaringbitmap_tpu import obs
     from roaringbitmap_tpu.parallel import aggregation
@@ -341,6 +439,7 @@ def run_workload(path: str) -> None:
                                                          random_query_pool)
     from roaringbitmap_tpu.parallel.multiset import (MultiSetBatchEngine,
                                                      random_multiset_pool)
+    from roaringbitmap_tpu.parallel.sharded_engine import ShardedBatchEngine
     from roaringbitmap_tpu.runtime import faults
     from roaringbitmap_tpu.utils import datasets
 
@@ -397,6 +496,17 @@ def run_workload(path: str) -> None:
         assert ms_budgeted == ms_clean, "budget-split pool diverged"
         assert ms.proactive_split_count > 0, \
             "tiny budget did not force a proactive POOL split"
+
+        # mesh-sharded lane (ISSUE 7): the same tenants pooled over a
+        # 2x2 dry-run mesh — sharded.* spans + the batch.shard event the
+        # schema checks above pin, bit-exact vs the single-device pool
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("rows", "data"))
+        sharded = ShardedBatchEngine(ms._engines, mesh=mesh)
+        sh_got = [[r.cardinality for r in rows]
+                  for rows in sharded.execute(ms_pool)]
+        assert sh_got == ms_clean, "2x2 mesh dispatch diverged from the "\
+            "single-device pool"
     finally:
         obs.disable()
 

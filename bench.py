@@ -81,6 +81,8 @@ MULTISET_S = (1, 4, 16)         # tenant counts of the multiset lane (ISSUE 5)
 MULTISET_Q = (8, 64)            # pooled query counts per cell
 SHARDED_MESH_ROWS = (1, 2, 4, 8)  # sharded lane mesh row-axis sweep (ISSUE 7)
 SHARDED_Q = (8, 64)               # pooled query counts per sharded cell
+EXPR_DEPTHS = (2, 3)            # expression lane DAG depths (ISSUE 8)
+EXPR_Q = (8, 64)                # expression pool sizes per cell
 
 
 def load_cpu_baseline(dataset: str) -> tuple[float | None, dict]:
@@ -498,6 +500,56 @@ def multiset_phase() -> dict:
     return out
 
 
+def expression_phase() -> dict:
+    """Expression-DAG fusion lane (ISSUE 8): depth-{2,3} compositional
+    expression pools of Q in EXPR_Q, fused into one launch per (bucket,
+    op-group) by the expression compiler (parallel.expr) vs the
+    node-at-a-time evaluator (one device launch per DAG reduce node,
+    host combines — the only way the pre-expression engines served
+    compositional traffic).  Resident sets are small (the dispatch-floor
+    regime fusion amortizes).  Every cell asserts fused results
+    bit-equal to node-at-a-time before timing; launches_saved comes from
+    the rb_expr_launches_saved_total counter delta."""
+    from roaringbitmap_tpu import obs
+    from roaringbitmap_tpu.parallel import expr
+    from roaringbitmap_tpu.parallel.batch_engine import BatchEngine
+    from roaringbitmap_tpu.utils import datasets
+
+    bms = datasets.synthetic_bitmaps(8, seed=88, universe=1 << 16,
+                                     density=0.006)
+    eng = BatchEngine.from_bitmaps(bms, layout="dense")
+    out: dict = {"resident_bitmaps": 8}
+    for depth in EXPR_DEPTHS:
+        for q in EXPR_Q:
+            pool = expr.random_expr_pool(8, q, depth=depth,
+                                         seed=0xE0 + depth)
+            want = [r.cardinality
+                    for r in expr.execute_node_at_a_time(eng, pool)]
+            snap0 = obs.snapshot()["counters"].get(
+                "rb_expr_launches_saved_total", [])
+            saved0 = sum(r["value"] for r in snap0)
+            got = [r.cardinality for r in eng.execute(pool)]
+            assert got == want, \
+                f"fused/node-at-a-time divergence (d={depth} Q={q})"
+            snap1 = obs.snapshot()["counters"].get(
+                "rb_expr_launches_saved_total", [])
+            saved = sum(r["value"] for r in snap1) - saved0
+            t_fused = best_of(lambda: eng.execute(pool))
+            t_node = best_of(
+                lambda: expr.execute_node_at_a_time(eng, pool), reps=3)
+            out[f"d{depth}_q{q}"] = {
+                "fused_qps": round(q / t_fused, 1),
+                "node_qps": round(q / t_node, 1),
+                "fused_vs_node_x": round(t_node / t_fused, 2),
+                "launches_saved": int(saved)}
+    d_max, q_max = max(EXPR_DEPTHS), max(EXPR_Q)
+    head = out.get(f"d{d_max}_q{q_max}") or {}
+    out["headline"] = {
+        "fused_vs_node_x": head.get("fused_vs_node_x"),
+        "launches_saved": head.get("launches_saved")}
+    return out
+
+
 def _dryrun_env(n_devices: int = 8) -> dict:
     """A CPU dry-run environment for subprocess cells: forced host
     platform device count, TPU plugin never initialised (the
@@ -674,9 +726,10 @@ SUMMARY_MAX_BYTES = 2048
 #: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
 #: they are trend inputs for the sentry, not driver-gate fields, and the
 #: full doc always keeps them
-SUMMARY_DROP_ORDER = ("phase_ms", "cost", "sharded", "marginal_us_spread",
-                      "multiset", "batched_qps", "marginal_us_median",
-                      "unit", "backend", "north_star")
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "sharded", "expression",
+                      "marginal_us_spread", "multiset", "batched_qps",
+                      "marginal_us_median", "unit", "backend",
+                      "north_star")
 
 
 def summary_line(out: dict, full_path: str,
@@ -765,6 +818,17 @@ def build_summary(out: dict, full_path: str) -> dict:
         lanes["overlap_ratio"] = (ms.get("headline") or {}).get(
             "overlap_ratio")
         s["multiset"] = lanes
+    # expression lane, compact: [fused_qps, node_qps, fused_vs_node_x,
+    # launches_saved] per (depth, Q) cell
+    ex = out.get("expression") or {}
+    ex_lanes = {}
+    for key, row in ex.items():
+        if isinstance(row, dict) and "fused_qps" in row:
+            ex_lanes[key] = [row["fused_qps"], row["node_qps"],
+                             row["fused_vs_node_x"],
+                             row["launches_saved"]]
+    if ex_lanes:
+        s["expression"] = ex_lanes
     # sharded lane, compact: [pooled_qps, shard_balance] per (mesh, Q)
     # cell + the mesh-vs-single headline ratio and the warm-restart
     # cold-path ratio (full cell detail stays in the full doc)
@@ -938,6 +1002,7 @@ def main() -> None:
         batched[results[name]["dataset"]] = batched_phase(states[name])
         results[name]["batched"] = batched[results[name]["dataset"]]
     multiset = multiset_phase()
+    expression = expression_phase()
     sharded = sharded_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
@@ -991,6 +1056,7 @@ def main() -> None:
             "/tmp/rb_tpu_trace")
     out["batched_by_dataset"] = batched
     out["multiset"] = multiset
+    out["expression"] = expression
     out["sharded"] = sharded
 
     # full document to disk; stdout gets ONLY the compact summary as its

@@ -80,6 +80,21 @@ def main() -> None:
           f"{cost.get('bytes_accessed', 0) / 1e6:.1f}MB accessed, "
           f"roofline {cost.get('roofline_fraction', 'n/a')}")
 
+    # the same composition as ONE fused expression launch (parallel.expr,
+    # docs/EXPRESSIONS.md): (tag0 | tag1) & ~tag2 — no intermediates ever
+    # leave the device, and the cardinality-only form never materializes
+    from roaringbitmap_tpu.parallel import expr
+
+    e = expr.and_(expr.or_(0, 1), expr.not_(2))
+    card = eng._ds.evaluate(e)          # counts-only short circuit
+    rep = eng.explain([expr.ExprQuery(e)])
+    [erow] = rep["exprs"]
+    print(f"fused expression (A|B) & ~C: cardinality={card:,} "
+          f"nodes={erow['nodes']} depth={erow['depth']} "
+          f"predicted={erow['predicted_bytes'] / 1e6:.2f}MB "
+          f"word_ops={erow['est_word_ops']:,}")
+    assert card == ((posts[0] | posts[1]) - posts[2]).cardinality
+
     # parity against the host tier
     host_t, host_v = RoaringBitmap(), RoaringBitmap()
     for b in posts:

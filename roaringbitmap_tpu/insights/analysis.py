@@ -295,6 +295,104 @@ def predict_batch_dispatch_word_ops(bucket_sigs: list, kind: str,
     return int(total)
 
 
+def _expr_step_rows(step) -> tuple:
+    """(kind, op_or_None, K_rows, extra_gather_copies) of one compiled
+    expression step signature (parallel.expr.ExprSection.signature)."""
+    kind = step[0]
+    if kind == "combine":
+        _, op, children, k = step
+        return kind, op, int(k), sum(1 for _, aligned in children
+                                     if not aligned)
+    if kind == "reduce":
+        return kind, None, int(step[3]), 0
+    return kind, None, int(step[1]), 0
+
+
+def predict_expr_dispatch_bytes(expr_sigs, engine: str) -> dict:
+    """Transient device bytes the fused expression sections of a plan
+    add to ONE dispatch — the DAG extension of
+    :func:`predict_batch_dispatch_bytes` (whose bucket model already
+    covers every reduce node's segmented-reduce cost).  Per fused
+    section:
+
+    - each resident-leaf gather and ad-hoc upload materializes its K
+      container rows once;
+    - each combine node holds one K-row intermediate, plus one gathered
+      K-row copy per key-UNaligned child (the alignment gather);
+    - the root outputs i32 per-key cards always, and its K result rows
+      only for bitmap-form roots — the cardinality-only short circuit
+      is visible here as output_bytes shrinking by ``K * ROW_BYTES``.
+    """
+    leaf = combine = outputs = 0
+    for sig in expr_sigs:
+        kind, bitmap_form, steps, _root, root_k = sig
+        if kind != "fused":
+            continue
+        for step in steps:
+            skind, _op, k, copies = _expr_step_rows(step)
+            if skind in ("leaf", "adhoc"):
+                leaf += k * ROW_BYTES
+            elif skind == "combine":
+                combine += (1 + copies) * k * ROW_BYTES
+        outputs += root_k * 4
+        if bitmap_form:
+            outputs += root_k * ROW_BYTES
+    total = leaf + combine + outputs
+    return {"leaf_bytes": leaf, "combine_bytes": combine,
+            "output_bytes": outputs, "peak_bytes": total}
+
+
+def predict_expr_word_ops(expr_sigs, engine: str) -> int:
+    """Word-op count the fused sections add to one dispatch — the
+    flops-proxy twin of :func:`predict_expr_dispatch_bytes` (reduce-node
+    compute is counted by ``predict_batch_dispatch_word_ops`` through
+    the pseudo-queries' buckets).  Per combine node: one K-row sweep per
+    pairwise op plus one per unaligned-child gather/mask; plus the
+    root's popcount sweep."""
+    words = 2048
+    total = 0
+    for sig in expr_sigs:
+        kind, _bitmap_form, steps, _root, root_k = sig
+        if kind != "fused":
+            continue
+        for step in steps:
+            skind, op, k, copies = _expr_step_rows(step)
+            if skind == "combine":
+                _, _, children, _ = step
+                total += k * words * max(1, len(children) - 1)
+                total += k * words * copies
+                if op == "andnot":
+                    total += k * words
+        total += root_k * words                     # root popcount
+    return int(total)
+
+
+def expr_node_report(sig) -> list:
+    """Per-DAG-node EXPLAIN rows for one compiled section signature:
+    ``{kind, op, keys, est_bytes, est_word_ops}`` per step — the DAG
+    counterpart of the per-bucket rows in ``BatchEngine.explain``."""
+    kind, bitmap_form, steps, root, root_k = sig
+    rows = []
+    words = 2048
+    for si, step in enumerate(steps):
+        skind, op, k, copies = _expr_step_rows(step)
+        if skind in ("leaf", "adhoc"):
+            b, w = k * ROW_BYTES, 0
+        elif skind == "reduce":
+            b, w = 0, 0                  # costed in its bucket's row
+        else:
+            _, _, children, _ = step
+            b = (1 + copies) * k * ROW_BYTES
+            w = k * words * (max(1, len(children) - 1) + copies
+                             + (1 if op == "andnot" else 0))
+        if si == root:
+            b += root_k * 4 + (root_k * ROW_BYTES if bitmap_form else 0)
+            w += root_k * words
+        rows.append({"kind": skind, "op": op, "keys": k,
+                     "est_bytes": int(b), "est_word_ops": int(w)})
+    return rows
+
+
 def predict_multiset_dispatch_bytes(bucket_sigs: list, sets: list,
                                     engine: str,
                                     pool_rows: int | None = None) -> dict:

@@ -1,11 +1,14 @@
-from . import aggregation, batch_engine, multiset, sharded_engine, sharding
+from . import (aggregation, batch_engine, expr, multiset, sharded_engine,
+               sharding)
 from .aggregation import DeviceBitmapSet
 from .batch_engine import BatchEngine, BatchQuery, BatchResult
+from .expr import ExprQuery
 from .multiset import BatchGroup, MultiSetBatchEngine
 from .sharded_engine import ShardedBatchEngine, default_mesh
 from .sharding import SPECS, SpecLayout
 
-__all__ = ["aggregation", "batch_engine", "multiset", "sharded_engine",
-           "sharding", "DeviceBitmapSet", "BatchEngine", "BatchQuery",
-           "BatchResult", "BatchGroup", "MultiSetBatchEngine",
-           "ShardedBatchEngine", "default_mesh", "SPECS", "SpecLayout"]
+__all__ = ["aggregation", "batch_engine", "expr", "multiset",
+           "sharded_engine", "sharding", "DeviceBitmapSet", "BatchEngine",
+           "BatchQuery", "BatchResult", "BatchGroup", "ExprQuery",
+           "MultiSetBatchEngine", "ShardedBatchEngine", "default_mesh",
+           "SPECS", "SpecLayout"]

@@ -757,6 +757,8 @@ class DeviceBitmapSet:
             self.keys.size)
         self.seg_ids = jax.device_put(seg_rows)
         self.head_idx = jax.device_put(head_idx)
+        #: lazily-built BatchEngine backing evaluate() expression queries
+        self._expr_engine = None
         # HBM ledger: resident bytes registered now, released when this
         # set is collected (rb_hbm_resident_bytes{kind,layout} gauges)
         obs_memory.LEDGER.register("bitmap_set", layout, self.hbm_bytes(),
@@ -994,6 +996,34 @@ class DeviceBitmapSet:
         # Roaring64Bitmap), so every consumer gets the right tier
         return packing.unpack_result(self.keys, np.asarray(words),
                                      np.asarray(cards), out_cls=out_cls)
+
+    def evaluate(self, expression, form: str | None = None,
+                 engine: str = "auto"):
+        """Evaluate a compositional set-algebra expression over this
+        resident set in ONE fused device launch (parallel.expr — the
+        device analog of the reference's lazy Container ops /
+        FastAggregation horizontal chains).  ``expression`` is an
+        ``expr`` IR tree (e.g. ``expr.and_(expr.or_(0, 1),
+        expr.not_(2))``) or an ``ExprQuery``; returns the cardinality
+        (``form="cardinality"``, the no-materialize short circuit) or
+        the result bitmap (``form="bitmap"``).  The backing BatchEngine
+        is built lazily and cached, so repeated expression shapes hit
+        its plan/program caches — see docs/EXPRESSIONS.md."""
+        from . import expr as expr_mod
+        from .batch_engine import BatchEngine
+
+        import dataclasses as _dc
+
+        if getattr(self, "_expr_engine", None) is None:
+            self._expr_engine = BatchEngine(self)
+        if isinstance(expression, expr_mod.ExprQuery):
+            # an explicit form= overrides the query's own (None keeps it)
+            q = (expression if form is None
+                 else _dc.replace(expression, form=form))
+        else:
+            q = expr_mod.ExprQuery(expression, form=form or "cardinality")
+        [res] = self._expr_engine.execute([q], engine=engine)
+        return res.bitmap if q.form == "bitmap" else res.cardinality
 
     def hbm_bytes(self) -> int:
         """Resident HBM bytes — the sum of the unified footprint model's

@@ -78,11 +78,20 @@ from ..ops import dense, kernels, packing
 from ..runtime import faults, guard
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
+from . import expr as expr_mod
 from .aggregation import DeviceBitmapSet, _engine
 
 WORDS32 = packing.WORDS32
 
 _RED_OP = {"or": "or", "xor": "xor", "and": "and", "andnot": "or"}
+
+
+def query_desc(q) -> str:
+    """Human-readable query tag for error messages (flat or expression)."""
+    if isinstance(q, expr_mod.ExprQuery):
+        return (f"expr depth={expr_mod.dag_stats(q.expr)['depth']} "
+                f"form={q.form}")
+    return f"{q.op} over {q.operands}"
 
 #: engine fallback ladder, fastest first; every guarded dispatch ends at
 #: the CPU sequential reference rung appended by runtime.guard
@@ -236,12 +245,40 @@ def plan_bucket(op: str, items) -> _Bucket:
         host=host)
 
 
-def bucket_body(words, b_sig, arrays, eng: str):
+class BatchPlan(list):
+    """A bucketed batch plan (list of :class:`_Bucket`, the shape every
+    pre-expression consumer iterates) extended with the expression-DAG
+    sections (parallel.expr).  ``owner`` maps expanded slot ids (the
+    qids recorded in buckets) back to original query indices — identity
+    for flat-only batches, and None-skipping for the internal pseudo
+    reduce nodes fused expressions plant in the buckets."""
+
+    def __init__(self, buckets=(), exprs=(), owner=None, n_queries=0):
+        super().__init__(buckets)
+        self.exprs = list(exprs)
+        self.owner = owner if owner is not None else {}
+        self.n_queries = n_queries
+
+    @property
+    def fused(self) -> list:
+        return expr_mod.fused_of(self.exprs)
+
+    @property
+    def expr_signature(self) -> tuple:
+        return expr_mod.signature_of(self.exprs)
+
+
+def bucket_body(words, b_sig, arrays, eng: str, force_heads: bool = False):
     """Traced body for one bucket: gather -> flat segmented reduce ->
     per-op post pass.  Returns (heads or None, cards).  ``words`` is the
     row image the gather indexes — a single resident set's image for
-    BatchEngine, the pooled concatenation for MultiSetBatchEngine."""
+    BatchEngine, the pooled concatenation for MultiSetBatchEngine.
+    ``force_heads`` makes the body return heads regardless of the
+    bucket's own needs_words — the expression compiler's in-program
+    consumption (the caller still gates program OUTPUTS on the
+    original flag)."""
     op, qn, r_pad, k_pad, n_steps, needs_words = b_sig
+    needs_words = needs_words or force_heads
     red = _RED_OP[op]
     g = words[arrays["gather"].reshape(-1)]
     ident = jnp.uint32(0xFFFFFFFF if op == "and" else 0)
@@ -353,6 +390,15 @@ class BatchEngine:
         return (rows, seg_local.astype(np.int32), self.keys[uniq],
                 key_keep, None)
 
+    def _plan_leaf(self, index: int):
+        """(gather_rows, keys) of ONE resident bitmap — the expression
+        compiler's leaf planner (rows in this set's image space)."""
+        if index < 0 or index >= self.n:
+            raise IndexError(
+                f"expression ref out of range 0..{self.n - 1}: {index}")
+        rows = np.flatnonzero(self._row_src == index)
+        return rows, self.keys[self._row_seg[rows]]
+
     def _plan_bucket(self, op: str, items) -> _Bucket:
         """items: [(qid, query, gather, seg_local, keys_q, key_keep,
         head_rows)] sharing (op, operand-count rung) — the module-level
@@ -365,15 +411,21 @@ class BatchEngine:
         b.host = None
         return b
 
-    def plan(self, queries) -> list:
+    def plan(self, queries) -> BatchPlan:
         """Bucketed plan: group by (op, pow2 operand count), pad shapes.
 
-        Plans are cached by the exact query tuple (BatchQuery is frozen/
-        hashable) — the prepared-statement pattern: a serving loop reissuing
-        the same batch shape pays the NumPy planning and array upload once.
-        Both this cache and the program cache are bounded LRUs
-        (runtime.cache.LRUCache) so adversarial query shapes cannot grow a
-        long-lived server without limit; see ``cache_stats``.
+        Plans are cached by the exact query tuple (BatchQuery and
+        ExprQuery are frozen/hashable) — the prepared-statement pattern:
+        a serving loop reissuing the same batch shape pays the NumPy
+        planning and array upload once.  Both this cache and the program
+        cache are bounded LRUs (runtime.cache.LRUCache) so adversarial
+        query shapes cannot grow a long-lived server without limit; see
+        ``cache_stats``.
+
+        Expression queries (parallel.expr.ExprQuery) expand here: each
+        canonical DAG's all-leaf reduce nodes become pseudo flat queries
+        riding the SAME bucketing below, and the combine steps compile
+        into per-query sections the program fuses after the reduces.
         """
         key = tuple(queries)
         cached = self._plans.get(key)
@@ -382,15 +434,41 @@ class BatchEngine:
         with obs_slo.phase("plan"), \
                 obs_trace.span("batch.plan", q=len(queries)) as sp:
             groups: dict = {}
+            owner: dict = {}
+            sections: list = []
+            counter = [0]
+
+            def add_item(pq: BatchQuery, own):
+                pid = counter[0]
+                counter[0] += 1
+                rows, segs, keys_q, keep, hrows = self._plan_query(pq)
+                rung = packing.next_pow2(max(1, len(set(pq.operands))))
+                groups.setdefault((pq.op, rung), []).append(
+                    (pid, pq, rows, segs, keys_q, keep, hrows))
+                if own is not None:
+                    owner[pid] = own
+                return pid, keys_q
+
             for qid, q in enumerate(queries):
-                rows, segs, keys_q, keep, hrows = self._plan_query(q)
-                rung = packing.next_pow2(max(1, len(set(q.operands))))
-                groups.setdefault((q.op, rung), []).append(
-                    (qid, q, rows, segs, keys_q, keep, hrows))
+                if isinstance(q, expr_mod.ExprQuery):
+                    sections.append(expr_mod.compile_query(
+                        q, qid, add_item, self._plan_leaf))
+                else:
+                    add_item(q, qid)
             with obs_trace.span("batch.bucket", groups=len(groups)):
-                plan = [self._plan_bucket(op, items)
-                        for (op, _), items in sorted(groups.items())]
-            sp.tag(buckets=len(plan))
+                buckets = [self._plan_bucket(op, items)
+                           for (op, _), items in sorted(groups.items())]
+            expr_mod.finalize_sections(sections, buckets)
+            for sec in sections:
+                if sec.kind == "fused":
+                    # single-set plans dispatch sync from the cache, so
+                    # the section uploads here and drops its host twin —
+                    # the _plan_bucket discipline
+                    sec.device_arrays()
+                    sec.host = None
+            plan = BatchPlan(buckets, exprs=sections, owner=owner,
+                             n_queries=len(queries))
+            sp.tag(buckets=len(plan), exprs=len(sections))
         self._plans.put(key, plan)
         return plan
 
@@ -435,7 +513,8 @@ class BatchEngine:
         signature, and any later jit dispatch of the same signature would
         have paid it anyway."""
         src, kind = self._resident_src()
-        sig = (eng, kind, tuple(b.signature for b in plan))
+        sig = (eng, kind, tuple(b.signature for b in plan),
+               plan.expr_signature)
         t_get = time.perf_counter()
         cached = self._programs.get(sig)
         if cached is not None:
@@ -443,22 +522,44 @@ class BatchEngine:
                                      time.perf_counter() - t_get)
             return cached
         b_sigs = [b.signature for b in plan]
+        fused = plan.fused
+        expr_bis = expr_mod.expr_bucket_ids(fused)
 
         with obs_slo.phase("program_build"), \
                 obs_trace.span("batch.program_build", engine=eng, kind=kind,
-                               buckets=len(plan)) as sp:
-            def run(src_in, barrays):
+                               buckets=len(plan), exprs=len(fused)) as sp:
+            def run(src_in, arrays):
                 words = self._words_from_src(src_in, kind, eng)
-                return [self._bucket_body(words, s, a, eng)
-                        for s, a in zip(b_sigs, barrays)]
+                barrays = arrays[:len(b_sigs)]
+                outs, heads_by_bi = [], [None] * len(b_sigs)
+                for bi, (s, a) in enumerate(zip(b_sigs, barrays)):
+                    # expr-feeding buckets compute heads IN-PROGRAM for
+                    # the combine steps; program outputs still follow
+                    # the bucket's own needs_words (internal reduce
+                    # heads are never read back — the fusion contract)
+                    heads, cards = bucket_body(
+                        words, s, a, eng, force_heads=bi in expr_bis)
+                    heads_by_bi[bi] = heads
+                    outs.append((heads if s[5] else None, cards))
+                if not fused:
+                    return outs
+                expr_outs = expr_mod.eval_sections(
+                    fused, arrays[len(b_sigs):], words, heads_by_bi)
+                return outs, expr_outs
 
             t0 = time.perf_counter()
             compiled = jax.jit(run).lower(
-                src, [b.device_arrays() for b in plan]).compile()
+                src, self._launch_arrays(plan)).compile()
             compile_s = time.perf_counter() - t0
             obs_cost.observe_compile("batch_engine", "miss", compile_s)
             predicted = insights.predict_batch_dispatch_bytes(
                 b_sigs, kind, self._ds._n_rows, eng)
+            if plan.exprs:
+                e_pred = insights.predict_expr_dispatch_bytes(
+                    plan.expr_signature, eng)
+                predicted = dict(predicted)
+                predicted["expr_bytes"] = e_pred["peak_bytes"]
+                predicted["peak_bytes"] += e_pred["peak_bytes"]
             measured = obs_memory.compiled_memory(compiled)
             cost = obs_cost.compiled_cost(compiled)
             sp.tag(predicted_bytes=predicted["peak_bytes"],
@@ -469,6 +570,14 @@ class BatchEngine:
             cached = (run, compiled, predicted, measured, cost)
         self._programs.put(sig, cached)
         return cached
+
+    def _launch_arrays(self, plan) -> list:
+        """The program's flat operand list: per-bucket arrays followed
+        by the fused expression sections' arrays (split inside the run
+        fn by the static bucket count)."""
+        arrays = [b.device_arrays() for b in plan]
+        arrays.extend(s.device_arrays() for s in plan.fused)
+        return arrays
 
     def _bucket_engine(self, plan, engine: str) -> str:
         eng = _engine(engine)
@@ -610,6 +719,12 @@ class BatchEngine:
         obs_slo.note_engine(eng)
         if inject:
             faults.maybe_fail("batch_engine", eng)
+        if not plan and not plan.fused:
+            # every query pruned at plan time (empty/adhoc expression
+            # roots): nothing for the device to do — the short circuit
+            return expr_mod.assemble_section_results(
+                plan.exprs, [], [None] * len(queries),
+                lambda qid: queries[qid].form)
         run, compiled, predicted, measured, cost = self._program(plan, eng)
         src, _ = self._resident_src()
         with obs_trace.span("batch.dispatch", engine=eng,
@@ -622,8 +737,9 @@ class BatchEngine:
             t_launch = time.perf_counter()
             with obs_slo.phase("dispatch"):
                 outs = (compiled if jit else run)(src,
-                                                  [b.device_arrays()
-                                                   for b in plan])
+                                                  self._launch_arrays(plan))
+            if plan.exprs:
+                expr_mod.record_fused_dispatch("batch_engine", plan.exprs)
             # sync before readback: the span's wall time is host work +
             # queueing, sync_ms is the device-side remainder.  The block
             # also runs untraced (the readback would wait anyway) so the
@@ -655,11 +771,18 @@ class BatchEngine:
             sp.event("batch.cost", **cost_ev)
         with obs_slo.phase("readback"), \
                 obs_trace.span("batch.readback", engine=eng, q=len(queries)):
+            if plan.fused:
+                bucket_outs, expr_outs = outs
+            else:
+                bucket_outs, expr_outs = outs, []
             results: list = [None] * len(queries)
-            for b, (heads, cards) in zip(plan, outs):
+            for b, (heads, cards) in zip(plan, bucket_outs):
                 cards = np.asarray(cards)
                 heads = None if heads is None else np.asarray(heads)
-                for slot, (qid, keys_q) in enumerate(zip(b.qids, b.keys)):
+                for slot, (pid, keys_q) in enumerate(zip(b.qids, b.keys)):
+                    qid = plan.owner.get(pid)
+                    if qid is None:
+                        continue        # internal expr reduce node
                     kq = keys_q.size
                     card = int(cards[slot, :kq].sum()) if kq else 0
                     bm = None
@@ -670,6 +793,9 @@ class BatchEngine:
                             np.zeros((0, WORDS32), np.uint32),
                             cards[slot, :kq])
                     results[qid] = BatchResult(cardinality=card, bitmap=bm)
+            expr_mod.assemble_section_results(
+                plan.exprs, expr_outs, results,
+                lambda qid: queries[qid].form)
         if inject and faults.should_corrupt("batch_engine", eng):
             # deterministic silent corruption (fault kind "silent"): the
             # case only the shadow cross-check can catch
@@ -698,11 +824,15 @@ class BatchEngine:
             self._hosts = hosts
         return self._hosts
 
-    def _sequential_one(self, q: BatchQuery):
+    def _sequential_one(self, q):
         """Host-side reference for ONE query, mirroring the batch
         semantics exactly (operands as a set; andnot = head minus the
-        union of the rest, head index included if repeated)."""
+        union of the rest, head index included if repeated).  Expression
+        queries evaluate their canonical DAG with host container
+        algebra — the rung every fused engine path is pinned against."""
         srcs = self._host_sources()
+        if isinstance(q, expr_mod.ExprQuery):
+            return expr_mod.evaluate_host(q.expr, srcs)
         if not q.operands:
             return srcs[0].__class__() if srcs else RoaringBitmap()
         if q.op == "andnot":
@@ -751,9 +881,8 @@ class BatchEngine:
                           f"equal cardinality {ref.cardinality} but "
                           f"differing members")
                 raise errors.ShadowMismatch(
-                    f"batch_engine query {i} ({queries[i].op} over "
-                    f"{queries[i].operands}) diverged from the sequential "
-                    f"reference: {detail}")
+                    f"batch_engine query {i} ({query_desc(queries[i])}) "
+                    f"diverged from the sequential reference: {detail}")
 
     # ---------------------------------------------------------- explain
 
@@ -764,9 +893,13 @@ class BatchEngine:
         proactive HBM-budget split compares against the budget."""
         plan = self.plan(list(queries))
         eng = self._bucket_engine(plan, engine)
-        return insights.predict_batch_dispatch_bytes(
+        total = insights.predict_batch_dispatch_bytes(
             [b.signature for b in plan], self._resident_src()[1],
             self._ds._n_rows, eng)["peak_bytes"]
+        if plan.exprs:
+            total += insights.predict_expr_dispatch_bytes(
+                plan.expr_signature, eng)["peak_bytes"]
+        return total
 
     def _split_layout(self, queries, eng: str, budget: int | None) -> list:
         """Sub-batch sizes the proactive splitter would dispatch — the
@@ -805,9 +938,16 @@ class BatchEngine:
         plan = self.plan(queries)
         eng = self._bucket_engine(plan, engine)
         kind = self._resident_src()[1]
-        prog_sig = (eng, kind, tuple(b.signature for b in plan))
+        prog_sig = (eng, kind, tuple(b.signature for b in plan),
+                    plan.expr_signature)
         predicted = insights.predict_batch_dispatch_bytes(
             [b.signature for b in plan], kind, self._ds._n_rows, eng)
+        if plan.exprs:
+            e_pred = insights.predict_expr_dispatch_bytes(
+                plan.expr_signature, eng)
+            predicted = dict(predicted)
+            predicted["expr_bytes"] = e_pred["peak_bytes"]
+            predicted["peak_bytes"] += e_pred["peak_bytes"]
         buckets, q_rows = [], [None] * len(queries)
         est_total_s = 0.0
         for bi, b in enumerate(plan):
@@ -833,14 +973,42 @@ class BatchEngine:
                 "predicted_bytes": share["peak_bytes"],
                 "est_word_ops": word_ops,
                 "est_device_ms": round(est_s * 1e3, 4)})
-            for qid in b.qids:
+            for pid in b.qids:
+                qid = plan.owner.get(pid)
+                if qid is None or isinstance(queries[qid],
+                                             expr_mod.ExprQuery):
+                    continue        # internal/flat expr slots row below
                 q = queries[qid]
                 q_rows[qid] = {
                     "op": q.op, "form": q.form,
                     "operands": len(set(q.operands)),
                     "rung": packing.next_pow2(max(1, len(set(q.operands)))),
                     "bucket": bi}
-        seq_ops = sum(max(0, len(set(q.operands)) - 1) for q in queries)
+        # per-DAG-node EXPLAIN rows for expression queries: the fused
+        # sections' predicted bytes/word-ops node by node, next to the
+        # canonical-DAG shape (docs/EXPRESSIONS.md "EXPLAIN")
+        expr_rows = []
+        for sec in plan.exprs:
+            sig = sec.signature
+            row = {
+                "qid": sec.qid, "kind": sec.kind, "form": sec.form,
+                "nodes": sec.n_nodes, "reduce_nodes": sec.n_reduce,
+                "combine_nodes": sec.n_combine, "depth": sec.depth,
+                "cse_saved": sec.cse_saved,
+                "predicted_bytes": insights.predict_expr_dispatch_bytes(
+                    [sig], eng)["peak_bytes"],
+                "est_word_ops": insights.predict_expr_word_ops(
+                    [sig], eng),
+                "per_node": insights.expr_node_report(sig),
+            }
+            q_rows[sec.qid] = {"op": "expr", "form": sec.form,
+                               "nodes": sec.n_nodes,
+                               "depth": sec.depth, "kind": sec.kind}
+            expr_rows.append(row)
+        seq_ops = sum(
+            expr_mod.host_op_count(q.expr)
+            if isinstance(q, expr_mod.ExprQuery)
+            else max(0, len(set(q.operands)) - 1) for q in queries)
         floor = {"host_pairwise_ops": seq_ops,
                  "observed_mean_seconds": None}
         for name, labels, inst in obs_metrics.REGISTRY.instruments():
@@ -886,6 +1054,7 @@ class BatchEngine:
                                insights.resident_set_bytes(
                                    self._ds).items()}},
             "buckets": buckets, "queries": q_rows,
+            "exprs": expr_rows,
             "predicted": {k: int(v) for k, v in predicted.items()},
             "hbm_budget_bytes": budget,
             "proactive_split": {
@@ -917,11 +1086,23 @@ class BatchEngine:
         program caches on its first real execute).  No device dispatch
         happens; the cost is compile-only and measured by
         ``rb_compile_seconds{site,cache}``.  Returns a JSON-able report
-        of what compiled."""
+        of what compiled.
+
+        ``rungs`` entries may also be expression shapes — ``"expr"``,
+        ``"expr:3"`` or ``("expr", 3)`` pre-compile the fused
+        depth-N op-mix programs (parallel.expr.rung_expressions), so a
+        serving loop's first compositional queries boot hot too."""
         cache_dir = rt_warmup.enable_compile_cache()
         t0 = time.perf_counter()
-        batches = ([list(queries)] if queries is not None else
-                   [self._rung_queries(r, ops) for r in rungs])
+        if queries is not None:
+            batches = [list(queries)]
+        else:
+            batches = []
+            for r in rungs:
+                kind, n = expr_mod.parse_warmup_rung(r)
+                batches.append(
+                    expr_mod.rung_expressions(n, self.n) if kind == "expr"
+                    else self._rung_queries(n, ops))
         programs = []
         for batch in batches:
             if not batch:
@@ -959,6 +1140,11 @@ class BatchEngine:
         methodology of DeviceBitmapSet.chained_aggregate).  Returns a
         jitted fn() -> sum over reps of every query's cardinality, modulo
         2^32; callers assert == (reps * expected_total) % 2^32."""
+        if any(isinstance(q, expr_mod.ExprQuery) for q in queries):
+            raise ValueError(
+                "chained_cardinality probes flat batches only; time "
+                "expression pools with repeated execute() calls (the "
+                "bench expression lane's methodology)")
         plan = self.plan(list(queries))
         eng = self._bucket_engine(plan, engine)
         src, kind = self._resident_src()

@@ -1,0 +1,1085 @@
+"""Expression-DAG query compiler: fuse compositional set algebra into
+one launch (ROADMAP item 4).
+
+Every engine before this module executed FLAT single-op queries: a
+``BatchQuery`` is one op over one operand subset, so a compositional
+request like ``(A | B) & ~C`` paid one launch (plus gather, readback and
+guard overhead) per logical node.  The reference never pays that tax —
+its lazy ``Container`` ops and the ``FastAggregation`` horizontal chains
+evaluate whole expressions without materializing intermediates
+(PAPER.md L1/L3).  This module is the device analog: a small logical-
+plan IR (an op DAG over set refs and ad-hoc bitmaps) plus a compiler
+that lowers a whole expression into the engines' existing one-dispatch
+batch programs, so intermediates live in registers/HBM scratch and are
+never read back.
+
+IR
+--
+Leaves: :func:`ref` (an index into the resident set) and :func:`bitmap`
+(an ad-hoc host RoaringBitmap, shipped with the plan).  Ops:
+:func:`or_`, :func:`and_`, :func:`xor`, :func:`andnot`, :func:`not_`.
+An :class:`ExprQuery` wraps a root expression with a result ``form``
+("cardinality" or "bitmap") and is accepted by ``BatchEngine``,
+``MultiSetBatchEngine`` and ``ShardedBatchEngine`` pools anywhere a
+``BatchQuery`` is.
+
+Compilation pipeline (:func:`compile_query`):
+
+1. **canonicalize + CSE** (:func:`canonicalize`): associative chains
+   flatten into one wide node (``or(or(a,b),c) -> or(a,b,c)``),
+   or/and operands dedupe (idempotent), xor operands cancel pairwise,
+   commutative children sort into a canonical order, double negation
+   drops, and ``and(x..., not(y)...)`` rewrites to
+   ``andnot(and(x...), y...)`` — the only bounded home for a
+   complement (a ``not_`` surviving canonicalization is an unbounded
+   complement over the 2^32 universe and raises).  Canonical nodes are
+   structurally hashable, so identical subtrees collapse to ONE DAG
+   node — the CSE; shared nodes compile and execute once.
+2. **reduce extraction**: every maximal all-leaf op node lowers to a
+   pseudo ``BatchQuery`` that rides the engines' EXISTING machinery —
+   ``_plan_query`` row selection, ``plan_bucket`` pow2 shape bucketing,
+   the per-op superbucket merge, the mesh lowering — i.e. the wide
+   segmented reduces stay the workhorse; the DAG only adds combine
+   passes on top.  A node with 2+ leaf children and a non-leaf sibling
+   splits its leaf run into a synthetic reduce so wide chains keep
+   riding the segmented reduce rather than pairwise combines.
+3. **fused combine steps**: interior nodes become elementwise bitwise
+   passes over key-aligned ``u32[K, 2048]`` blocks inside the SAME
+   compiled program (alignment gathers are plan-time host arrays; a
+   child key absent from the node's key space contributes the identity).
+   Key spaces: or/xor = union of child keys, and = intersection,
+   andnot = the head's keys.
+4. **short circuits**: a cardinality-only root never materializes its
+   result image (the program outputs i32 per-key cards only — the words
+   stay scratch); a node whose key space prunes empty (disjoint AND,
+   all-cancelled XOR) is eliminated at plan time and, when the root
+   itself prunes, the query never touches the device at all.  An
+   ``andnot`` rest that prunes empty is dropped (``x & ~0 == x`` — the
+   full-range complement of nothing).
+
+Observability: each compilation emits an ``expr.compile`` span (nodes /
+reduce_nodes / combine_nodes / depth / cse_saved tags); every device
+dispatch carrying fused expressions bumps ``rb_expr_nodes_fused`` and
+``rb_expr_launches_saved_total`` (the node-at-a-time evaluator would
+have paid ~one launch per DAG op node; fused they share one).  See
+docs/EXPRESSIONS.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..ops import dense, packing
+
+WORDS32 = packing.WORDS32
+
+#: ops the IR accepts; "not" only survives until canonicalization
+OPS = ("or", "and", "xor", "andnot")
+
+
+# ------------------------------------------------------------------- IR
+
+class Expr:
+    """Base marker for expression nodes (never instantiated directly)."""
+
+    __slots__ = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Ref(Expr):
+    """Leaf: index of a bitmap in the resident DeviceBitmapSet."""
+
+    index: int
+
+
+class AdHoc(Expr):
+    """Leaf: an ad-hoc host bitmap (not resident) shipped with the plan.
+
+    The input is SNAPSHOTTED (cloned) at leaf construction: cached plans
+    pack the leaf's rows once, so aliasing a caller-mutable bitmap would
+    make a plan-cache hit silently replay pre-mutation contents.  The
+    snapshot makes the semantics deterministic instead — an AdHoc leaf
+    always evaluates the bitmap as it was when the leaf was built.
+    Identity equality (two leaves equal iff they share one snapshot)
+    keeps structurally-equal but distinct bitmaps from colliding in
+    cached plans.
+    """
+
+    __slots__ = ("bm",)
+
+    def __init__(self, bm):
+        if not hasattr(bm, "containers"):
+            bm = bm.to_bitmap()     # buffer.ImmutableRoaringBitmap
+        else:
+            bm = bm.clone()
+        object.__setattr__(self, "bm", bm)
+
+    def __setattr__(self, *a):      # frozen, like the dataclass leaves
+        raise AttributeError("AdHoc is immutable")
+
+    def __eq__(self, o):
+        return isinstance(o, AdHoc) and o.bm is self.bm
+
+    def __hash__(self):
+        return id(self.bm)
+
+    def __repr__(self):
+        return f"AdHoc(<bitmap {id(self.bm):#x}>)"
+
+
+class Node(Expr):
+    """Interior op node over child expressions.
+
+    Structural equality/hash with per-node caching: a deeply SHARED dag
+    (CSE's whole point) has exponential tree size, so recomputing
+    hashes or sort keys per visit would make planning exponential in
+    depth — the caches plus canonicalization's interning (equal
+    canonical subtrees unify to one object, letting tuple equality
+    short-circuit on identity) keep every walk O(dag)."""
+
+    __slots__ = ("op", "children", "_hash", "_skey_c")
+
+    def __init__(self, op: str, children: tuple):
+        self.op = op
+        self.children = tuple(children)
+        self._hash = None
+        self._skey_c = None
+
+    def __eq__(self, o):
+        if self is o:
+            return True
+        return (isinstance(o, Node) and self.op == o.op
+                and self.children == o.children)
+
+    def __hash__(self):
+        h = self._hash
+        if h is None:
+            h = self._hash = hash((self.op, self.children))
+        return h
+
+    def __repr__(self):
+        return f"Node({self.op!r}, {self.children!r})"
+
+
+#: the canonical empty result (e.g. a fully-cancelled xor)
+EMPTY = Node("empty", ())
+
+
+def _as_expr(x) -> Expr:
+    if isinstance(x, Expr):
+        return x
+    if isinstance(x, (int, np.integer)):
+        return Ref(int(x))
+    raise TypeError(
+        f"expression operand must be an Expr or a resident index, got "
+        f"{type(x).__name__}")
+
+
+def ref(i: int) -> Ref:
+    return Ref(int(i))
+
+
+def bitmap(bm) -> AdHoc:
+    """Ad-hoc leaf over a host bitmap not resident in the set."""
+    return AdHoc(bm)
+
+
+def or_(*xs) -> Expr:
+    return Node("or", tuple(_as_expr(x) for x in xs))
+
+
+def and_(*xs) -> Expr:
+    return Node("and", tuple(_as_expr(x) for x in xs))
+
+
+def xor(*xs) -> Expr:
+    return Node("xor", tuple(_as_expr(x) for x in xs))
+
+
+def andnot(head, *rest) -> Expr:
+    """head minus the union of ``rest`` (the BatchQuery andnot shape)."""
+    return Node("andnot", (_as_expr(head),)
+                + tuple(_as_expr(x) for x in rest))
+
+
+def not_(x) -> Expr:
+    """Complement — bounded only inside an ``and_`` (where it rewrites
+    to ``andnot``); anywhere else canonicalization raises."""
+    return Node("not", (_as_expr(x),))
+
+
+@dataclasses.dataclass(frozen=True)
+class ExprQuery:
+    """One compositional request against a resident set — the DAG
+    generalization of :class:`~.batch_engine.BatchQuery`.  Accepted by
+    every engine's ``execute`` next to flat queries; a single-node
+    expression IS a flat query (it lowers to the identical plan)."""
+
+    expr: Expr
+    form: str = "cardinality"
+
+    def __post_init__(self):
+        if not isinstance(self.expr, Expr):
+            object.__setattr__(self, "expr", _as_expr(self.expr))
+        if self.form not in ("cardinality", "bitmap"):
+            raise ValueError(f"unsupported result form {self.form!r}")
+
+
+# --------------------------------------------------- canonicalize + CSE
+
+_ASSOC = ("or", "and", "xor")
+
+
+def _skey(e: Expr):
+    """Deterministic structural sort key for commutative child ordering
+    (AdHoc keys by object identity — stable within a process, which is
+    all a plan cache needs).  Cached per Node so shared-dag sorting
+    stays O(dag)."""
+    if isinstance(e, Ref):
+        return (0, e.index)
+    if isinstance(e, AdHoc):
+        return (1, id(e.bm))
+    k = e._skey_c
+    if k is None:
+        k = e._skey_c = (2, e.op, tuple(_skey(c) for c in e.children))
+    return k
+
+
+def canonicalize(e) -> Expr:
+    """Canonical DAG form: flattened associative chains, deduped/sorted
+    commutative operands, pairwise-cancelled xor, ``not`` absorbed into
+    ``andnot`` (or rejected as unbounded), structural sharing for CSE.
+    Raises ValueError on an unbounded complement or an empty ``and``."""
+    out = _canon(_as_expr(e), {}, {})
+    if isinstance(out, Node) and out.op == "not":
+        raise ValueError(
+            "unbounded complement: a bare not_ root spans the whole "
+            "2^32 universe (complements are bounded only inside and_)")
+    return out
+
+
+def _canon(e: Expr, memo: dict, intern: dict) -> Expr:
+    got = memo.get(e)
+    if got is not None:
+        return got
+    out = _canon_uncached(e, memo, intern)
+    # intern the canonical node: structurally-equal results from
+    # different input branches unify to ONE object, so later equality
+    # checks short-circuit on identity and every walk stays O(dag)
+    out = intern.setdefault(out, out)
+    memo[e] = out
+    return out
+
+
+def _canon_uncached(e: Expr, memo: dict, intern: dict) -> Expr:
+    if isinstance(e, (Ref, AdHoc)):
+        return e
+    if e.op == "empty":
+        return EMPTY
+    if e.op == "not":
+        c = _canon(e.children[0], memo, intern)
+        if isinstance(c, Node) and c.op == "not":
+            return c.children[0]            # double negation
+        return Node("not", (c,))
+    if e.op == "andnot":
+        if not e.children:
+            return EMPTY
+        head = _canon(e.children[0], memo, intern)
+        rest: list = []
+        for r in e.children[1:]:
+            r = _canon(r, memo, intern)
+            if isinstance(r, Node) and r.op == "empty":
+                continue                    # x & ~0 == x
+            if isinstance(r, Node) and r.op == "or":
+                rest.extend(r.children)     # ~(a|b|c): rests ARE a union
+            else:
+                rest.append(r)
+        if isinstance(head, Node):
+            if head.op == "empty":
+                return EMPTY
+            if head.op == "not":
+                raise ValueError(
+                    "unbounded complement: andnot head is a not_ node "
+                    "(complements are bounded only inside and_)")
+            if head.op == "andnot":
+                # andnot(andnot(h, s...), r...) == andnot(h, s..., r...)
+                rest = list(head.children[1:]) + rest
+                head = head.children[0]
+        if any(isinstance(r, Node) and r.op == "not" for r in rest):
+            raise ValueError(
+                "unbounded complement: not_ inside an andnot rest")
+        seen, uniq = set(), []
+        for r in sorted(rest, key=_skey):
+            if r not in seen:
+                seen.add(r)
+                uniq.append(r)
+        if head in seen:
+            return EMPTY                    # h & ~(h | ...) == 0
+        if not uniq:
+            return head
+        return Node("andnot", (head, *uniq))
+    if e.op in _ASSOC:
+        flat: list = []
+        for c in e.children:
+            c = _canon(c, memo, intern)
+            if isinstance(c, Node) and c.op == e.op:
+                flat.extend(c.children)     # associative flatten
+            else:
+                flat.append(c)
+        if e.op == "and":
+            if any(isinstance(c, Node) and c.op == "empty" for c in flat):
+                return EMPTY
+            neg = [c for c in flat
+                   if isinstance(c, Node) and c.op == "not"]
+            pos = [c for c in flat if c not in neg]
+            if neg:
+                if not pos:
+                    raise ValueError(
+                        "unbounded complement: and_ of only not_ nodes")
+                base = _canon(Node("and", tuple(pos)), memo, intern)
+                return _canon(
+                    Node("andnot",
+                         (base, *(n.children[0] for n in neg))), memo,
+                    intern)
+        else:
+            flat = [c for c in flat
+                    if not (isinstance(c, Node) and c.op == "empty")]
+        if any(isinstance(c, Node) and c.op == "not" for c in flat):
+            raise ValueError(
+                f"unbounded complement: not_ under {e.op}_ (complements "
+                "are bounded only inside and_)")
+        flat.sort(key=_skey)
+        if e.op == "xor":
+            uniq: list = []                 # pairwise cancellation
+            for c in flat:
+                if uniq and uniq[-1] == c:
+                    uniq.pop()
+                else:
+                    uniq.append(c)
+        else:
+            uniq = []
+            for c in flat:                  # idempotent dedupe
+                if not uniq or uniq[-1] != c:
+                    uniq.append(c)
+        if not uniq:
+            if e.op == "and":
+                raise ValueError("and_ needs at least one operand")
+            return EMPTY
+        if len(uniq) == 1:
+            return uniq[0]
+        return Node(e.op, tuple(uniq))
+    raise ValueError(f"unknown expression op {e.op!r}")
+
+
+def dag_stats(e: Expr) -> dict:
+    """Canonical-DAG shape report: unique op-node count, depth, and the
+    CSE saving (tree op nodes minus DAG op nodes)."""
+    return _dag_stats_canonical(canonicalize(e))
+
+
+def _dag_stats_canonical(e: Expr) -> dict:
+    """`dag_stats` over an ALREADY-canonical node.  Memoized per node:
+    the tree-node count of a shared dag is exponential in depth by
+    construction (that is cse_saved's whole story), so it is computed
+    as per-node sums in O(dag), never by walking the tree."""
+    uniq: set = set()
+    info: dict = {}          # node -> (tree_nodes, depth)
+
+    def walk(n):
+        if not isinstance(n, Node) or n.op == "empty":
+            return 0, 0
+        got = info.get(n)
+        if got is not None:
+            return got
+        uniq.add(n)
+        t, d = 1, 1
+        for c in n.children:
+            ct, cd = walk(c)
+            t += ct
+            d = max(d, cd + 1)
+        info[n] = (t, d)
+        return t, d
+
+    tree_nodes, depth = walk(e)
+    return {"nodes": len(uniq), "tree_nodes": tree_nodes,
+            "cse_saved": tree_nodes - len(uniq), "depth": depth}
+
+
+def host_op_count(e: Expr) -> int:
+    """Pairwise host container ops a sequential evaluation pays — the
+    expression analog of ``len(operands) - 1`` in the explain floor."""
+    try:
+        return _host_op_count_canonical(canonicalize(e))
+    except ValueError:
+        return 0
+
+
+def _host_op_count_canonical(e: Expr) -> int:
+    total = 0
+    for n in _dag_nodes(e):
+        if isinstance(n, Node) and n.op != "empty":
+            total += max(0, len(n.children) - 1)
+    return total
+
+
+def _dag_nodes(e: Expr) -> list:
+    """Unique nodes of the canonical DAG in topological (children-first)
+    order."""
+    seen: dict = {}
+    order: list = []
+
+    def walk(n):
+        if n in seen:
+            return
+        seen[n] = True
+        if isinstance(n, Node):
+            for c in n.children:
+                walk(c)
+        order.append(n)
+
+    walk(e)
+    return order
+
+
+# ------------------------------------------------- host reference rung
+
+def evaluate_host(e, sources) -> object:
+    """Bit-exact host-side evaluation of an expression over ``sources``
+    (a list of host RoaringBitmaps) — the sequential reference rung every
+    fused engine path is pinned against, and the guard ladder's floor."""
+    from ..core.bitmap import RoaringBitmap
+
+    e = canonicalize(e)
+    memo: dict = {}
+
+    def ev(n):
+        got = memo.get(n)
+        if got is not None:
+            return got
+        if isinstance(n, Ref):
+            if n.index < 0 or n.index >= len(sources):
+                raise IndexError(
+                    f"expression ref out of range 0..{len(sources) - 1}: "
+                    f"{n.index}")
+            v = sources[n.index]
+        elif isinstance(n, AdHoc):
+            v = n.bm
+        elif n.op == "empty":
+            v = RoaringBitmap()
+        elif n.op == "andnot":
+            v = ev(n.children[0]).clone()
+            for r in n.children[1:]:
+                v = v - ev(r)
+        else:
+            import operator
+
+            fn = {"or": operator.or_, "and": operator.and_,
+                  "xor": operator.xor}[n.op]
+            parts = [ev(c) for c in n.children]
+            v = parts[0]
+            for p in parts[1:]:
+                v = fn(v, p)
+        memo[n] = v
+        return v
+
+    out = ev(e)
+    if isinstance(e, (Ref, AdHoc)):
+        # a bare-leaf root must not alias the caller's resident source
+        return out.clone()
+    return out
+
+
+# ----------------------------------------------------- compiled section
+
+@dataclasses.dataclass
+class ExprSection:
+    """One compiled expression of a batch plan.
+
+    ``kind``: "fused" (combine steps run in-program), "flat" (the root
+    lowered to a bare pseudo-query — the single-node case), "empty"
+    (root pruned at plan time; never touches the device) or "adhoc"
+    (the root is an ad-hoc bitmap; resolved on the host).
+
+    Steps (fused sections), each a static-shaped tuple:
+      ("leaf", K)                  value = image[host[g{i}]]        u32[K, W]
+      ("adhoc", K)                 value = host[w{i}]               u32[K, W]
+      ("reduce", bi, slot, kq)     value = bucket_heads[bi][slot, :kq]
+      ("combine", op, children, K) children = ((step, aligned), ...);
+                                   non-aligned children gather through
+                                   host[i{i}_{k}] masked by host[o{i}_{k}]
+    """
+
+    qid: int
+    form: str
+    kind: str
+    steps: list = dataclasses.field(default_factory=list)
+    root: int = -1
+    root_keys: np.ndarray = None
+    host: dict | None = None
+    arrays: dict | None = None
+    adhoc_bm: object = None
+    n_nodes: int = 0
+    n_reduce: int = 0
+    n_combine: int = 0
+    depth: int = 0
+    cse_saved: int = 0
+    host_ops: int = 0
+
+    @property
+    def signature(self):
+        return (self.kind, self.form == "bitmap",
+                tuple(tuple(s) for s in self.steps), self.root,
+                0 if self.root_keys is None else int(self.root_keys.size))
+
+    def device_arrays(self, fresh: bool = False) -> dict:
+        if fresh:
+            if self.host is None:
+                raise RuntimeError(
+                    "fresh=True needs the host operand dict, which this "
+                    "plan dropped after its cached upload")
+            return {k: jnp.asarray(v) for k, v in self.host.items()}
+        if self.arrays is None:
+            self.arrays = {k: jnp.asarray(v) for k, v in self.host.items()}
+        return self.arrays
+
+
+def _pack_adhoc(bm) -> tuple:
+    """Host bitmap -> (u16 keys, u32[K, 2048] dense rows) for plan-time
+    shipping of an ad-hoc leaf."""
+    keys = packing._keys_of(bm)
+    if keys.size == 0:
+        return keys, np.zeros((0, WORDS32), np.uint32)
+    words = np.stack([packing.container_words_u32(c)
+                      for c in bm.containers])
+    return keys, words.astype(np.uint32)
+
+
+def _is_reduce(n: Expr) -> bool:
+    return (isinstance(n, Node) and n.op in OPS
+            and all(isinstance(c, Ref) for c in n.children))
+
+
+def compile_query(q: ExprQuery, qid: int, plan_reduce,
+                  plan_leaf) -> ExprSection:
+    """Compile one :class:`ExprQuery` against an engine's planner.
+
+    ``plan_reduce(batch_query, owner)`` registers a pseudo flat query
+    into the engine's bucketing machinery and returns ``(pid, keys)`` —
+    ``owner`` is the original query id when the pseudo IS the root (the
+    flat case, read back straight from its bucket) and None for
+    internal reduce nodes (consumed in-program, never read back).
+    ``plan_leaf(index)`` returns ``(gather_rows, keys)`` for a resident
+    leaf, rows in whatever row space the caller's image gather uses.
+    """
+    from .batch_engine import BatchQuery
+
+    # ONE canonicalization per compile: stats/host-op walks take the
+    # already-canonical (interned) dag
+    e = canonicalize(q.expr)
+    stats = _dag_stats_canonical(e)
+    with obs_trace.span("expr.compile", qid=qid, form=q.form,
+                        nodes=stats["nodes"],
+                        depth=stats["depth"],
+                        cse_saved=stats["cse_saved"]) as sp:
+        sec = ExprSection(qid=qid, form=q.form, kind="fused",
+                          n_nodes=max(1, stats["nodes"]),
+                          depth=stats["depth"],
+                          cse_saved=stats["cse_saved"],
+                          host_ops=_host_op_count_canonical(e))
+        if isinstance(e, Node) and e.op == "empty":
+            sec.kind = "empty"
+            sp.tag(kind=sec.kind)
+            return sec
+        if isinstance(e, AdHoc):
+            sec.kind, sec.adhoc_bm = "adhoc", e.bm
+            sp.tag(kind=sec.kind)
+            return sec
+        if isinstance(e, Ref):
+            plan_reduce(BatchQuery("or", (e.index,), form=q.form), qid)
+            sec.kind, sec.n_reduce = "flat", 1
+            sp.tag(kind=sec.kind)
+            return sec
+        if _is_reduce(e):
+            # flat root — but prune an empty key space first (disjoint
+            # AND, all-empty operands): the empty short circuit applies
+            # one level down too, and skips the device entirely
+            leaf_keys = [plan_leaf(c.index)[1] for c in e.children]
+            if e.op == "and":
+                inter = leaf_keys[0]
+                for k in leaf_keys[1:]:
+                    inter = np.intersect1d(inter, k, assume_unique=True)
+                dead = inter.size == 0
+            elif e.op == "andnot":
+                dead = leaf_keys[0].size == 0
+            else:
+                dead = all(k.size == 0 for k in leaf_keys)
+            if dead:
+                sec.kind = "empty"
+                sp.tag(kind=sec.kind)
+                return sec
+            # child order already matches BatchQuery semantics (andnot
+            # keeps its head first through canonicalization)
+            ops = tuple(c.index for c in e.children)
+            plan_reduce(BatchQuery(e.op, ops, form=q.form), qid)
+            sec.kind, sec.n_reduce = "flat", 1
+            sp.tag(kind=sec.kind)
+            return sec
+
+        steps: list = []
+        host: dict = {}
+        keyof: dict = {}          # step idx -> np u16 key array
+        memo: dict = {}           # canonical node -> step idx | None
+
+        def emit_leaf_run(refs: list) -> int | None:
+            """2+ sibling leaves of a combine: lower the run as a
+            synthetic OR reduce so it rides the wide segmented reduce."""
+            # internal pseudos stay cardinality-form: their heads are
+            # consumed IN-PROGRAM (the run fn forces head computation
+            # for expr-feeding buckets) and must never become program
+            # outputs — that readback is what fusion deletes
+            bq = BatchQuery("or", tuple(r.index for r in refs),
+                            form="cardinality")
+            pid, keys = plan_reduce(bq, None)
+            if keys.size == 0:
+                return None
+            sec.n_reduce += 1
+            si = len(steps)
+            steps.append(("reduce", pid, 0, int(keys.size)))
+            keyof[si] = keys
+            return si
+
+        def emit(n) -> int | None:
+            if n in memo:
+                return memo[n]
+            si = _emit(n)
+            memo[n] = si
+            return si
+
+        def _emit(n) -> int | None:
+            if isinstance(n, Ref):
+                rows, keys = plan_leaf(n.index)
+                if keys.size == 0:
+                    return None
+                si = len(steps)
+                steps.append(("leaf", int(keys.size)))
+                host[f"g{si}"] = np.asarray(rows, np.int32)
+                keyof[si] = keys
+                return si
+            if isinstance(n, AdHoc):
+                keys, words = _pack_adhoc(n.bm)
+                if keys.size == 0:
+                    return None
+                si = len(steps)
+                steps.append(("adhoc", int(keys.size)))
+                host[f"w{si}"] = words
+                keyof[si] = keys
+                return si
+            if n.op == "empty":
+                return None
+            if _is_reduce(n):
+                ops = tuple(c.index for c in n.children)
+                pid, keys = plan_reduce(
+                    BatchQuery(n.op, ops, form="cardinality"), None)
+                if keys.size == 0:
+                    return None
+                sec.n_reduce += 1
+                si = len(steps)
+                steps.append(("reduce", pid, 0, int(keys.size)))
+                keyof[si] = keys
+                return si
+            # interior combine node.  Group sibling leaf runs of
+            # or/and/xor into synthetic reduces (>= 2 refs)
+            children = list(n.children)
+            if n.op in _ASSOC:
+                refs = [c for c in children if isinstance(c, Ref)]
+                if len(refs) >= 2 and len(refs) < len(children):
+                    if n.op == "or":
+                        rest = [c for c in children
+                                if not isinstance(c, Ref)]
+                        run = emit_leaf_run(refs)
+                        cis = [run] + [emit(c) for c in rest]
+                        return _combine("or", cis)
+                    # and/xor leaf runs stay native reduce nodes of
+                    # their own op
+                    rest = [c for c in children if not isinstance(c, Ref)]
+                    sub = Node(n.op, tuple(refs))
+                    cis = [emit(sub)] + [emit(c) for c in rest]
+                    return _combine(n.op, cis)
+            if n.op == "andnot":
+                head_ci = emit(children[0])
+                rest_cis = [emit(c) for c in children[1:]]
+                return _combine("andnot", [head_ci] + rest_cis)
+            cis = [emit(c) for c in children]
+            return _combine(n.op, cis)
+
+        def _combine(op: str, cis: list) -> int | None:
+            if op == "andnot":
+                head = cis[0]
+                if head is None:
+                    return None             # 0 & ~x == 0
+                rest = [c for c in cis[1:] if c is not None]
+                if not rest:
+                    return head             # x & ~0 == x
+                cis = [head] + rest
+                node_keys = keyof[head]
+            elif op == "and":
+                if any(c is None for c in cis):
+                    return None             # empty annihilates
+                node_keys = keyof[cis[0]]
+                for c in cis[1:]:
+                    node_keys = np.intersect1d(node_keys, keyof[c],
+                                               assume_unique=True)
+                if node_keys.size == 0:
+                    return None             # disjoint key spaces
+            else:                           # or / xor
+                cis = [c for c in cis if c is not None]
+                if not cis:
+                    return None
+                if len(cis) == 1:
+                    return cis[0]
+                node_keys = keyof[cis[0]]
+                for c in cis[1:]:
+                    node_keys = np.union1d(node_keys, keyof[c])
+            node_keys = node_keys.astype(np.uint16)
+            sec.n_combine += 1
+            si = len(steps)
+            spec = []
+            for k, ci in enumerate(cis):
+                ck = keyof[ci]
+                aligned = (ck.size == node_keys.size
+                           and bool(np.array_equal(ck, node_keys)))
+                if not aligned:
+                    idx = np.searchsorted(ck, node_keys).clip(
+                        0, max(0, ck.size - 1)).astype(np.int32)
+                    ok = ck[idx] == node_keys
+                    host[f"i{si}_{k}"] = idx
+                    host[f"o{si}_{k}"] = ok
+                spec.append((ci, aligned))
+            steps.append(("combine", op, tuple(spec),
+                          int(node_keys.size)))
+            keyof[si] = node_keys
+            return si
+
+        root = emit(e)
+        if root is None:
+            sec.kind = "empty"
+            sp.tag(kind=sec.kind)
+            return sec
+        sec.steps, sec.root = steps, root
+        sec.root_keys = keyof[root]
+        sec.host = host
+        sp.tag(kind=sec.kind, reduce_nodes=sec.n_reduce,
+               combine_nodes=sec.n_combine, steps=len(steps),
+               root_keys=int(sec.root_keys.size))
+        return sec
+
+
+def fused_of(sections) -> list:
+    """The sections whose combine steps run in-program — THE filter
+    every plan's ``fused`` property delegates to (one definition of the
+    contract across the three engines)."""
+    return [s for s in sections if s.kind == "fused"]
+
+
+def signature_of(sections) -> tuple:
+    """The expression half of a plan/program cache signature."""
+    return tuple(s.signature for s in sections)
+
+
+def finalize_sections(sections, buckets) -> None:
+    """Resolve reduce steps' pseudo-query ids to their bucket slots,
+    after ``plan_bucket`` assigned them (bucket ``qids`` carry the
+    pids)."""
+    loc = {pid: (bi, slot, b.keys[slot].size)
+           for bi, b in enumerate(buckets)
+           for slot, pid in enumerate(b.qids)}
+    for sec in sections:
+        if sec.kind != "fused":
+            continue
+        for si, st in enumerate(sec.steps):
+            if st[0] == "reduce":
+                bi, slot, kq = loc[st[1]]
+                sec.steps[si] = ("reduce", bi, slot, kq)
+
+
+# -------------------------------------------------------- traced eval
+
+def expr_bucket_ids(sections) -> frozenset:
+    """Bucket indices whose heads fused combine steps consume — the run
+    fn forces head COMPUTATION for these (traced, in-program) without
+    widening the program's OUTPUTS (the bucket's own ``needs_words``
+    keeps meaning "some real bitmap-form query reads these back")."""
+    return frozenset(
+        st[1] for sec in sections if sec.kind == "fused"
+        for st in sec.steps if st[0] == "reduce")
+
+
+def traced_bucket_heads(buckets, op_groups, group_outs,
+                        live_ok: bool) -> list:
+    """Slice per-op superbucket flat head tensors back into per-bucket
+    ``[q, k_pad, W]`` blocks INSIDE the traced program — the traced twin
+    of ``MultiSetBatchEngine._bucket_outputs`` — so fused combine steps
+    can read reduce-node values without a readback.  ``live_ok`` mirrors
+    the engines' regular-fast-path layout rule (live one-slot-per-query
+    outputs on non-pallas rungs)."""
+    out: list = [None] * len(buckets)
+    for grp, (heads_f, _cards) in zip(op_groups, group_outs):
+        if heads_f is None:
+            continue
+        live = live_ok and grp.regular
+        for bi, s0 in zip(grp.bucket_idx, grp.seg_offs):
+            b = buckets[bi]
+            if live:
+                s0l = s0 // 2
+                out[bi] = heads_f[s0l:s0l + b.q].reshape(b.q, 1, WORDS32)
+            else:
+                n = b.q * (b.k_pad + 1)
+                out[bi] = heads_f[s0:s0 + n].reshape(
+                    b.q, b.k_pad + 1, WORDS32)[:, :b.k_pad]
+    return out
+
+
+def eval_section(sec: ExprSection, arrs: dict, words, bucket_heads):
+    """Traced fused evaluation of one section: walk the compiled steps
+    bottom-up, keeping every intermediate a traced value (registers /
+    HBM scratch — never read back).  Returns ``(heads_or_None, cards)``
+    with heads ``u32[K_root, W]`` only for bitmap-form roots (the
+    cardinality short circuit: the popcount is the only root output)."""
+    vals: list = [None] * len(sec.steps)
+    for si, st in enumerate(sec.steps):
+        kind = st[0]
+        if kind == "leaf":
+            v = words[arrs[f"g{si}"]]
+        elif kind == "adhoc":
+            v = arrs[f"w{si}"]
+        elif kind == "reduce":
+            _, bi, slot, kq = st
+            v = bucket_heads[bi][slot, :kq]
+        else:
+            _, op, children, _k = st
+            parts = []
+            for k, (ci, aligned) in enumerate(children):
+                cv = vals[ci]
+                if not aligned:
+                    cv = cv[arrs[f"i{si}_{k}"]]
+                    cv = jnp.where(arrs[f"o{si}_{k}"][:, None], cv,
+                                   jnp.uint32(0))
+                parts.append(cv)
+            if op == "andnot":
+                rest = parts[1]
+                for p in parts[2:]:
+                    rest = rest | p
+                v = parts[0] & ~rest
+            else:
+                fn = dense.OPS[op]
+                v = parts[0]
+                for p in parts[1:]:
+                    v = fn(v, p)
+        vals[si] = v
+    rootv = vals[sec.root]
+    cards = dense.popcount(rootv)
+    return (rootv if sec.form == "bitmap" else None), cards
+
+
+def eval_sections(sections, arrays_list, words, bucket_heads) -> list:
+    return [eval_section(sec, arrs, words, bucket_heads)
+            for sec, arrs in zip(sections, arrays_list)]
+
+
+# ---------------------------------------------------------- accounting
+
+def record_fused_dispatch(site: str, sections) -> None:
+    """Metric bump at a device-dispatch site carrying expressions:
+    ``rb_expr_nodes_fused`` counts DAG op nodes executed fused;
+    ``rb_expr_launches_saved_total`` credits the launches a
+    node-at-a-time evaluator (one launch per op node) would have paid
+    beyond the expression's share of this one dispatch."""
+    sections = [s for s in sections if s is not None]
+    if not sections:
+        return
+    nodes = sum(s.n_nodes for s in sections)
+    obs_metrics.counter("rb_expr_nodes_fused", site=site).inc(nodes)
+    saved = sum(max(0, s.n_nodes - 1) for s in sections)
+    if saved:
+        obs_metrics.counter("rb_expr_launches_saved_total",
+                            site=site).inc(saved)
+
+
+def assemble_section_result(sec: ExprSection, out, form: str):
+    """Host readback of one section's device outputs -> (cardinality,
+    bitmap|None).  ``out`` is the (heads, cards) pair for fused
+    sections, ignored for empty/adhoc ones."""
+    from ..core.bitmap import RoaringBitmap
+
+    if sec.kind == "empty":
+        return 0, (RoaringBitmap() if form == "bitmap" else None)
+    if sec.kind == "adhoc":
+        bm = sec.adhoc_bm
+        return bm.cardinality, (bm.clone() if form == "bitmap" else None)
+    heads, cards = out
+    cards = np.asarray(cards)
+    bm = None
+    if form == "bitmap":
+        bm = packing.unpack_result(sec.root_keys, np.asarray(heads),
+                                   cards)
+    return int(cards.sum()), bm
+
+
+def assemble_section_results(sections, expr_outs, results,
+                             form_of) -> list:
+    """Fill ``results`` in place for every non-flat section (flat roots
+    were read back from their buckets) — THE shared readback tail of
+    the three engines.  ``form_of(qid)`` resolves a query's result
+    form; ``expr_outs`` aligns with the fused subset in order."""
+    from .batch_engine import BatchResult
+
+    fi = 0
+    for sec in sections:
+        if sec.kind == "flat":
+            continue
+        out = None
+        if sec.kind == "fused":
+            out = expr_outs[fi]
+            fi += 1
+        card, bm = assemble_section_result(sec, out, form_of(sec.qid))
+        results[sec.qid] = BatchResult(cardinality=card, bitmap=bm)
+    return results
+
+
+# ------------------------------------------------ unfused reference
+
+def execute_node_at_a_time(engine, queries) -> list:
+    """The un-fused baseline the bench/acceptance lanes compare against:
+    every reduce node of every expression is its OWN single-query device
+    launch (``BatchEngine.execute`` of one flat query, intermediate
+    bitmaps read back), combines run on the host — the only way the
+    pre-expression engines could serve compositional traffic.  Bit-exact
+    with the fused path by construction."""
+    from .batch_engine import BatchQuery, BatchResult
+
+    out = []
+    for q in queries:
+        if isinstance(q, BatchQuery):
+            out.append(engine.execute([q])[0])
+            continue
+        e = canonicalize(q.expr)
+        memo: dict = {}
+
+        def ev(n):
+            got = memo.get(n)
+            if got is not None:
+                return got
+            if isinstance(n, Ref):
+                v = engine._host_sources()[n.index]
+            elif isinstance(n, AdHoc):
+                v = n.bm
+            elif n.op == "empty":
+                from ..core.bitmap import RoaringBitmap
+
+                v = RoaringBitmap()
+            elif _is_reduce(n):
+                ops = tuple(c.index for c in n.children)
+                v = engine.execute(
+                    [BatchQuery(n.op, ops, form="bitmap")])[0].bitmap
+            elif n.op == "andnot":
+                v = ev(n.children[0]).clone()
+                for r in n.children[1:]:
+                    v = v - ev(r)
+            else:
+                import operator
+
+                fn = {"or": operator.or_, "and": operator.and_,
+                      "xor": operator.xor}[n.op]
+                parts = [ev(c) for c in n.children]
+                v = parts[0]
+                for p in parts[1:]:
+                    v = fn(v, p)
+            memo[n] = v
+            return v
+
+        rb = ev(e)
+        if isinstance(e, (Ref, AdHoc)):
+            # a bare-leaf root must not alias the engine's host-source
+            # cache (the shadow reference) or the AdHoc snapshot
+            rb = rb.clone()
+        out.append(BatchResult(
+            cardinality=rb.cardinality,
+            bitmap=rb if q.form == "bitmap" else None))
+    return out
+
+
+# ------------------------------------------------- workload generators
+
+def random_expr_pool(n_bitmaps: int, q: int, depth: int = 2,
+                     seed: int = 0xDA6, form: str = "cardinality",
+                     max_fan: int = 3) -> list:
+    """Deterministic depth-``depth`` expression pool over ``n_bitmaps``
+    residents — the shared workload of the bench expression lane and the
+    acceptance tests.  Mixes or/and/xor/andnot interior nodes with
+    leaf-level reduce chains; one query in four carries a ``not_`` term
+    (exercising the andnot rewrite)."""
+    if n_bitmaps < 2:
+        raise ValueError("expression pool needs at least 2 residents")
+    rng = np.random.default_rng(seed)
+
+    def leaf_chain():
+        k = int(rng.integers(2, min(5, n_bitmaps + 1)))
+        refs = [int(x) for x in rng.choice(n_bitmaps, size=k,
+                                           replace=False)]
+        op = ("or", "xor", "and")[int(rng.integers(3))]
+        return Node(op, tuple(Ref(r) for r in refs))
+
+    def build(d):
+        if d <= 1:
+            return leaf_chain()
+        fan = int(rng.integers(2, max_fan + 1))
+        kids = tuple(build(d - 1) for _ in range(fan))
+        op = ("or", "and", "xor", "andnot")[int(rng.integers(4))]
+        return Node(op, kids)
+
+    pool = []
+    for i in range(q):
+        e = build(depth)
+        if i % 4 == 3:
+            e = Node("and", (e, Node("not", (Ref(int(
+                rng.integers(n_bitmaps))),))))
+        pool.append(ExprQuery(e, form=form))
+    return pool
+
+
+def rung_expressions(depth: int, n_residents: int,
+                     form: str = "cardinality") -> list:
+    """Representative depth-``depth`` op-mix shapes for warmup: the
+    expression analog of ``BatchEngine._rung_queries`` — deterministic,
+    so a warmed serving loop's first matching execute hits the plan AND
+    program caches."""
+    r = [Ref(i % n_residents) for i in range(4)]
+    base = [Node("or", (r[0], r[1])), Node("xor", (r[2], r[3])),
+            Node("and", (r[0], r[2]))]
+    exprs = [Node("and", (base[0], base[1])),
+             Node("or", (base[1], base[2])),
+             Node("andnot", (base[0], r[2])),
+             Node("and", (base[0], Node("not", (r[3],))))]
+    for _ in range(max(0, depth - 2)):
+        exprs = [Node("or", (exprs[0], exprs[1])),
+                 Node("and", (exprs[1], exprs[2])),
+                 Node("andnot", (exprs[2], exprs[3].children[0])),
+                 Node("xor", (exprs[3], exprs[0]))]
+    return [ExprQuery(e, form=form) for e in exprs]
+
+
+def parse_warmup_rung(r):
+    """Warmup rung vocabulary shared by the three engines: an int is a
+    pow2 operand rung (the flat shapes); ``"expr"``, ``"expr:3"`` or
+    ``("expr", 3)`` is an expression-shape rung at that depth."""
+    if isinstance(r, str) and r.startswith("expr"):
+        _, _, d = r.partition(":")
+        return "expr", int(d) if d else 2
+    if isinstance(r, tuple) and len(r) == 2 and r[0] == "expr":
+        return "expr", int(r[1])
+    return "flat", int(r)

@@ -91,10 +91,11 @@ from ..runtime import errors, faults, guard
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
 from ..ops import dense
+from . import expr as expr_mod
 from .aggregation import DeviceBitmapSet, _engine
 from .batch_engine import (ENGINE_LADDER, PLAN_CACHE_MAX, PROGRAM_CACHE_MAX,
                            WORDS32, _RED_OP, BatchEngine, BatchQuery,
-                           BatchResult, bucket_body, plan_bucket)
+                           BatchResult, bucket_body, plan_bucket, query_desc)
 
 #: the guard/trace/metric site of every pooled dispatch
 SITE = "multiset"
@@ -195,6 +196,10 @@ class _PoolPlan:
     #                       budget-probe plans that are halved away never
     #                       touch the device
     n_pool_rows: int      # total selected rows (the pooled image height)
+    #: fused expression sections (parallel.expr) + the expanded-slot ->
+    #: original-query owner map (None-skipped internal reduce pseudos)
+    exprs: list = dataclasses.field(default_factory=list)
+    owner: dict = dataclasses.field(default_factory=dict)
     #: per-bucket readback constants (operand counts + live-key masks),
     #: computed once per plan — the readback loop runs per dispatch
     rb_meta: dict = dataclasses.field(default_factory=dict)
@@ -207,10 +212,19 @@ class _PoolPlan:
         return dev
 
     @property
+    def fused(self) -> list:
+        return expr_mod.fused_of(self.exprs)
+
+    @property
+    def expr_signature(self) -> tuple:
+        return expr_mod.signature_of(self.exprs)
+
+    @property
     def signature(self):
         return (self.sids,
                 tuple(int(self.row_sel[s].shape[0]) for s in self.sids),
-                tuple(b.signature for b in self.buckets))
+                tuple(b.signature for b in self.buckets),
+                self.expr_signature)
 
 
 def _merge_op_groups(buckets) -> list:
@@ -308,11 +322,15 @@ def _fold_rows(fn, blk):
     return blk[:, 0]
 
 
-def _op_body(words, g_sig, arrays, eng: str):
+def _op_body(words, g_sig, arrays, eng: str, force_heads: bool = False):
     """Traced body for one op superbucket: ONE gather + ONE flat
     segmented reduce for every same-op bucket of the pool, post passes
-    on the flat head axis.  Returns (heads_flat or None, cards_flat)."""
+    on the flat head axis.  Returns (heads_flat or None, cards_flat).
+    ``force_heads`` returns heads regardless of the group's own
+    needs_words — in-program consumption by fused expression combines
+    (program outputs still gate on the original flag)."""
     op, nseg, _n_rows, n_steps, needs_words, reg_shapes = g_sig
+    needs_words = needs_words or force_heads
     red = _RED_OP[op]
     g = words[arrays["gather"]]
     ident = jnp.uint32(0xFFFFFFFF if op == "and" else 0)
@@ -356,14 +374,17 @@ def _op_body(words, g_sig, arrays, eng: str):
     return (heads if needs_words else None), cards
 
 
-def assemble_pooled_results(bucket_outputs, pooled, rb_meta: dict) -> list:
+def assemble_pooled_results(bucket_outputs, pooled, rb_meta: dict,
+                            owner: dict | None = None) -> list:
     """Normalized per-bucket device outputs -> per-query BatchResults in
     pooled order — the readback assembly shared by
     :class:`MultiSetBatchEngine` and ``parallel.sharded_engine``.  One
     vectorized masked sum per bucket (not per query): a pooled readback
     walks Q x S results, so per-query ndarray reductions would rival the
     launch itself; the mask constants are plan-static and cached in
-    ``rb_meta`` keyed by bucket identity."""
+    ``rb_meta`` keyed by bucket identity.  ``owner`` maps expanded slot
+    ids back to pooled query indices (expression plans; None = identity,
+    internal reduce pseudos are skipped)."""
     pooled = list(pooled)
     results: list = [None] * len(pooled)
     for b, heads, cards in bucket_outputs:
@@ -376,7 +397,10 @@ def assemble_pooled_results(bucket_outputs, pooled, rb_meta: dict) -> list:
         kqs, live = meta
         sums = np.where(live[:, :cards.shape[1]],
                         cards[:len(b.keys)], 0).sum(axis=1)
-        for slot, (qid, keys_q) in enumerate(zip(b.qids, b.keys)):
+        for slot, (pid, keys_q) in enumerate(zip(b.qids, b.keys)):
+            qid = pid if owner is None else owner.get(pid)
+            if qid is None:
+                continue        # internal expr reduce node, in-program
             kq = keys_q.size
             bm = None
             if pooled[qid][1].form == "bitmap":
@@ -497,26 +521,53 @@ class MultiSetBatchEngine:
                 obs_trace.span("multiset.plan", q=len(pooled),
                                sets=len(sids)) as sp:
             groups: dict = {}
-            for qid, (sid, q) in enumerate(pooled):
+            owner: dict = {}
+            sections: list = []
+            counter = [0]
+
+            def add_item(sid, pq, own):
+                pid = counter[0]
+                counter[0] += 1
                 eng = self._engines[sid]
-                rows, segs, keys_q, keep, hrows = eng._plan_query(q)
+                rows, segs, keys_q, keep, hrows = eng._plan_query(pq)
                 off = offsets[sid]
                 rows = rows + off
                 if hrows is not None:
                     hrows = hrows + off
-                rung = packing.next_pow2(max(1, len(set(q.operands))))
-                groups.setdefault((q.op, rung), []).append(
-                    (qid, q, rows, segs, keys_q, keep, hrows))
+                rung = packing.next_pow2(max(1, len(set(pq.operands))))
+                groups.setdefault((pq.op, rung), []).append(
+                    (pid, pq, rows, segs, keys_q, keep, hrows))
+                if own is not None:
+                    owner[pid] = own
+                return pid, keys_q
+
+            def plan_leaf(sid, i):
+                rows, keys = self._engines[sid]._plan_leaf(i)
+                return rows + offsets[sid], keys
+
+            for qid, (sid, q) in enumerate(pooled):
+                if isinstance(q, expr_mod.ExprQuery):
+                    sections.append(expr_mod.compile_query(
+                        q, qid,
+                        lambda pq, own, sid=sid: add_item(sid, pq, own),
+                        lambda i, sid=sid: plan_leaf(sid, i)))
+                else:
+                    add_item(sid, q, qid)
             with obs_trace.span("multiset.pool", groups=len(groups)):
                 buckets = [plan_bucket(op, items)
                            for (op, _), items in sorted(groups.items())]
                 # compact the pooled row space: every gather row the
                 # pool references, once, sorted — per-set selections
                 # concatenate to exactly this order, and the bucket
-                # gathers remap to positions in it
+                # gathers (plus the expression sections' leaf gathers)
+                # remap to positions in it
                 refs = [b.host["gather"].ravel() for b in buckets]
                 refs += [b.host["head_gather"].ravel() for b in buckets
                          if "head_gather" in b.host]
+                refs += [v.ravel() for sec in sections
+                         if sec.kind == "fused" and sec.host
+                         for k, v in sec.host.items()
+                         if k.startswith("g")]
                 pool_rows = (np.unique(np.concatenate(refs)) if refs
                              else np.zeros(1, np.int64))
                 if pool_rows.size == 0:
@@ -531,6 +582,13 @@ class MultiSetBatchEngine:
                         if k in b.host:
                             b.host[k] = np.searchsorted(
                                 pool_rows, b.host[k]).astype(np.int32)
+                for sec in sections:
+                    if sec.kind != "fused" or not sec.host:
+                        continue
+                    for k in list(sec.host):
+                        if k.startswith("g"):
+                            sec.host[k] = np.searchsorted(
+                                pool_rows, sec.host[k]).astype(np.int32)
                 row_sel = {}
                 for sid in sids:
                     off = offsets[sid]
@@ -538,16 +596,18 @@ class MultiSetBatchEngine:
                                        & (pool_rows < off
                                           + self._rows[sid])]
                     row_sel[sid] = (in_set - off).astype(np.int32)
+            expr_mod.finalize_sections(sections, buckets)
             occupancy = (len(pooled)
                          / max(1, sum(b.q for b in buckets)))
             obs_metrics.gauge("rb_multiset_pool_occupancy",
                               site=SITE).set(occupancy)
             sp.tag(buckets=len(buckets), occupancy=round(occupancy, 4),
-                   pool_rows=int(pool_rows.size))
+                   pool_rows=int(pool_rows.size), exprs=len(sections))
         plan = _PoolPlan(buckets=buckets,
                          op_groups=_merge_op_groups(buckets),
                          sids=sids, row_sel=row_sel,
-                         n_pool_rows=int(pool_rows.size))
+                         n_pool_rows=int(pool_rows.size),
+                         exprs=sections, owner=owner)
         self._plans.put(key, plan)
         return plan
 
@@ -584,16 +644,23 @@ class MultiSetBatchEngine:
         seq = list(pooled_or_groups)
         if seq and isinstance(seq[0], (BatchGroup, tuple)) \
                 and not (isinstance(seq[0], tuple) and len(seq[0]) == 2
-                         and isinstance(seq[0][1], BatchQuery)):
+                         and isinstance(seq[0][1],
+                                        (BatchQuery, expr_mod.ExprQuery))):
             return self._flatten(seq)[0]
         return tuple(seq)
 
     def _predict(self, plan: _PoolPlan, eng: str) -> dict:
         sets = [(self._engines[s]._resident_src()[1],
                  self._engines[s]._ds._n_rows) for s in plan.sids]
-        return insights.predict_multiset_dispatch_bytes(
+        out = insights.predict_multiset_dispatch_bytes(
             [b.signature for b in plan.buckets], sets, eng,
             pool_rows=plan.n_pool_rows)
+        if plan.exprs:
+            e = insights.predict_expr_dispatch_bytes(
+                plan.expr_signature, eng)
+            out["expr_bytes"] = e["peak_bytes"]
+            out["peak_bytes"] += e["peak_bytes"]
+        return out
 
     # ------------------------------------------------------------ programs
 
@@ -617,11 +684,15 @@ class MultiSetBatchEngine:
         kinds = [k for _, k in srcs]
         b_sigs = [b.signature for b in plan.buckets]
         g_sigs = [g.sig for g in plan.op_groups]
+        fused = plan.fused
+        expr_bis = expr_mod.expr_bucket_ids(fused)
+        group_force = [any(bi in expr_bis for bi in g.bucket_idx)
+                       for g in plan.op_groups]
 
         with obs_slo.phase("program_build"), \
                 obs_trace.span("multiset.program_build", engine=eng,
                                sets=len(engines), buckets=len(b_sigs),
-                               donate=donate) as sp:
+                               donate=donate, exprs=len(fused)) as sp:
             def pooled_words(src_list, sel_list):
                 # per-tenant image -> referenced-row selection -> pooled
                 # concat: the transient image is the pool's true row
@@ -635,15 +706,38 @@ class MultiSetBatchEngine:
             if eng == "xla-vmap":
                 # unmerged per-bucket cross-check path: proves the op
                 # merge and the query-axis flattening equivalent
-                def run(src_list, sel_list, barrays):
+                def run(src_list, sel_list, arrays):
                     words = pooled_words(src_list, sel_list)
-                    return [bucket_body(words, s, a, eng)
-                            for s, a in zip(b_sigs, barrays)]
+                    outs, heads_by_bi = [], [None] * len(b_sigs)
+                    for bi, (s, a) in enumerate(zip(b_sigs,
+                                                    arrays[:len(b_sigs)])):
+                        heads, cards = bucket_body(
+                            words, s, a, eng,
+                            force_heads=bi in expr_bis)
+                        heads_by_bi[bi] = heads
+                        outs.append((heads if s[5] else None, cards))
+                    if not fused:
+                        return outs
+                    return outs, expr_mod.eval_sections(
+                        fused, arrays[len(b_sigs):], words, heads_by_bi)
             else:
-                def run(src_list, sel_list, garrays):
+                def run(src_list, sel_list, arrays):
                     words = pooled_words(src_list, sel_list)
-                    return [_op_body(words, s, a, eng)
-                            for s, a in zip(g_sigs, garrays)]
+                    outs, group_heads = [], []
+                    for gi, (s, a) in enumerate(zip(g_sigs,
+                                                    arrays[:len(g_sigs)])):
+                        heads, cards = _op_body(
+                            words, s, a, eng,
+                            force_heads=group_force[gi])
+                        group_heads.append((heads, cards))
+                        outs.append((heads if s[4] else None, cards))
+                    if not fused:
+                        return outs
+                    bucket_heads = expr_mod.traced_bucket_heads(
+                        plan.buckets, plan.op_groups, group_heads,
+                        live_ok=(eng != "pallas"))
+                    return outs, expr_mod.eval_sections(
+                        fused, arrays[len(g_sigs):], words, bucket_heads)
 
             jit_kw = {"donate_argnums": (2,)} if donate else {}
             # donate-variant lowering traces against avals only: caching
@@ -983,6 +1077,8 @@ class MultiSetBatchEngine:
             # reached the device (docs/OBSERVABILITY.md)
             obs_metrics.counter("rb_multiset_launches_total",
                                 site=SITE).inc()
+            if plan.exprs:
+                expr_mod.record_fused_dispatch(SITE, plan.exprs)
             if sync:
                 with obs_slo.phase("sync"):
                     outs = sp.sync(outs)
@@ -1016,9 +1112,13 @@ class MultiSetBatchEngine:
         upload the subset per launch, the sync path uploads it once and
         caches it per keyset."""
         if eng == "xla-vmap":
-            return [b.device_arrays(fresh=fresh) for b in plan.buckets]
-        return [g.device_arrays(fresh=fresh, keys=_op_group_keys(g, eng))
-                for g in plan.op_groups]
+            arrays = [b.device_arrays(fresh=fresh) for b in plan.buckets]
+        else:
+            arrays = [g.device_arrays(fresh=fresh,
+                                      keys=_op_group_keys(g, eng))
+                      for g in plan.op_groups]
+        arrays.extend(s.device_arrays(fresh=fresh) for s in plan.fused)
+        return arrays
 
     def _operand_avals(self, plan: _PoolPlan, eng: str) -> list:
         """ShapeDtypeStruct pytree matching the DONATE-variant
@@ -1029,10 +1129,14 @@ class MultiSetBatchEngine:
         aval = lambda v: jax.ShapeDtypeStruct(
             v.shape, jax.dtypes.canonicalize_dtype(v.dtype))
         if eng == "xla-vmap":
-            return [{k: aval(v) for k, v in b.host.items()}
-                    for b in plan.buckets]
-        return [{k: aval(g.host[k]) for k in _op_group_keys(g, eng)}
-                for g in plan.op_groups]
+            avals = [{k: aval(v) for k, v in b.host.items()}
+                     for b in plan.buckets]
+        else:
+            avals = [{k: aval(g.host[k]) for k in _op_group_keys(g, eng)}
+                     for g in plan.op_groups]
+        avals.extend({k: aval(v) for k, v in s.host.items()}
+                     for s in plan.fused)
+        return avals
 
     def _bucket_outputs(self, plan: _PoolPlan, outs, eng: str):
         """Normalize program outputs to per-bucket (bucket, heads,
@@ -1068,12 +1172,19 @@ class MultiSetBatchEngine:
     def _readback(self, plan: _PoolPlan, outs, pooled, eng: str,
                   inject: bool) -> list:
         """Device outputs -> per-query BatchResults in pooled order."""
+        if plan.fused:
+            outs, expr_outs = outs
+        else:
+            expr_outs = []
         with obs_slo.phase("readback"), \
                 obs_trace.span("multiset.readback", engine=eng,
                                q=len(pooled)):
             results = assemble_pooled_results(
                 self._bucket_outputs(plan, outs, eng), pooled,
-                plan.rb_meta)
+                plan.rb_meta, owner=plan.owner if plan.exprs else None)
+            expr_mod.assemble_section_results(
+                plan.exprs, expr_outs, results,
+                lambda qid: pooled[qid][1].form)
         if inject and faults.should_corrupt(SITE, eng):
             results[0] = BatchResult(
                 cardinality=results[0].cardinality + 1,
@@ -1106,7 +1217,7 @@ class MultiSetBatchEngine:
                 bad = got.bitmap != ref
             if bad:
                 raise errors.ShadowMismatch(
-                    f"multiset query {i} ({q.op} over {q.operands} on set "
+                    f"multiset query {i} ({query_desc(q)} on set "
                     f"{sid}) diverged from the sequential reference: got "
                     f"cardinality {got.cardinality}, want "
                     f"{ref.cardinality}")
@@ -1131,9 +1242,15 @@ class MultiSetBatchEngine:
         cache_dir = rt_warmup.enable_compile_cache()
         t0 = time.perf_counter()
         if pools is None:
-            pools = [[BatchGroup(sid, e._rung_queries(r, ops))
-                      for sid, e in enumerate(self._engines)]
-                     for r in rungs]
+            pools = []
+            for r in rungs:
+                kind, n = expr_mod.parse_warmup_rung(r)
+                pools.append([
+                    BatchGroup(sid,
+                               expr_mod.rung_expressions(n, e.n)
+                               if kind == "expr"
+                               else e._rung_queries(n, ops))
+                    for sid, e in enumerate(self._engines)])
         programs = []
         for pool in pools:
             pooled, _ = self._flatten(list(pool))

@@ -88,9 +88,11 @@ from ..ops import dense, packing
 from ..runtime import faults, guard
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
+from . import expr as expr_mod
 from .aggregation import DeviceBitmapSet
 from .batch_engine import (PLAN_CACHE_MAX, PROGRAM_CACHE_MAX, WORDS32,
-                           _RED_OP, BatchEngine, BatchQuery, plan_bucket)
+                           _RED_OP, BatchEngine, BatchQuery, plan_bucket,
+                           query_desc)
 from .multiset import (BatchGroup, MultiSetBatchEngine, _donation_supported,
                        _merge_op_groups, assemble_pooled_results)
 from .sharding import SPECS, SpecLayout, _butterfly_combine, _intern_mesh, \
@@ -151,13 +153,25 @@ class _ShardedPlan:
     sids: tuple
     padded: list          # per group: {key: np array} device-pad layout
     n_pads: tuple         # per group: padded flat row count
+    #: fused expression sections (parallel.expr) + expanded-slot owner
+    exprs: list = dataclasses.field(default_factory=list)
+    owner: dict = dataclasses.field(default_factory=dict)
     rb_meta: dict = dataclasses.field(default_factory=dict)
     _arrays: list | None = None   # device twins, uploaded lazily
 
     @property
+    def fused(self) -> list:
+        return expr_mod.fused_of(self.exprs)
+
+    @property
+    def expr_signature(self) -> tuple:
+        return expr_mod.signature_of(self.exprs)
+
+    @property
     def signature(self):
         return (self.sids, self.n_pads,
-                tuple(g.sig for g in self.op_groups))
+                tuple(g.sig for g in self.op_groups),
+                self.expr_signature)
 
 
 class ShardedBatchEngine:
@@ -308,16 +322,40 @@ class ShardedBatchEngine:
                 obs_trace.span("sharded.plan", q=len(pooled),
                                sets=len(sids), mesh=self._mesh_label) as sp:
             groups: dict = {}
-            for qid, (sid, q) in enumerate(pooled):
+            owner: dict = {}
+            sections: list = []
+            counter = [0]
+
+            def add_item(sid, pq, own):
+                pid = counter[0]
+                counter[0] += 1
                 eng = self._engines[sid]
-                rows, segs, keys_q, keep, hrows = eng._plan_query(q)
+                rows, segs, keys_q, keep, hrows = eng._plan_query(pq)
                 off = int(self._base[sid])
                 rows = rows + off
                 if hrows is not None:
                     hrows = hrows + off
-                rung = packing.next_pow2(max(1, len(set(q.operands))))
-                groups.setdefault((q.op, rung), []).append(
-                    (qid, q, rows, segs, keys_q, keep, hrows))
+                rung = packing.next_pow2(max(1, len(set(pq.operands))))
+                groups.setdefault((pq.op, rung), []).append(
+                    (pid, pq, rows, segs, keys_q, keep, hrows))
+                if own is not None:
+                    owner[pid] = own
+                return pid, keys_q
+
+            def plan_leaf(sid, i):
+                # the sharded pool image is the FULL concat, so leaf
+                # gathers stay global rows — no compaction remap
+                rows, keys = self._engines[sid]._plan_leaf(i)
+                return rows + int(self._base[sid]), keys
+
+            for qid, (sid, q) in enumerate(pooled):
+                if isinstance(q, expr_mod.ExprQuery):
+                    sections.append(expr_mod.compile_query(
+                        q, qid,
+                        lambda pq, own, sid=sid: add_item(sid, pq, own),
+                        lambda i, sid=sid: plan_leaf(sid, i)))
+                else:
+                    add_item(sid, q, qid)
             with obs_trace.span("sharded.pool", groups=len(groups)):
                 buckets = [plan_bucket(op, items)
                            for (op, _), items in sorted(groups.items())]
@@ -341,11 +379,13 @@ class ShardedBatchEngine:
                         host["head_ok"] = g.host["head_ok"]
                     padded.append(host)
                     n_pads.append(n_pad)
+            expr_mod.finalize_sections(sections, buckets)
             sp.tag(buckets=len(buckets), op_groups=len(op_groups),
-                   flat_rows=int(sum(n_pads)))
+                   flat_rows=int(sum(n_pads)), exprs=len(sections))
         plan = _ShardedPlan(buckets=buckets, op_groups=op_groups,
                             sids=sids, padded=padded,
-                            n_pads=tuple(n_pads))
+                            n_pads=tuple(n_pads),
+                            exprs=sections, owner=owner)
         self._plans.put(key, plan)
         return plan
 
@@ -362,11 +402,25 @@ class ShardedBatchEngine:
                 v, shard_v if k in ("gather", "valid", "flat_seg")
                 else repl) for k, v in host.items()}
 
+        def expr_upload(sec, f):
+            # expression sections run on the replicated post-pass side
+            # (combines over butterfly-combined heads), so every operand
+            # — leaf gather indices included — places replicated, like
+            # the andnot head_gather precedent above
+            if f:
+                return {k: jax.device_put(v, repl)
+                        for k, v in sec.host.items()}
+            if sec.arrays is None:
+                sec.arrays = {k: jax.device_put(v, repl)
+                              for k, v in sec.host.items()}
+            return sec.arrays
+
         if fresh:
-            return [upload(h) for h in plan.padded]
+            return ([upload(h) for h in plan.padded]
+                    + [expr_upload(s, True) for s in plan.fused])
         if plan._arrays is None:
             plan._arrays = [upload(h) for h in plan.padded]
-        return plan._arrays
+        return plan._arrays + [expr_upload(s, False) for s in plan.fused]
 
     def _operand_avals(self, plan: _ShardedPlan) -> list:
         """Sharding-carrying avals matching ``_operands(fresh=True)`` —
@@ -381,8 +435,14 @@ class ShardedBatchEngine:
                 sharding=(shard_v if k in ("gather", "valid", "flat_seg")
                           else repl))
 
-        return [{k: aval(k, v) for k, v in h.items()}
-                for h in plan.padded]
+        avals = [{k: aval(k, v) for k, v in h.items()}
+                 for h in plan.padded]
+        avals.extend(
+            {k: jax.ShapeDtypeStruct(
+                v.shape, jax.dtypes.canonicalize_dtype(v.dtype),
+                sharding=repl) for k, v in s.host.items()}
+            for s in plan.fused)
+        return avals
 
     def predict_dispatch_bytes(self, groups_or_queries) -> dict:
         """Per-shard + mesh-total transient prediction of ONE sharded
@@ -394,18 +454,32 @@ class ShardedBatchEngine:
         return self._predict(self._plan(tuple(pooled)))
 
     def _predict(self, plan: _ShardedPlan) -> dict:
-        return insights.predict_sharded_dispatch_bytes(
+        out = insights.predict_sharded_dispatch_bytes(
             [b.signature for b in plan.buckets], self.pool_rows,
             self.mesh_devices,
             self.mesh_shape[0] if self.placement == "sharded" else 1)
+        if plan.exprs:
+            # fused combine intermediates live on the replicated side:
+            # every device holds them, so they add to BOTH the per-shard
+            # figure (the budget-relevant one) and D x to the mesh total
+            e = insights.predict_expr_dispatch_bytes(
+                plan.expr_signature, "xla")["peak_bytes"]
+            out["expr_bytes"] = e
+            out["per_shard_bytes"] += e
+            out["peak_bytes"] += self.mesh_devices * e
+        return out
 
     # ------------------------------------------------------------- programs
 
-    def _group_body(self, g_sig, n_pad: int, arrs, pool_words):
+    def _group_body(self, g_sig, n_pad: int, arrs, pool_words,
+                    force_heads: bool = False):
         """Traced body for one op superbucket on the mesh: gather from
         the rows-sharded pool, shard-local segmented reduce, butterfly
-        combine per mesh axis, replicated post passes."""
+        combine per mesh axis, replicated post passes.  ``force_heads``
+        returns heads for in-program fused-expression consumption
+        regardless of the group's own needs_words."""
         op, nseg, _n_rows, n_steps, needs_words, _reg = g_sig
+        needs_words = needs_words or force_heads
         red = _RED_OP[op]
         mesh, specs = self._mesh, self._specs
         ident = jnp.uint32(0xFFFFFFFF if op == "and" else 0)
@@ -465,13 +539,34 @@ class ShardedBatchEngine:
             return cached
         g_sigs = [g.sig for g in plan.op_groups]
         n_pads = plan.n_pads
+        fused = plan.fused
+        expr_bis = expr_mod.expr_bucket_ids(fused)
+        group_force = [any(bi in expr_bis for bi in g.bucket_idx)
+                       for g in plan.op_groups]
 
         with obs_slo.phase("program_build"), \
                 obs_trace.span("sharded.program_build", mesh=self._mesh_label,
-                               groups=len(g_sigs), donate=donate) as sp:
-            def run(pool_words, garrays):
-                return [self._group_body(s, n, a, pool_words)
-                        for s, n, a in zip(g_sigs, n_pads, garrays)]
+                               groups=len(g_sigs), donate=donate,
+                               exprs=len(fused)) as sp:
+            def run(pool_words, arrays):
+                outs, group_heads = [], []
+                for gi, (s, n, a) in enumerate(zip(g_sigs, n_pads,
+                                                   arrays[:len(g_sigs)])):
+                    heads, cards = self._group_body(
+                        s, n, a, pool_words,
+                        force_heads=group_force[gi])
+                    group_heads.append((heads, cards))
+                    outs.append((heads if s[4] else None, cards))
+                if not fused:
+                    return outs
+                # fused combine passes run on the replicated side, after
+                # every group's butterfly combine — the padded flat head
+                # layout (no live fast path on the mesh)
+                bucket_heads = expr_mod.traced_bucket_heads(
+                    plan.buckets, plan.op_groups, group_heads,
+                    live_ok=False)
+                return outs, expr_mod.eval_sections(
+                    fused, arrays[len(g_sigs):], pool_words, bucket_heads)
 
             jit_kw = {"donate_argnums": (1,)} if donate else {}
             operands = (self._operand_avals(plan) if donate
@@ -623,6 +718,8 @@ class ShardedBatchEngine:
                                                   operands)
             obs_metrics.counter("rb_sharded_launches_total", site=SITE,
                                 mesh=self._mesh_label).inc()
+            if plan.exprs:
+                expr_mod.record_fused_dispatch(SITE, plan.exprs)
             with obs_slo.phase("sync"):
                 outs = sp.sync(outs)
                 outs = jax.block_until_ready(outs)
@@ -677,14 +774,22 @@ class ShardedBatchEngine:
 
     def _readback(self, plan: _ShardedPlan, outs, pooled,
                   inject: bool) -> list:
+        from .batch_engine import BatchResult
+
+        if plan.fused:
+            outs, expr_outs = outs
+        else:
+            expr_outs = []
         with obs_slo.phase("readback"), \
                 obs_trace.span("sharded.readback", q=len(pooled),
                                mesh=self._mesh_label):
             results = assemble_pooled_results(
-                self._group_outputs(plan, outs), pooled, plan.rb_meta)
+                self._group_outputs(plan, outs), pooled, plan.rb_meta,
+                owner=plan.owner if plan.exprs else None)
+            expr_mod.assemble_section_results(
+                plan.exprs, expr_outs, results,
+                lambda qid: pooled[qid][1].form)
         if inject and faults.should_corrupt(SITE, guard.MESH):
-            from .batch_engine import BatchResult
-
             results[0] = BatchResult(
                 cardinality=results[0].cardinality + 1,
                 bitmap=results[0].bitmap)
@@ -704,7 +809,7 @@ class ShardedBatchEngine:
                 bad = got.bitmap != ref
             if bad:
                 raise errors.ShadowMismatch(
-                    f"sharded query {i} ({q.op} over {q.operands} on set "
+                    f"sharded query {i} ({query_desc(q)} on set "
                     f"{sid}) diverged from the sequential reference: got "
                     f"cardinality {got.cardinality}, want "
                     f"{ref.cardinality}")
@@ -722,9 +827,15 @@ class ShardedBatchEngine:
         cache_dir = rt_warmup.enable_compile_cache()
         t0 = time.perf_counter()
         if pools is None:
-            pools = [[BatchGroup(sid, e._rung_queries(r, ops))
-                      for sid, e in enumerate(self._engines)]
-                     for r in rungs]
+            pools = []
+            for r in rungs:
+                kind, n = expr_mod.parse_warmup_rung(r)
+                pools.append([
+                    BatchGroup(sid,
+                               expr_mod.rung_expressions(n, e.n)
+                               if kind == "expr"
+                               else e._rung_queries(n, ops))
+                    for sid, e in enumerate(self._engines)])
         programs = []
         for pool in pools:
             groups, _ = self._normalize(pool)

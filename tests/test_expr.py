@@ -1,0 +1,465 @@
+"""Expression-DAG query compiler acceptance (ISSUE 8).
+
+Pins:
+- fused expression execution bit-exact against host-side sequential
+  evaluation across (DAG shape x layout x engine rung), including under
+  injected oom/transient faults and on the sequential floor;
+- canonicalization + CSE: associative flatten, idempotent dedupe, xor
+  pairwise cancellation, the and(not) -> andnot rewrite, double-negation
+  elimination, unbounded-complement rejection, and shared subtrees
+  compiling to ONE reduce pseudo-query;
+- the cardinality-only short circuit never materializes the result
+  image (HBM-ledger-pinned, and the footprint model's output bytes
+  shrink) and empty-pruned roots never touch the device;
+- pooled expressions through MultiSetBatchEngine (S > 1) and a 2x2
+  mesh ShardedBatchEngine, with the proactive HBM splitter splitting
+  fused pools under ROARING_TPU_HBM_BUDGET (property test);
+- warmup(rungs=("expr:2",)) pre-compiles the fused programs a matching
+  execute then cache-hits;
+- rb_expr_nodes_fused / rb_expr_launches_saved_total move, and
+  explain() reports per-DAG-node predicted bytes/word-ops;
+- CPU-proxy acceptance (slow lane): fused depth-2/3 expressions >= 2x
+  the node-at-a-time evaluator's QPS, bit-exact.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.insights import analysis as insights
+from roaringbitmap_tpu.obs import memory as obs_memory
+from roaringbitmap_tpu.parallel import (BatchEngine, BatchGroup, BatchQuery,
+                                        DeviceBitmapSet,
+                                        MultiSetBatchEngine)
+from roaringbitmap_tpu.parallel import expr
+from roaringbitmap_tpu.runtime import faults, guard
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+@pytest.fixture(scope="module")
+def bitmaps():
+    rng = np.random.default_rng(0xE54)
+    out = []
+    for i in range(8):
+        vals = [rng.integers(0, 1 << 17, 2000).astype(np.uint32)]
+        if i % 3 == 0:
+            vals.append(np.arange(1 << 16, (1 << 16) + 6000,
+                                  dtype=np.uint32))
+        out.append(RoaringBitmap.from_values(
+            np.unique(np.concatenate(vals))))
+    return out
+
+
+@pytest.fixture(scope="module")
+def engine(bitmaps):
+    return BatchEngine.from_bitmaps(bitmaps, layout="dense")
+
+
+DEPTH2 = expr.and_(expr.or_(0, 1), expr.not_(2))          # (A|B) & ~C
+DEPTH3 = expr.xor(expr.and_(expr.or_(0, 1), expr.or_(2, 3)),
+                  expr.andnot(expr.or_(4, 5), 6))
+
+
+def _want(e, bitmaps):
+    return expr.evaluate_host(e, bitmaps)
+
+
+# ------------------------------------------------------ canonicalize/CSE
+
+def test_canonicalize_flatten_dedupe_sort():
+    e = expr.canonicalize(expr.or_(expr.or_(2, 1), 1, expr.or_(0)))
+    assert isinstance(e, expr.Node) and e.op == "or"
+    assert tuple(c.index for c in e.children) == (0, 1, 2)
+    # single-operand chains collapse to the leaf
+    assert expr.canonicalize(expr.or_(3)) == expr.ref(3)
+    # and dedupes too
+    e = expr.canonicalize(expr.and_(1, 1, 0))
+    assert tuple(c.index for c in e.children) == (0, 1)
+
+
+def test_canonicalize_xor_cancellation():
+    assert expr.canonicalize(expr.xor(expr.ref(1), expr.ref(1))) \
+        is expr.EMPTY
+    e = expr.canonicalize(expr.xor(1, 1, 2))
+    assert e == expr.ref(2)
+
+
+def test_canonicalize_not_rewrites():
+    # and(x, not(y)) -> andnot(x, y)
+    e = expr.canonicalize(DEPTH2)
+    assert isinstance(e, expr.Node) and e.op == "andnot"
+    # double negation
+    assert expr.canonicalize(
+        expr.and_(expr.ref(0), expr.not_(expr.not_(expr.ref(1))))
+    ) == expr.canonicalize(expr.and_(0, 1))
+    # nested andnot absorption: (h - s) - r == h - (s | r)
+    e = expr.canonicalize(expr.andnot(expr.andnot(0, 1), 2))
+    assert e.op == "andnot" and len(e.children) == 3
+    # head in rests prunes to empty
+    assert expr.canonicalize(expr.andnot(expr.ref(0), 1, 0)) \
+        is expr.EMPTY
+
+
+def test_unbounded_complement_rejected():
+    with pytest.raises(ValueError):
+        expr.canonicalize(expr.or_(0, expr.not_(1)))
+    with pytest.raises(ValueError):
+        expr.canonicalize(expr.not_(expr.ref(0)))
+    with pytest.raises(ValueError):
+        expr.canonicalize(expr.and_(expr.not_(0), expr.not_(1)))
+
+
+def test_cse_shared_subtree_compiles_once(engine):
+    sub = expr.or_(0, 1)
+    e = expr.and_(sub, expr.xor(sub, expr.ref(2)))
+    assert expr.dag_stats(e)["cse_saved"] > 0
+    plan = engine.plan([expr.ExprQuery(e)])
+    # the shared or(0,1) reduce registered exactly ONE pseudo-query
+    pseudo = [pid for b in plan for pid in b.qids
+              if plan.owner.get(pid) is None]
+    assert len(pseudo) == 1
+    [sec] = plan.fused
+    assert sum(1 for st in sec.steps if st[0] == "reduce") == 1
+
+
+# ----------------------------------------------------- engine parity
+
+@pytest.mark.parametrize("layout,engines", [
+    ("dense", ("xla", "xla-vmap", "pallas")),
+    ("compact", ("xla", "pallas")),
+    ("counts", ("xla",)),
+])
+def test_fused_parity_vs_host_sequential(bitmaps, layout, engines):
+    """(DAG shape x layout x engine rung) parity: fused expression pools
+    bit-exact against the host sequential evaluator on every rung."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout=layout)
+    pool = ([expr.ExprQuery(DEPTH2, form="bitmap"),
+             expr.ExprQuery(DEPTH3, form="bitmap"),
+             BatchQuery("xor", (1, 4), form="bitmap")]
+            + expr.random_expr_pool(8, 5, depth=2, seed=7, form="bitmap"))
+    want = [(_want(q.expr, bitmaps) if isinstance(q, expr.ExprQuery)
+             else bitmaps[1] ^ bitmaps[4]) for q in pool]
+    for e in engines:
+        got = eng.execute(pool, engine=e, fallback=False)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert g.cardinality == w.cardinality, (layout, e, i)
+            assert g.bitmap == w, (layout, e, i)
+
+
+def test_flat_root_is_a_batch_query(engine, bitmaps):
+    """A single-node expression IS a flat query: identical results, no
+    fused section, same bucket machinery."""
+    q_expr = expr.ExprQuery(expr.or_(1, 2, 3), form="bitmap")
+    q_flat = BatchQuery("or", (1, 2, 3), form="bitmap")
+    plan = engine.plan([q_expr])
+    assert not plan.fused and plan.exprs[0].kind == "flat"
+    [a] = engine.execute([q_expr])
+    [b] = engine.execute([q_flat])
+    assert a.cardinality == b.cardinality and a.bitmap == b.bitmap
+
+
+def test_adhoc_bitmap_leaf(engine, bitmaps):
+    rng = np.random.default_rng(11)
+    ad = RoaringBitmap.from_values(
+        np.unique(rng.integers(0, 1 << 17, 3000).astype(np.uint32)))
+    e = expr.and_(expr.or_(0, 1), expr.bitmap(ad))
+    [got] = engine.execute([expr.ExprQuery(e, form="bitmap")])
+    want = (bitmaps[0] | bitmaps[1]) & ad
+    assert got.bitmap == want
+    # adhoc root short-circuits on the host
+    [r] = engine.execute([expr.ExprQuery(expr.bitmap(ad))])
+    assert r.cardinality == ad.cardinality
+
+
+def test_fused_parity_under_faults(engine, bitmaps):
+    pool = [expr.ExprQuery(DEPTH2, form="bitmap"),
+            expr.ExprQuery(DEPTH3, form="bitmap")]
+    want = [_want(q.expr, bitmaps) for q in pool]
+    with faults.inject("oom=0.4,transient=0.1:0xE1"):
+        got = engine.execute(pool, engine="xla")
+    assert [g.bitmap for g in got] == want
+    with faults.inject("lowering=1.0:0xE2"):    # every device rung dead
+        got = engine.execute(pool, engine="xla")
+    assert [g.bitmap for g in got] == want
+
+
+# ------------------------------------------------------ short circuits
+
+def test_cardinality_only_never_materializes(engine, bitmaps):
+    """Ledger pin: a cardinality-only expression registers no resident
+    bytes, returns no bitmap, and the footprint model's output bytes
+    shrink by the root image vs the bitmap form."""
+    q = expr.ExprQuery(DEPTH2)          # form="cardinality"
+    ledger_before = obs_memory.LEDGER.snapshot()
+    [got] = engine.execute([q])
+    assert obs_memory.LEDGER.snapshot() == ledger_before
+    assert got.bitmap is None
+    assert got.cardinality == _want(DEPTH2, bitmaps).cardinality
+    card_sig = engine.plan([q]).expr_signature
+    bm_sig = engine.plan(
+        [expr.ExprQuery(DEPTH2, form="bitmap")]).expr_signature
+    card_b = insights.predict_expr_dispatch_bytes(card_sig, "xla")
+    bm_b = insights.predict_expr_dispatch_bytes(bm_sig, "xla")
+    k_root = card_sig[0][-1]
+    assert bm_b["output_bytes"] - card_b["output_bytes"] \
+        == k_root * insights.ROW_BYTES
+
+
+def test_empty_pruning_skips_the_device(engine):
+    """xor(x, x) and disjoint-AND roots prune at plan time: correct
+    empty results with zero compiled programs."""
+    lo = RoaringBitmap.from_values(np.arange(100, dtype=np.uint32))
+    hi = RoaringBitmap.from_values(
+        np.arange(1 << 20, (1 << 20) + 100, dtype=np.uint32))
+    eng = BatchEngine.from_bitmaps([lo, hi], layout="dense")
+    n_programs = len(eng._programs)
+    got = eng.execute([
+        expr.ExprQuery(expr.xor(expr.ref(0), expr.ref(0)), form="bitmap"),
+        expr.ExprQuery(expr.and_(0, 1), form="bitmap"),
+    ])
+    assert [r.cardinality for r in got] == [0, 0]
+    assert got[0].bitmap == RoaringBitmap()
+    assert len(eng._programs) == n_programs   # nothing compiled
+
+
+# ------------------------------------------------- explain + budget
+
+def test_explain_reports_per_dag_node_costs(engine):
+    rep = engine.explain([expr.ExprQuery(DEPTH3, form="bitmap"),
+                          BatchQuery("or", (0, 1))])
+    [erow] = rep["exprs"]
+    assert erow["nodes"] >= 3 and erow["combine_nodes"] >= 1
+    assert erow["predicted_bytes"] > 0 and erow["est_word_ops"] > 0
+    kinds = {r["kind"] for r in erow["per_node"]}
+    assert "combine" in kinds
+    assert all(r["est_bytes"] >= 0 and r["est_word_ops"] >= 0
+               for r in erow["per_node"])
+    assert rep["predicted"]["expr_bytes"] > 0
+    assert rep["queries"][0]["op"] == "expr"
+    assert rep["queries"][1]["op"] == "or"
+
+
+def test_budget_splits_fused_batches(bitmaps, tmp_path):
+    """Property: under ROARING_TPU_HBM_BUDGET the proactive splitter
+    halves fused expression batches BEFORE dispatch, every dispatched
+    launch's prediction fits the budget, bit-exact."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    pool = expr.random_expr_pool(8, 12, depth=2, seed=23, form="bitmap")
+    want = [_want(q.expr, bitmaps) for q in pool]
+    full = eng.predict_dispatch_bytes(pool)
+    budget = max(1, full // 3)
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    got = eng.execute(pool, engine="xla",
+                      policy=guard.GuardPolicy(hbm_budget=budget))
+    obs.disable()
+    assert [g.bitmap for g in got] == want
+    assert eng.proactive_split_count > 0
+    spans = [json.loads(line) for line in open(path)]
+    mems = [ev for s in spans if s["name"] == "batch.dispatch"
+            for ev in s["events"] if ev["name"] == "batch.memory"]
+    assert mems and all(ev["predicted_bytes"] <= budget for ev in mems)
+
+
+# ---------------------------------------------------- pooled engines
+
+@pytest.fixture(scope="module")
+def tenants():
+    rng = np.random.default_rng(0xE55)
+    return [[RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 17, 1500).astype(np.uint32)))
+        for _ in range(6)] for _ in range(3)]
+
+
+def _expr_pool(form="bitmap"):
+    return [BatchGroup(sid, [
+        expr.ExprQuery(DEPTH2, form=form),
+        BatchQuery("xor", (1, 3), form=form),
+        expr.ExprQuery(expr.xor(expr.or_(2, 3), expr.and_(4, 5)),
+                       form=form)]) for sid in range(3)]
+
+
+def _assert_pool_parity(got, tenants, tag):
+    for sid, rows in enumerate(got):
+        srcs = tenants[sid]
+        assert rows[0].bitmap == _want(DEPTH2, srcs), (tag, sid, 0)
+        assert rows[1].bitmap == (srcs[1] ^ srcs[3]), (tag, sid, 1)
+        assert rows[2].bitmap == _want(
+            expr.xor(expr.or_(2, 3), expr.and_(4, 5)), srcs), (tag, sid, 2)
+
+
+def test_multiset_pooled_expressions(tenants):
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    pool = _expr_pool()
+    for e in ("xla", "xla-vmap", "pallas"):
+        _assert_pool_parity(eng.execute(pool, engine=e), tenants, e)
+    with faults.inject("lowering=1.0:0xE3"):
+        _assert_pool_parity(eng.execute(pool, engine="xla"), tenants,
+                            "floor")
+
+
+def test_multiset_budget_splits_fused_pools(tenants, tmp_path):
+    """The acceptance property one level up: the pooled proactive HBM
+    splitter splits fused expression POOLS under the budget, bit-exact,
+    every dispatched launch within budget."""
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    pool = _expr_pool()
+    full = eng.predict_dispatch_bytes(pool)
+    budget = max(1, full // 3)
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    got = eng.execute(pool, engine="xla",
+                      policy=guard.GuardPolicy(hbm_budget=budget))
+    obs.disable()
+    _assert_pool_parity(got, tenants, "budget")
+    assert eng.proactive_split_count > 0
+    spans = [json.loads(line) for line in open(path)]
+    mems = [ev for s in spans if s["name"] == "multiset.dispatch"
+            for ev in s["events"] if ev["name"] == "multiset.memory"]
+    assert mems and all(ev["predicted_bytes"] <= budget for ev in mems)
+
+
+def test_sharded_mesh_expressions(tenants):
+    import jax
+    from jax.sharding import Mesh
+
+    from roaringbitmap_tpu.parallel import ShardedBatchEngine
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("rows", "data"))
+    ms = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    sh = ShardedBatchEngine(ms._engines, mesh=mesh)
+    pool = _expr_pool()
+    _assert_pool_parity(sh.execute(pool), tenants, "mesh")
+    # mesh -> single demotion stays bit-exact for fused pools
+    with faults.inject("lowering@mesh=1.0:0xE4"):
+        _assert_pool_parity(sh.execute(pool), tenants, "demoted")
+
+
+# ------------------------------------------------- warmup + metrics
+
+def test_warmup_expr_rungs_precompile(bitmaps):
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    rep = eng.warmup(rungs=("expr:2",))
+    assert rep["programs"]
+    hits0 = eng._programs.stats()["hits"]
+    n0 = len(eng._programs)
+    got = eng.execute(expr.rung_expressions(2, eng.n), engine="auto")
+    assert len(got) == len(expr.rung_expressions(2, eng.n))
+    assert len(eng._programs) == n0          # nothing new compiled
+    assert eng._programs.stats()["hits"] > hits0
+
+
+def test_fused_metrics_move(engine, bitmaps):
+    obs.reset()
+    pool = [expr.ExprQuery(DEPTH2), expr.ExprQuery(DEPTH3)]
+    engine.execute(pool, engine="xla")
+    snap = obs.snapshot()
+    fused = snap["counters"]["rb_expr_nodes_fused"][0]["value"]
+    saved = snap["counters"]["rb_expr_launches_saved_total"][0]["value"]
+    assert fused >= 4            # both DAGs' op nodes rode one launch
+    assert saved > 0
+
+
+def test_device_bitmapset_evaluate(bitmaps):
+    ds = DeviceBitmapSet(bitmaps, layout="dense")
+    want = _want(DEPTH2, bitmaps)
+    assert ds.evaluate(DEPTH2) == want.cardinality
+    assert ds.evaluate(DEPTH2, form="bitmap") == want
+
+
+def test_deep_shared_dag_planning_is_polynomial():
+    """A deeply CSE-shared dag has exponential TREE size by
+    construction; canonicalize/dag_stats/compile must stay O(dag)
+    (per-node hash/sort-key caching + interning), not walk the tree —
+    the regression that once made a depth-24 shared expression hang the
+    planner."""
+    import time
+
+    a, b = expr.ref(0), expr.ref(1)
+    for i in range(40):
+        a, b = expr.or_(a, expr.and_(b, expr.ref(2 + i % 3))), \
+            expr.xor(a, b)
+    t0 = time.perf_counter()
+    stats = expr.dag_stats(expr.xor(a, b))
+    wall = time.perf_counter() - t0
+    assert stats["cse_saved"] > 0 and stats["tree_nodes"] > stats["nodes"]
+    assert wall < 5.0, f"shared-dag stats took {wall:.1f}s"
+
+
+def test_node_at_a_time_bare_leaf_root_never_aliases(engine, bitmaps):
+    """The unfused evaluator must clone bare-leaf roots: mutating its
+    result must not corrupt the engine's host-source (shadow-reference)
+    cache."""
+    [r] = expr.execute_node_at_a_time(
+        engine, [expr.ExprQuery(expr.ref(0), form="bitmap")])
+    before = engine._host_sources()[0].cardinality
+    r.bitmap.ior(RoaringBitmap.from_values(
+        np.array([1, 2, 3], np.uint32)))
+    assert engine._host_sources()[0].cardinality == before
+
+
+def test_adhoc_snapshot_survives_mutation(engine, bitmaps):
+    """AdHoc leaves snapshot at construction: mutating the source after
+    building the query must not change a cached plan's answer (nor the
+    host reference it is checked against)."""
+    ad = RoaringBitmap.from_values(np.array([1, 70000], np.uint32))
+    q = expr.ExprQuery(expr.and_(expr.or_(0, 1), expr.bitmap(ad)),
+                       form="bitmap")
+    [r1] = engine.execute([q])
+    ad.add(5)
+    [r2] = engine.execute([q])
+    assert r1.bitmap == r2.bitmap == expr.evaluate_host(q.expr, bitmaps)
+
+
+def test_node_at_a_time_reference_parity(engine, bitmaps):
+    pool = [expr.ExprQuery(DEPTH2, form="bitmap"),
+            expr.ExprQuery(DEPTH3, form="bitmap")]
+    fused = engine.execute(pool)
+    unfused = expr.execute_node_at_a_time(engine, pool)
+    for f, u in zip(fused, unfused):
+        assert f.cardinality == u.cardinality and f.bitmap == u.bitmap
+
+
+# ---------------------------------------------------- CPU-proxy perf
+
+def _timed(fn):
+    import time
+
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+@pytest.mark.slow
+def test_fused_2x_vs_node_at_a_time():
+    """Acceptance: fused depth-2/3 expressions >= 2x the node-at-a-time
+    QPS on the CPU proxy (one launch vs one launch per reduce node),
+    bit-exact."""
+    rng = np.random.default_rng(0xE56)
+    bms = [RoaringBitmap.from_values(
+        rng.integers(0, 1 << 16, 400).astype(np.uint32))
+        for _ in range(8)]
+    eng = BatchEngine.from_bitmaps(bms, layout="dense")
+    pool = (expr.random_expr_pool(8, 8, depth=2, seed=31)
+            + expr.random_expr_pool(8, 8, depth=3, seed=32))
+    fused = eng.execute(pool, engine="xla")
+    unfused = expr.execute_node_at_a_time(eng, pool)
+    assert [f.cardinality for f in fused] == \
+        [u.cardinality for u in unfused]
+    t_fused = min(_timed(lambda: eng.execute(pool, engine="xla"))
+                  for _ in range(5))
+    t_node = min(_timed(lambda: expr.execute_node_at_a_time(eng, pool))
+                 for _ in range(5))
+    assert t_node >= 2.0 * t_fused, (t_node, t_fused, t_node / t_fused)

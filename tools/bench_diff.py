@@ -57,8 +57,13 @@ import sys
 #: is perfect, so lower is better) and ``warm_restart_x`` (warm
 #: first-query over steady marginal — the cold-path ratio ROADMAP item 3
 #: drives down).
+#: The expression lane (bench.py expression_phase, ISSUE 8) adds
+#: ``expression.d{D}_q{Q}.{fused,node}_qps`` (via ``qps``),
+#: ``fused_vs_node_x`` (the fusion headline, explicit via ``fused_vs``)
+#: and its ``launches_saved`` counts (explicit).
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
-          "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs")
+          "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
+          "fused_vs")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
          "shard_balance", "warm_restart")
 #: checked before HIGHER/LOWER: lanes whose good direction is genuinely

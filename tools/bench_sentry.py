@@ -48,6 +48,13 @@ same virtual mesh the test suite runs on.  The sharded bench lanes the
 smoke guards (``sharded.m{R}x1_q{Q}.pooled_qps``, ``shard_balance``,
 ``warm_restart_x``) feed the sentry's direction table through
 bench_diff's lane vocabulary.
+
+``--smoke-expr`` (ISSUE 8) prepends the fused-expression bit-exactness
+smoke: a depth-2/3 expression pool executed FUSED (the expression-DAG
+compiler, one launch) must match the host-side sequential evaluator
+exactly, clean and through a forced pallas demotion — pinning the
+``expression.d{D}_q{Q}.fused_qps`` / ``fused_vs_node_x`` bench lanes'
+correctness before their trend is gated.
 """
 
 from __future__ import annotations
@@ -281,6 +288,44 @@ def sharded_smoke() -> int:
     return 1 if mismatches else 0
 
 
+def expr_smoke() -> int:
+    """Fused-expression bit-exactness smoke (ISSUE 8): a depth-2/3
+    expression pool executed fused (one launch) must match the
+    host-side sequential evaluator exactly — clean AND through a forced
+    pallas demotion.  Returns 0 on parity, 1 on divergence."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.parallel import BatchEngine
+    from roaringbitmap_tpu.parallel import expr
+    from roaringbitmap_tpu.runtime import faults
+
+    rng = np.random.default_rng(0xE5A)
+    bms = [RoaringBitmap.from_values(
+        np.unique(rng.integers(0, 1 << 17, 1500).astype(np.uint32)))
+        for _ in range(8)]
+    eng = BatchEngine.from_bitmaps(bms, layout="dense")
+    pool = (expr.random_expr_pool(8, 6, depth=2, seed=41, form="bitmap")
+            + expr.random_expr_pool(8, 6, depth=3, seed=42,
+                                    form="bitmap"))
+    want = [expr.evaluate_host(q.expr, bms) for q in pool]
+    cells, mismatches = [], 0
+    got = eng.execute(pool, engine="xla")
+    ok = all(g.cardinality == w.cardinality and g.bitmap == w
+             for g, w in zip(got, want))
+    cells.append({"case": "fused", "ok": ok})
+    mismatches += not ok
+    with faults.inject("lowering@pallas=1.0:43"):
+        got = eng.execute(pool, engine="pallas")
+    ok = all(g.cardinality == w.cardinality and g.bitmap == w
+             for g, w in zip(got, want))
+    cells.append({"case": "fused-demoted", "ok": ok})
+    mismatches += not ok
+    print(json.dumps({"smoke_expr": cells, "ok": mismatches == 0}))
+    return 1 if mismatches else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -309,10 +354,18 @@ def main() -> int:
     ap.add_argument("--smoke-sharded", action="store_true",
                     help="first run the mesh-sharded parity smoke "
                          "(needs >= 4 devices; exit 1 on divergence)")
+    ap.add_argument("--smoke-expr", action="store_true",
+                    help="first run the fused-expression bit-exactness "
+                         "smoke vs host sequential evaluation (exit 1 "
+                         "on divergence)")
     args = ap.parse_args()
 
     if args.smoke_sharded:
         rc = sharded_smoke()
+        if rc:
+            return rc
+    if args.smoke_expr:
+        rc = expr_smoke()
         if rc:
             return rc
 

@@ -42,6 +42,14 @@ and a wide aggregation — then (2) validates every emitted line:
   ``ROARING_TPU_SLO_MS`` produced an ``slo`` event whose ``phases_ms``
   breakdown sums to within 5% of its ``wall_ms``.  On arbitrary dumps
   these event schemas are validated wherever the events appear.
+- expression semantics (ISSUE 8): the --workload run drives a fused
+  3-node expression pool (including one forced pallas->xla demotion,
+  bit-exact) — ``expr.compile`` spans must appear with numeric
+  ``nodes`` / ``depth`` tags (``reduce_nodes`` / ``combine_nodes`` on
+  fused compilations) and the run must have credited
+  ``rb_expr_launches_saved_total``.  On arbitrary dumps the
+  ``expr.compile`` tag schema is validated wherever the span appears
+  (presence is a --workload-only demand, the PR 5 convention);
 - mesh-sharded semantics (ISSUE 7): the --workload run drives a 2x2
   dry-run mesh dispatch (the workload forces an 8-device CPU host
   platform for exactly this) — the ``sharded.*`` span vocabulary must
@@ -143,6 +151,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _multiset_semantics([s for _, s in spans])
         errors += _cost_slo_semantics([s for _, s in spans])
         errors += _sharded_semantics([s for _, s in spans])
+        errors += _expr_semantics([s for _, s in spans])
     return errors
 
 
@@ -219,6 +228,43 @@ def _workload_semantics(spans: list[dict],
                                   require_miss=budget_semantics)
     errors += _sharded_semantics(spans, require=budget_semantics,
                                  complete=True)
+    errors += _expr_semantics(spans, require=budget_semantics)
+    return errors
+
+
+def _expr_semantics(spans: list[dict], require: bool = False) -> list[str]:
+    """The expression compiler's span vocabulary (parallel.expr,
+    docs/EXPRESSIONS.md).  Arbitrary dumps validate the ``expr.compile``
+    tag schema wherever the span appears; ``require`` (the --workload
+    run, which drives a fused 3-node expression) demands at least one
+    fused compilation."""
+    errors: list[str] = []
+    compiles = [s for s in spans if s.get("name") == "expr.compile"]
+    for s in compiles:
+        tags = s.get("tags") or {}
+        if not isinstance(tags.get("nodes"), int) or tags["nodes"] < 1:
+            errors.append(f"expr.compile span without a positive nodes "
+                          f"tag: {tags!r}")
+        if not isinstance(tags.get("depth"), int) or tags["depth"] < 0:
+            errors.append(f"expr.compile span without a numeric depth "
+                          f"tag: {tags!r}")
+        if tags.get("kind") == "fused":
+            for field in ("reduce_nodes", "combine_nodes"):
+                if not isinstance(tags.get(field), int) \
+                        or tags[field] < 0:
+                    errors.append(
+                        f"fused expr.compile span without a numeric "
+                        f"{field} tag: {tags!r}")
+    if require:
+        if not compiles:
+            errors.append("no expr.compile span — the expression "
+                          "workload was not traced")
+        elif not any((s.get("tags") or {}).get("kind") == "fused"
+                     for s in compiles):
+            errors.append(
+                "no fused expr.compile span — the 3-node expression "
+                f"did not fuse (saw kinds: "
+                f"{[(s.get('tags') or {}).get('kind') for s in compiles]!r})")
     return errors
 
 
@@ -476,6 +522,32 @@ def run_workload(path: str) -> None:
         assert missed == clean, "SLO-missing batch diverged (accounting "\
             "must never change results)"
         aggregation.or_(*bms[:8])
+
+        # expression lane (ISSUE 8): a fused 3-node DAG — (A|B) & ~C —
+        # clean, then under a forced pallas demotion, bit-exact; the
+        # expr.compile spans + launches-saved credit are what the
+        # semantics checks above pin
+        from roaringbitmap_tpu import obs as _obs
+        from roaringbitmap_tpu.parallel import expr
+
+        e3 = expr.and_(expr.or_(0, 1), expr.not_(2))
+        expr_pool = [expr.ExprQuery(e3, form="bitmap"),
+                     expr.ExprQuery(expr.xor(expr.or_(3, 4),
+                                             expr.and_(5, 6)))]
+        expr_clean = [r.cardinality for r in eng.execute(expr_pool)]
+        with faults.inject("lowering@pallas=1.0:9"):
+            expr_demoted = [r.cardinality
+                            for r in eng.execute(expr_pool,
+                                                 engine="pallas")]
+        assert expr_demoted == expr_clean, \
+            "demoted fused expression diverged from clean run"
+        host = expr.evaluate_host(e3, bms)
+        assert expr_clean[0] == host.cardinality, \
+            "fused expression diverged from host sequential evaluation"
+        saved = _obs.snapshot()["counters"].get(
+            "rb_expr_launches_saved_total", [])
+        assert sum(r["value"] for r in saved) > 0, \
+            "fused expressions credited no saved launches"
 
         # pooled cross-tenant lane: 3 tenants, one pooled launch
         # (multiset.* spans), then a tiny budget forcing a POOL split

@@ -83,6 +83,9 @@ SHARDED_MESH_ROWS = (1, 2, 4, 8)  # sharded lane mesh row-axis sweep (ISSUE 7)
 SHARDED_Q = (8, 64)               # pooled query counts per sharded cell
 EXPR_DEPTHS = (2, 3)            # expression lane DAG depths (ISSUE 8)
 EXPR_Q = (8, 64)                # expression pool sizes per cell
+SERVING_RATES = (0.5, 2.0, 4.0)  # serving lane arrival-rate multiples of
+#                                  the measured sustainable rate (ISSUE 10)
+SERVING_N = 400                  # arrivals per sweep cell
 
 
 def load_cpu_baseline(dataset: str) -> tuple[float | None, dict]:
@@ -550,6 +553,134 @@ def expression_phase() -> dict:
     return out
 
 
+def serving_phase() -> dict:
+    """Sustained-throughput serving lane (ISSUE 10): a timed arrival
+    stream replayed through the continuous-batching ``ServingLoop`` at
+    SERVING_RATES multiples of the measured sustainable rate — per-cell
+    p50/p99 request latency, SLO attainment of the served (non-shed)
+    queries, and the shed rate.  The 4x cell runs twice: shedding ON
+    (the graceful-degradation claim: survivors stay inside their SLO)
+    and shedding OFF (the control: attainment collapses, proving the
+    ladder earns its keep rather than overload merely being bad).
+    Served results are parity-sampled against the per-set sequential
+    reference every cell.  Arrival gaps ride the fault clock, so the
+    sweep costs execute time, not wall-clock idle."""
+    import numpy as np
+
+    from roaringbitmap_tpu.parallel import BatchQuery, MultiSetBatchEngine
+    from roaringbitmap_tpu.runtime import faults, guard
+    from roaringbitmap_tpu.serving import (ServingLoop, ServingPolicy,
+                                           ServingRequest)
+    from roaringbitmap_tpu.utils import datasets
+
+    s, per_tenant, pool_target = 4, 8, 16
+    tenants = [datasets.synthetic_bitmaps(
+        per_tenant, seed=70 + i, universe=1 << 16, density=0.006)
+        for i in range(s)]
+    engine = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    nosleep = guard.GuardPolicy(backoff_base=0.0, sleep=lambda _s: None)
+
+    # bounded shape vocabulary — the prepared-statement serving pattern:
+    # real front-ends reissue a finite query-template set, and the plan/
+    # program caches (plus warmup) exist for exactly that; fully random
+    # operand subsets would instead measure one compile per pool
+    shapes = [("or", (0, 1, 2)), ("and", (1, 2, 3)), ("xor", (0, 2, 4)),
+              ("andnot", (0, 1, 3)), ("or", (3, 4)), ("and", (0, 5))]
+
+    def requests(n, seed):
+        rng = np.random.default_rng(seed)
+        return [ServingRequest(
+            int(rng.integers(s)),
+            BatchQuery(*shapes[int(rng.integers(len(shapes)))]),
+            tenant=f"t{int(rng.integers(s))}")
+            for _ in range(n)]
+
+    def fresh_loop(**kw) -> ServingLoop:
+        kw.setdefault("pool_target", pool_target)
+        kw.setdefault("guard", nosleep)
+        kw.setdefault("max_queue", 4096)
+        return ServingLoop(engine, ServingPolicy(**kw))
+
+    # warm the shape vocabulary at BOTH pool targets (the overload
+    # ladder halves the target, which is a distinct program shape —
+    # compiling it mid-incident would be the cold path the warmup story
+    # exists to kill), then calibrate the SUSTAINABLE rate through the
+    # loop itself (admission + assembly + dispatch + SLO accounting
+    # included — engine-only probes undercount the path)
+    for tgt in (pool_target, max(1, pool_target // 2)):
+        w = fresh_loop(pool_target=tgt, default_deadline_ms=600_000.0)
+        # representative-traffic warm (what a production boot replays):
+        # pool PROGRAMS key on per-set referenced-row counts, so only
+        # traffic-shaped pools cover the signatures the sweep will hit
+        w.replay((0.0, r) for r in requests(SERVING_N, 300 + tgt))
+    warm = fresh_loop(default_deadline_ms=600_000.0)
+    n_cal = pool_target * 8
+    t0 = faults.clock()
+    warm.replay((0.0, r) for r in requests(n_cal, 2))
+    t_per_q = (faults.clock() - t0) / n_cal
+    sustainable_qps = 1.0 / t_per_q
+    # deadline: several pool-times of headroom — roomy at <= 1x load,
+    # unmeetable for stale arrivals under sustained overload
+    deadline_ms = max(20.0, 8 * pool_target * t_per_q * 1e3)
+
+    def sweep(rate: float, shed: bool, seed: int) -> dict:
+        # slack_x 3: the shed rule judges against predicted execute
+        # time, and CPU-proxy pool walls swing ~2x with scheduling —
+        # survivors must clear their SLO with margin, not sit on its edge
+        loop = fresh_loop(default_deadline_ms=deadline_ms, shed=shed,
+                          slack_x=3.0)
+        gap = 1.0 / (sustainable_qps * rate)
+        reqs = requests(SERVING_N, seed)
+        t0 = faults.clock()
+        tickets = loop.replay((i * gap, r) for i, r in enumerate(reqs))
+        span_s = faults.clock() - t0
+        served = [t for t in tickets if t.ok]
+        # parity sample: served answers vs the sequential reference
+        for t in served[:: max(1, len(served) // 24)]:
+            ref = engine._engines[t.request.set_id]._sequential_one(
+                t.query)
+            assert t.result.cardinality == ref.cardinality, \
+                f"serving parity failure at rate {rate}x"
+        walls = sorted(t.wall_ms for t in served)
+        attained = sum(1 for t in served if not t.missed)
+        n = len(tickets)
+        return {
+            "arrival_x": rate, "shed_enabled": shed,
+            "served": len(served),
+            "shed_rate": round(
+                sum(t.status == "shed" for t in tickets) / n, 4),
+            "rejected": sum(t.status == "rejected" for t in tickets),
+            "served_qps": round(len(served) / max(span_s, 1e-9), 1),
+            "p50_ms": round(walls[len(walls) // 2], 3) if walls else None,
+            "p99_ms": round(walls[int(len(walls) * 0.99)], 3)
+            if walls else None,
+            "slo_attainment": round(attained / max(1, len(served)), 4),
+            "degrade_level_peak": loop.level_peak,
+        }
+
+    out: dict = {
+        "tenants": s, "pool_target": pool_target,
+        "sustainable_qps": round(sustainable_qps, 1),
+        "deadline_ms": round(deadline_ms, 3),
+    }
+    for i, rate in enumerate(SERVING_RATES):
+        key = f"x{rate:g}".replace(".", "_")
+        out[key] = sweep(rate, shed=True, seed=100 + i)
+    # the control arm runs at the SAME rate as the overload headline —
+    # the collapse proof must be apples-to-apples
+    top = SERVING_RATES[-1]
+    ctrl_key = f"x{top:g}_noshed".replace(".", "_")
+    out[ctrl_key] = sweep(top, shed=False, seed=200)
+    over, ctrl = out[f"x{top:g}".replace(".", "_")], out[ctrl_key]
+    out["headline"] = {
+        "overload_attainment": over["slo_attainment"],
+        "noshed_attainment": ctrl["slo_attainment"],
+        "meets_90": over["slo_attainment"] >= 0.90,
+        "shed_rate": over["shed_rate"],
+    }
+    return out
+
+
 def _dryrun_env(n_devices: int = 8) -> dict:
     """A CPU dry-run environment for subprocess cells: forced host
     platform device count, TPU plugin never initialised (the
@@ -726,10 +857,10 @@ SUMMARY_MAX_BYTES = 2048
 #: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
 #: they are trend inputs for the sentry, not driver-gate fields, and the
 #: full doc always keeps them
-SUMMARY_DROP_ORDER = ("phase_ms", "cost", "sharded", "expression",
-                      "marginal_us_spread", "multiset", "batched_qps",
-                      "marginal_us_median", "unit", "backend",
-                      "north_star")
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "serving", "sharded",
+                      "expression", "marginal_us_spread", "multiset",
+                      "batched_qps", "marginal_us_median", "unit",
+                      "backend", "north_star")
 
 
 def summary_line(out: dict, full_path: str,
@@ -829,6 +960,19 @@ def build_summary(out: dict, full_path: str) -> dict:
                              row["launches_saved"]]
     if ex_lanes:
         s["expression"] = ex_lanes
+    # serving lane, compact: [p50_ms, p99_ms, slo_attainment, shed_rate]
+    # per arrival-rate cell + the overload-vs-control attainment headline
+    sv = out.get("serving") or {}
+    sv_lanes = {}
+    for key, row in sv.items():
+        if isinstance(row, dict) and "slo_attainment" in row:
+            sv_lanes[key] = [row.get("p50_ms"), row.get("p99_ms"),
+                             row["slo_attainment"], row["shed_rate"]]
+    if sv_lanes:
+        head = sv.get("headline") or {}
+        sv_lanes["overload_attainment"] = head.get("overload_attainment")
+        sv_lanes["noshed_attainment"] = head.get("noshed_attainment")
+        s["serving"] = sv_lanes
     # sharded lane, compact: [pooled_qps, shard_balance] per (mesh, Q)
     # cell + the mesh-vs-single headline ratio and the warm-restart
     # cold-path ratio (full cell detail stays in the full doc)
@@ -1003,6 +1147,7 @@ def main() -> None:
         results[name]["batched"] = batched[results[name]["dataset"]]
     multiset = multiset_phase()
     expression = expression_phase()
+    serving = serving_phase()
     sharded = sharded_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
@@ -1057,6 +1202,7 @@ def main() -> None:
     out["batched_by_dataset"] = batched
     out["multiset"] = multiset
     out["expression"] = expression
+    out["serving"] = serving
     out["sharded"] = sharded
 
     # full document to disk; stdout gets ONLY the compact summary as its

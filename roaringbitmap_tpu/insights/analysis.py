@@ -430,6 +430,26 @@ def predict_multiset_dispatch_bytes(bucket_sigs: list, sets: list,
     return out
 
 
+def predict_multiset_dispatch_word_ops(bucket_sigs: list, sets: list,
+                                       engine: str,
+                                       pool_rows: int | None = None) -> int:
+    """Word-op count of ONE pooled MultiSetBatchEngine launch — the
+    flops-proxy twin of :func:`predict_multiset_dispatch_bytes`, feeding
+    ``obs.cost.estimate_seconds`` so a serving front-end can budget a
+    pool's execute time BEFORE dispatching it (deadline-aware pool
+    assembly, docs/SERVING.md).  On top of the single-set bucket model:
+    one write per rebuilt row word for every "streams"-resident tenant's
+    in-program densify, plus one pass over the compacted pooled image
+    (the per-set selection + concat the flat gather reads from)."""
+    words = 2048
+    total = predict_batch_dispatch_word_ops(bucket_sigs, "dense", 0, engine)
+    total += sum((int(n) + 1) * words
+                 for kind, n in sets if kind == "streams")
+    if pool_rows:
+        total += int(pool_rows) * words
+    return int(total)
+
+
 def predict_sharded_dispatch_bytes(bucket_sigs: list, pool_rows: int,
                                    mesh_devices: int,
                                    mesh_rows: int | None = None,

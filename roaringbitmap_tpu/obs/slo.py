@@ -248,6 +248,21 @@ def note_engine(engine: str) -> None:
         ctx.engine = engine
 
 
+def count_outcome(site: str, missed: bool,
+                  tenant: str | None = None) -> None:
+    """One SLO outcome outside a query context — the serving loop's
+    per-REQUEST accounting (a pooled dispatch serves many requests with
+    different deadlines, so the per-context counting above cannot
+    attribute them individually).  Same counter names, optionally
+    per-tenant labeled: ``rb_slo_attained_total`` /
+    ``rb_slo_missed_total{site[,tenant]}``."""
+    labels = {"site": site}
+    if tenant is not None:
+        labels["tenant"] = tenant
+    name = "rb_slo_missed_total" if missed else "rb_slo_attained_total"
+    _metrics.counter(name, **labels).inc()
+
+
 def set_attribution(on: bool) -> None:
     """Force phase attribution on/off independent of any deadline — the
     bench lanes use this to capture a per-phase breakdown without

@@ -32,12 +32,16 @@ set.  The planner:
    stream-resident tenants) + concat + the flat segmented reduce
    (``batch_engine.bucket_body``).
 
-Pipelined (double-buffered) dispatch
-------------------------------------
+Pipelined (depth-N) dispatch
+----------------------------
 When a pool needs multiple launches — the proactive HBM-budget split, or
 ``execute_pipelined`` streaming several ticks — launches flow through a
-depth-``GuardPolicy.pipeline_depth`` (default 2) window: launch k+1 is
-planned/packed/bucketized on the host *while launch k runs on device*
+depth-``GuardPolicy.pipeline_depth`` window (depth 1 = strictly serial,
+2 = the classic double buffer, N keeps up to N-1 launches in flight
+while the N-th is planned — deeper windows keep the device busy across
+burstier host-side planning, at N-1 launches of extra transient HBM):
+launch k+1 is planned/packed/bucketized on the host *while launch k
+runs on device*
 (JAX async dispatch — nothing blocks until readback), and launch k-1's
 readback is drained as the window slides.  Host planning time spent
 while at least one launch was in flight is **hidden** behind device
@@ -640,6 +644,32 @@ class MultiSetBatchEngine:
         eng = self._pool_engine(plan, engine)
         return self._predict(plan, eng)["peak_bytes"]
 
+    def predict_dispatch_seconds(self, pooled_or_groups,
+                                 engine: str = "auto") -> float:
+        """Pre-dispatch execute-time estimate of ONE pooled launch: the
+        unified footprint model's bytes + the pooled word-op count
+        (``insights.predict_multiset_dispatch_word_ops``) through
+        ``obs.cost.estimate_seconds`` — at the peak-table ceilings until
+        dispatches at (multiset, engine) calibrate the achieved rates.
+        The quantity the serving loop's deadline-aware pool assembly
+        budgets against BEFORE dispatching (docs/SERVING.md): every
+        admitted pool shape is an AOT-analyzable program, so the
+        admission controller can reason about it up front."""
+        pooled = self._as_pooled(pooled_or_groups)
+        if not pooled:
+            return 0.0
+        plan = self._plan_pool(pooled)
+        eng = self._pool_engine(plan, engine)
+        pred = self._predict(plan, eng)
+        word_ops = insights.predict_multiset_dispatch_word_ops(
+            [b.signature for b in plan.buckets], self._plan_sets(plan),
+            eng, pool_rows=plan.n_pool_rows)
+        if plan.exprs:
+            word_ops += insights.predict_expr_word_ops(
+                plan.expr_signature, eng)
+        return obs_cost.estimate_seconds(word_ops, pred["peak_bytes"],
+                                         SITE, eng)
+
     def _as_pooled(self, pooled_or_groups):
         seq = list(pooled_or_groups)
         if seq and isinstance(seq[0], (BatchGroup, tuple)) \
@@ -649,12 +679,16 @@ class MultiSetBatchEngine:
             return self._flatten(seq)[0]
         return tuple(seq)
 
-    def _predict(self, plan: _PoolPlan, eng: str) -> dict:
-        sets = [(self._engines[s]._resident_src()[1],
+    def _plan_sets(self, plan: _PoolPlan) -> list:
+        """``[(resident kind, n_rows)]`` for every set a plan touches —
+        the shared input of the bytes and word-ops footprint models."""
+        return [(self._engines[s]._resident_src()[1],
                  self._engines[s]._ds._n_rows) for s in plan.sids]
+
+    def _predict(self, plan: _PoolPlan, eng: str) -> dict:
         out = insights.predict_multiset_dispatch_bytes(
-            [b.signature for b in plan.buckets], sets, eng,
-            pool_rows=plan.n_pool_rows)
+            [b.signature for b in plan.buckets], self._plan_sets(plan),
+            eng, pool_rows=plan.n_pool_rows)
         if plan.exprs:
             e = insights.predict_expr_dispatch_bytes(
                 plan.expr_signature, eng)
@@ -936,6 +970,12 @@ class MultiSetBatchEngine:
                 res = payload
             else:
                 try:
+                    # the drain-time fault seam: a deferred device fault
+                    # surfaces here, after the dispatching slot already
+                    # returned — injected at its own scope so the re-run
+                    # semantics are testable at any pipeline depth
+                    if payload.inject:
+                        faults.maybe_fail(f"{SITE}.drain", payload.eng)
                     res = self._readback(payload.plan, payload.outs,
                                          payload.queries, payload.eng,
                                          payload.inject)

@@ -13,7 +13,9 @@ kind   one of ``transient`` (retryable device hiccup), ``oom`` (device
        allocator failure), ``lowering`` (compiler rejection), ``corrupt``
        (corrupt serialized input), ``coordinator`` (distributed barrier
        timeout), ``silent`` (result corrupted WITHOUT an exception — only
-       the shadow cross-check can catch it).
+       the shadow cross-check can catch it), ``slow`` (injected latency
+       before a dispatch: no exception, the **fault clock** below jumps
+       forward by SLOW_LATENCY_S — deadlines expire, nothing sleeps).
 scope  optional dispatch-site name ("batch_engine", "aggregation",
        "sharding", "multihost") or engine rung ("pallas", "xla",
        "xla-vmap", "sharded", "coordinator"); omitted = everywhere.
@@ -31,6 +33,18 @@ property the CI fault shard and failure repros rely on.  Injected
 exceptions deliberately take the RAW shapes real faults arrive in (status-
 string RuntimeErrors, NotImplementedError) so errors.classify is exercised
 end to end, not bypassed.
+
+The fault clock
+---------------
+``clock()`` is virtual-time monotonic: real ``time.monotonic()`` plus an
+injected offset.  A firing ``slow`` rule (``maybe_delay``) and explicit
+``advance_clock(seconds)`` both advance the offset WITHOUT sleeping, so
+deadline expiry, load shedding, and backpressure paths are CI-testable in
+microseconds of wall time — ``runtime.guard.Deadline`` and the serving
+loop (``roaringbitmap_tpu.serving``) read this clock, which is why
+injected latency actually expires their budgets.  The offset only ever
+grows (the clock stays monotonic); ``reset_clock()`` is test hygiene for
+suites that assert absolute virtual timestamps.
 """
 
 from __future__ import annotations
@@ -38,6 +52,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import time
 import zlib
 
 import numpy as np
@@ -46,9 +61,16 @@ from . import errors
 
 ENV_VAR = "ROARING_TPU_FAULTS"
 
-KINDS = ("transient", "oom", "lowering", "corrupt", "coordinator", "silent")
-#: kinds that raise at the boundary (everything but the silent corruption)
-RAISING_KINDS = KINDS[:-1]
+KINDS = ("transient", "oom", "lowering", "corrupt", "coordinator", "silent",
+         "slow")
+#: kinds that raise at the boundary (silent corrupts results in place,
+#: slow advances the fault clock — neither raises)
+RAISING_KINDS = KINDS[:5]
+
+#: virtual latency one firing ``slow`` rule injects, seconds — sized so a
+#: handful of fires blows a ms-scale serving deadline but a single fire
+#: under a second-scale guard deadline only burns budget
+SLOW_LATENCY_S = 0.05
 
 
 @dataclasses.dataclass(frozen=True)
@@ -157,6 +179,50 @@ def inject(spec: str):
         yield plan
     finally:
         _override.pop()
+
+
+# -------------------------------------------------------------- fault clock
+
+_clock_offset = 0.0
+
+
+def clock() -> float:
+    """Virtual-time monotonic clock: ``time.monotonic()`` plus every
+    injected/advanced offset.  THE clock of deadline-sensitive layers
+    (guard.Deadline, the serving loop) — injected ``slow`` latency and
+    test-driven ``advance_clock`` expire real budgets through it."""
+    return time.monotonic() + _clock_offset
+
+
+def advance_clock(seconds: float) -> None:
+    """Jump the fault clock forward (never backward — monotonicity is the
+    one property every Deadline shares)."""
+    global _clock_offset
+    _clock_offset += max(0.0, float(seconds))
+
+
+def reset_clock() -> None:
+    """Zero the injected offset (test hygiene; live Deadlines started
+    under an advanced clock would see time regress, so only reset
+    between, not inside, scenarios)."""
+    global _clock_offset
+    _clock_offset = 0.0
+
+
+def maybe_delay(site: str, engine: str | None = None) -> float:
+    """The pre-dispatch latency hook: when a ``slow`` rule fires for
+    (site, engine), advance the fault clock by SLOW_LATENCY_S and return
+    the injected seconds (0.0 otherwise).  No sleeping, no exception —
+    the latency is visible only to ``clock()`` readers, which is exactly
+    the deterministic-deadline-expiry seam the serving loop's shedding
+    and the guard's deadline tests need."""
+    plan = active()
+    if plan is None:
+        return 0.0
+    if plan.pick(site, engine, kinds=("slow",)) is not None:
+        advance_clock(SLOW_LATENCY_S)
+        return SLOW_LATENCY_S
+    return 0.0
 
 
 # ---------------------------------------------------------------- injection

@@ -38,7 +38,7 @@ from typing import Callable
 
 import numpy as np
 
-from . import errors
+from . import errors, faults
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
@@ -105,7 +105,10 @@ class GuardPolicy:
     #: (parallel.multiset): launch k+1 is planned/packed on the host while
     #: up to this many launches run on device.  1 disables pipelining
     #: (strictly serial plan -> dispatch -> drain); the default 2 is the
-    #: classic double buffer (one launch computing, one draining).
+    #: classic double buffer (one launch computing, one draining); any
+    #: depth N >= 2 keeps up to N-1 launches in flight — bit-exact at
+    #: every depth, drain-time faults re-run that launch synchronously
+    #: regardless of depth (tests/test_multiset.py pins N in {1, 2, 4}).
     pipeline_depth: int = 2
     #: per-query latency objective, milliseconds (obs.slo.SloPolicy /
     #: ROARING_TPU_SLO_MS): every guarded execute is attributed per phase
@@ -140,12 +143,31 @@ class GuardPolicy:
         env.update(overrides)
         return cls(**env)
 
+    def for_remaining(self, remaining_s: float) -> "GuardPolicy":
+        """Per-dispatch policy derived from an admitted request's
+        REMAINING deadline: the hard guard ``deadline`` (what bounds
+        retry/backoff inside ``run_with_fallback``) and the SLO
+        accounting deadline are both clamped to ``remaining_s``, so a
+        retry storm can never spend more wall than the query has left —
+        the two knobs cannot disagree past admission (the serving loop's
+        deadline-propagation contract, docs/SERVING.md)."""
+        remaining_s = max(0.0, float(remaining_s))
+        dl = (remaining_s if self.deadline is None
+              else min(self.deadline, remaining_s))
+        slo = remaining_s * 1e3
+        if self.slo_deadline_ms is not None:
+            slo = min(self.slo_deadline_ms, slo)
+        return dataclasses.replace(self, deadline=dl, slo_deadline_ms=slo)
+
 
 class Deadline:
     """Monotonic wall budget shared across retries, rungs, and recursive
-    batch splits (a split must not reset the clock)."""
+    batch splits (a split must not reset the clock).  The default clock
+    is the FAULT clock (``faults.clock`` — real monotonic plus injected
+    ``slow`` latency), so deadline expiry is deterministically testable
+    without wall-clock flakiness."""
 
-    def __init__(self, seconds: float | None, clock=time.monotonic):
+    def __init__(self, seconds: float | None, clock=faults.clock):
         self.seconds = seconds
         self._clock = clock
         self._t0 = clock()
@@ -322,6 +344,10 @@ def run_with_fallback(site: str, chain, attempt, *, policy=None,
             next_rung = rungs[ri + 1] if ri + 1 < len(rungs) else None
             backoff = policy.backoff_base
             for att in range(policy.max_attempts):
+                # injected pre-dispatch latency (the `slow` fault kind)
+                # lands before the expiry check, so a slowed attempt can
+                # deterministically exhaust the deadline
+                faults.maybe_delay(site, rung)
                 if dl.expired():
                     raise _deadline_error(site, dl, last)
                 try:

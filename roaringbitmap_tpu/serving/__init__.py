@@ -1,0 +1,32 @@
+"""Always-on serving front-end: deadline-aware continuous batching.
+
+Everything below ``serving`` is call-and-return; this package is the
+layer that faces an arrival STREAM (docs/SERVING.md is the operator
+reference):
+
+- ``ServingLoop`` admits :class:`ServingRequest`\\ s (a ``BatchQuery`` or
+  ``ExprQuery`` + tenant + per-request deadline), coalesces them into
+  ``MultiSetBatchEngine`` / ``ShardedBatchEngine`` pools, and dispatches
+  when the pool fills OR the oldest request's deadline minus the pool's
+  predicted execute time nears;
+- **admission control** rejects (typed :class:`AdmissionRejected`) when
+  the HBM ledger plus the pool's predicted footprint would exceed the
+  ``ROARING_TPU_HBM_BUDGET`` headroom, or a tenant queue is full;
+- **load shedding** drops (typed :class:`RequestShed`) or degrades
+  (bitmap -> cardinality-only, per-tenant policy) the requests that
+  cannot meet their deadline instead of letting them poison the pool;
+- **graceful degradation** under sustained overload walks a ladder
+  (shrink pool target -> shed optional fields -> per-tenant fair-share
+  caps) and recovers symmetrically.
+
+Everything reports through the existing vocabulary: ``serving.admit`` /
+``serving.assemble`` / ``serving.dispatch`` / ``serving.shed`` spans,
+``rb_serving_*`` metrics, per-tenant ``rb_slo_attained_total`` /
+``rb_slo_missed_total``, with guard demotions unchanged underneath.
+"""
+
+from .loop import (AdmissionRejected, RequestShed, ServingLoop,
+                   ServingPolicy, ServingRequest, TenantPolicy, Ticket)
+
+__all__ = ["ServingLoop", "ServingPolicy", "ServingRequest",
+           "TenantPolicy", "Ticket", "AdmissionRejected", "RequestShed"]

@@ -1,0 +1,776 @@
+"""Deadline-aware continuous batching over the pooled engines.
+
+The aggregation argument this repo is built on (batch wide, defer
+repair, never materialize intermediates) only pays off at serving time
+if a front-end actually assembles wide pools from a stream.  The JAX AOT
+model is what makes that safe to do under admission control: every pool
+shape this loop admits is a pre-compiled, cost/memory-analyzed program,
+so its footprint (``insights.predict_multiset_dispatch_bytes``) and its
+execute time (``predict_dispatch_seconds``, calibrated by ``obs.cost``'s
+observed achieved rates) are known BEFORE the dispatch — the admission
+controller and the deadline-aware assembler reason about both up front.
+
+Time.  Every timestamp in this module reads the FAULT clock
+(``runtime.faults.clock`` — real monotonic plus injected offset), the
+same clock ``guard.Deadline`` runs on.  That one choice is what makes
+deadline expiry, shedding, backpressure, and the soak test CI-testable
+in microseconds of wall time: a ``slow`` fault rule or an explicit
+``faults.advance_clock`` moves queue age, deadlines, and guard budgets
+together, deterministically.
+
+Execution model.  The loop is tick-driven and synchronous — ``submit``
+admits (or rejects, typed) one request; ``pump`` assembles and
+dispatches every ready pool; ``drain`` forces the remainder out;
+``replay`` runs a timed arrival stream through all three.  A thread
+calling ``pump`` on a timer is a production deployment; the tests and
+the bench lane drive the same object directly.
+
+Deadline propagation.  Each dispatch derives its guard policy via
+``GuardPolicy.for_remaining``: the hard retry/backoff deadline inside
+``run_with_fallback`` is clamped to the pool's tightest admitted
+remaining deadline (floored at the pool's predicted execute time x
+``slack_x`` — an admitted pool is always granted the time the model
+says it needs, else admission of a doomed pool would deadlock), so the
+guard can never spend wall the queries no longer have.
+
+The degradation ladder (level 0..3, symmetric recovery):
+
+====== ==============================================================
+level  effect (cumulative)
+====== ==============================================================
+0      normal service
+1      pool target halves — smaller pools, lower queue latency
+2      optional fields shed: bitmap-form results degrade to
+       cardinality-only (typed as ``degraded``, never silent)
+3      per-tenant fair-share caps: a pool grants each tenant at most
+       its weighted share of slots (weighted stride scheduling
+       already orders assembly at every level; level 3 makes the
+       share a hard cap)
+====== ==============================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import logging
+import os
+from collections import deque
+
+from ..obs import memory as obs_memory
+from ..obs import metrics as obs_metrics
+from ..obs import slo as obs_slo
+from ..obs import trace as obs_trace
+from ..parallel import expr as expr_mod
+from ..parallel.batch_engine import BatchQuery, query_desc
+from ..parallel.multiset import BatchGroup
+from ..runtime import errors, faults, guard
+from ..runtime.cache import LRUCache
+
+_log = logging.getLogger("roaringbitmap_tpu.serving")
+
+#: the guard/trace/metric site of the serving loop
+SITE = "serving"
+
+ENV_POOL = "ROARING_TPU_SERVING_POOL"
+ENV_DEADLINE_MS = "ROARING_TPU_SERVING_DEADLINE_MS"
+ENV_SHED = "ROARING_TPU_SERVING_SHED"
+ENV_HEADROOM = "ROARING_TPU_SERVING_HEADROOM"
+ENV_MAX_QUEUE = "ROARING_TPU_SERVING_MAX_QUEUE"
+
+#: ladder depth (level 3 is the last rung: fair-share caps)
+MAX_LEVEL = 3
+
+
+class AdmissionRejected(errors.RoaringRuntimeError):
+    """Typed admission refusal — the request never entered a queue.
+
+    ``reason`` is one of ``"queue_full"`` / ``"hbm"``; ``context``
+    carries the numbers the decision was made on (queue depth or
+    predicted/resident/budget bytes), so a caller can log or retry
+    against real figures instead of a string."""
+
+    def __init__(self, msg: str, reason: str, **context):
+        super().__init__(msg)
+        self.reason = reason
+        self.context = dict(context)
+
+
+class RequestShed(errors.RoaringRuntimeError):
+    """Typed load-shed: the request WAS admitted but was dropped before
+    (or instead of) dispatch — deadline unmeetable, already expired, or
+    HBM pressure at assembly.  Shed is always an error a caller sees,
+    never a silent drop."""
+
+    def __init__(self, msg: str, reason: str, **context):
+        super().__init__(msg)
+        self.reason = reason
+        self.context = dict(context)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingRequest:
+    """One arriving query: a flat ``BatchQuery`` or compositional
+    ``ExprQuery`` against resident set ``set_id``, owned by ``tenant``,
+    due ``deadline_ms`` after arrival (None = the loop's default)."""
+
+    set_id: int
+    query: object            # BatchQuery | ExprQuery
+    tenant: str = "default"
+    deadline_ms: float | None = None
+
+    def __post_init__(self):
+        if not isinstance(self.query, (BatchQuery, expr_mod.ExprQuery)):
+            raise TypeError(
+                f"ServingRequest.query must be a BatchQuery or ExprQuery, "
+                f"got {type(self.query).__name__}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """Per-tenant knobs: WRR ``weight`` (fair-share slots scale with
+    it), ``on_deadline`` — ``"drop"`` sheds an unmeetable request with a
+    typed error, ``"degrade"`` serves it cardinality-only instead —
+    and an optional per-tenant queue cap."""
+
+    weight: float = 1.0
+    on_deadline: str = "drop"
+    max_queue: int | None = None
+
+    def __post_init__(self):
+        if self.on_deadline not in ("drop", "degrade"):
+            raise ValueError(
+                f"on_deadline must be 'drop' or 'degrade', "
+                f"got {self.on_deadline!r}")
+        if self.weight <= 0:
+            raise ValueError(f"tenant weight must be > 0: {self.weight}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingPolicy:
+    """Knobs of one serving loop; ``from_env`` is the deployment
+    default.  ``guard`` is the BASE guard policy — each dispatch clamps
+    it to the pool's remaining deadline via
+    ``GuardPolicy.for_remaining``."""
+
+    pool_target: int = 64          # queries per pool at level 0
+    max_queue: int = 1024          # per-tenant pending cap (admission)
+    default_deadline_ms: float = 100.0
+    hbm_headroom: float = 0.9      # admitted fraction of the HBM budget
+    slack_x: float = 1.5           # predicted-execute safety factor
+    dispatch_margin_ms: float = 5.0  # early-dispatch margin on deadlines
+    shed: bool = True              # load shedding master switch
+    degrade: bool = True           # overload ladder enabled
+    escalate_after: int = 2        # consecutive hot pumps per step up
+    recover_after: int = 4         # consecutive calm pumps per step down
+    overload_pressure: float = 1.5   # backlog/pool_target that reads hot
+    tenants: dict = dataclasses.field(default_factory=dict)
+    guard: guard.GuardPolicy | None = None
+    engine: str = "auto"
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServingPolicy":
+        env: dict = {}
+        if ENV_POOL in os.environ:
+            env["pool_target"] = max(1, int(os.environ[ENV_POOL]))
+        if ENV_DEADLINE_MS in os.environ:
+            env["default_deadline_ms"] = float(os.environ[ENV_DEADLINE_MS])
+        if ENV_SHED in os.environ:
+            env["shed"] = os.environ[ENV_SHED] not in ("0", "false", "")
+        if ENV_HEADROOM in os.environ:
+            env["hbm_headroom"] = float(os.environ[ENV_HEADROOM])
+        if ENV_MAX_QUEUE in os.environ:
+            env["max_queue"] = max(1, int(os.environ[ENV_MAX_QUEUE]))
+        env.update(overrides)
+        return cls(**env)
+
+    def tenant(self, name: str) -> TenantPolicy:
+        return self.tenants.get(name) or _DEFAULT_TENANT
+
+
+_DEFAULT_TENANT = TenantPolicy()
+
+
+@dataclasses.dataclass
+class Ticket:
+    """One admitted (or rejected) request's lifecycle record — the
+    caller's handle.  ``status``: ``queued`` -> ``done`` | ``shed`` |
+    ``failed`` (typed ``error`` set for the last two); ``rejected``
+    tickets only come out of ``replay`` (``submit`` raises instead).
+    ``degraded`` marks a bitmap request served cardinality-only."""
+
+    request: ServingRequest
+    seq: int = -1
+    enqueued_at: float = 0.0     # fault-clock arrival stamp
+    deadline_at: float = float("inf")
+    status: str = "queued"
+    result: object = None        # BatchResult when done
+    error: Exception | None = None
+    degraded: bool = False
+    wall_ms: float | None = None
+    missed: bool | None = None   # SLO outcome (done tickets)
+    pending_bytes: int = 0       # admission-time footprint estimate
+    _degraded_query: object = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "done"
+
+    @property
+    def query(self):
+        """The query as it will dispatch (degraded form when shed-to-
+        cardinality applied)."""
+        return self._degraded_query or self.request.query
+
+    def degrade_fields(self) -> bool:
+        """bitmap -> cardinality-only (idempotent); True when the form
+        actually changed."""
+        if self.query.form != "bitmap":
+            return False
+        self._degraded_query = dataclasses.replace(self.query,
+                                                   form="cardinality")
+        self.degraded = True
+        return True
+
+
+class ServingLoop:
+    """Continuous-batching front-end over a pooled engine.
+
+    ``engine`` is a ``MultiSetBatchEngine`` or ``ShardedBatchEngine``
+    (anything exposing ``execute(groups, engine=, policy=)``,
+    ``predict_dispatch_bytes``, and the adopted per-set ``_engines``
+    list).  One loop instance is single-threaded, like the engines
+    under it.
+    """
+
+    def __init__(self, engine, policy: ServingPolicy | None = None):
+        self._engine = engine
+        self.policy = policy or ServingPolicy.from_env()
+        self.n_sets = len(engine._engines)
+        self._queues: dict[str, deque] = {}
+        self._vtime: dict[str, float] = {}   # weighted-stride scheduler
+        self._seq = 0
+        self._pending_bytes = 0
+        self._req_bytes = LRUCache(1024, name="serving_req_bytes")
+        self._walls: deque = deque(maxlen=8)  # (s_per_query, compiled)
+        self._s_per_q: float | None = None
+        #: the assembled pool's precise predicted bytes, computed once by
+        #: _trim_to_budget and consumed by the next _dispatch's span tag
+        self._assembled_bytes: int | None = None
+        # MultiSetBatchEngine's predictor takes the engine string; the
+        # sharded engine's does not — resolve once, not per dispatch
+        self._pred_takes_engine = "engine" in inspect.signature(
+            engine.predict_dispatch_bytes).parameters
+        self.level = 0
+        self.level_peak = 0          # highest ladder level since build
+        self._hot = self._calm = 0
+        self._sheds_since_pump = 0
+        self._completed_sheds: list = []
+        self.stats = {"admitted": 0, "rejected": 0, "served": 0,
+                      "shed": 0, "failed": 0, "pools": 0, "degraded": 0}
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, request: ServingRequest,
+               arrival: float | None = None) -> Ticket:
+        """Admit one request (typed ``AdmissionRejected`` on refusal).
+        ``arrival`` back-dates the fault-clock arrival stamp (a replay
+        driver that fell behind its stream passes the scheduled time);
+        deadlines run from arrival, so queue age counts against them."""
+        now = faults.clock()
+        arrival = now if arrival is None else min(arrival, now)
+        deadline_ms = (request.deadline_ms
+                       if request.deadline_ms is not None
+                       else self.policy.default_deadline_ms)
+        tp = self.policy.tenant(request.tenant)
+        # range-check BEFORE the span opens: a caller bug must raise
+        # plain, not leave an outcome-less serving.admit span behind
+        # (check_trace validates the outcome tag on every dump)
+        if not 0 <= request.set_id < self.n_sets:
+            raise IndexError(
+                f"set_id out of range 0..{self.n_sets - 1}: "
+                f"{request.set_id}")
+        with obs_trace.span("serving.admit", site=SITE,
+                            tenant=request.tenant,
+                            set_id=request.set_id) as sp:
+            q = self._queues.setdefault(request.tenant, deque())
+            cap = tp.max_queue or self.policy.max_queue
+            if len(q) >= cap:
+                self._reject(sp, request, "queue_full",
+                             queue_depth=len(q), cap=cap)
+            req_bytes = self._request_bytes(request)
+            budget = guard.resolve_hbm_budget(self.policy.guard)
+            resident = obs_memory.LEDGER.resident_bytes()
+            headroom = (None if budget is None
+                        else int(budget * self.policy.hbm_headroom))
+            if (headroom is not None
+                    and resident + self._pending_bytes + req_bytes
+                    > headroom):
+                self._reject(sp, request, "hbm",
+                             predicted_bytes=req_bytes,
+                             pending_bytes=self._pending_bytes,
+                             resident_bytes=resident,
+                             budget_bytes=budget, headroom_bytes=headroom)
+            self._seq += 1
+            t = Ticket(request=request, seq=self._seq,
+                       enqueued_at=arrival,
+                       deadline_at=arrival + deadline_ms / 1e3,
+                       pending_bytes=req_bytes)
+            q.append(t)
+            self._vtime.setdefault(
+                request.tenant, max(self._vtime.values(), default=0.0))
+            self._pending_bytes += req_bytes
+            self.stats["admitted"] += 1
+            obs_metrics.counter("rb_serving_requests_total",
+                                tenant=request.tenant).inc()
+            self._queue_gauge(request.tenant)
+            sp.tag(outcome="admitted", queue_depth=len(q),
+                   predicted_bytes=req_bytes, resident_bytes=resident,
+                   budget_bytes=budget, deadline_ms=deadline_ms)
+        return t
+
+    def _reject(self, sp, request: ServingRequest, reason: str, **ctx):
+        self.stats["rejected"] += 1
+        obs_metrics.counter("rb_serving_admission_rejected_total",
+                            reason=reason).inc()
+        sp.tag(outcome="rejected", reason=reason, **ctx)
+        _log.warning("%s: admission rejected (%s) for tenant %r: %s",
+                     SITE, reason, request.tenant, ctx)
+        raise AdmissionRejected(
+            f"{SITE}: {reason} — {query_desc(request.query)} for tenant "
+            f"{request.tenant!r} refused ({ctx})", reason, **ctx)
+
+    def _request_bytes(self, request: ServingRequest) -> int:
+        """Per-request footprint estimate (the admission increment): the
+        single-query predicted dispatch bytes of that request against
+        its own set — plan-cached, so repeated shapes are dict hits."""
+        key = (request.set_id, request.query)
+        b = self._req_bytes.get(key)
+        if b is None:
+            be = self._engine._engines[request.set_id]
+            b = int(be.predict_dispatch_bytes([request.query],
+                                              engine=self.policy.engine))
+            self._req_bytes.put(key, b)
+        return b
+
+    # ------------------------------------------------------------- pumping
+
+    def pump(self, force: bool = False) -> list:
+        """Assemble + dispatch every ready pool; returns the completed
+        (done/shed/failed) tickets.  ``force`` dispatches partial pools
+        regardless of fill/deadline readiness (the drain path)."""
+        self._update_ladder(self._backlog())
+        out: list = []
+        while True:
+            pool, progressed = self._assemble(force)
+            if pool:
+                out.extend(self._dispatch(pool))
+            out.extend(self._completed_sheds)
+            self._completed_sheds = []
+            if not progressed:
+                break
+        self._queue_gauge()
+        return out
+
+    def drain(self) -> list:
+        """Force every queued request out (dispatch or shed) — the
+        stream-end flush."""
+        out: list = []
+        while self._backlog():
+            got = self.pump(force=True)
+            out.extend(got)
+            if not got:      # defensive: nothing moved, nothing will
+                break
+        return out
+
+    def replay(self, arrivals) -> list:
+        """Replay a timed arrival stream: ``(at_s, request)`` pairs with
+        nondecreasing offsets from stream start, in fault-clock seconds.
+        The clock fast-forwards through idle gaps; when the loop has
+        fallen behind (a pool execute outlasted the inter-arrival gap)
+        the request is submitted late but back-dated to its scheduled
+        arrival — queue age is real.  Returns one ticket per arrival in
+        arrival order (rejected arrivals get a ``rejected`` ticket with
+        the typed error attached), after a final ``drain``."""
+        t0 = faults.clock()
+        tickets: list = []
+        for at_s, req in arrivals:
+            sched = t0 + float(at_s)
+            now = faults.clock()
+            if sched > now:
+                faults.advance_clock(sched - now)
+            try:
+                t = self.submit(req, arrival=sched)
+            except AdmissionRejected as exc:
+                t = Ticket(request=req, enqueued_at=sched,
+                           status="rejected", error=exc)
+            tickets.append(t)
+            self.pump()
+        self.drain()
+        return tickets
+
+    def _backlog(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _pool_target(self) -> int:
+        t = self.policy.pool_target
+        return max(1, t // 2) if self.level >= 1 else t
+
+    # ------------------------------------------------------------ assembly
+
+    def _assemble(self, force: bool):
+        """One pool attempt: ``(tickets_or_None, progressed)``.
+        ``progressed`` False means nothing is ready — the pump loop
+        stops and waits for more arrivals or deadline pressure."""
+        self._completed_sheds = []
+        backlog = self._backlog()
+        if backlog == 0:
+            return None, False
+        now = faults.clock()
+        target = self._pool_target()
+        take = min(backlog, target)
+        if not force and backlog < target:
+            # deadline pressure: dispatch a partial pool when the oldest
+            # request's remaining budget nears the predicted execute
+            # time (+ margin) — the "or the deadline nears" half of the
+            # dispatch rule
+            oldest = min(t.deadline_at
+                         for q in self._queues.values() for t in q)
+            est = ((self._s_per_q or 1e-3) * take
+                   * self.policy.slack_x)
+            if oldest - now > est + self.policy.dispatch_margin_ms / 1e3:
+                return None, False
+        with obs_trace.span("serving.assemble", site=SITE,
+                            backlog=backlog, target=target,
+                            level=self.level) as sp:
+            picked = self._pick(target)
+            if not picked:
+                return None, False
+            if self.level >= 2 and self.policy.degrade:
+                # ladder level 2: shed optional fields pool-wide
+                for t in picked:
+                    if t.degrade_fields():
+                        self._count_degraded("fields")
+            picked = self._shed_unmeetable(picked, now)
+            picked = self._trim_to_budget(picked, sp)
+            sp.tag(pool=len(picked),
+                   shed=self._sheds_since_pump)
+            return (picked or None), True
+
+    def _pick(self, target: int) -> list:
+        """Weighted stride scheduling over tenant queues: repeatedly
+        take from the backlogged tenant with the smallest virtual time,
+        advancing it by 1/weight per slot — weight-2 tenants get ~2x
+        the slots under contention at every ladder level.  Level 3 adds
+        the hard per-pool cap (fair-share throttling)."""
+        caps: dict | None = None
+        if self.level >= MAX_LEVEL and self.policy.degrade:
+            active = [t for t, q in self._queues.items() if q]
+            wsum = sum(self.policy.tenant(t).weight for t in active) or 1.0
+            caps = {t: max(1, round(target
+                                    * self.policy.tenant(t).weight / wsum))
+                    for t in active}
+        picked: list = []
+        taken: dict = {}
+        while len(picked) < target:
+            ready = [t for t, q in self._queues.items() if q
+                     and (caps is None or taken.get(t, 0) < caps[t])]
+            if not ready:
+                break
+            tenant = min(ready, key=lambda t: (self._vtime[t], t))
+            picked.append(self._queues[tenant].popleft())
+            taken[tenant] = taken.get(tenant, 0) + 1
+            self._vtime[tenant] += 1.0 / self.policy.tenant(tenant).weight
+        return picked
+
+    def _shed_unmeetable(self, picked: list, now: float) -> list:
+        """Drop (or degrade, per tenant policy) the members that cannot
+        meet their deadline even if the pool dispatched right now —
+        expired requests always shed; the rest are judged against the
+        pool's predicted execute time.  Shedding OFF serves everything,
+        however late (the bench lane's attainment-collapse arm)."""
+        if not self.policy.shed or not picked:
+            return picked
+        est = self._estimate_seconds(picked)
+        keep: list = []
+        for t in picked:
+            remaining = t.deadline_at - now
+            if remaining <= 0:
+                self._shed(t, "expired", remaining_ms=remaining * 1e3)
+                continue
+            if remaining < est * self.policy.slack_x:
+                tp = self.policy.tenant(t.request.tenant)
+                if tp.on_deadline == "degrade" and t.degrade_fields():
+                    # cheaper shape may now fit the budget; served
+                    # cardinality-only rather than dropped
+                    self._count_degraded("deadline")
+                    keep.append(t)
+                    continue
+                self._shed(t, "deadline", remaining_ms=remaining * 1e3,
+                           est_ms=est * 1e3)
+                continue
+            keep.append(t)
+        return keep
+
+    def _trim_to_budget(self, picked: list, sp) -> list:
+        """HBM backpressure at assembly: requeue the pool's tail while
+        the POOLED predicted footprint plus ledger-resident bytes
+        exceeds the headroom (admission's per-request estimate cannot
+        see pooling effects; this is the precise gate the acceptance
+        property is asserted on).  A single request that alone exceeds
+        the headroom is shed typed — it can never dispatch.  The final
+        figure is kept for the dispatch span tag
+        (``_assembled_bytes``), and tails are dropped by their cheap
+        per-request estimate between precise re-checks, so an
+        over-budget pool costs ~2 pooled plans, not one per ticket."""
+        self._assembled_bytes = None
+        budget = guard.resolve_hbm_budget(self.policy.guard)
+        if budget is None or not picked:
+            return picked
+        headroom = int(budget * self.policy.hbm_headroom)
+        while picked:
+            predicted = self._pool_bytes(picked)
+            resident = obs_memory.LEDGER.resident_bytes()
+            if predicted + resident <= headroom:
+                self._assembled_bytes = predicted
+                break
+            if len(picked) == 1:
+                self._shed(picked[0], "hbm", predicted_bytes=predicted,
+                           resident_bytes=resident, budget_bytes=budget)
+                return []
+            est = predicted
+            while len(picked) > 1 and est + resident > headroom:
+                tail = picked.pop()
+                self._queues[tail.request.tenant].appendleft(tail)
+                est -= tail.pending_bytes
+                sp.event("requeue", site=SITE,
+                         tenant=tail.request.tenant,
+                         predicted_bytes=predicted,
+                         resident_bytes=resident,
+                         headroom_bytes=headroom)
+        return picked
+
+    def _shed(self, t: Ticket, reason: str, **ctx) -> None:
+        t.status = "shed"
+        t.error = RequestShed(
+            f"{SITE}: shed ({reason}) — {query_desc(t.request.query)} "
+            f"for tenant {t.request.tenant!r} ({ctx})", reason, **ctx)
+        self._pending_bytes -= t.pending_bytes
+        self.stats["shed"] += 1
+        self._sheds_since_pump += 1
+        obs_metrics.counter("rb_serving_shed_total", reason=reason).inc()
+        with obs_trace.span("serving.shed", site=SITE,
+                            tenant=t.request.tenant, reason=reason,
+                            **{k: v for k, v in ctx.items()
+                               if isinstance(v, (int, float))}):
+            pass
+        self._completed_sheds.append(t)
+
+    def _count_degraded(self, reason: str) -> None:
+        self.stats["degraded"] += 1
+        obs_metrics.counter("rb_serving_degraded_total",
+                            reason=reason).inc()
+
+    # ------------------------------------------------------------- dispatch
+
+    def _pooled(self, tickets: list) -> list:
+        return [(t.request.set_id, t.query) for t in tickets]
+
+    def _pool_bytes(self, tickets: list) -> int:
+        groups, _ = self._group(tickets)
+        # predict for the engine the dispatch will actually run — an
+        # "auto"-resolved rung can omit e.g. the xla doubling scratch
+        # and under-gate the backpressure property
+        pred = (self._engine.predict_dispatch_bytes(
+                    groups, engine=self.policy.engine)
+                if self._pred_takes_engine
+                else self._engine.predict_dispatch_bytes(groups))
+        if isinstance(pred, dict):
+            # ShardedBatchEngine reports per-shard + mesh-total; the HBM
+            # budget is per-device, so the per-shard figure gates
+            return int(pred.get("per_shard_bytes", pred["peak_bytes"]))
+        return int(pred)
+
+    def _estimate_seconds(self, tickets: list) -> float:
+        """Predicted pool execute seconds: the engine's AOT cost model
+        when it offers one (calibrated by observed achieved rates after
+        the first dispatches), floored by the loop's own EWMA of
+        measured pool walls — the model knows device time, the EWMA
+        knows the whole dispatch path."""
+        fn = getattr(self._engine, "predict_dispatch_seconds", None)
+        est = float(fn(self._pooled(tickets),
+                       engine=self.policy.engine)) if fn else 0.0
+        if self._s_per_q is not None:
+            est = max(est, self._s_per_q * len(tickets))
+        return max(est, 1e-4)
+
+    def _dispatch(self, tickets: list) -> list:
+        now = faults.clock()
+        est = self._estimate_seconds(tickets)
+        # deadline propagation: the guard gets the tightest admitted
+        # remaining deadline, floored at the predicted execute time x
+        # slack (an admitted pool is always granted its predicted time)
+        remaining = min(t.deadline_at for t in tickets) - now
+        deadline_s = max(remaining, est * self.policy.slack_x, 1e-3)
+        base = self.policy.guard or guard.GuardPolicy.from_env()
+        pol = base.for_remaining(deadline_s)
+        groups, order = self._group(tickets)
+        faults.maybe_delay(SITE)
+        budget = guard.resolve_hbm_budget(self.policy.guard)
+        # the trim already computed this pool's precise figure; only a
+        # budget-less path (nothing trimmed) computes it here
+        predicted = self._assembled_bytes
+        self._assembled_bytes = None
+        if predicted is None:
+            predicted = self._pool_bytes(tickets)
+        with obs_trace.span("serving.dispatch", site=SITE,
+                            pool=len(tickets), tenants=len(
+                                {t.request.tenant for t in tickets}),
+                            level=self.level) as sp:
+            sp.tag(predicted_bytes=predicted,
+                   resident_bytes=obs_memory.LEDGER.resident_bytes(),
+                   budget_bytes=budget, est_ms=round(est * 1e3, 4),
+                   deadline_s=round(deadline_s, 6))
+            miss0 = self._compile_misses()
+            t0 = faults.clock()
+            try:
+                rows = self._engine.execute(groups,
+                                            engine=self.policy.engine,
+                                            policy=pol)
+            except Exception as exc:
+                fault = errors.classify(exc)
+                if fault is None:
+                    raise              # programming error, never masked
+                return self._fail(tickets, fault, sp)
+            wall = faults.clock() - t0
+        flat = [r for rws in rows for r in rws]
+        # learn the per-query wall compile-aware: a ONE-TIME program
+        # compile folded into the estimate would read as sustained
+        # slowness and mass-shed the next pools, but when compiles are
+        # CHRONIC (a pool-shape churn the caches cannot absorb) they ARE
+        # the service time and must be believed — so keep (wall,
+        # compiled?) samples and take the median of the warm ones unless
+        # the window is majority-compiled
+        compiled = self._compile_misses() != miss0
+        self._walls.append((wall / max(1, len(tickets)), compiled))
+        warm = [w for w, c in self._walls if not c]
+        chronic = 2 * sum(c for _, c in self._walls) > len(self._walls)
+        vals = sorted(w for w, _ in self._walls) if (chronic or not warm) \
+            else sorted(warm)
+        self._s_per_q = vals[len(vals) // 2]
+        self.stats["pools"] += 1
+        obs_metrics.counter("rb_serving_pools_total").inc()
+        done = faults.clock()
+        for t, res in zip(order, flat):
+            t.result = res
+            t.status = "done"
+            t.wall_ms = (done - t.enqueued_at) * 1e3
+            dl_ms = (t.deadline_at - t.enqueued_at) * 1e3
+            t.missed = t.wall_ms > dl_ms
+            obs_slo.count_outcome(SITE, t.missed, tenant=t.request.tenant)
+            self._pending_bytes -= t.pending_bytes
+            self.stats["served"] += 1
+        return order
+
+    @staticmethod
+    def _compile_misses() -> int:
+        """Process-wide program-compile count (the
+        ``rb_compile_seconds{cache="miss"}`` observations) — the witness
+        that a dispatch paid a one-time compile and its wall must not
+        calibrate the steady-state estimator."""
+        return int(sum(
+            inst.count
+            for name, labels, inst in obs_metrics.REGISTRY.instruments()
+            if name == "rb_compile_seconds"
+            and labels.get("cache") == "miss"))
+
+    def _group(self, tickets: list):
+        """Tickets -> BatchGroups by set_id (first-appearance order) +
+        the ticket list reordered to the engine's flattened pooled
+        order, so results zip back positionally."""
+        by_sid: dict = {}
+        for t in tickets:
+            by_sid.setdefault(t.request.set_id, []).append(t)
+        groups = [BatchGroup(sid, [t.query for t in ts])
+                  for sid, ts in by_sid.items()]
+        order = [t for ts in by_sid.values() for t in ts]
+        return groups, order
+
+    def _fail(self, tickets: list, fault, sp) -> list:
+        """A whole-pool typed failure (the guard already walked its full
+        ladder): every member gets the classified fault — visible,
+        typed, never silent."""
+        sp.tag(status="failed", error_class=type(fault).__name__)
+        obs_metrics.counter("rb_serving_pool_failures_total",
+                            error_class=type(fault).__name__).inc()
+        for t in tickets:
+            t.status = "failed"
+            t.error = fault
+            self._pending_bytes -= t.pending_bytes
+            self.stats["failed"] += 1
+        _log.error("%s: pool of %d failed: %s", SITE, len(tickets), fault)
+        return tickets
+
+    # ----------------------------------------------------- overload ladder
+
+    def _update_ladder(self, backlog: int) -> None:
+        """Escalate/recover the degradation level from two hot signals —
+        backlog pressure against the BASE pool target, and any shed
+        since the previous pump — debounced by ``escalate_after`` /
+        ``recover_after`` consecutive pumps; recovery is symmetric, one
+        level per calm streak."""
+        if not self.policy.degrade:
+            self._sheds_since_pump = 0
+            return
+        pressure = backlog / max(1, self.policy.pool_target)
+        hot = (pressure > self.policy.overload_pressure
+               or self._sheds_since_pump > 0)
+        self._sheds_since_pump = 0
+        if hot:
+            self._hot += 1
+            self._calm = 0
+            if self._hot >= self.policy.escalate_after \
+                    and self.level < MAX_LEVEL:
+                self._set_level(self.level + 1, pressure)
+                self._hot = 0
+        else:
+            self._calm += 1
+            self._hot = 0
+            if self._calm >= self.policy.recover_after and self.level > 0:
+                self._set_level(self.level - 1, pressure)
+                self._calm = 0
+
+    def _set_level(self, level: int, pressure: float) -> None:
+        prev, self.level = self.level, level
+        self.level_peak = max(self.level_peak, level)
+        obs_metrics.gauge("rb_serving_degrade_level").set(level)
+        obs_trace.current().event(
+            "degrade", site=SITE, level_from=prev, level_to=level,
+            pressure=round(pressure, 4))
+        _log.warning("%s: degradation level %d -> %d (pressure %.2f)",
+                     SITE, prev, level, pressure,
+                     extra={"rb_site": SITE, "rb_event": "degrade",
+                            "rb_level": level})
+
+    # -------------------------------------------------------------- health
+
+    def _queue_gauge(self, tenant: str | None = None) -> None:
+        tenants = ([tenant] if tenant is not None else
+                   list(self._queues))
+        for t in tenants:
+            obs_metrics.gauge("rb_serving_queue_depth", tenant=t).set(
+                len(self._queues.get(t) or ()))
+
+    def snapshot(self) -> dict:
+        """Loop state as plain JSON — the serving half of a health
+        endpoint (``obs.snapshot()`` is the registry half)."""
+        return {
+            "level": self.level,
+            "level_peak": self.level_peak,
+            "pool_target": self._pool_target(),
+            "backlog": self._backlog(),
+            "queues": {t: len(q) for t, q in self._queues.items()},
+            "pending_bytes": self._pending_bytes,
+            "s_per_query_est": self._s_per_q,
+            "stats": dict(self.stats),
+        }

@@ -151,6 +151,75 @@ class TestFaultSpec:
             assert faults.active() is plan
         assert faults.active() is prev
 
+    def test_slow_grammar_and_separation(self):
+        plan = faults.FaultPlan.from_spec("slow@serving=0.25,slow:7")
+        kinds = [(r.kind, r.scope, r.rate) for r in plan.rules]
+        assert kinds == [("slow", "serving", 0.25), ("slow", None, 1.0)]
+        # the raising picker skips slow rules entirely — maybe_fail can
+        # never raise from injected latency
+        assert plan.pick("s", "e") is None
+        assert plan.pick("s", "e", kinds=("slow",)) == "slow"
+        with faults.inject("slow:3"):
+            faults.maybe_fail("s", "e")              # must not raise
+
+
+class TestFaultClock:
+    def setup_method(self):
+        faults.reset_clock()
+
+    def teardown_method(self):
+        faults.reset_clock()
+
+    def test_clock_advances_without_sleeping(self):
+        t0 = faults.clock()
+        w0 = time.monotonic()
+        faults.advance_clock(2.5)
+        assert faults.clock() - t0 >= 2.5
+        assert time.monotonic() - w0 < 1.0           # no real waiting
+        faults.advance_clock(-5.0)                    # never backwards
+        assert faults.clock() - t0 >= 2.5
+
+    def test_maybe_delay_is_deterministic(self):
+        seen = []
+        for _ in range(2):
+            faults.reset_clock()
+            with faults.inject("slow=0.3:99"):
+                seen.append([faults.maybe_delay("s", "e")
+                             for _ in range(32)])
+        assert seen[0] == seen[1]
+        fired = [d for d in seen[0] if d]
+        assert fired and all(d == faults.SLOW_LATENCY_S for d in fired)
+        assert len(fired) < 32                       # rate < 1 skips some
+
+    def test_deadline_expires_on_the_fault_clock(self):
+        dl = guard.Deadline(0.2)
+        assert not dl.expired() and dl.remaining() > 0
+        faults.advance_clock(0.5)
+        assert dl.expired() and dl.remaining() == 0.0
+
+    def test_slow_injection_exhausts_guard_deadline_typed(self):
+        """Every attempt burns SLOW_LATENCY_S of virtual time before the
+        expiry check, so a sub-quantum deadline dies typed on the first
+        rung — no wall clock involved."""
+        calls = []
+        with faults.inject("slow@t=1.0:4"):
+            with pytest.raises(errors.TransientDeviceError,
+                               match="deadline"):
+                guard.run_with_fallback(
+                    "t", ("e1",), lambda e: calls.append(e),
+                    policy=guard.GuardPolicy(
+                        deadline=faults.SLOW_LATENCY_S / 2,
+                        backoff_base=0.0, sleep=lambda s: None))
+        assert calls == []                   # expired before any attempt
+
+    def test_for_remaining_derives_guard_deadline(self):
+        base = guard.GuardPolicy(deadline=10.0, slo_deadline_ms=9000.0)
+        p = base.for_remaining(0.5)
+        assert p.deadline == 0.5 and p.slo_deadline_ms == 500.0
+        assert p.max_attempts == base.max_attempts   # only deadlines move
+        open_ = guard.GuardPolicy().for_remaining(2.0)
+        assert open_.deadline == 2.0 and open_.slo_deadline_ms == 2000.0
+
 
 # ----------------------------------------------------------------- LRU cache
 

@@ -1,0 +1,483 @@
+"""Always-on serving loop acceptance (ISSUE 10).
+
+Pins:
+- served results bit-exact vs each set's sequential reference across
+  query shapes (BatchQuery AND ExprQuery, both forms), tenants, and
+  engines (multiset + mesh-sharded) — one admission/shed/fairness path;
+- typed admission control: ``AdmissionRejected`` on queue caps and on
+  HBM backpressure, and the backpressure PROPERTY — no dispatched
+  pool's predicted footprint plus ledger-resident bytes exceeds the
+  budget (asserted from the ``serving.dispatch`` trace spans);
+- load shedding: expired/unmeetable requests shed with typed
+  ``RequestShed`` (reason carried) or degrade bitmap -> cardinality per
+  tenant policy — never silent;
+- deadline propagation: the guard's per-dispatch deadline is clamped to
+  the pool's remaining deadline (``GuardPolicy.for_remaining``), so a
+  retry storm cannot outspend the query's budget — all on the fault
+  clock, zero wall-clock flakiness;
+- the overload ladder escalates (pool shrink -> field shed -> fair-share
+  caps) and recovers symmetrically; weighted fairness orders assembly;
+- the soak (slow lane): a >= 30 s simulated arrival stream under
+  transient+oom+slow injection across >= 100 pools — bit-exact non-shed
+  results, typed errors otherwise, HBM ledger back at baseline.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.obs import memory as obs_memory
+from roaringbitmap_tpu.parallel import (BatchEngine, BatchQuery,
+                                        MultiSetBatchEngine, expr)
+from roaringbitmap_tpu.runtime import errors, faults, guard
+from roaringbitmap_tpu.serving import (AdmissionRejected, RequestShed,
+                                       ServingLoop, ServingPolicy,
+                                       ServingRequest, TenantPolicy)
+
+#: no real sleeping, no outer deadline — per-dispatch deadlines come
+#: from the serving loop's remaining-deadline clamp
+NOSLEEP = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)
+
+#: far-future deadline for tests that pin parity, not timing (compile
+#: walls on a cold engine are real seconds)
+EASY_MS = 300_000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    faults.reset_clock()
+    yield
+    obs.disable()
+    obs.reset()
+    faults.reset_clock()
+
+
+@pytest.fixture(scope="module")
+def tenant_bitmaps():
+    rng = np.random.default_rng(0x5E11)
+    out = []
+    for s in range(3):
+        out.append([RoaringBitmap.from_values(np.unique(
+            rng.integers(0, 1 << 16, 700).astype(np.uint32)))
+            for _ in range(6)])
+    return out
+
+
+@pytest.fixture(scope="module")
+def engine(tenant_bitmaps):
+    return MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps,
+                                                layout="dense")
+
+
+def _policy(**kw) -> ServingPolicy:
+    kw.setdefault("guard", NOSLEEP)
+    kw.setdefault("default_deadline_ms", EASY_MS)
+    return ServingPolicy(**kw)
+
+
+def _requests(n: int, n_sets: int = 3, seed: int = 0xA11,
+              form_every: int = 3, expr_every: int = 7):
+    """Mixed-shape stream: flat mixed-op queries, periodic bitmap forms,
+    periodic expression DAGs — the one-wire-shape contract."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        sid = int(rng.integers(n_sets))
+        form = "bitmap" if i % form_every == 0 else "cardinality"
+        if expr_every and i % expr_every == 3:
+            e = expr.and_(expr.or_(0, 1), expr.not_(2))
+            q = expr.ExprQuery(e, form=form)
+        else:
+            op = ("or", "and", "xor", "andnot")[int(rng.integers(4))]
+            k = int(rng.integers(2, 5))
+            q = BatchQuery(op, tuple(
+                int(x) for x in rng.choice(6, size=k, replace=False)),
+                form=form)
+        out.append(ServingRequest(sid, q, tenant=f"t{sid}"))
+    return out
+
+
+def _assert_ticket_exact(engine, t):
+    ref = engine._engines[t.request.set_id]._sequential_one(t.query)
+    assert t.result.cardinality == ref.cardinality, t.request
+    if t.query.form == "bitmap":
+        assert t.result.bitmap == ref, t.request
+
+
+# ------------------------------------------------------------ parity path
+
+def test_serves_mixed_queries_bit_exact(engine):
+    loop = ServingLoop(engine, _policy(pool_target=8))
+    reqs = _requests(25)
+    tickets = [loop.submit(r) for r in reqs]
+    loop.pump()
+    loop.drain()
+    assert all(t.status == "done" for t in tickets)
+    for t in tickets:
+        _assert_ticket_exact(engine, t)
+    assert loop.stats["served"] == len(reqs)
+    assert loop.stats["pools"] >= 2
+    # per-tenant SLO accounting reconciles with the served count
+    snap = obs.snapshot()["counters"]
+    attained = sum(r["value"]
+                   for r in snap.get("rb_slo_attained_total", [])
+                   if r["labels"].get("site") == "serving")
+    missed = sum(r["value"]
+                 for r in snap.get("rb_slo_missed_total", [])
+                 if r["labels"].get("site") == "serving")
+    assert attained + missed == len(reqs)
+
+
+def test_expr_and_flat_share_one_path(engine):
+    """Satellite: ExprQuery pools admit natively — the serving answer
+    equals the direct engine call for the identical pooled queries."""
+    loop = ServingLoop(engine, _policy(pool_target=6))
+    reqs = [ServingRequest(1, expr.ExprQuery(
+        expr.xor(expr.or_(0, 1), expr.and_(2, 3)), form="bitmap"),
+        tenant="e"),
+        ServingRequest(1, BatchQuery("or", (0, 1, 2), form="bitmap"),
+                       tenant="e"),
+        ServingRequest(0, expr.ExprQuery(
+            expr.and_(expr.or_(1, 2), expr.not_(0))), tenant="e")]
+    tickets = [loop.submit(r) for r in reqs]
+    loop.drain()
+    assert all(t.ok for t in tickets)
+    direct = engine.execute([(r.set_id, (r.query,)) for r in reqs],
+                            engine="auto")
+    flat = [r for rows in direct for r in rows]
+    for t, d in zip(tickets, flat):
+        assert t.result.cardinality == d.cardinality
+        if t.request.query.form == "bitmap":
+            assert t.result.bitmap == d.bitmap
+
+
+def test_sharded_engine_behind_the_same_loop(tenant_bitmaps, engine):
+    """The loop pools into a ShardedBatchEngine unchanged (its dict
+    footprint prediction rides the per-shard budget figure)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from roaringbitmap_tpu.parallel import ShardedBatchEngine
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("rows", "data"))
+    sharded = ShardedBatchEngine(engine._engines, mesh=mesh)
+    loop = ServingLoop(sharded, _policy(pool_target=8))
+    reqs = _requests(12, seed=0x5A)
+    tickets = [loop.submit(r) for r in reqs]
+    loop.drain()
+    assert all(t.ok for t in tickets)
+    for t in tickets:
+        _assert_ticket_exact(engine, t)
+
+
+# ------------------------------------------------------------- admission
+
+def test_queue_cap_rejects_typed(engine):
+    loop = ServingLoop(engine, _policy(max_queue=4))
+    for i in range(4):
+        loop.submit(ServingRequest(0, BatchQuery("or", (0, 1))))
+    with pytest.raises(AdmissionRejected) as ei:
+        loop.submit(ServingRequest(0, BatchQuery("or", (0, 1))))
+    assert ei.value.reason == "queue_full"
+    assert ei.value.context["queue_depth"] == 4
+    snap = obs.snapshot()["counters"]
+    rej = snap["rb_serving_admission_rejected_total"]
+    assert rej[0]["labels"]["reason"] == "queue_full"
+    assert loop.stats["rejected"] == 1
+    assert loop._backlog() == 4          # the reject left no residue
+
+
+def test_hbm_backpressure_rejects_and_pools_fit_budget(engine, tmp_path):
+    """Acceptance: with a budget set, admission rejects typed once the
+    ledger + pending footprint exceeds the headroom, and NO dispatched
+    pool's predicted bytes + resident bytes exceed the budget —
+    asserted from the serving.dispatch trace spans."""
+    probe = ServingRequest(0, BatchQuery("or", (0, 1, 2)))
+    scratch = ServingLoop(engine, _policy())
+    per_req = scratch._request_bytes(probe)
+    resident = obs_memory.LEDGER.resident_bytes()
+    budget = int((resident + 3.2 * per_req) / 0.9)
+    pol = _policy(guard=guard.GuardPolicy(
+        backoff_base=0.0, sleep=lambda s: None, hbm_budget=budget),
+        pool_target=8)
+    loop = ServingLoop(engine, pol)
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    admitted, rejected = [], []
+    for i in range(8):
+        try:
+            admitted.append(loop.submit(ServingRequest(
+                0, BatchQuery("or", (0, 1, 2)), tenant="h")))
+        except AdmissionRejected as e:
+            rejected.append(e)
+    loop.drain()
+    obs.disable()
+    assert admitted and rejected
+    assert all(e.reason == "hbm" for e in rejected)
+    assert all(e.context["budget_bytes"] == budget for e in rejected)
+    served = [t for t in admitted if t.ok]
+    assert served
+    for t in served:
+        _assert_ticket_exact(engine, t)
+    spans = [json.loads(line) for line in open(path)]
+    dispatches = [s for s in spans if s["name"] == "serving.dispatch"]
+    assert dispatches
+    for s in dispatches:
+        tags = s["tags"]
+        assert tags["predicted_bytes"] + tags["resident_bytes"] \
+            <= tags["budget_bytes"], tags
+    admits = [s for s in spans if s["name"] == "serving.admit"]
+    outcomes = {s["tags"]["outcome"] for s in admits}
+    assert outcomes == {"admitted", "rejected"}
+
+
+# -------------------------------------------------------------- shedding
+
+def test_expired_requests_shed_typed(engine):
+    loop = ServingLoop(engine, _policy(pool_target=4))
+    t = loop.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                   deadline_ms=50.0))
+    faults.advance_clock(0.2)            # virtual: the deadline passed
+    done = loop.pump(force=True)
+    assert t in done and t.status == "shed"
+    assert isinstance(t.error, RequestShed)
+    assert t.error.reason == "expired"
+    snap = obs.snapshot()["counters"]["rb_serving_shed_total"]
+    assert any(r["labels"]["reason"] == "expired" for r in snap)
+
+
+def test_unmeetable_drop_vs_degrade_per_tenant(engine):
+    """A request whose remaining budget is under the predicted execute
+    time sheds on a "drop" tenant and serves cardinality-only on a
+    "degrade" tenant."""
+    pol = _policy(pool_target=4, tenants={
+        "d": TenantPolicy(on_deadline="drop"),
+        "g": TenantPolicy(on_deadline="degrade")})
+    loop = ServingLoop(engine, pol)
+    loop._s_per_q = 0.2                  # calibrated: 200 ms per query
+    td = loop.submit(ServingRequest(
+        0, BatchQuery("or", (0, 1), form="bitmap"), tenant="d",
+        deadline_ms=100.0))
+    tg = loop.submit(ServingRequest(
+        0, BatchQuery("or", (0, 1), form="bitmap"), tenant="g",
+        deadline_ms=100.0))
+    loop.pump(force=True)
+    assert td.status == "shed" and td.error.reason == "deadline"
+    assert tg.status == "done" and tg.degraded
+    assert tg.result.bitmap is None      # cardinality-only, typed as such
+    _assert_ticket_exact(engine, tg)     # ...and the count is exact
+    snap = obs.snapshot()["counters"]
+    assert any(r["labels"]["reason"] == "deadline"
+               for r in snap["rb_serving_degraded_total"])
+
+
+def test_shedding_disabled_serves_late(engine):
+    loop = ServingLoop(engine, _policy(pool_target=4, shed=False))
+    t = loop.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                   deadline_ms=10.0))
+    faults.advance_clock(0.5)
+    loop.pump(force=True)
+    assert t.status == "done" and t.missed is True
+    _assert_ticket_exact(engine, t)
+
+
+def test_slow_fault_is_counted_against_slo(engine):
+    """The `slow` kind at the serving site: injected pre-dispatch
+    latency expires the request's SLO deterministically — served, but
+    counted missed."""
+    loop = ServingLoop(engine, _policy(pool_target=2, shed=False))
+    with faults.inject("slow@serving=1.0:3"):
+        t = loop.submit(ServingRequest(
+            0, BatchQuery("or", (0, 1)), tenant="s",
+            deadline_ms=faults.SLOW_LATENCY_S * 1e3 / 2))
+        loop.pump(force=True)
+    assert t.status == "done" and t.missed is True
+    snap = obs.snapshot()["counters"]["rb_slo_missed_total"]
+    assert any(r["labels"].get("tenant") == "s" for r in snap)
+
+
+# ------------------------------------------------- deadline propagation
+
+def test_for_remaining_clamps_both_knobs():
+    base = guard.GuardPolicy(deadline=10.0, slo_deadline_ms=5000.0)
+    p = base.for_remaining(0.25)
+    assert p.deadline == 0.25 and p.slo_deadline_ms == 250.0
+    # a tighter pre-existing knob survives
+    tight = guard.GuardPolicy(deadline=0.1, slo_deadline_ms=50.0)
+    p = tight.for_remaining(0.25)
+    assert p.deadline == 0.1 and p.slo_deadline_ms == 50.0
+    # unset knobs are derived, not left open
+    p = guard.GuardPolicy().for_remaining(1.5)
+    assert p.deadline == 1.5 and p.slo_deadline_ms == 1500.0
+
+
+def test_guard_cannot_outspend_remaining_deadline(engine):
+    """Satellite: slow+transient injection at the engine site — every
+    attempt burns SLOW_LATENCY_S of virtual time and fails transient, so
+    without the remaining-deadline clamp the ladder would spend
+    attempts x rungs x 50 ms; with it the dispatch dies typed within the
+    pool's remaining budget."""
+    remaining_ms = 120.0
+    loop = ServingLoop(engine, _policy(pool_target=2, shed=False))
+    t0 = faults.clock()
+    with faults.inject("slow@multiset=1.0,transient@multiset=1.0,"
+                       "transient@batch_engine=1.0,"
+                       "slow@batch_engine=1.0:5"):
+        t = loop.submit(ServingRequest(
+            0, BatchQuery("or", (0, 1)), deadline_ms=remaining_ms))
+        loop.pump(force=True)
+    spent = faults.clock() - t0
+    assert t.status == "failed"
+    assert isinstance(t.error, errors.RoaringRuntimeError)
+    assert "deadline" in str(t.error)
+    # 3 attempts x 4 rungs x 50 ms = 600 ms un-clamped; the clamp cuts
+    # the ladder within remaining + one slow quantum
+    assert spent <= remaining_ms / 1e3 + 2 * faults.SLOW_LATENCY_S, spent
+    snap = obs.snapshot()["counters"]["rb_serving_pool_failures_total"]
+    assert snap and snap[0]["value"] >= 1
+
+
+# ------------------------------------------------------ overload ladder
+
+def test_ladder_escalates_and_recovers_symmetrically(engine):
+    pol = _policy(pool_target=4, escalate_after=1, recover_after=2,
+                  overload_pressure=1.5)
+    loop = ServingLoop(engine, pol)
+    levels = []
+    for _ in range(3):
+        for r in _requests(16, seed=0xF00, expr_every=0):
+            loop.submit(r)
+        loop.pump(force=True)
+        levels.append(loop.level)
+    assert levels == [1, 2, 3]
+    assert loop._pool_target() == 2      # level >= 1 halves the target
+    # level 2+: bitmap requests served cardinality-only (field shedding)
+    t = loop.submit(ServingRequest(
+        0, BatchQuery("or", (0, 1), form="bitmap")))
+    loop.pump(force=True)
+    assert t.ok and t.degraded and t.result.bitmap is None
+    gauge = obs.snapshot()["gauges"]["rb_serving_degrade_level"]
+    assert gauge[0]["value"] == 3
+    # symmetric recovery: calm pumps walk the ladder back down
+    for want in (2, 1, 0):
+        loop.pump()
+        loop.pump()
+        assert loop.level == want
+    assert obs.snapshot()["gauges"]["rb_serving_degrade_level"][0][
+        "value"] == 0
+
+
+def test_weighted_fair_share(engine):
+    """Stride scheduling: a weight-2 tenant gets twice the pool slots
+    of a weight-1 tenant under contention."""
+    pol = _policy(pool_target=6, tenants={
+        "a": TenantPolicy(weight=2.0), "b": TenantPolicy(weight=1.0)})
+    loop = ServingLoop(engine, pol)
+    for i in range(12):
+        loop.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                   tenant="a"))
+        loop.submit(ServingRequest(1, BatchQuery("or", (0, 1)),
+                                   tenant="b"))
+    picked = loop._pick(6)
+    by = {"a": 0, "b": 0}
+    for t in picked:
+        by[t.request.tenant] += 1
+    assert by == {"a": 4, "b": 2}
+    # level-3 caps make the share a hard per-pool bound
+    loop.level = 3
+    picked = loop._pick(6)
+    caps = {"a": 0, "b": 0}
+    for t in picked:
+        caps[t.request.tenant] += 1
+    assert caps == {"a": 4, "b": 2}
+
+
+# -------------------------------------------------------------- tracing
+
+def test_serving_span_vocabulary(engine, tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    loop = ServingLoop(engine, _policy(pool_target=4))
+    for r in _requests(6, seed=2, expr_every=0):
+        loop.submit(r)
+    t = loop.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                   deadline_ms=1.0))
+    faults.advance_clock(0.05)
+    loop.drain()
+    obs.disable()
+    assert t.status == "shed"
+    spans = [json.loads(line) for line in open(path)]
+    names = {s["name"] for s in spans}
+    assert {"serving.admit", "serving.assemble", "serving.dispatch",
+            "serving.shed"} <= names
+    sheds = [s for s in spans if s["name"] == "serving.shed"]
+    assert all(s["tags"].get("reason") and s["tags"].get("tenant")
+               for s in sheds)
+
+
+def test_replay_backdates_late_arrivals(engine):
+    loop = ServingLoop(engine, _policy(pool_target=4))
+    reqs = _requests(8, seed=9, expr_every=0)
+    tickets = loop.replay((i * 0.01, r) for i, r in enumerate(reqs))
+    assert len(tickets) == len(reqs)
+    assert all(t.status in ("done", "shed") for t in tickets)
+    # arrival stamps follow the schedule: strictly increasing
+    stamps = [t.enqueued_at for t in tickets]
+    assert all(b > a for a, b in zip(stamps, stamps[1:]))
+
+
+# ------------------------------------------------------------ soak (slow)
+
+@pytest.mark.slow
+def test_soak_sustained_stream_under_faults(tenant_bitmaps):
+    """>= 30 s of simulated arrivals across >= 100 pools under
+    transient+oom+slow injection: every non-shed query bit-exact, every
+    shed/failed query typed, the HBM ledger back at its pre-soak
+    baseline (no leak across pools)."""
+    engine = MultiSetBatchEngine.from_bitmap_sets(tenant_bitmaps,
+                                                  layout="dense")
+    pol = _policy(pool_target=4, default_deadline_ms=120_000.0)
+    loop = ServingLoop(engine, pol)
+    # prime the compiled programs so the soak measures serving, not
+    # compiles (the production warmup() story)
+    for r in _requests(16, seed=1, expr_every=5):
+        loop.submit(r)
+    loop.drain()
+    # flush cyclic garbage BEFORE both ledger readings: earlier tests'
+    # engines sit in reference cycles, and a cyclic-GC pass firing
+    # mid-soak would release THEIR registrations between the two
+    # snapshots — a false leak signal about the serving loop
+    import gc
+
+    gc.collect()
+    baseline = obs_memory.LEDGER.snapshot()
+
+    n = 500
+    gap = 0.08                           # 500 x 80 ms = 40 s simulated
+    reqs = _requests(n, seed=0x50AC, expr_every=6)
+    with faults.inject("transient=0.05,oom=0.05,slow=0.1:0x50AC"):
+        tickets = loop.replay(
+            (i * gap, r) for i, r in enumerate(reqs))
+    assert len(tickets) == n
+    assert loop.stats["pools"] >= 100
+    statuses = {t.status for t in tickets}
+    assert "queued" not in statuses and "rejected" not in statuses
+    served = shed = 0
+    for t in tickets:
+        if t.status == "done":
+            served += 1
+            _assert_ticket_exact(engine, t)
+        else:
+            shed += 1
+            assert isinstance(t.error, (RequestShed,
+                                        errors.RoaringRuntimeError))
+            assert str(t.error)          # typed AND descriptive
+    assert served >= n * 0.5, (served, shed)
+    gc.collect()
+    assert obs_memory.LEDGER.snapshot() == baseline

@@ -61,9 +61,13 @@ import sys
 #: ``expression.d{D}_q{Q}.{fused,node}_qps`` (via ``qps``),
 #: ``fused_vs_node_x`` (the fusion headline, explicit via ``fused_vs``)
 #: and its ``launches_saved`` counts (explicit).
+#: The serving lane (bench.py serving_phase, ISSUE 10) adds per-rate
+#: ``serving.x{R}`` cells ([p50_ms, p99_ms, slo_attainment, shed_rate])
+#: and the ``overload_attainment`` headline — attainment is gated HIGHER
+#: (via ``attain``); the cells' latency entries ride the ``_ms`` rule.
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
-          "fused_vs")
+          "fused_vs", "attain")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
          "shard_balance", "warm_restart")
 #: checked before HIGHER/LOWER: lanes whose good direction is genuinely
@@ -76,8 +80,13 @@ LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
 #: trend inputs for the sentry's table, not gate fields; a
 #: sub-millisecond residual phase swinging 2x between rounds is noise,
 #: and time moving BETWEEN phases (more dispatch, less other) is not a
-#: regression at all.
-NEUTRAL = ("host_overlapped", "phase_ms")
+#: regression at all.  The serving control/outcome lanes are neutral
+#: too: ``noshed_attainment`` is the attainment-COLLAPSE control (lower
+#: is the expected proof, higher is not a regression), and ``shed_rate``
+#: at overload is a policy outcome, not a quality axis (more shedding
+#: with higher survivor attainment can be the better trade); the
+#: ``x4`` cells' serving direction signal is ``slo_attainment``.
+NEUTRAL = ("host_overlapped", "phase_ms", "noshed", "shed_rate")
 
 
 def salvage_tail_json(tail: str) -> dict | None:

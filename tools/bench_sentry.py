@@ -49,6 +49,14 @@ smoke guards (``sharded.m{R}x1_q{Q}.pooled_qps``, ``shard_balance``,
 ``warm_restart_x``) feed the sentry's direction table through
 bench_diff's lane vocabulary.
 
+``--smoke-serving`` (ISSUE 10) prepends the serving-loop robustness
+smoke: an overloaded burst through the continuous-batching front-end
+must serve every completed request bit-exactly vs the sequential
+reference, shed/reject the rest with TYPED errors (never silently),
+respect the HBM backpressure property on every dispatched pool, and
+return the HBM ledger to its pre-burst baseline — pinning the
+``serving.x{R}`` bench lanes' correctness before their trend is gated.
+
 ``--smoke-expr`` (ISSUE 8) prepends the fused-expression bit-exactness
 smoke: a depth-2/3 expression pool executed FUSED (the expression-DAG
 compiler, one launch) must match the host-side sequential evaluator
@@ -326,6 +334,67 @@ def expr_smoke() -> int:
     return 1 if mismatches else 0
 
 
+def serving_smoke() -> int:
+    """Serving-loop robustness smoke (ISSUE 10, see module docstring).
+    Returns 0 when every contract holds, 1 otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.obs import memory as obs_memory
+    from roaringbitmap_tpu.parallel import (BatchEngine, BatchQuery,
+                                            MultiSetBatchEngine)
+    from roaringbitmap_tpu.runtime import errors, faults, guard
+    from roaringbitmap_tpu.serving import (AdmissionRejected, RequestShed,
+                                           ServingLoop, ServingPolicy,
+                                           ServingRequest)
+
+    rng = np.random.default_rng(0x5E12)
+    tenants = [[RoaringBitmap.from_values(
+        np.unique(rng.integers(0, 1 << 16, 900).astype(np.uint32)))
+        for _ in range(6)] for _ in range(3)]
+    engine = MultiSetBatchEngine(
+        [BatchEngine.from_bitmaps(t, layout="dense") for t in tenants])
+    loop = ServingLoop(engine, ServingPolicy(
+        pool_target=4, max_queue=6, default_deadline_ms=120_000.0,
+        guard=guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)))
+    baseline = obs_memory.LEDGER.snapshot()
+    checks: dict = {}
+    tickets, rejected = [], []
+    ops = ("or", "and", "xor", "andnot")
+    for i in range(24):
+        try:
+            tickets.append(loop.submit(ServingRequest(
+                i % 3, BatchQuery(ops[i % 4], (0, 1, 2)),
+                tenant=f"t{i % 3}")))
+        except AdmissionRejected as exc:
+            rejected.append(exc)
+    loop.drain()                         # serve the admitted backlog
+    doomed = loop.submit(ServingRequest(
+        0, BatchQuery("or", (0, 1)), tenant="t0", deadline_ms=1.0))
+    faults.advance_clock(0.05)
+    loop.drain()
+    checks["typed_rejections"] = bool(rejected) and all(
+        isinstance(e, errors.RoaringRuntimeError) and e.reason
+        for e in rejected)
+    checks["typed_shed"] = (doomed.status == "shed"
+                            and isinstance(doomed.error, RequestShed)
+                            and doomed.error.reason == "expired")
+    checks["nothing_silent"] = all(
+        t.status == "done" or t.error is not None for t in tickets)
+    served = [t for t in tickets if t.status == "done"]
+    checks["served"] = bool(served)
+    checks["bit_exact"] = all(
+        t.result.cardinality == engine._engines[
+            t.request.set_id]._sequential_one(t.request.query).cardinality
+        for t in served)
+    checks["ledger_baseline"] = \
+        obs_memory.LEDGER.snapshot() == baseline
+    ok = all(checks.values())
+    print(json.dumps({"smoke_serving": checks, "ok": ok}))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -358,10 +427,18 @@ def main() -> int:
                     help="first run the fused-expression bit-exactness "
                          "smoke vs host sequential evaluation (exit 1 "
                          "on divergence)")
+    ap.add_argument("--smoke-serving", action="store_true",
+                    help="first run the serving-loop robustness smoke "
+                         "(typed shed/reject, bit-exact served results, "
+                         "ledger baseline; exit 1 on violation)")
     args = ap.parse_args()
 
     if args.smoke_sharded:
         rc = sharded_smoke()
+        if rc:
+            return rc
+    if args.smoke_serving:
+        rc = serving_smoke()
         if rc:
             return rc
     if args.smoke_expr:

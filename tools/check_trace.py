@@ -50,6 +50,16 @@ and a wide aggregation — then (2) validates every emitted line:
   ``rb_expr_launches_saved_total``.  On arbitrary dumps the
   ``expr.compile`` tag schema is validated wherever the span appears
   (presence is a --workload-only demand, the PR 5 convention);
+- serving semantics (ISSUE 10): the --workload run drives an OVERLOADED
+  continuous-batching loop (tiny per-tenant queue caps force a typed
+  admission rejection; a virtually-expired deadline forces a typed
+  shed) — the ``serving.admit`` / ``serving.assemble`` /
+  ``serving.dispatch`` / ``serving.shed`` span vocabulary must appear,
+  every ``serving.dispatch`` must carry positive ``predicted_bytes``
+  and non-negative ``resident_bytes`` tags, and wherever a numeric
+  ``budget_bytes`` tag is present the backpressure property
+  ``predicted + resident <= budget`` must hold (the ISSUE 10
+  acceptance assertion, checked on every dump);
 - mesh-sharded semantics (ISSUE 7): the --workload run drives a 2x2
   dry-run mesh dispatch (the workload forces an 8-device CPU host
   platform for exactly this) — the ``sharded.*`` span vocabulary must
@@ -152,6 +162,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _cost_slo_semantics([s for _, s in spans])
         errors += _sharded_semantics([s for _, s in spans])
         errors += _expr_semantics([s for _, s in spans])
+        errors += _serving_semantics([s for _, s in spans])
     return errors
 
 
@@ -229,6 +240,67 @@ def _workload_semantics(spans: list[dict],
     errors += _sharded_semantics(spans, require=budget_semantics,
                                  complete=True)
     errors += _expr_semantics(spans, require=budget_semantics)
+    errors += _serving_semantics(spans, require=budget_semantics)
+    return errors
+
+
+def _serving_semantics(spans: list[dict],
+                       require: bool = False) -> list[str]:
+    """The serving loop's span vocabulary (roaringbitmap_tpu.serving,
+    docs/SERVING.md).  Arbitrary dumps validate the schemas wherever the
+    spans appear — including the HBM backpressure PROPERTY on every
+    ``serving.dispatch`` that carries a numeric budget tag; ``require``
+    (the --workload run, which drives an overloaded loop) additionally
+    demands the span vocabulary, a rejected admission, and a typed
+    shed."""
+    errors: list[str] = []
+    dispatches = [s for s in spans if s.get("name") == "serving.dispatch"]
+    for s in dispatches:
+        tags = s.get("tags") or {}
+        if not isinstance(tags.get("pool"), int) or tags["pool"] < 1:
+            errors.append(f"serving.dispatch span without a positive "
+                          f"pool tag: {tags!r}")
+        p = tags.get("predicted_bytes")
+        if not isinstance(p, (int, float)) or p <= 0:
+            errors.append(f"serving.dispatch span without positive "
+                          f"predicted_bytes: {tags!r}")
+        r = tags.get("resident_bytes")
+        if not isinstance(r, (int, float)) or r < 0:
+            errors.append(f"serving.dispatch span without non-negative "
+                          f"resident_bytes: {tags!r}")
+        b = tags.get("budget_bytes")
+        if isinstance(b, (int, float)) \
+                and isinstance(p, (int, float)) \
+                and isinstance(r, (int, float)) and p + r > b:
+            errors.append(
+                "serving.dispatch violates the backpressure property "
+                f"predicted + resident <= budget: {tags!r}")
+    sheds = [s for s in spans if s.get("name") == "serving.shed"]
+    for s in sheds:
+        tags = s.get("tags") or {}
+        if not tags.get("reason") or not tags.get("tenant"):
+            errors.append(f"serving.shed span lacks reason/tenant tags: "
+                          f"{tags!r}")
+    admits = [s for s in spans if s.get("name") == "serving.admit"]
+    for s in admits:
+        out = (s.get("tags") or {}).get("outcome")
+        if out not in ("admitted", "rejected"):
+            errors.append(f"serving.admit span outcome not "
+                          f"admitted/rejected: {s.get('tags')!r}")
+    if require:
+        for required in ("serving.admit", "serving.assemble",
+                         "serving.dispatch", "serving.shed"):
+            if not any(s.get("name") == required for s in spans):
+                errors.append(f"no {required} span — the serving loop "
+                              "was not traced")
+        if not any((s.get("tags") or {}).get("outcome") == "rejected"
+                   for s in admits):
+            errors.append("no rejected serving.admit span — the forced "
+                          "queue-cap admission case did not record")
+        if not any((s.get("tags") or {}).get("reason") == "expired"
+                   for s in sheds):
+            errors.append("no expired serving.shed span — the forced "
+                          "deadline-shed case did not record")
     return errors
 
 
@@ -579,6 +651,47 @@ def run_workload(path: str) -> None:
                   for rows in sharded.execute(ms_pool)]
         assert sh_got == ms_clean, "2x2 mesh dispatch diverged from the "\
             "single-device pool"
+
+        # serving lane (ISSUE 10): an OVERLOADED continuous-batching
+        # burst over the same tenants — a tiny per-tenant queue cap
+        # forces a typed AdmissionRejected, a virtually-expired deadline
+        # forces a typed shed, and the served remainder is bit-exact;
+        # the serving.* span vocabulary + the backpressure property tags
+        # are what the semantics checks above pin
+        from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+        from roaringbitmap_tpu.runtime import guard as rt_guard
+        from roaringbitmap_tpu.serving import (AdmissionRejected,
+                                               RequestShed, ServingLoop,
+                                               ServingPolicy,
+                                               ServingRequest)
+
+        loop = ServingLoop(ms, ServingPolicy(
+            pool_target=4, max_queue=3, default_deadline_ms=60_000.0,
+            guard=rt_guard.GuardPolicy(backoff_base=0.0,
+                                       sleep=lambda s: None)))
+        tickets, rejected = [], 0
+        for i in range(15):
+            try:
+                tickets.append(loop.submit(ServingRequest(
+                    i % 3, BatchQuery("or", (0, 1, 2)),
+                    tenant=f"t{i % 3}")))
+            except AdmissionRejected as exc:
+                assert exc.reason == "queue_full"
+                rejected += 1
+        assert rejected > 0, "tiny queue cap did not reject"
+        loop.drain()                     # serve the admitted backlog
+        doomed = loop.submit(ServingRequest(
+            0, BatchQuery("or", (0, 1)), tenant="t0", deadline_ms=1.0))
+        faults.advance_clock(0.05)       # virtual: the deadline passed
+        loop.drain()
+        assert doomed.status == "shed" \
+            and isinstance(doomed.error, RequestShed), doomed.status
+        for t in tickets:
+            assert t.status == "done", t.status
+            ref = ms._engines[t.request.set_id]._sequential_one(
+                t.request.query)
+            assert t.result.cardinality == ref.cardinality, \
+                "serving result diverged from the sequential reference"
     finally:
         obs.disable()
 

@@ -144,6 +144,11 @@ ROW_BYTES = C.WORDS_PER_CONTAINER * 8
 #: u32 words — half the dense rows it replaces (8 rows x 8 KiB -> 32 KiB)
 NIBBLE_GROUP_BYTES = 4 * 2048 * 4
 
+#: bytes of one megakernel per-lane popcount partial (i32[128]) — the
+#: cardinality output unit of the one-kernel hot path (ops.megakernel):
+#: 16x smaller than flushing the 8 KiB row it summarizes
+MEGA_CARD_ROW_BYTES = 128 * 4
+
 
 def dense_rows_bytes(n_rows: int) -> int:
     """HBM bytes of ``n_rows`` densified container rows."""
@@ -248,6 +253,18 @@ def predict_batch_dispatch_bytes(bucket_sigs: list, kind: str,
     """
     gather = scratch = heads = outputs = 0
     for op, q, r_pad, k_pad, _n_steps, needs_words in bucket_sigs:
+        if engine == "megakernel":
+            # the one-kernel hot path (ops.megakernel): operand rows
+            # stream straight from the resident image through the
+            # BlockSpec gather and every reduce head lives in the VMEM
+            # scratch accumulator — no HBM gather block, no doubling
+            # scratch, no head tensor.  Only the outputs remain: one
+            # 512 B per-lane popcount partial per key slot, plus the
+            # result rows for bitmap-form queries.
+            outputs += q * k_pad * MEGA_CARD_ROW_BYTES
+            if needs_words:
+                outputs += q * k_pad * ROW_BYTES
+            continue
         block = q * r_pad * ROW_BYTES
         gather += block
         if engine != "pallas":
@@ -284,7 +301,8 @@ def predict_batch_dispatch_word_ops(bucket_sigs: list, kind: str,
     words = 2048           # u32 lanes per container row
     total = 0
     for op, q, r_pad, k_pad, n_steps, needs_words in bucket_sigs:
-        passes = 1 if engine == "pallas" else max(1, int(n_steps))
+        passes = (1 if engine in ("pallas", "megakernel")
+                  else max(1, int(n_steps)))
         total += q * r_pad * words * passes          # segmented reduce
         head_rows = q * (k_pad + 1)
         total += head_rows * words                   # mask + popcount pass
@@ -327,6 +345,15 @@ def predict_expr_dispatch_bytes(expr_sigs, engine: str) -> dict:
     for sig in expr_sigs:
         kind, bitmap_form, steps, _root, root_k = sig
         if kind != "fused":
+            continue
+        if engine == "megakernel":
+            # one-kernel lowering: leaf rows stream through the kernel's
+            # BlockSpec gather and combine intermediates are VMEM slots
+            # — only the root's popcount partials (and its rows, for
+            # bitmap form) reach HBM
+            outputs += root_k * MEGA_CARD_ROW_BYTES
+            if bitmap_form:
+                outputs += root_k * ROW_BYTES
             continue
         for step in steps:
             skind, _op, k, copies = _expr_step_rows(step)

@@ -215,7 +215,8 @@ TRACKER = CostTracker()
 
 
 def record_dispatch(site: str, engine: str, cost: dict | None,
-                    device_s: float, devices: int = 1, **extra) -> dict:
+                    device_s: float, devices: int = 1,
+                    est: dict | None = None, **extra) -> dict:
     """Per-dispatch cost accounting: combine the program's static cost
     analysis with the measured launch time into achieved rates + the
     roofline fraction, push the gauges, feed the tracker, and return the
@@ -223,10 +224,24 @@ def record_dispatch(site: str, engine: str, cost: dict | None,
     payload.  ``devices`` scales the roofline ceilings for mesh-sharded
     launches: the peak table is per-device, and an SPMD program's static
     cost analysis counts the WHOLE mesh's flops/bytes, so its legal time
-    bound divides by the device count."""
+    bound divides by the device count.
+
+    ``est`` is the caller's model estimate ``{"flops", "bytes_accessed"}``
+    (the insights footprint/word-op model): when the compiler's own
+    analysis is missing or reports no bytes — ``cost_analysis()`` on
+    ``pallas_call`` programs can legally return zero/partial
+    ``bytes_accessed`` — the estimate takes its place so the roofline
+    gauge stays meaningful instead of pinning to a nonsense fraction,
+    and the event is flagged ``estimated=True``."""
     doc: dict = {"device_ms": round(max(0.0, device_s) * 1e3, 4), **extra}
     if devices > 1:
         doc["devices"] = int(devices)
+    if est is not None and (cost is None
+                            or cost.get("bytes_accessed", 0.0) <= 0.0):
+        cost = {"flops": float(est.get("flops") or 0.0),
+                "bytes_accessed": float(est.get("bytes_accessed") or 0.0),
+                "transcendentals": 0.0}
+        doc["estimated"] = True
     _metrics.counter("rb_device_time_seconds_total", site=site,
                      engine=engine).inc(max(0.0, device_s))
     if cost is not None:

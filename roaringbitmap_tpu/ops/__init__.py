@@ -1,3 +1,3 @@
-from . import dense, kernels, packing
+from . import dense, kernels, megakernel, packing
 
-__all__ = ["dense", "kernels", "packing"]
+__all__ = ["dense", "kernels", "megakernel", "packing"]

@@ -1,0 +1,685 @@
+"""One-kernel hot path: a Pallas persistent megakernel for the fused
+expression pipeline (ROADMAP item 3).
+
+The PR 8 fused path still lowers to gather -> segmented reduce ->
+key-aligned combine passes as SEPARATE XLA ops: every stage round-trips
+its ``u32[K, 2048]`` blocks through HBM, which is exactly the
+intermediate-materialization cost the Roaring lazy/horizontal-aggregation
+argument says to avoid (PAPERS.md §1 — ``lazyor``/``repairAfterLazy``
+keep the accumulator hot and repair once at the end).  This module is
+the kernel-level analog: the WHOLE per-bucket expression pipeline — the
+operand gathers, every segmented reduce, the interior or/and/xor/andnot
+combine passes (alignment masking included), and the root popcount /
+bitmap outputs — executes as ONE ``pallas_call`` whose per-segment and
+per-node intermediates live in a VMEM scratch accumulator and never
+touch HBM.
+
+Execution model
+---------------
+The plan-time **assembler** (:func:`build_full` / :func:`build_combines`)
+flattens a bucketed batch plan plus its compiled expression sections
+(parallel.expr.ExprSection) into a static instruction stream — one grid
+step per instruction, seven scalar-prefetched i32 arrays (opcode /
+dst-slot / src-slot / row / bank / out-row / card-row).  The kernel body
+is a 15-way ``lax.select_n`` over bitwise micro-ops against a
+``u32[S, 16, 128]`` VMEM scratch (``pltpu.VMEM`` — never flushed to
+HBM):
+
+- **row ops** stream one operand row per step straight from the resident
+  image via the input BlockSpec's prefetched index map (``row[i]``) —
+  the gather never materializes an HBM copy;
+- **reduce** = LOAD_ROW for a segment's first row + OP_ROW for the rest
+  (the host assembler walks only REAL rows, so padding work and the
+  ``is_head`` recomputation of the multi-op kernels disappear, and the
+  AND identity/workShyAnd masking folds into plan-time ZEROs);
+- **combine** = slot-to-slot bitwise ops; key-UNaligned children resolve
+  through plan-time index arrays into per-key slot/row sources, with
+  absent keys constant-folded to the op identity (skip for or/xor,
+  ZERO for and) — the ``force_heads_sig`` machinery of the multi-op
+  path folds into the kernel body: expr-feeding reduce heads simply
+  stay VMEM slots;
+- **outputs**: OUT flushes a slot's 8 KiB row to HBM only for
+  bitmap-form results; CARD writes a 512 B per-lane popcount partial
+  per key — the cardinality-only short circuit costs 16x less output
+  than a row, and nothing else leaves the chip.
+
+Two banks feed row ops: bank 0 is the resident (or pooled) row image,
+bank 1 ships ad-hoc leaf rows (and, in combine-only mode, the
+pre-gathered leaf rows).  ``mode="combine"`` (:func:`build_combines`) is
+the mesh composition: the sharded engine keeps its shard-local reduce +
+ppermute butterfly and hands the REPLICATED post-butterfly head tensors
+to the megakernel as bank 0, so the interior combine passes fuse into
+one kernel on every device.
+
+Budget math (docs/EXPRESSIONS.md "Megakernel lowering"): the scratch
+holds ``n_slots`` 8 KiB rows in VMEM (:data:`MAX_SLOTS` bounds it) and
+the instruction stream prefetches into SMEM (:data:`MAX_STEPS`); a plan
+past either bound reports ``fits() == False`` and the engines demote to
+the multi-op pallas rung — the existing pallas -> xla ladder is the
+safety net below that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import packing
+
+WORDS32 = packing.WORDS32
+_SUB, _LANE = 16, 128
+
+#: bytes of one accumulator slot (u32[16, 128] = one container row)
+SLOT_BYTES = _SUB * _LANE * 4
+
+#: VMEM accumulator ceiling: slots past this demote to the multi-op
+#: pallas rung (8 MiB of a ~16 MiB/core VMEM, leaving room for the
+#: streamed operand blocks and the double-buffered output windows)
+MAX_SLOTS = (8 << 20) // SLOT_BYTES
+
+#: instruction-stream ceiling: 7 i32 arrays prefetch into SMEM, so the
+#: stream is bounded well under the segmented kernels' loose
+#: SMEM_PREFETCH_MAX (7 * 4 B * 2^14 ≈ 448 KiB of SMEM)
+MAX_STEPS = 1 << 14
+
+# --------------------------------------------------------------- opcodes
+#
+# Every step reads acc[dst] (cur), acc[src] (srcv) and the banked row,
+# computes one value and writes it back to acc[dst]; OUT/CARD steps point
+# dst at the dead slot and route srcv to the output/card row their
+# prefetched orow/crow arrays select.  NOP-like steps are absorbed by
+# the dead slot / dead rows, so padding the stream to a pow2 costs
+# nothing but grid steps.
+
+(NOP, LOAD_ROW, OR_ROW, AND_ROW, XOR_ROW, ANDNOT_ROW_REV, ZERO,
+ COPY_SLOT, OR_SLOT, AND_SLOT, XOR_SLOT, ANDNOT_SLOT, ANDNOT_ROW,
+ OUT, CARD) = range(15)
+
+_OP_ROW = {"or": OR_ROW, "and": AND_ROW, "xor": XOR_ROW}
+_OP_SLOT = {"or": OR_SLOT, "and": AND_SLOT, "xor": XOR_SLOT}
+
+
+def _kernel(opc_ref, dst_ref, src_ref, row_ref, bank_ref, orow_ref,
+            crow_ref, wa_ref, wb_ref, out_ref, card_ref, acc_ref):
+    i = pl.program_id(0)
+    opc = opc_ref[i]
+    dst = dst_ref[i]
+    src = src_ref[i]
+    row = jnp.where(bank_ref[i] == 1, wb_ref[0], wa_ref[0])
+    cur = acc_ref[dst]
+    srcv = acc_ref[src]
+    acc_ref[dst] = jax.lax.select_n(
+        opc,
+        cur,                    # NOP
+        row,                    # LOAD_ROW
+        cur | row,              # OR_ROW
+        cur & row,              # AND_ROW
+        cur ^ row,              # XOR_ROW
+        row & ~cur,             # ANDNOT_ROW_REV (head & ~rest-union)
+        jnp.zeros_like(cur),    # ZERO
+        srcv,                   # COPY_SLOT
+        cur | srcv,             # OR_SLOT
+        cur & srcv,             # AND_SLOT
+        cur ^ srcv,             # XOR_SLOT
+        cur & ~srcv,            # ANDNOT_SLOT
+        cur & ~row,             # ANDNOT_ROW
+        cur,                    # OUT (dead-slot write)
+        cur,                    # CARD (dead-slot write)
+    )
+    # unconditional output writes: non-OUT/CARD steps land on the dead
+    # out/card row their index maps select, real steps carry acc[src]
+    out_ref[0] = srcv
+    card_ref[0] = jnp.sum(
+        jax.lax.population_count(srcv).astype(jnp.int32), axis=0)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+class _Emitter:
+    """Instruction-stream builder: one append per micro-op, pow2-padded
+    into the seven prefetch arrays at finish()."""
+
+    def __init__(self):
+        self.ops: list = []     # (opc, dst, src, row, bank, orow, crow)
+
+    def emit(self, opc, dst=0, src=0, row=0, bank=0, orow=None,
+             crow=None):
+        self.ops.append((opc, dst, src, row, bank, orow, crow))
+
+    def finish(self, n_slots: int, out_pad: int, card_pad: int) -> dict:
+        n = max(1, len(self.ops))
+        n_pad = packing.next_pow2(n)
+        host = {
+            "opc": np.zeros(n_pad, np.int32),
+            "dst": np.full(n_pad, n_slots, np.int32),
+            "src": np.zeros(n_pad, np.int32),
+            "row": np.zeros(n_pad, np.int32),
+            "bank": np.zeros(n_pad, np.int32),
+            "orow": np.full(n_pad, out_pad, np.int32),
+            "crow": np.full(n_pad, card_pad, np.int32),
+        }
+        for i, (opc, dst, src, row, bank, orow, crow) in enumerate(
+                self.ops):
+            host["opc"][i] = opc
+            host["dst"][i] = dst if opc not in (OUT, CARD) else n_slots
+            host["src"][i] = src
+            host["row"][i] = row
+            host["bank"][i] = bank
+            if orow is not None:
+                host["orow"][i] = orow
+            if crow is not None:
+                host["crow"][i] = crow
+        return host
+
+
+@dataclasses.dataclass
+class MegaPlan:
+    """One assembled megakernel program: the instruction stream (host
+    NumPy, device twins uploaded lazily — the multiset donate path
+    re-uploads fresh per launch like every other operand dict), the
+    static kernel shape, and the output-layout metadata the traced
+    wrappers slice the HBM outputs back through."""
+
+    mode: str                 # "full" | "combine"
+    n_steps: int              # real instruction count (pre-pad)
+    steps_pad: int
+    n_slots: int              # real accumulator slots (pre-pad)
+    slots_pad: int
+    out_pad: int              # pow2-padded OUT rows (0 = none)
+    card_pad: int
+    host: dict | None         # instr arrays + "extra" (bank-1 rows) +
+    #                           "leafidx" (combine mode bank-1 gather)
+    arrays: dict | None = None
+    #: per bucket: (card_base, out_base | None, n_real, k_pad)
+    bucket_out: tuple = ()
+    #: per fused section: (card_base, out_base | None, k_root)
+    expr_out: tuple = ()
+    #: combine mode: heads-bank row base per op group (-1 = group
+    #: produces no heads and is never referenced)
+    group_base: tuple = ()
+    #: static bank-1 row count (survives the host drop — part of the
+    #: program-shape signature)
+    extra_rows: int = 1
+    leaf_rows: int = 0
+
+    @property
+    def signature(self) -> tuple:
+        return (self.mode, self.steps_pad, self.slots_pad, self.out_pad,
+                self.card_pad, self.extra_rows, self.leaf_rows,
+                self.bucket_out, self.expr_out)
+
+    def fits(self) -> bool:
+        return (self.slots_pad + 1 <= MAX_SLOTS
+                and self.steps_pad <= MAX_STEPS)
+
+    @property
+    def vmem_bytes(self) -> int:
+        return (self.slots_pad + 1) * SLOT_BYTES
+
+    def stats_event(self) -> dict:
+        """The ``expr.megakernel`` span-event payload
+        (docs/OBSERVABILITY.md; tools/check_trace.py pins the schema)."""
+        return {"mode": self.mode, "steps": int(self.n_steps),
+                "slots": int(self.n_slots),
+                "vmem_bytes": int(self.vmem_bytes),
+                "out_rows": int(self.out_pad),
+                "card_rows": int(self.card_pad),
+                "sections": len(self.expr_out)}
+
+    def device_arrays(self, fresh: bool = False) -> dict:
+        if fresh:
+            if self.host is None:
+                raise RuntimeError(
+                    "fresh=True needs the host instruction stream, which "
+                    "this plan dropped after its cached upload")
+            return {k: jnp.asarray(v) for k, v in self.host.items()}
+        if self.arrays is None:
+            self.arrays = {k: jnp.asarray(v) for k, v in self.host.items()}
+        return self.arrays
+
+
+# ------------------------------------------------------------- assembler
+
+def _emit_bucket(em: _Emitter, b, base: int, card_base: int,
+                 out_base) -> None:
+    """One shape bucket's whole pipeline: per-(query, key) segmented
+    reduce over REAL rows only, plan-time masking (heads_ok / workShyAnd
+    key_keep / andnot head pass), per-slot popcount partials, and OUT
+    rows when the bucket's own needs_words demands them."""
+    host = b.host
+    n_real, k_pad = len(b.qids), b.k_pad
+    red = OR_ROW if b.op in ("or", "andnot") else _OP_ROW[b.op]
+    for qi in range(n_real):
+        valid = host["valid"][qi]
+        rows = host["gather"][qi][valid]
+        segs = host["seg_local"][qi][valid]
+        for k in range(k_pad):
+            slot = base + qi * k_pad + k
+            ok = bool(host["heads_ok"][qi, k])
+            if b.op == "and" and not bool(host["key_keep"][qi, k]):
+                ok = False
+            seg_rows = rows[segs == k] if ok else rows[:0]
+            if b.op == "andnot":
+                if not bool(host["head_ok"][qi, k]):
+                    em.emit(ZERO, dst=slot)
+                elif seg_rows.size == 0:
+                    # no rest rows: head & ~0 == the head row itself
+                    em.emit(LOAD_ROW, dst=slot,
+                            row=int(host["head_gather"][qi, k]))
+                else:
+                    em.emit(LOAD_ROW, dst=slot, row=int(seg_rows[0]))
+                    for r in seg_rows[1:]:
+                        em.emit(OR_ROW, dst=slot, row=int(r))
+                    em.emit(ANDNOT_ROW_REV, dst=slot,
+                            row=int(host["head_gather"][qi, k]))
+            elif not ok or seg_rows.size == 0:
+                em.emit(ZERO, dst=slot)
+            else:
+                em.emit(LOAD_ROW, dst=slot, row=int(seg_rows[0]))
+                for r in seg_rows[1:]:
+                    em.emit(red, dst=slot, row=int(r))
+    for qi in range(n_real):
+        for k in range(k_pad):
+            slot = base + qi * k_pad + k
+            em.emit(CARD, src=slot, crow=card_base + qi * k_pad + k)
+            if out_base is not None:
+                em.emit(OUT, src=slot, orow=out_base + qi * k_pad + k)
+
+
+class _SectionCtx:
+    """Per-section assembly state: maps compiled steps to (slot | row)
+    sources for each of the node's keys."""
+
+    def __init__(self, sec, slot_of_reduce, extra_base, leaf_row):
+        self.sec = sec
+        self.slot_of_reduce = slot_of_reduce
+        self.extra_base = extra_base
+        self.leaf_row = leaf_row
+        self.combine_base: dict = {}
+
+    def source(self, ci: int, j: int):
+        """("slot", s) | ("row", bank, r) for step ``ci``'s key ``j``."""
+        st = self.sec.steps[ci]
+        kind = st[0]
+        if kind == "leaf":
+            bank, row = self.leaf_row(self.sec, ci, j)
+            return ("row", bank, row)
+        if kind == "adhoc":
+            return ("row", 1, self.extra_base[ci] + j)
+        if kind == "reduce":
+            _, bi, slot, _kq = st
+            return self.slot_of_reduce(bi, slot, j)
+        return ("slot", self.combine_base[ci] + j)
+
+
+def _emit_combine(em: _Emitter, ctx: _SectionCtx, si: int) -> None:
+    """One interior combine node: per key, resolve each child through
+    the plan-time alignment arrays into a slot/row source, constant-fold
+    absent keys to the op identity, and chain the bitwise micro-ops."""
+    sec = ctx.sec
+    _, op, children, kq = sec.steps[si]
+    base = ctx.combine_base[si]
+    host = sec.host
+    for j in range(kq):
+        dst = base + j
+        parts = []
+        for k, (ci, aligned) in enumerate(children):
+            if aligned:
+                jj, ok = j, True
+            else:
+                jj = int(host[f"i{si}_{k}"][j])
+                ok = bool(host[f"o{si}_{k}"][j])
+            parts.append((ok, ctx.source(ci, jj) if ok else None))
+        if op == "andnot":
+            # head is key-aligned by construction (node keys ARE its
+            # keys); absent rest children contribute ~0 == all-ones
+            _, head = parts[0]
+            _emit_set(em, dst, head)
+            for ok, srcp in parts[1:]:
+                if ok:
+                    _emit_op(em, dst, srcp, ANDNOT_SLOT, ANDNOT_ROW)
+        elif op == "and":
+            if not all(ok for ok, _ in parts):
+                # an absent AND child annihilates the key (cannot
+                # happen for intersection key spaces — kept as the
+                # plan-time guard the traced path encodes as a mask)
+                em.emit(ZERO, dst=dst)
+                continue
+            _emit_set(em, dst, parts[0][1])
+            for _, srcp in parts[1:]:
+                _emit_op(em, dst, srcp, AND_SLOT, AND_ROW)
+        else:
+            live = [srcp for ok, srcp in parts if ok]
+            if not live:
+                em.emit(ZERO, dst=dst)
+                continue
+            _emit_set(em, dst, live[0])
+            s_op, r_op = (_OP_SLOT[op], _OP_ROW[op])
+            for srcp in live[1:]:
+                _emit_op(em, dst, srcp, s_op, r_op)
+
+
+def _emit_set(em: _Emitter, dst: int, srcp) -> None:
+    if srcp[0] == "slot":
+        em.emit(COPY_SLOT, dst=dst, src=srcp[1])
+    else:
+        em.emit(LOAD_ROW, dst=dst, row=srcp[2], bank=srcp[1])
+
+
+def _emit_op(em: _Emitter, dst: int, srcp, slot_op: int,
+             row_op: int) -> None:
+    if srcp[0] == "slot":
+        em.emit(slot_op, dst=dst, src=srcp[1])
+    else:
+        em.emit(row_op, dst=dst, row=srcp[2], bank=srcp[1])
+
+
+def _pack_extra(sections) -> tuple:
+    """Bank-1 rows: every ad-hoc leaf's container rows, concatenated;
+    per-(section-id, step) base offsets for the assembler."""
+    rows, bases = [], {}
+    off = 0
+    for sid, sec in enumerate(sections):
+        for ci, st in enumerate(sec.steps):
+            if st[0] == "adhoc":
+                w = sec.host[f"w{ci}"]
+                bases[(sid, ci)] = off
+                rows.append(np.asarray(w, np.uint32))
+                off += int(w.shape[0])
+    if rows:
+        return np.concatenate(rows, axis=0), bases
+    return np.zeros((1, WORDS32), np.uint32), bases
+
+
+def _assemble(mode: str, buckets, sections, slot_of_reduce, leaf_row,
+              extra, extra_bases, emit_buckets: bool) -> MegaPlan:
+    """Shared assembly tail of :func:`build_full` /
+    :func:`build_combines`: allocate accumulator slots and output rows,
+    walk buckets (full mode) then every section's combine steps in
+    topological order, and close with the CARD/OUT output phase."""
+    n_slots = 0
+    bucket_base: list = []
+    if emit_buckets:
+        for b in buckets:
+            bucket_base.append(n_slots)
+            n_slots += len(b.qids) * b.k_pad
+    n_card = n_out = 0
+    bucket_out: list = []
+    if emit_buckets:
+        for b in buckets:
+            ob = n_out if b.needs_words else None
+            bucket_out.append((n_card, ob, len(b.qids), b.k_pad))
+            n_card += len(b.qids) * b.k_pad
+            if ob is not None:
+                n_out += len(b.qids) * b.k_pad
+
+    em = _Emitter()
+    if emit_buckets:
+        for b, base, (cb, ob, _n, _k) in zip(buckets, bucket_base,
+                                             bucket_out):
+            _emit_bucket(em, b, base, cb, ob)
+
+    ctxs: list = []
+    for sid, sec in enumerate(sections):
+        ctx = _SectionCtx(
+            sec,
+            slot_of_reduce=slot_of_reduce(bucket_base),
+            extra_base={ci: extra_bases.get((sid, ci), 0)
+                        for ci, st in enumerate(sec.steps)
+                        if st[0] == "adhoc"},
+            leaf_row=leaf_row)
+        for si, st in enumerate(sec.steps):
+            if st[0] == "combine":
+                ctx.combine_base[si] = n_slots
+                n_slots += int(st[3])
+        ctxs.append(ctx)
+    for ctx in ctxs:
+        for si, st in enumerate(ctx.sec.steps):
+            if st[0] == "combine":
+                _emit_combine(em, ctx, si)
+
+    expr_out: list = []
+    for ctx in ctxs:
+        sec = ctx.sec
+        k_root = int(sec.root_keys.size)
+        root_srcs = [ctx.source(sec.root, j) for j in range(k_root)]
+        if any(s[0] == "row" for s in root_srcs):
+            # a combine that collapsed to its only live child (bare
+            # leaf/ad-hoc root — or a reduce root in combine mode, where
+            # reduce values are bank rows): give the root its own slots
+            # so OUT/CARD have a slot source
+            base = n_slots
+            n_slots += k_root
+            for j, s in enumerate(root_srcs):
+                _emit_set(em, base + j, s)
+            root_slots = [base + j for j in range(k_root)]
+        else:
+            root_slots = [s[1] for s in root_srcs]
+        ob = n_out if sec.form == "bitmap" else None
+        expr_out.append((n_card, ob, k_root))
+        for j in range(k_root):
+            em.emit(CARD, src=root_slots[j], crow=n_card + j)
+            if ob is not None:
+                em.emit(OUT, src=root_slots[j], orow=n_out + j)
+        n_card += k_root
+        if ob is not None:
+            n_out += k_root
+
+    slots_pad = packing.next_pow2(max(1, n_slots))
+    out_pad = packing.next_pow2(n_out) if n_out else 0
+    card_pad = packing.next_pow2(max(1, n_card))
+    host = em.finish(slots_pad, out_pad, card_pad)
+    host["extra"] = extra
+    return MegaPlan(
+        mode=mode, n_steps=len(em.ops),
+        steps_pad=int(host["opc"].shape[0]),
+        n_slots=n_slots, slots_pad=slots_pad,
+        out_pad=out_pad, card_pad=card_pad, host=host,
+        bucket_out=tuple(bucket_out), expr_out=tuple(expr_out),
+        extra_rows=int(extra.shape[0]))
+
+
+def build_full(buckets, sections) -> MegaPlan:
+    """Assemble the FULL pipeline megakernel for a bucketed plan with
+    fused expression sections: every bucket's segmented reduce + post
+    passes AND every section's combine/output steps in one instruction
+    stream.  Bucket/section host arrays must still be alive (the
+    engines call this at plan time, before the upload-and-drop
+    discipline runs); row indices are whatever image space the plan
+    gathers from (set-local for BatchEngine, pooled for the multiset
+    planner — the assembler just copies them into the stream)."""
+    fused = [s for s in sections if s.kind == "fused"]
+    extra, extra_bases = _pack_extra(fused)
+
+    def slot_of_reduce(bucket_base):
+        def fn(bi, slot, j):
+            return ("slot", bucket_base[bi] + slot * buckets[bi].k_pad + j)
+        return fn
+
+    def leaf_row(sec, ci, j):
+        # full mode streams leaves straight from the row image (bank 0)
+        return 0, int(sec.host[f"g{ci}"][j])
+
+    return _assemble("full", buckets, fused, slot_of_reduce, leaf_row,
+                     extra, extra_bases, emit_buckets=True)
+
+
+def build_combines(buckets, op_groups, sections, expr_bis) -> MegaPlan:
+    """Assemble the COMBINE-ONLY megakernel (the mesh composition):
+    reduce-node values arrive as rows of the post-butterfly flat head
+    bank (bank 0 — the padded ``q * (k_pad + 1)`` layout of
+    ``expr.traced_bucket_heads``), resident leaves as pre-gathered rows
+    and ad-hoc leaves as shipped rows (bank 1); only the combine steps
+    and root outputs run in-kernel."""
+    fused = [s for s in sections if s.kind == "fused"]
+    extra, extra_bases = _pack_extra(fused)
+
+    # bank-0 layout: concat of every head-PRODUCING group's flat tensor
+    produces = [g.needs_words or any(bi in expr_bis
+                                     for bi in g.bucket_idx)
+                for g in op_groups]
+    group_base, off = [], 0
+    for g, p in zip(op_groups, produces):
+        group_base.append(off if p else -1)
+        if p:
+            off += int(g.nseg)
+    bucket_row0 = {}
+    for g, gb in zip(op_groups, group_base):
+        for bi, s0 in zip(g.bucket_idx, g.seg_offs):
+            bucket_row0[bi] = (gb + s0) if gb >= 0 else -1
+
+    def slot_of_reduce(_bucket_base):
+        def fn(bi, slot, j):
+            r0 = bucket_row0[bi]
+            if r0 < 0:
+                raise AssertionError(
+                    f"expr-feeding bucket {bi} in a headless op group")
+            return ("row", 0, r0 + slot * (buckets[bi].k_pad + 1) + j)
+        return fn
+
+    # bank-1 layout: pre-gathered leaf rows first, ad-hoc rows after
+    leaf_parts, leaf_bases = [], {}
+    off = 0
+    for sid, sec in enumerate(fused):
+        for ci, st in enumerate(sec.steps):
+            if st[0] == "leaf":
+                g = np.asarray(sec.host[f"g{ci}"], np.int64)
+                leaf_bases[(sid, ci)] = off
+                leaf_parts.append(g)
+                off += int(g.size)
+    leaf_idx = (np.concatenate(leaf_parts) if leaf_parts
+                else np.zeros(0, np.int64)).astype(np.int32)
+    n_leaf = int(leaf_idx.size)
+    sec_id = {id(sec): sid for sid, sec in enumerate(fused)}
+
+    def leaf_row(sec, ci, j):
+        # combine mode pre-gathers leaves into bank 1, before the extras
+        return 1, leaf_bases[(sec_id[id(sec)], ci)] + j
+
+    # extra-bank rows sit AFTER the gathered leaf rows in bank 1
+    extra_bases = {k: v + n_leaf for k, v in extra_bases.items()}
+    mega = _assemble("combine", buckets, fused, slot_of_reduce, leaf_row,
+                     extra, extra_bases, emit_buckets=False)
+    mega.host["leafidx"] = leaf_idx
+    mega.group_base = tuple(group_base)
+    mega.leaf_rows = n_leaf
+    return mega
+
+
+# --------------------------------------------------------- traced eval
+
+def _raw_call(mega: MegaPlan, bank_a, bank_b, arrs):
+    """The pallas_call: one sequential grid pass over the instruction
+    stream.  Returns the raw padded (out, cards) buffers."""
+    steps = int(arrs["opc"].shape[0])
+    out_pad = max(1, mega.out_pad)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=7,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((1, _SUB, _LANE),
+                         lambda i, opc, dst, src, row, bank, orow, crow:
+                         (jnp.where(bank[i] == 0, row[i], 0), 0, 0)),
+            pl.BlockSpec((1, _SUB, _LANE),
+                         lambda i, opc, dst, src, row, bank, orow, crow:
+                         (jnp.where(bank[i] == 1, row[i], 0), 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _SUB, _LANE),
+                         lambda i, opc, dst, src, row, bank, orow, crow:
+                         (orow[i], 0, 0)),
+            pl.BlockSpec((1, _LANE),
+                         lambda i, opc, dst, src, row, bank, orow, crow:
+                         (crow[i], 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((mega.slots_pad + 1, _SUB, _LANE), jnp.uint32)],
+    )
+    out, cards = pl.pallas_call(
+        _kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((out_pad + 1, _SUB, _LANE), jnp.uint32),
+            jax.ShapeDtypeStruct((mega.card_pad + 1, _LANE), jnp.int32),
+        ],
+        interpret=_use_interpret(),
+    )(arrs["opc"], arrs["dst"], arrs["src"], arrs["row"], arrs["bank"],
+      arrs["orow"], arrs["crow"],
+      bank_a.reshape(-1, _SUB, _LANE), bank_b.reshape(-1, _SUB, _LANE))
+    return out, cards
+
+
+def _call(mega: MegaPlan, bank_a, bank_b, arrs, wrap=None):
+    """One megakernel dispatch -> (out_rows u32[out_pad, 2048] | None,
+    card_rows i32[card_pad, 128]).  ``wrap`` (the mesh composition)
+    wraps the raw call — e.g. a fully-replicated ``shard_map`` so every
+    device runs the whole kernel on its replica instead of letting the
+    SPMD partitioner slice the grid."""
+    fn = lambda a, b, r: _raw_call(mega, a, b, r)
+    if wrap is not None:
+        fn = wrap(fn)
+    out, cards = fn(bank_a, bank_b, arrs)
+    out_rows = (out[:mega.out_pad].reshape(mega.out_pad, WORDS32)
+                if mega.out_pad else None)
+    return out_rows, cards[:mega.card_pad]
+
+
+def _slice_outputs(mega: MegaPlan, out_rows, card_rows):
+    """HBM outputs -> (per-bucket outs, per-section expr outs), the
+    engines' run-fn contract: buckets get (heads|None, cards[n, k_pad]),
+    fused sections get (heads|None, cards[K])."""
+    cards = jnp.sum(card_rows, axis=1)
+    outs = []
+    for cb, ob, n, k_pad in mega.bucket_out:
+        c = cards[cb:cb + n * k_pad].reshape(n, k_pad)
+        h = (out_rows[ob:ob + n * k_pad].reshape(n, k_pad, WORDS32)
+             if ob is not None else None)
+        outs.append((h, c))
+    expr_outs = []
+    for cb, ob, k_root in mega.expr_out:
+        c = cards[cb:cb + k_root]
+        h = out_rows[ob:ob + k_root] if ob is not None else None
+        expr_outs.append((h, c))
+    return outs, expr_outs
+
+
+def eval_full(mega: MegaPlan, words, arrs):
+    """Traced FULL-mode evaluation: ``words`` is the resident (or
+    pooled) row image the stream's bank-0 rows index; returns the
+    ``(bucket_outs, expr_outs)`` pair the engines' fused run fns
+    return."""
+    out_rows, card_rows = _call(mega, words, arrs["extra"], arrs)
+    return _slice_outputs(mega, out_rows, card_rows)
+
+
+def eval_combines(mega: MegaPlan, group_heads, pool_words, arrs,
+                  wrap=None):
+    """Traced COMBINE-mode evaluation (the sharded engine's replicated
+    post-butterfly side): bank 0 = the producing groups' flat head
+    tensors, bank 1 = pre-gathered leaf rows + ad-hoc rows.  The leaf
+    gather runs OUTSIDE the kernel (it may cross shards on a
+    rows-sharded pool; ``wrap``'s replicated in_specs then hand every
+    device the full banks).  Returns the per-section expr outs only
+    (bucket outputs stay with the group bodies)."""
+    bank_a = [h for h, _ in group_heads if h is not None]
+    bank_a = (jnp.concatenate(bank_a, axis=0) if bank_a
+              else jnp.zeros((1, WORDS32), jnp.uint32))
+    leaf_idx = arrs["leafidx"]
+    parts = []
+    if int(leaf_idx.shape[0]):
+        parts.append(pool_words[leaf_idx])
+    parts.append(arrs["extra"])
+    bank_b = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
+                                                              axis=0)
+    kernel_arrs = {k: v for k, v in arrs.items() if k != "leafidx"}
+    out_rows, card_rows = _call(mega, bank_a, bank_b, kernel_arrs,
+                                wrap=wrap)
+    _outs, expr_outs = _slice_outputs(mega, out_rows, card_rows)
+    return expr_outs

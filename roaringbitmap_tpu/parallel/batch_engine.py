@@ -74,7 +74,7 @@ from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
-from ..ops import dense, kernels, packing
+from ..ops import dense, kernels, megakernel, packing
 from ..runtime import faults, guard
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
@@ -94,8 +94,30 @@ def query_desc(q) -> str:
     return f"{q.op} over {q.operands}"
 
 #: engine fallback ladder, fastest first; every guarded dispatch ends at
-#: the CPU sequential reference rung appended by runtime.guard
-ENGINE_LADDER = ("pallas", "xla", "xla-vmap")
+#: the CPU sequential reference rung appended by runtime.guard.  The
+#: top rung is the one-kernel hot path (ops.megakernel): the whole
+#: fused-expression pipeline in one Pallas grid kernel — plans without
+#: fused sections (or past its VMEM/SMEM budget) resolve it down to the
+#: multi-op pallas rung, and the existing pallas -> xla demotion is the
+#: safety net below that
+ENGINE_LADDER = ("megakernel", "pallas", "xla", "xla-vmap")
+
+
+def resolve_query_engine(engine: str, queries) -> str:
+    """The guard chain's STARTING rung for a batch: an explicit
+    ``engine="megakernel"`` always starts there; ``"auto"`` starts there
+    only where auto already means pallas (TPU) AND the batch carries
+    expression queries — flat-only batches gain nothing from the
+    instruction-stream kernel, and the CPU proxy keeps its xla default.
+    ``_bucket_engine`` still demotes a megakernel rung whose plan has no
+    fused sections or doesn't fit the VMEM/SMEM budget."""
+    if engine == "megakernel":
+        return engine
+    eng = _engine(engine)
+    if (engine == "auto" and eng == "pallas"
+            and any(isinstance(q, expr_mod.ExprQuery) for q in queries)):
+        return "megakernel"
+    return eng
 
 #: cache caps: a long-lived server with adversarial query shapes must not
 #: grow the prepared-plan / compiled-program maps without bound (plans are
@@ -253,11 +275,16 @@ class BatchPlan(list):
     for flat-only batches, and None-skipping for the internal pseudo
     reduce nodes fused expressions plant in the buckets."""
 
-    def __init__(self, buckets=(), exprs=(), owner=None, n_queries=0):
+    def __init__(self, buckets=(), exprs=(), owner=None, n_queries=0,
+                 mega=None):
         super().__init__(buckets)
         self.exprs = list(exprs)
         self.owner = owner if owner is not None else {}
         self.n_queries = n_queries
+        #: the assembled one-kernel program (ops.megakernel.MegaPlan)
+        #: when the plan has fused sections; the megakernel rung demotes
+        #: when it is None or past its VMEM/SMEM budget
+        self.mega = mega
 
     @property
     def fused(self) -> list:
@@ -399,18 +426,6 @@ class BatchEngine:
         rows = np.flatnonzero(self._row_src == index)
         return rows, self.keys[self._row_seg[rows]]
 
-    def _plan_bucket(self, op: str, items) -> _Bucket:
-        """items: [(qid, query, gather, seg_local, keys_q, key_keep,
-        head_rows)] sharing (op, operand-count rung) — the module-level
-        ``plan_bucket`` shared with parallel.multiset.  Single-set plans
-        dispatch straight from the cache (no remap, no donation), so the
-        device arrays upload here and the NumPy twins are dropped rather
-        than held for the plan's LRU lifetime."""
-        b = plan_bucket(op, items)
-        b.device_arrays()
-        b.host = None
-        return b
-
     def plan(self, queries) -> BatchPlan:
         """Bucketed plan: group by (op, pow2 operand count), pad shapes.
 
@@ -456,19 +471,33 @@ class BatchEngine:
                 else:
                     add_item(q, qid)
             with obs_trace.span("batch.bucket", groups=len(groups)):
-                buckets = [self._plan_bucket(op, items)
+                buckets = [plan_bucket(op, items)
                            for (op, _), items in sorted(groups.items())]
             expr_mod.finalize_sections(sections, buckets)
+            # the one-kernel program assembles from the buckets' and
+            # sections' HOST arrays, so it must build before the
+            # upload-and-drop discipline below frees them
+            mega = None
+            if expr_mod.fused_of(sections):
+                mega = megakernel.build_full(buckets, sections)
+            # single-set plans dispatch sync from the cache (no remap,
+            # no donation), so the device arrays upload here and every
+            # NumPy twin is dropped rather than held for the plan's LRU
+            # lifetime
+            for b in buckets:
+                b.device_arrays()
+                b.host = None
             for sec in sections:
                 if sec.kind == "fused":
-                    # single-set plans dispatch sync from the cache, so
-                    # the section uploads here and drops its host twin —
-                    # the _plan_bucket discipline
                     sec.device_arrays()
                     sec.host = None
+            if mega is not None:
+                mega.device_arrays()
+                mega.host = None
             plan = BatchPlan(buckets, exprs=sections, owner=owner,
-                             n_queries=len(queries))
-            sp.tag(buckets=len(plan), exprs=len(sections))
+                             n_queries=len(queries), mega=mega)
+            sp.tag(buckets=len(plan), exprs=len(sections),
+                   mega=mega is not None)
         self._plans.put(key, plan)
         return plan
 
@@ -487,8 +516,12 @@ class BatchEngine:
         if kind == "dense":
             return src
         streams, chunks, _ = src
+        # the megakernel gathers from the rebuilt image like pallas does,
+        # so its in-program densify is the chunked one-hot kernel too
+        pallas_like = eng in ("pallas", "megakernel")
         return self._ds._densify_from(
-            streams, chunks if eng == "pallas" else None, eng)
+            streams, chunks if pallas_like else None,
+            "pallas" if pallas_like else eng)
 
     def _bucket_body(self, words, b_sig, arrays, eng: str):
         """Traced body for one bucket — the module-level ``bucket_body``
@@ -515,6 +548,11 @@ class BatchEngine:
         src, kind = self._resident_src()
         sig = (eng, kind, tuple(b.signature for b in plan),
                plan.expr_signature)
+        if eng == "megakernel":
+            # the instruction stream's shape is plan data, not bucket
+            # shape: two plans sharing padded bucket signatures can still
+            # assemble different step/slot/output counts
+            sig = sig + (plan.mega.signature,)
         t_get = time.perf_counter()
         cached = self._programs.get(sig)
         if cached is not None:
@@ -528,28 +566,40 @@ class BatchEngine:
         with obs_slo.phase("program_build"), \
                 obs_trace.span("batch.program_build", engine=eng, kind=kind,
                                buckets=len(plan), exprs=len(fused)) as sp:
-            def run(src_in, arrays):
-                words = self._words_from_src(src_in, kind, eng)
-                barrays = arrays[:len(b_sigs)]
-                outs, heads_by_bi = [], [None] * len(b_sigs)
-                for bi, (s, a) in enumerate(zip(b_sigs, barrays)):
-                    # expr-feeding buckets compute heads IN-PROGRAM for
-                    # the combine steps; program outputs still follow
-                    # the bucket's own needs_words (internal reduce
-                    # heads are never read back — the fusion contract)
-                    heads, cards = bucket_body(
-                        words, s, a, eng, force_heads=bi in expr_bis)
-                    heads_by_bi[bi] = heads
-                    outs.append((heads if s[5] else None, cards))
-                if not fused:
-                    return outs
-                expr_outs = expr_mod.eval_sections(
-                    fused, arrays[len(b_sigs):], words, heads_by_bi)
-                return outs, expr_outs
+            if eng == "megakernel":
+                mega = plan.mega
+
+                def run(src_in, arrays):
+                    # the one-kernel hot path: gather + every segmented
+                    # reduce + combine passes + outputs in ONE pallas
+                    # grid kernel; VMEM accumulators carry the reduce
+                    # heads straight into the combines (ops.megakernel)
+                    words = self._words_from_src(src_in, kind, eng)
+                    return megakernel.eval_full(mega, words, arrays[0])
+            else:
+                def run(src_in, arrays):
+                    words = self._words_from_src(src_in, kind, eng)
+                    barrays = arrays[:len(b_sigs)]
+                    outs, heads_by_bi = [], [None] * len(b_sigs)
+                    for bi, (s, a) in enumerate(zip(b_sigs, barrays)):
+                        # expr-feeding buckets compute heads IN-PROGRAM
+                        # for the combine steps; program outputs still
+                        # follow the bucket's own needs_words (internal
+                        # reduce heads are never read back — the fusion
+                        # contract)
+                        heads, cards = bucket_body(
+                            words, s, a, eng, force_heads=bi in expr_bis)
+                        heads_by_bi[bi] = heads
+                        outs.append((heads if s[5] else None, cards))
+                    if not fused:
+                        return outs
+                    expr_outs = expr_mod.eval_sections(
+                        fused, arrays[len(b_sigs):], words, heads_by_bi)
+                    return outs, expr_outs
 
             t0 = time.perf_counter()
             compiled = jax.jit(run).lower(
-                src, self._launch_arrays(plan)).compile()
+                src, self._launch_arrays(plan, eng)).compile()
             compile_s = time.perf_counter() - t0
             obs_cost.observe_compile("batch_engine", "miss", compile_s)
             predicted = insights.predict_batch_dispatch_bytes(
@@ -571,24 +621,34 @@ class BatchEngine:
         self._programs.put(sig, cached)
         return cached
 
-    def _launch_arrays(self, plan) -> list:
+    def _launch_arrays(self, plan, eng: str = "xla") -> list:
         """The program's flat operand list: per-bucket arrays followed
         by the fused expression sections' arrays (split inside the run
-        fn by the static bucket count)."""
+        fn by the static bucket count).  The megakernel rung ships the
+        assembled instruction stream instead."""
+        if eng == "megakernel":
+            return [plan.mega.device_arrays()]
         arrays = [b.device_arrays() for b in plan]
         arrays.extend(s.device_arrays() for s in plan.fused)
         return arrays
 
     def _bucket_engine(self, plan, engine: str) -> str:
         eng = _engine(engine)
+        if eng == "megakernel" and not (
+                plan.mega is not None and plan.mega.fits()):
+            # no fused sections, or past the VMEM/SMEM instruction
+            # budget: the one-kernel rung resolves down to the multi-op
+            # pallas rung (whose own bounds apply below)
+            eng = "pallas"
+        ds = self._ds
+        if (eng in ("pallas", "megakernel")
+                and ds.words is None and ds._chunks is not None
+                and int(ds._chunks[1].size) > kernels.SMEM_PREFETCH_MAX):
+            eng = "xla"  # in-program chunk densify: chunk_row prefetch
         if eng == "pallas":
             longest = max((b.q * b.r_pad for b in plan), default=0)
             if longest > kernels.SMEM_PREFETCH_MAX:
                 eng = "xla"  # flat_seg prefetch must fit SMEM
-            ds = self._ds
-            if (ds.words is None and ds._chunks is not None
-                    and int(ds._chunks[1].size) > kernels.SMEM_PREFETCH_MAX):
-                eng = "xla"  # in-program chunk densify: chunk_row prefetch
         return eng
 
     def execute(self, queries, engine: str = "auto", jit: bool = True,
@@ -622,7 +682,8 @@ class BatchEngine:
                 return self._execute_once(queries, engine, jit,
                                           inject=False)
             policy = policy or guard.GuardPolicy.from_env()
-            chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
+            chain = guard.chain_from(
+                resolve_query_engine(engine, queries), ENGINE_LADDER)
             # SLO accounting + per-phase attribution for the whole execute
             # (splits and demotions included; the guard's own per-dispatch
             # context is suppressed under this one)
@@ -736,10 +797,14 @@ class BatchEngine:
                       if obs_trace.enabled() else None)
             t_launch = time.perf_counter()
             with obs_slo.phase("dispatch"):
-                outs = (compiled if jit else run)(src,
-                                                  self._launch_arrays(plan))
+                outs = (compiled if jit else run)(
+                    src, self._launch_arrays(plan, eng))
             if plan.exprs:
                 expr_mod.record_fused_dispatch("batch_engine", plan.exprs)
+            if eng == "megakernel":
+                # the one-kernel event (docs/OBSERVABILITY.md;
+                # tools/check_trace.py pins the schema)
+                sp.event("expr.megakernel", **plan.mega.stats_event())
             # sync before readback: the span's wall time is host work +
             # queueing, sync_ms is the device-side remainder.  The block
             # also runs untraced (the readback would wait anyway) so the
@@ -764,9 +829,14 @@ class BatchEngine:
             sp.event("batch.memory", **mem)
             # cost/roofline accounting: the program's static cost analysis
             # against the measured launch wall (tools/check_trace.py pins
-            # the batch.cost event schema)
+            # the batch.cost event schema).  The model estimate backs the
+            # gauge where cost_analysis under-reports (pallas programs
+            # can legally report zero bytes_accessed) — flagged
+            # estimated=True in the event.
             cost_ev = obs_cost.record_dispatch(
-                "batch_engine", eng, cost, launch_s, q=len(queries))
+                "batch_engine", eng, cost, launch_s,
+                est=self._cost_estimate(plan, eng, predicted),
+                q=len(queries))
             self.last_dispatch_cost = cost_ev
             sp.event("batch.cost", **cost_ev)
         with obs_slo.phase("readback"), \
@@ -802,6 +872,20 @@ class BatchEngine:
             results[0] = BatchResult(cardinality=results[0].cardinality + 1,
                                      bitmap=results[0].bitmap)
         return results
+
+    def _cost_estimate(self, plan, eng: str, predicted: dict) -> dict:
+        """Model fallback for the roofline gauge when the compiler's
+        cost_analysis under-reports (obs.cost.record_dispatch ``est``):
+        the unified word-op model as the flops proxy, the predicted
+        transient footprint as the byte proxy."""
+        word_ops = insights.predict_batch_dispatch_word_ops(
+            [b.signature for b in plan], self._resident_src()[1],
+            self._ds._n_rows, eng)
+        if plan.exprs:
+            word_ops += insights.predict_expr_word_ops(
+                plan.expr_signature, eng)
+        return {"flops": word_ops,
+                "bytes_accessed": predicted["peak_bytes"]}
 
     # ----------------------------------------------- CPU sequential rung
 
@@ -891,8 +975,13 @@ class BatchEngine:
         one batch (the unified footprint model,
         insights.predict_batch_dispatch_bytes) — the quantity the
         proactive HBM-budget split compares against the budget."""
-        plan = self.plan(list(queries))
-        eng = self._bucket_engine(plan, engine)
+        queries = list(queries)
+        plan = self.plan(queries)
+        # mirror execute()'s chain-start resolution so the budgeted
+        # figure models the rung that would actually dispatch (auto +
+        # expressions on TPU = the megakernel's outputs-only footprint)
+        eng = self._bucket_engine(plan,
+                                  resolve_query_engine(engine, queries))
         total = insights.predict_batch_dispatch_bytes(
             [b.signature for b in plan], self._resident_src()[1],
             self._ds._n_rows, eng)["peak_bytes"]
@@ -936,10 +1025,16 @@ class BatchEngine:
         budget = guard.resolve_hbm_budget(policy)
         plan_hit = tuple(queries) in self._plans
         plan = self.plan(queries)
-        eng = self._bucket_engine(plan, engine)
+        # explain reports what execute() WOULD do, so it mirrors its
+        # chain-start resolution (auto + expressions on TPU starts at
+        # the megakernel rung)
+        eng = self._bucket_engine(plan,
+                                  resolve_query_engine(engine, queries))
         kind = self._resident_src()[1]
         prog_sig = (eng, kind, tuple(b.signature for b in plan),
                     plan.expr_signature)
+        if eng == "megakernel":
+            prog_sig = prog_sig + (plan.mega.signature,)
         predicted = insights.predict_batch_dispatch_bytes(
             [b.signature for b in plan], kind, self._ds._n_rows, eng)
         if plan.exprs:
@@ -1043,8 +1138,8 @@ class BatchEngine:
         return {
             "site": "batch_engine", "q": len(queries),
             "engine_requested": engine, "engine": eng,
-            "engine_chain": list(guard.chain_from(_engine(engine),
-                                                  ENGINE_LADDER)),
+            "engine_chain": list(guard.chain_from(
+                resolve_query_engine(engine, queries), ENGINE_LADDER)),
             "layout": self._ds.layout, "source_kind": kind,
             "plan_cache_hit": plan_hit,
             "program_cache_hit": prog_sig in self._programs,
@@ -1112,6 +1207,15 @@ class BatchEngine:
             self._program(plan, eng)
             programs.append({"q": len(batch), "buckets": len(plan),
                              "engine": eng})
+            mega_eng = self._bucket_engine(plan, "megakernel")
+            if mega_eng == "megakernel" and eng != "megakernel":
+                # expression shapes resolve to the new TOP rung too: a
+                # serving loop warmed here never pays the one-kernel
+                # program's first compile in-band, whatever rung its
+                # traffic requests
+                self._program(plan, mega_eng)
+                programs.append({"q": len(batch), "buckets": len(plan),
+                                 "engine": mega_eng})
         return {"site": "batch_engine",
                 "compile_cache_dir": cache_dir,
                 "programs": programs,

@@ -57,11 +57,22 @@ Compilation pipeline (:func:`compile_query`):
    ``andnot`` rest that prunes empty is dropped (``x & ~0 == x`` — the
    full-range complement of nothing).
 
+Lowering rungs: the compiled sections lower two ways.  The multi-op
+path (this module's ``eval_sections`` + the engines' ``bucket_body``)
+runs gather -> segmented reduce -> combine passes as separate XLA ops;
+the **megakernel rung** (``ops.megakernel``, the engine ladder's top:
+megakernel -> pallas -> xla -> xla-vmap -> sequential) assembles the
+same sections into ONE Pallas grid kernel whose reduce heads and
+combine intermediates live in a VMEM scratch accumulator — the
+intermediates never touch HBM, and per-dispatch transient bytes drop
+to outputs-only (docs/EXPRESSIONS.md "Megakernel lowering").
+
 Observability: each compilation emits an ``expr.compile`` span (nodes /
 reduce_nodes / combine_nodes / depth / cse_saved tags); every device
 dispatch carrying fused expressions bumps ``rb_expr_nodes_fused`` and
 ``rb_expr_launches_saved_total`` (the node-at-a-time evaluator would
-have paid ~one launch per DAG op node; fused they share one).  See
+have paid ~one launch per DAG op node; fused they share one), and
+megakernel-rung dispatches add an ``expr.megakernel`` event.  See
 docs/EXPRESSIONS.md.
 """
 

@@ -90,7 +90,7 @@ from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
-from ..ops import kernels, packing
+from ..ops import kernels, megakernel, packing
 from ..runtime import errors, faults, guard
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
@@ -99,7 +99,8 @@ from . import expr as expr_mod
 from .aggregation import DeviceBitmapSet, _engine
 from .batch_engine import (ENGINE_LADDER, PLAN_CACHE_MAX, PROGRAM_CACHE_MAX,
                            WORDS32, _RED_OP, BatchEngine, BatchQuery,
-                           BatchResult, bucket_body, plan_bucket, query_desc)
+                           BatchResult, bucket_body, plan_bucket,
+                           query_desc, resolve_query_engine)
 
 #: the guard/trace/metric site of every pooled dispatch
 SITE = "multiset"
@@ -207,6 +208,10 @@ class _PoolPlan:
     #: per-bucket readback constants (operand counts + live-key masks),
     #: computed once per plan — the readback loop runs per dispatch
     rb_meta: dict = dataclasses.field(default_factory=dict)
+    #: the assembled one-kernel program (ops.megakernel.MegaPlan) when
+    #: the pool has fused sections; its host stream stays alive for the
+    #: pipelined dispatcher's fresh (donated) re-uploads
+    mega: object = None
     _row_sel_dev: dict = dataclasses.field(default_factory=dict)
 
     def row_sel_dev(self, sid: int):
@@ -601,6 +606,13 @@ class MultiSetBatchEngine:
                                           + self._rows[sid])]
                     row_sel[sid] = (in_set - off).astype(np.int32)
             expr_mod.finalize_sections(sections, buckets)
+            # the one-kernel program assembles from the REMAPPED host
+            # gathers (pooled row space), after finalize resolved the
+            # reduce steps' bucket slots; the pool keeps every host
+            # array alive for the donate path, so nothing drops here
+            mega = None
+            if expr_mod.fused_of(sections):
+                mega = megakernel.build_full(buckets, sections)
             occupancy = (len(pooled)
                          / max(1, sum(b.q for b in buckets)))
             obs_metrics.gauge("rb_multiset_pool_occupancy",
@@ -611,7 +623,7 @@ class MultiSetBatchEngine:
                          op_groups=_merge_op_groups(buckets),
                          sids=sids, row_sel=row_sel,
                          n_pool_rows=int(pool_rows.size),
-                         exprs=sections, owner=owner)
+                         exprs=sections, owner=owner, mega=mega)
         self._plans.put(key, plan)
         return plan
 
@@ -622,16 +634,20 @@ class MultiSetBatchEngine:
         in-program rebuild (same rules as BatchEngine._bucket_engine,
         taken over every referenced set)."""
         eng = _engine(engine)
-        if eng == "pallas":
-            longest = max((g.n_rows for g in plan.op_groups), default=0)
-            if longest > kernels.SMEM_PREFETCH_MAX:
-                eng = "xla"
+        if eng == "megakernel" and not (
+                plan.mega is not None and plan.mega.fits()):
+            eng = "pallas"
+        if eng in ("pallas", "megakernel"):
             for sid in plan.sids:
                 ds = self._engines[sid]._ds
                 if (ds.words is None and ds._chunks is not None
                         and int(ds._chunks[1].size)
                         > kernels.SMEM_PREFETCH_MAX):
                     eng = "xla"
+        if eng == "pallas":
+            longest = max((g.n_rows for g in plan.op_groups), default=0)
+            if longest > kernels.SMEM_PREFETCH_MAX:
+                eng = "xla"
         return eng
 
     def predict_dispatch_bytes(self, pooled_or_groups,
@@ -641,7 +657,10 @@ class MultiSetBatchEngine:
         budget (insights.predict_multiset_dispatch_bytes)."""
         pooled = self._as_pooled(pooled_or_groups)
         plan = self._plan_pool(pooled)
-        eng = self._pool_engine(plan, engine)
+        # mirror execute()'s chain-start resolution so the budgeted
+        # figure models the rung that would actually dispatch
+        eng = self._pool_engine(plan, resolve_query_engine(
+            engine, [q for _, q in pooled]))
         return self._predict(plan, eng)["peak_bytes"]
 
     def predict_dispatch_seconds(self, pooled_or_groups,
@@ -659,7 +678,8 @@ class MultiSetBatchEngine:
         if not pooled:
             return 0.0
         plan = self._plan_pool(pooled)
-        eng = self._pool_engine(plan, engine)
+        eng = self._pool_engine(plan, resolve_query_engine(
+            engine, [q for _, q in pooled]))
         pred = self._predict(plan, eng)
         word_ops = insights.predict_multiset_dispatch_word_ops(
             [b.signature for b in plan.buckets], self._plan_sets(plan),
@@ -707,6 +727,8 @@ class MultiSetBatchEngine:
         arrays."""
         donate = donate and _donation_supported()
         sig = (eng, plan.signature, donate)
+        if eng == "megakernel":
+            sig = sig + (plan.mega.signature,)
         t_get = time.perf_counter()
         cached = self._programs.get(sig)
         if cached is not None:
@@ -737,7 +759,18 @@ class MultiSetBatchEngine:
                 return (rows[0] if len(rows) == 1
                         else jnp.concatenate(rows, axis=0))
 
-            if eng == "xla-vmap":
+            if eng == "megakernel":
+                mega = plan.mega
+
+                def run(src_list, sel_list, arrays):
+                    # one-kernel hot path over the pooled image: every
+                    # bucket's reduce + the fused combines + outputs in
+                    # one pallas grid kernel (ops.megakernel); the
+                    # bucket gathers were offset-remapped into the
+                    # pooled row space at plan time
+                    words = pooled_words(src_list, sel_list)
+                    return megakernel.eval_full(mega, words, arrays[0])
+            elif eng == "xla-vmap":
                 # unmerged per-bucket cross-check path: proves the op
                 # merge and the query-axis flattening equivalent
                 def run(src_list, sel_list, arrays):
@@ -839,7 +872,9 @@ class MultiSetBatchEngine:
                 return self._regroup(flat, lengths)
             t_exec0 = time.perf_counter()
             policy = policy or guard.GuardPolicy.from_env()
-            chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
+            chain = guard.chain_from(
+                resolve_query_engine(engine, [q for _, q in pooled]),
+                ENGINE_LADDER)
             budget = guard.resolve_hbm_budget(policy)
             deadline = guard.Deadline(policy.deadline)
             # one in-budget launch — the steady-state serving tick — is
@@ -877,7 +912,10 @@ class MultiSetBatchEngine:
         pools = [list(p) for p in pools]
         metas = [self._flatten(p) for p in pools]
         policy = policy or guard.GuardPolicy.from_env()
-        chain = guard.chain_from(_engine(engine), ENGINE_LADDER)
+        chain = guard.chain_from(
+            resolve_query_engine(
+                engine, [q for pooled, _ in metas for _, q in pooled]),
+            ENGINE_LADDER)
         budget = guard.resolve_hbm_budget(policy)
         deadline = guard.Deadline(policy.deadline)
         n_sets = len({sid for pooled, _ in metas for sid, _ in pooled})
@@ -1119,6 +1157,8 @@ class MultiSetBatchEngine:
                                 site=SITE).inc()
             if plan.exprs:
                 expr_mod.record_fused_dispatch(SITE, plan.exprs)
+            if eng == "megakernel":
+                sp.event("expr.megakernel", **plan.mega.stats_event())
             if sync:
                 with obs_slo.phase("sync"):
                     outs = sp.sync(outs)
@@ -1133,8 +1173,17 @@ class MultiSetBatchEngine:
                 # roofline accounting needs a device-complete wall; an
                 # async (pipelined) launch finishes at drain time, where
                 # its share of the window cannot be attributed honestly
+                word_ops = insights.predict_multiset_dispatch_word_ops(
+                    [b.signature for b in plan.buckets],
+                    self._plan_sets(plan), eng,
+                    pool_rows=plan.n_pool_rows)
+                if plan.exprs:
+                    word_ops += insights.predict_expr_word_ops(
+                        plan.expr_signature, eng)
                 cost_ev = obs_cost.record_dispatch(
                     SITE, eng, cost, time.perf_counter() - t_launch,
+                    est={"flops": word_ops,
+                         "bytes_accessed": predicted["peak_bytes"]},
                     q=len(pooled), sets=len(plan.sids))
                 self.last_dispatch_cost = cost_ev
                 sp.event("multiset.cost", **cost_ev)
@@ -1151,6 +1200,8 @@ class MultiSetBatchEngine:
         for this engine ship (``_op_group_keys``): donating launches
         upload the subset per launch, the sync path uploads it once and
         caches it per keyset."""
+        if eng == "megakernel":
+            return [plan.mega.device_arrays(fresh=fresh)]
         if eng == "xla-vmap":
             arrays = [b.device_arrays(fresh=fresh) for b in plan.buckets]
         else:
@@ -1168,6 +1219,8 @@ class MultiSetBatchEngine:
         the program reads)."""
         aval = lambda v: jax.ShapeDtypeStruct(
             v.shape, jax.dtypes.canonicalize_dtype(v.dtype))
+        if eng == "megakernel":
+            return [{k: aval(v) for k, v in plan.mega.host.items()}]
         if eng == "xla-vmap":
             avals = [{k: aval(v) for k, v in b.host.items()}
                      for b in plan.buckets]
@@ -1182,7 +1235,9 @@ class MultiSetBatchEngine:
         """Normalize program outputs to per-bucket (bucket, heads,
         cards) host arrays — op superbuckets slice their members out of
         the flat head axis."""
-        if eng == "xla-vmap":
+        if eng in ("xla-vmap", "megakernel"):
+            # both return per-BUCKET outputs already (the megakernel's
+            # output layout slices per bucket, not per op group)
             for b, (heads, cards) in zip(plan.buckets, outs):
                 yield (b, None if heads is None else np.asarray(heads),
                        np.asarray(cards))
@@ -1304,15 +1359,23 @@ class MultiSetBatchEngine:
                 continue
             plan = self._plan_pool(pooled)
             eng = self._pool_engine(plan, engine)
-            self._program(plan, eng)
-            if _donation_supported():
-                # the pipelined dispatcher compiles the DONATE variant
-                # (a distinct program-cache key): warm it too, or the
-                # first serving tick pays the compile warmup exists to
-                # remove
-                self._program(plan, eng, donate=True)
-            programs.append({"q": len(pooled), "sets": len(sids),
-                             "buckets": len(plan.buckets), "engine": eng})
+            engs = [eng]
+            mega_eng = self._pool_engine(plan, "megakernel")
+            if mega_eng == "megakernel" and eng != "megakernel":
+                # expression pools warm the one-kernel TOP rung too, so
+                # a serving loop requesting it never compiles in-band
+                engs.append(mega_eng)
+            for e in engs:
+                self._program(plan, e)
+                if _donation_supported():
+                    # the pipelined dispatcher compiles the DONATE
+                    # variant (a distinct program-cache key): warm it
+                    # too, or the first serving tick pays the compile
+                    # warmup exists to remove
+                    self._program(plan, e, donate=True)
+                programs.append({"q": len(pooled), "sets": len(sids),
+                                 "buckets": len(plan.buckets),
+                                 "engine": e})
         return {"site": SITE, "compile_cache_dir": cache_dir,
                 "programs": programs,
                 "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}

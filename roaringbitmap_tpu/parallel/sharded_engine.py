@@ -84,7 +84,7 @@ from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
-from ..ops import dense, packing
+from ..ops import dense, megakernel, packing
 from ..runtime import faults, guard
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
@@ -157,7 +157,12 @@ class _ShardedPlan:
     exprs: list = dataclasses.field(default_factory=list)
     owner: dict = dataclasses.field(default_factory=dict)
     rb_meta: dict = dataclasses.field(default_factory=dict)
+    #: combine-mode one-kernel program (ops.megakernel.build_combines):
+    #: the fused combine passes run as ONE pallas grid kernel on the
+    #: replicated post-butterfly side; None when absent or past budget
+    mega: object = None
     _arrays: list | None = None   # device twins, uploaded lazily
+    _mega_arrays: dict | None = None
 
     @property
     def fused(self) -> list:
@@ -380,12 +385,26 @@ class ShardedBatchEngine:
                     padded.append(host)
                     n_pads.append(n_pad)
             expr_mod.finalize_sections(sections, buckets)
+            # combine-only one-kernel program for the replicated
+            # post-butterfly side: reduce heads arrive as bank rows, the
+            # combine passes + root outputs fuse into one pallas kernel
+            # per SPMD dispatch; past its VMEM/SMEM budget the plan
+            # keeps the multi-op eval_sections path (mega=None)
+            mega = None
+            fused = expr_mod.fused_of(sections)
+            if fused:
+                mega = megakernel.build_combines(
+                    buckets, op_groups, sections,
+                    expr_mod.expr_bucket_ids(fused))
+                if not mega.fits():
+                    mega = None
             sp.tag(buckets=len(buckets), op_groups=len(op_groups),
-                   flat_rows=int(sum(n_pads)), exprs=len(sections))
+                   flat_rows=int(sum(n_pads)), exprs=len(sections),
+                   mega=mega is not None)
         plan = _ShardedPlan(buckets=buckets, op_groups=op_groups,
                             sids=sids, padded=padded,
                             n_pads=tuple(n_pads),
-                            exprs=sections, owner=owner)
+                            exprs=sections, owner=owner, mega=mega)
         self._plans.put(key, plan)
         return plan
 
@@ -415,12 +434,28 @@ class ShardedBatchEngine:
                               for k, v in sec.host.items()}
             return sec.arrays
 
+        def mega_upload(f):
+            # the combine-mode instruction stream replaces the per-
+            # section operands wholesale; replicated like everything
+            # on the post-butterfly side
+            if f:
+                return [{k: jax.device_put(v, repl)
+                         for k, v in plan.mega.host.items()}]
+            if plan._mega_arrays is None:
+                plan._mega_arrays = {
+                    k: jax.device_put(v, repl)
+                    for k, v in plan.mega.host.items()}
+            return [plan._mega_arrays]
+
         if fresh:
             return ([upload(h) for h in plan.padded]
-                    + [expr_upload(s, True) for s in plan.fused])
+                    + (mega_upload(True) if plan.mega is not None
+                       else [expr_upload(s, True) for s in plan.fused]))
         if plan._arrays is None:
             plan._arrays = [upload(h) for h in plan.padded]
-        return plan._arrays + [expr_upload(s, False) for s in plan.fused]
+        return plan._arrays + (
+            mega_upload(False) if plan.mega is not None
+            else [expr_upload(s, False) for s in plan.fused])
 
     def _operand_avals(self, plan: _ShardedPlan) -> list:
         """Sharding-carrying avals matching ``_operands(fresh=True)`` —
@@ -437,11 +472,15 @@ class ShardedBatchEngine:
 
         avals = [{k: aval(k, v) for k, v in h.items()}
                  for h in plan.padded]
-        avals.extend(
-            {k: jax.ShapeDtypeStruct(
-                v.shape, jax.dtypes.canonicalize_dtype(v.dtype),
-                sharding=repl) for k, v in s.host.items()}
-            for s in plan.fused)
+        repl_aval = lambda v: jax.ShapeDtypeStruct(
+            v.shape, jax.dtypes.canonicalize_dtype(v.dtype),
+            sharding=repl)
+        if plan.mega is not None:
+            avals.append({k: repl_aval(v)
+                          for k, v in plan.mega.host.items()})
+        else:
+            avals.extend({k: repl_aval(v) for k, v in s.host.items()}
+                         for s in plan.fused)
         return avals
 
     def predict_dispatch_bytes(self, groups_or_queries) -> dict:
@@ -462,8 +501,12 @@ class ShardedBatchEngine:
             # fused combine intermediates live on the replicated side:
             # every device holds them, so they add to BOTH the per-shard
             # figure (the budget-relevant one) and D x to the mesh total
+            # — under the combine-mode megakernel they are VMEM slots
+            # and only the root outputs remain
             e = insights.predict_expr_dispatch_bytes(
-                plan.expr_signature, "xla")["peak_bytes"]
+                plan.expr_signature,
+                "megakernel" if plan.mega is not None else "xla"
+            )["peak_bytes"]
             out["expr_bytes"] = e
             out["per_shard_bytes"] += e
             out["peak_bytes"] += self.mesh_devices * e
@@ -531,6 +574,8 @@ class ShardedBatchEngine:
         scratch like the PR 5 pipelined dispatcher."""
         donate = donate and _donation_supported()
         sig = (guard.MESH, plan.signature, donate)
+        if plan.mega is not None:
+            sig = sig + (plan.mega.signature,)
         t_get = time.perf_counter()
         cached = self._programs.get(sig)
         if cached is not None:
@@ -559,6 +604,25 @@ class ShardedBatchEngine:
                     outs.append((heads if s[4] else None, cards))
                 if not fused:
                     return outs
+                if plan.mega is not None:
+                    # one-kernel combine passes on the replicated side:
+                    # the butterfly-combined flat head tensors feed the
+                    # megakernel as bank rows, combines + root outputs
+                    # run in one pallas grid kernel per device.  The
+                    # kernel runs under a fully-replicated shard_map so
+                    # the SPMD partitioner replicates it whole instead
+                    # of slicing its grid across the mesh.
+                    repl = self._specs.replicated()
+
+                    def wrap(fn):
+                        return shard_map(
+                            fn, mesh=self._mesh,
+                            in_specs=(repl, repl, repl),
+                            out_specs=(repl, repl), check_vma=False)
+
+                    return outs, megakernel.eval_combines(
+                        plan.mega, group_heads, pool_words,
+                        arrays[len(g_sigs)], wrap=wrap)
                 # fused combine passes run on the replicated side, after
                 # every group's butterfly combine — the padded flat head
                 # layout (no live fast path on the mesh)
@@ -720,6 +784,8 @@ class ShardedBatchEngine:
                                 mesh=self._mesh_label).inc()
             if plan.exprs:
                 expr_mod.record_fused_dispatch(SITE, plan.exprs)
+            if plan.mega is not None:
+                sp.event("expr.megakernel", **plan.mega.stats_event())
             with obs_slo.phase("sync"):
                 outs = sp.sync(outs)
                 outs = jax.block_until_ready(outs)
@@ -733,9 +799,17 @@ class ShardedBatchEngine:
             mem["mesh_total_predicted_bytes"] = predicted["peak_bytes"]
             self.last_dispatch_memory = mem
             sp.event("sharded.memory", **mem)
+            word_ops = insights.predict_batch_dispatch_word_ops(
+                [b.signature for b in plan.buckets], "dense", 0, "xla")
+            if plan.exprs:
+                word_ops += insights.predict_expr_word_ops(
+                    plan.expr_signature, "xla")
             cost_ev = obs_cost.record_dispatch(
                 SITE, guard.MESH, cost, launch_s,
-                devices=self.mesh_devices, q=len(pooled))
+                devices=self.mesh_devices,
+                est={"flops": word_ops,
+                     "bytes_accessed": predicted["peak_bytes"]},
+                q=len(pooled))
             self.last_dispatch_cost = cost_ev
             sp.event("sharded.cost", **cost_ev)
             # the mesh-keyed shard event (tools/check_trace.py schema):

@@ -136,9 +136,9 @@ def test_cse_shared_subtree_compiles_once(engine):
 # ----------------------------------------------------- engine parity
 
 @pytest.mark.parametrize("layout,engines", [
-    ("dense", ("xla", "xla-vmap", "pallas")),
-    ("compact", ("xla", "pallas")),
-    ("counts", ("xla",)),
+    ("dense", ("xla", "xla-vmap", "pallas", "megakernel")),
+    ("compact", ("xla", "pallas", "megakernel")),
+    ("counts", ("xla", "megakernel")),
 ])
 def test_fused_parity_vs_host_sequential(bitmaps, layout, engines):
     """(DAG shape x layout x engine rung) parity: fused expression pools
@@ -302,7 +302,7 @@ def _assert_pool_parity(got, tenants, tag):
 def test_multiset_pooled_expressions(tenants):
     eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
     pool = _expr_pool()
-    for e in ("xla", "xla-vmap", "pallas"):
+    for e in ("xla", "xla-vmap", "pallas", "megakernel"):
         _assert_pool_parity(eng.execute(pool, engine=e), tenants, e)
     with faults.inject("lowering=1.0:0xE3"):
         _assert_pool_parity(eng.execute(pool, engine="xla"), tenants,

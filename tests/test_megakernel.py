@@ -1,0 +1,375 @@
+"""One-kernel hot path acceptance (ISSUE 11).
+
+Pins:
+- megakernel parity matrix — (op mix x dense/compact/counts layout x
+  Batch/MultiSet/Sharded-2x2-mesh) — bit-exact vs the host sequential
+  evaluator (``expr.evaluate_host``), including mixed flat + expression
+  pools and ad-hoc leaves;
+- the demotion ladder: ``ROARING_TPU_FAULTS`` forced lowering faults
+  land megakernel -> pallas -> xla, every rung bit-exact;
+- the HBM-budget proactive split property ON the megakernel rung;
+- ``warmup(rungs=("expr:N",))`` pre-compiles the megakernel rung so a
+  matching ``engine="megakernel"`` execute cache-hits;
+- the footprint model: the megakernel lowering's predicted transient
+  bytes drop >= 2x vs the multi-op lowering at identical plans (the
+  acceptance referee where XLA's cost_analysis under-reports pallas
+  programs), and ``obs.cost.record_dispatch`` falls back to the model
+  estimate with ``estimated=True`` instead of a meaningless roofline;
+- the ``expr.megakernel`` span event schema at every dispatch site.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.insights import analysis as insights
+from roaringbitmap_tpu.obs import cost as obs_cost
+from roaringbitmap_tpu.ops import megakernel
+from roaringbitmap_tpu.parallel import (BatchEngine, BatchGroup, BatchQuery,
+                                        MultiSetBatchEngine,
+                                        ShardedBatchEngine)
+from roaringbitmap_tpu.parallel import expr
+from roaringbitmap_tpu.parallel.batch_engine import resolve_query_engine
+from roaringbitmap_tpu.runtime import faults, guard
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    yield
+    obs.disable()
+    obs.reset()
+    # engines built per test sit in reference cycles (compiled-program
+    # run closures capture the engine), so their resident-set ledger
+    # registrations would otherwise linger until an arbitrary later GC
+    # and skew the ledger baselines test_memory_obs samples
+    import gc
+
+    gc.collect()
+
+
+@pytest.fixture(scope="module")
+def bitmaps():
+    rng = np.random.default_rng(0x11E9)
+    out = []
+    for i in range(8):
+        vals = [rng.integers(0, 1 << 17, 2000).astype(np.uint32)]
+        if i % 3 == 0:
+            vals.append(np.arange(1 << 16, (1 << 16) + 5000,
+                                  dtype=np.uint32))
+        out.append(RoaringBitmap.from_values(
+            np.unique(np.concatenate(vals))))
+    return out
+
+
+DEPTH2 = expr.and_(expr.or_(0, 1), expr.not_(2))
+DEPTH3 = expr.xor(expr.and_(expr.or_(0, 1), expr.or_(2, 3)),
+                  expr.andnot(expr.or_(4, 5), 6))
+
+
+def _pool(form="bitmap"):
+    return ([expr.ExprQuery(DEPTH2, form=form),
+             expr.ExprQuery(DEPTH3, form=form),
+             BatchQuery("xor", (1, 4), form=form),
+             BatchQuery("and", (0, 3, 6), form=form),
+             BatchQuery("andnot", (2, 5, 7), form=form),
+             expr.ExprQuery(DEPTH2)]     # cardinality-only root
+            + expr.random_expr_pool(8, 5, depth=2, seed=19, form=form))
+
+
+def _want(pool, bitmaps):
+    out = []
+    for q in pool:
+        if isinstance(q, expr.ExprQuery):
+            out.append(expr.evaluate_host(q.expr, bitmaps))
+        else:
+            out.append(BatchEngine.from_bitmaps(
+                bitmaps, layout="dense")._sequential_one(q))
+    return out
+
+
+def _assert_parity(got, want, pool, tag):
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g.cardinality == w.cardinality, (tag, i)
+        if pool[i].form == "bitmap":
+            assert g.bitmap == w, (tag, i)
+
+
+# ------------------------------------------------------- parity matrix
+
+@pytest.mark.parametrize("layout", ["dense", "compact", "counts"])
+def test_batch_megakernel_parity(bitmaps, layout):
+    """(op x layout) parity on the single-set engine: the whole fused
+    pipeline in ONE pallas grid kernel, bit-exact vs the host."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout=layout)
+    pool = _pool()
+    want = _want(pool, bitmaps)
+    got = eng.execute(pool, engine="megakernel", fallback=False)
+    _assert_parity(got, want, pool, layout)
+    plan = eng.plan(pool)
+    assert plan.mega is not None and plan.mega.mode == "full"
+    assert eng._bucket_engine(plan, "megakernel") == "megakernel"
+
+
+def test_batch_megakernel_adhoc_leaves(bitmaps):
+    rng = np.random.default_rng(3)
+    ad = RoaringBitmap.from_values(
+        np.unique(rng.integers(0, 1 << 17, 2500).astype(np.uint32)))
+    e = expr.xor(expr.and_(expr.or_(0, 1), expr.bitmap(ad)),
+                 expr.andnot(expr.bitmap(ad), 2))
+    q = expr.ExprQuery(e, form="bitmap")
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    [got] = eng.execute([q], engine="megakernel", fallback=False)
+    want = expr.evaluate_host(e, bitmaps)
+    assert got.cardinality == want.cardinality and got.bitmap == want
+
+
+def test_multiset_megakernel_parity():
+    rng = np.random.default_rng(0x11EA)
+    tenants = [[RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 17, 1500).astype(np.uint32)))
+        for _ in range(6)] for _ in range(3)]
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    pool = [BatchGroup(sid, [
+        expr.ExprQuery(DEPTH2, form="bitmap"),
+        BatchQuery("xor", (1, 3), form="bitmap"),
+        expr.ExprQuery(expr.xor(expr.or_(2, 3), expr.and_(4, 5)),
+                       form="bitmap")]) for sid in range(3)]
+    got = eng.execute(pool, engine="megakernel", fallback=False)
+    for sid, rows in enumerate(got):
+        srcs = tenants[sid]
+        assert rows[0].bitmap == expr.evaluate_host(DEPTH2, srcs), sid
+        assert rows[1].bitmap == (srcs[1] ^ srcs[3]), sid
+        assert rows[2].bitmap == expr.evaluate_host(
+            expr.xor(expr.or_(2, 3), expr.and_(4, 5)), srcs), sid
+
+
+def test_sharded_mesh_megakernel_combines():
+    """The mesh composition: combine passes run as ONE kernel on the
+    replicated post-butterfly side (mode="combine"), bit-exact on a 2x2
+    mesh for sharded AND replicated placement."""
+    import jax
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(0x11EB)
+    tenants = [[RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 17, 1500).astype(np.uint32)))
+        for _ in range(7)] for _ in range(3)]
+    pool = [BatchGroup(sid, [
+        expr.ExprQuery(DEPTH2, form="bitmap"),
+        expr.ExprQuery(DEPTH3),
+        BatchQuery("andnot", (0, 1, 3), form="bitmap")])
+        for sid in range(3)]
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("rows", "data"))
+    for placement in ("replicated", "sharded"):
+        sh = ShardedBatchEngine.from_bitmap_sets(
+            tenants, mesh=mesh, layout="dense")
+        sh2 = ShardedBatchEngine(sh._engines, mesh=mesh,
+                                 placement=placement)
+        got = sh2.execute(pool, fallback=False)
+        plan = sh2._plan(tuple(sh2._single._flatten(pool)[0]))
+        assert plan.mega is not None and plan.mega.mode == "combine", \
+            placement
+        for sid, rows in enumerate(got):
+            srcs = tenants[sid]
+            assert rows[0].bitmap == expr.evaluate_host(DEPTH2, srcs), \
+                (placement, sid)
+            assert rows[1].cardinality == expr.evaluate_host(
+                DEPTH3, srcs).cardinality, (placement, sid)
+            want = srcs[0].clone() - srcs[1] - srcs[3]
+            assert rows[2].bitmap == want, (placement, sid)
+
+
+# -------------------------------------------------- demotion ladder
+
+def test_forced_demotion_megakernel_pallas_xla(bitmaps):
+    """Injected lowering faults walk megakernel -> pallas -> xla, every
+    landing bit-exact (the ISSUE acceptance ladder pin)."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    pool = _pool()
+    want = _want(pool, bitmaps)
+    cases = [
+        ("lowering@megakernel=1.0:0x11", "pallas"),
+        ("lowering@megakernel=1.0,lowering@pallas=1.0:0x12", "xla"),
+    ]
+    for spec, landing in cases:
+        guard.reset_dispatch_stats()
+        with faults.inject(spec):
+            got = eng.execute(pool, engine="megakernel")
+        _assert_parity(got, want, pool, spec)
+        stats = guard.dispatch_stats("batch_engine")
+        assert stats["demotions"] >= (1 if landing == "pallas" else 2), \
+            (spec, stats)
+    # every device rung dead: the sequential floor still answers
+    with faults.inject("lowering=1.0:0x13"):
+        got = eng.execute(pool, engine="megakernel")
+    _assert_parity(got, want, pool, "floor")
+
+
+def test_unfit_plans_resolve_to_pallas(bitmaps, monkeypatch):
+    """A plan with no fused sections — or one past the VMEM/SMEM budget
+    — resolves the megakernel rung down to pallas silently."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    flat = [BatchQuery("or", (0, 1)), BatchQuery("xor", (2, 3))]
+    plan = eng.plan(flat)
+    assert plan.mega is None
+    assert eng._bucket_engine(plan, "megakernel") == "pallas"
+    got = eng.execute(flat, engine="megakernel", fallback=False)
+    want = _want(flat, bitmaps)
+    _assert_parity(got, want, flat, "flat")
+    # budget squeeze: force fits() False via the slot ceiling
+    pool = _pool()
+    eplan = eng.plan(pool)
+    monkeypatch.setattr(megakernel, "MAX_SLOTS", 1)
+    assert not eplan.mega.fits()
+    assert eng._bucket_engine(eplan, "megakernel") == "pallas"
+
+
+def test_auto_resolution_rules(bitmaps):
+    """On the CPU proxy auto stays xla (unchanged default); explicit
+    megakernel always starts the chain at the top rung."""
+    pool = _pool()
+    assert resolve_query_engine("auto", pool) == "xla"
+    assert resolve_query_engine("megakernel", pool) == "megakernel"
+    assert resolve_query_engine("pallas", pool) == "pallas"
+    chain = guard.chain_from(
+        resolve_query_engine("megakernel", pool),
+        ("megakernel", "pallas", "xla", "xla-vmap"))
+    assert chain == ("megakernel", "pallas", "xla", "xla-vmap",
+                     "sequential")
+
+
+# ------------------------------------------------ budget + bytes model
+
+def test_budget_splits_megakernel_batches(bitmaps, tmp_path):
+    """Property: ROARING_TPU_HBM_BUDGET proactively splits megakernel
+    batches BEFORE dispatch, every dispatched launch's prediction fits
+    the budget, bit-exact."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    pool = expr.random_expr_pool(8, 12, depth=2, seed=29, form="bitmap")
+    want = [expr.evaluate_host(q.expr, bitmaps) for q in pool]
+    full = eng.predict_dispatch_bytes(pool, engine="megakernel")
+    budget = max(1, full // 3)
+    path = str(tmp_path / "trace.jsonl")
+    obs.enable(path)
+    got = eng.execute(pool, engine="megakernel",
+                      policy=guard.GuardPolicy(hbm_budget=budget))
+    obs.disable()
+    assert [g.bitmap for g in got] == want
+    assert eng.proactive_split_count > 0
+    spans = [json.loads(line) for line in open(path)]
+    mems = [ev for s in spans if s["name"] == "batch.dispatch"
+            for ev in s["events"] if ev["name"] == "batch.memory"]
+    assert mems and all(ev["predicted_bytes"] <= budget for ev in mems)
+    megas = [ev for s in spans if s["name"] == "batch.dispatch"
+             for ev in s["events"] if ev["name"] == "expr.megakernel"]
+    assert megas and all(ev["mode"] == "full" and ev["steps"] > 0
+                         and ev["slots"] > 0 and ev["vmem_bytes"] > 0
+                         for ev in megas)
+
+
+def test_bytes_model_2x_drop(bitmaps):
+    """THE acceptance referee: predicted transient bytes per fused
+    expression batch drop >= 2x under the megakernel lowering vs the
+    multi-op pallas AND xla lowerings of the IDENTICAL plan."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    pool = [q for q in _pool() if isinstance(q, expr.ExprQuery)]
+    plan = eng.plan(pool)
+    b_sigs = [b.signature for b in plan]
+    by_eng = {}
+    for e in ("megakernel", "pallas", "xla"):
+        total = insights.predict_batch_dispatch_bytes(
+            b_sigs, "dense", 0, e)["peak_bytes"]
+        total += insights.predict_expr_dispatch_bytes(
+            plan.expr_signature, e)["peak_bytes"]
+        by_eng[e] = total
+    assert by_eng["pallas"] >= 2 * by_eng["megakernel"], by_eng
+    assert by_eng["xla"] >= 2 * by_eng["megakernel"], by_eng
+
+
+def test_roofline_estimated_fallback():
+    """obs.cost satellite: when cost_analysis is missing or reports no
+    bytes (legal for pallas_call programs), the model estimate backs
+    the roofline gauge and the event is flagged estimated=True."""
+    obs.reset()
+    doc = obs_cost.record_dispatch(
+        "t_mega", "megakernel", None, 0.01,
+        est={"flops": 1e6, "bytes_accessed": 2e6})
+    assert doc["estimated"] is True
+    assert doc["bytes_accessed"] == 2e6
+    assert 0 < doc["roofline_fraction"] <= 1.0
+    doc = obs_cost.record_dispatch(
+        "t_mega", "megakernel",
+        {"flops": 5.0, "bytes_accessed": 0.0, "transcendentals": 0.0},
+        0.01, est={"flops": 1e6, "bytes_accessed": 2e6})
+    assert doc["estimated"] is True and doc["bytes_accessed"] == 2e6
+    # a real analysis is never overridden
+    doc = obs_cost.record_dispatch(
+        "t_mega", "xla",
+        {"flops": 5.0, "bytes_accessed": 7.0, "transcendentals": 0.0},
+        0.01, est={"flops": 1e6, "bytes_accessed": 2e6})
+    assert "estimated" not in doc and doc["bytes_accessed"] == 7.0
+
+
+def test_dispatch_cost_event_carries_bytes(bitmaps):
+    """Every megakernel dispatch reports a usable bytes_accessed figure
+    (real or flagged estimate) — the gauge the bench lane's
+    mega_vs_multiop_x cell reads."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    pool = _pool("cardinality")
+    eng.execute(pool, engine="megakernel", fallback=False)
+    ev = eng.last_dispatch_cost
+    assert ev["bytes_accessed"] > 0
+    assert 0 < ev["roofline_fraction"] <= 1.0
+
+
+# ----------------------------------------------------------- warmup
+
+def test_warmup_precompiles_megakernel_rung(bitmaps):
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    rep = eng.warmup(rungs=("expr:2",))
+    assert any(p["engine"] == "megakernel" for p in rep["programs"])
+    hits0 = eng._programs.stats()["hits"]
+    n0 = len(eng._programs)
+    got = eng.execute(expr.rung_expressions(2, eng.n),
+                      engine="megakernel")
+    assert len(got) == len(expr.rung_expressions(2, eng.n))
+    assert len(eng._programs) == n0          # nothing new compiled
+    assert eng._programs.stats()["hits"] > hits0
+
+
+def test_multiset_warmup_precompiles_megakernel_rung():
+    rng = np.random.default_rng(0x11EC)
+    tenants = [[RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 16, 800).astype(np.uint32)))
+        for _ in range(4)] for _ in range(2)]
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    rep = eng.warmup(rungs=("expr:2",))
+    assert any(p["engine"] == "megakernel" for p in rep["programs"])
+
+
+# ------------------------------------------------------ cache hygiene
+
+def test_program_cache_keys_on_instruction_shape(bitmaps):
+    """Two plans sharing padded bucket signatures but different real
+    row counts must compile DIFFERENT megakernel programs (the
+    instruction stream is plan data, not bucket shape)."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    p1 = [expr.ExprQuery(expr.and_(expr.or_(0, 1), expr.not_(2)))]
+    p2 = [expr.ExprQuery(expr.and_(expr.or_(0, 3), expr.not_(5)))]
+    plan1, plan2 = eng.plan(p1), eng.plan(p2)
+    n0 = len(eng._programs)
+    eng.execute(p1, engine="megakernel", fallback=False)
+    n1 = len(eng._programs)
+    eng.execute(p2, engine="megakernel", fallback=False)
+    n2 = len(eng._programs)
+    assert n1 > n0
+    if plan1.mega.signature != plan2.mega.signature:
+        assert n2 > n1
+    else:
+        assert n2 == n1      # identical shapes legitimately share

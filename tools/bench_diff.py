@@ -60,14 +60,18 @@ import sys
 #: The expression lane (bench.py expression_phase, ISSUE 8) adds
 #: ``expression.d{D}_q{Q}.{fused,node}_qps`` (via ``qps``),
 #: ``fused_vs_node_x`` (the fusion headline, explicit via ``fused_vs``)
-#: and its ``launches_saved`` counts (explicit).
+#: and its ``launches_saved`` counts (explicit).  The one-kernel lane
+#: (ISSUE 11) adds ``mega_vs_multiop_x`` — the per-dispatch
+#: transient-byte DROP ratio of the megakernel lowering vs the multi-op
+#: one — gated HIGHER via ``mega_vs`` (checked before the generic
+#: ``bytes`` lower-is-better fragment).
 #: The serving lane (bench.py serving_phase, ISSUE 10) adds per-rate
 #: ``serving.x{R}`` cells ([p50_ms, p99_ms, slo_attainment, shed_rate])
 #: and the ``overload_attainment`` headline — attainment is gated HIGHER
 #: (via ``attain``); the cells' latency entries ride the ``_ms`` rule.
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
-          "fused_vs", "attain")
+          "fused_vs", "mega_vs", "attain")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
          "shard_balance", "warm_restart")
 #: checked before HIGHER/LOWER: lanes whose good direction is genuinely

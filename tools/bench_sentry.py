@@ -297,10 +297,12 @@ def sharded_smoke() -> int:
 
 
 def expr_smoke() -> int:
-    """Fused-expression bit-exactness smoke (ISSUE 8): a depth-2/3
+    """Fused-expression bit-exactness smoke (ISSUE 8 + 11): a depth-2/3
     expression pool executed fused (one launch) must match the
     host-side sequential evaluator exactly — clean AND through a forced
-    pallas demotion.  Returns 0 on parity, 1 on divergence."""
+    pallas demotion, AND on the one-kernel megakernel rung (clean +
+    demoted down its megakernel -> pallas ladder).  Returns 0 on
+    parity, 1 on divergence."""
     sys.path.insert(0, os.path.dirname(_HERE))
     import numpy as np
 
@@ -329,6 +331,20 @@ def expr_smoke() -> int:
     ok = all(g.cardinality == w.cardinality and g.bitmap == w
              for g, w in zip(got, want))
     cells.append({"case": "fused-demoted", "ok": ok})
+    mismatches += not ok
+    # one-kernel hot path (ISSUE 11): the megakernel rung clean, and
+    # its demotion ladder (megakernel -> pallas) under an injected
+    # lowering fault — both pinned bit-exact vs the host evaluator
+    got = eng.execute(pool, engine="megakernel")
+    ok = all(g.cardinality == w.cardinality and g.bitmap == w
+             for g, w in zip(got, want))
+    cells.append({"case": "megakernel", "ok": ok})
+    mismatches += not ok
+    with faults.inject("lowering@megakernel=1.0:44"):
+        got = eng.execute(pool, engine="megakernel")
+    ok = all(g.cardinality == w.cardinality and g.bitmap == w
+             for g, w in zip(got, want))
+    cells.append({"case": "megakernel-demoted", "ok": ok})
     mismatches += not ok
     print(json.dumps({"smoke_expr": cells, "ok": mismatches == 0}))
     return 1 if mismatches else 0

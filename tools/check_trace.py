@@ -307,10 +307,26 @@ def _serving_semantics(spans: list[dict],
 def _expr_semantics(spans: list[dict], require: bool = False) -> list[str]:
     """The expression compiler's span vocabulary (parallel.expr,
     docs/EXPRESSIONS.md).  Arbitrary dumps validate the ``expr.compile``
-    tag schema wherever the span appears; ``require`` (the --workload
-    run, which drives a fused 3-node expression) demands at least one
-    fused compilation."""
+    tag schema — and the ``expr.megakernel`` dispatch-event schema
+    (ops.megakernel, docs/EXPRESSIONS.md "Megakernel lowering") —
+    wherever they appear; ``require`` (the --workload run, which drives
+    a fused 3-node expression clean, demoted AND through the megakernel
+    rung) demands at least one fused compilation and one megakernel
+    dispatch event."""
     errors: list[str] = []
+    megas = [ev for s in spans for ev in s.get("events", [])
+             if ev.get("name") == "expr.megakernel"]
+    for ev in megas:
+        if ev.get("mode") not in ("full", "combine"):
+            errors.append(f"expr.megakernel event with bad mode: {ev!r}")
+        for field in ("steps", "slots", "vmem_bytes", "card_rows",
+                      "sections"):
+            if not isinstance(ev.get(field), int) or ev[field] < 0:
+                errors.append(f"expr.megakernel event without a numeric "
+                              f"{field}: {ev!r}")
+        if not (isinstance(ev.get("steps"), int) and ev["steps"] > 0):
+            errors.append(f"expr.megakernel event with no instructions: "
+                          f"{ev!r}")
     compiles = [s for s in spans if s.get("name") == "expr.compile"]
     for s in compiles:
         tags = s.get("tags") or {}
@@ -337,6 +353,9 @@ def _expr_semantics(spans: list[dict], require: bool = False) -> list[str]:
                 "no fused expr.compile span — the 3-node expression "
                 f"did not fuse (saw kinds: "
                 f"{[(s.get('tags') or {}).get('kind') for s in compiles]!r})")
+        if not megas:
+            errors.append("no expr.megakernel event — the one-kernel "
+                          "workload case did not record")
     return errors
 
 
@@ -620,6 +639,19 @@ def run_workload(path: str) -> None:
             "rb_expr_launches_saved_total", [])
         assert sum(r["value"] for r in saved) > 0, \
             "fused expressions credited no saved launches"
+        # one-kernel lane (ISSUE 11): the SAME pool through the
+        # megakernel rung — bit-exact vs an EXPLICIT multi-op rung (on
+        # TPU engine="auto" resolves expression pools to the megakernel
+        # itself, which would make this a self-comparison), and its
+        # dispatch span must carry the expr.megakernel event the schema
+        # checks above pin
+        expr_multiop = [r.cardinality
+                        for r in eng.execute(expr_pool, engine="xla")]
+        expr_mega = [r.cardinality
+                     for r in eng.execute(expr_pool,
+                                          engine="megakernel")]
+        assert expr_mega == expr_multiop, \
+            "megakernel expression diverged from multi-op run"
 
         # pooled cross-tenant lane: 3 tenants, one pooled launch
         # (multiset.* spans), then a tiny budget forcing a POOL split

@@ -727,6 +727,76 @@ def serving_phase() -> dict:
     return out
 
 
+def mutation_phase() -> dict:
+    """Mutable-tenant lane (ISSUE 12, docs/MUTATION.md): two cells.
+
+    (a) delta-vs-repack: a warmed single-segment ``apply_delta`` against
+    a resident N=32 set, vs the full re-pack of the same (updated)
+    sources — the five-orders-of-magnitude asymmetry ROADMAP item 1
+    names, pinned as ``delta_vs_repack_x``.  (b) cache-vs-recompute: a
+    repeated depth-2 expression trace replayed through a result-cached
+    engine vs the recompute path (identical engine, no cache), bit-exact
+    asserted before timing — ``cache_vs_recompute_x`` is the
+    repeated-expression serving claim."""
+    from roaringbitmap_tpu.mutation import ResultCache
+    from roaringbitmap_tpu.parallel import expr as expr_mod
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+    from roaringbitmap_tpu.parallel.batch_engine import BatchEngine
+    from roaringbitmap_tpu.utils import datasets
+
+    out: dict = {}
+    # big enough that the full re-pack is honest work (~8M values, a
+    # 116 MiB dense image): the delta's wall is a flat ~0.4 ms of host
+    # planning + dispatch overhead regardless of set size, which is the
+    # whole asymmetry being measured
+    bms = datasets.synthetic_bitmaps(64, seed=90, universe=1 << 25,
+                                     density=0.03)
+    ds = DeviceBitmapSet(bms, layout="dense")
+    ds.warmup_delta(1)
+    ds.apply_delta(adds={0: [1]})        # warm the whole patch path
+    counter = [1]
+
+    def one_delta():
+        counter[0] += 1
+        rep = ds.apply_delta(adds={0: [counter[0]]})
+        assert rep["mode"] == "patch", rep
+
+    t_delta = best_of(one_delta)
+    hosts = ds.host_bitmaps()
+    t_repack = best_of(lambda: DeviceBitmapSet(hosts, layout="dense"),
+                       reps=3)
+    # bit-exactness of the patched resident vs the re-packed one
+    patched = ds.aggregate("or")
+    assert DeviceBitmapSet(hosts, layout="dense").aggregate("or") \
+        == patched, "delta-patched set diverged from a fresh re-pack"
+    out["delta"] = {"sets": 64, "delta_ms": round(t_delta * 1e3, 4),
+                    "repack_ms": round(t_repack * 1e3, 2),
+                    "delta_vs_repack_x": round(t_repack / t_delta, 1)}
+
+    trace = expr_mod.random_expr_pool(16, 32, depth=3, seed=9)
+    bms2 = datasets.synthetic_bitmaps(16, seed=91, universe=1 << 20,
+                                      density=0.02)
+    recompute = BatchEngine(DeviceBitmapSet(bms2, layout="dense"),
+                            result_cache=None)
+    cached = BatchEngine(DeviceBitmapSet(bms2, layout="dense"),
+                         result_cache=ResultCache(128 << 20))
+    ref = [r.cardinality for r in recompute.execute(trace)]
+    got = [r.cardinality for r in cached.execute(trace)]
+    assert got == ref, "cached expression replay diverged"
+    t_recompute = best_of(lambda: recompute.execute(trace), reps=3)
+    t_cached = best_of(lambda: cached.execute(trace), reps=3)
+    out["cache"] = {
+        "trace_q": len(trace),
+        "recompute_qps": round(len(trace) / t_recompute, 1),
+        "cached_qps": round(len(trace) / t_cached, 1),
+        "cache_vs_recompute_x": round(t_recompute / t_cached, 1),
+        "cache_stats": cached.result_cache.stats()}
+    out["headline"] = {
+        "delta_vs_repack_x": out["delta"]["delta_vs_repack_x"],
+        "cache_vs_recompute_x": out["cache"]["cache_vs_recompute_x"]}
+    return out
+
+
 def _dryrun_env(n_devices: int = 8) -> dict:
     """A CPU dry-run environment for subprocess cells: forced host
     platform device count, TPU plugin never initialised (the
@@ -903,10 +973,10 @@ SUMMARY_MAX_BYTES = 2048
 #: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
 #: they are trend inputs for the sentry, not driver-gate fields, and the
 #: full doc always keeps them
-SUMMARY_DROP_ORDER = ("phase_ms", "cost", "serving", "sharded",
-                      "expression", "marginal_us_spread", "multiset",
-                      "batched_qps", "marginal_us_median", "unit",
-                      "backend", "north_star")
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "mutation", "serving",
+                      "sharded", "expression", "marginal_us_spread",
+                      "multiset", "batched_qps", "marginal_us_median",
+                      "unit", "backend", "north_star")
 
 
 def summary_line(out: dict, full_path: str,
@@ -1039,6 +1109,16 @@ def build_summary(out: dict, full_path: str) -> dict:
         if "warm_restart_x" in wr:
             sh_lanes["warm_restart_x"] = wr["warm_restart_x"]
         s["sharded"] = sh_lanes
+    # mutation lane, compact: the in-place delta's speedup over a full
+    # re-pack and the result cache's replay speedup over recompute
+    # (bench.py mutation_phase, docs/MUTATION.md)
+    mu = out.get("mutation") or {}
+    if mu.get("headline"):
+        mu_lane = dict(mu["headline"])
+        if "delta" in mu:
+            mu_lane["delta_ms"] = mu["delta"].get("delta_ms")
+            mu_lane["repack_ms"] = mu["delta"].get("repack_ms")
+        s["mutation"] = mu_lane
     return s
 
 
@@ -1200,6 +1280,7 @@ def main() -> None:
     expression = expression_phase()
     serving = serving_phase()
     sharded = sharded_phase()
+    mutation = mutation_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
     # exactly once.  A single steady-state marginal at VMEM-resident
@@ -1255,6 +1336,7 @@ def main() -> None:
     out["expression"] = expression
     out["serving"] = serving
     out["sharded"] = sharded
+    out["mutation"] = mutation
 
     # full document to disk; stdout gets ONLY the compact summary as its
     # final line (the driver's bounded tail capture must parse it)

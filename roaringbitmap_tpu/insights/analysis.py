@@ -477,6 +477,18 @@ def predict_multiset_dispatch_word_ops(bucket_sigs: list, sets: list,
     return int(total)
 
 
+def predict_delta_patch_bytes(p_rows: int) -> dict:
+    """Transient device bytes of ONE in-place delta patch
+    (mutation.delta, docs/MUTATION.md): the gathered current rows, the
+    add/remove masks, and the scattered result — all ``p_rows`` 8 KiB
+    rows, so a single-segment delta moves ~32 KiB against the full
+    re-pack's whole-image rebuild.  The asymmetry IS the mutation
+    subsystem's claim; the bench mutation lane pins it."""
+    b = int(p_rows) * ROW_BYTES
+    return {"gather_bytes": b, "mask_bytes": 2 * b, "output_bytes": b,
+            "peak_bytes": 4 * b}
+
+
 def predict_sharded_dispatch_bytes(bucket_sigs: list, pool_rows: int,
                                    mesh_devices: int,
                                    mesh_rows: int | None = None,

@@ -1,0 +1,40 @@
+"""Mutable tenants: versioned delta ingest + materialized result reuse.
+
+The serving stack built through PR 11 is read-only in practice: any
+update to a resident ``DeviceBitmapSet`` is a full re-pack
+(``ingest_compile_ms_one_time`` ~ 1.07 s against a ~10 us marginal op —
+five orders of magnitude, ROADMAP item 1).  This package closes that gap
+with two coupled halves:
+
+- :mod:`.delta` — **versioned delta ingest**: ``DeviceBitmapSet.
+  apply_delta(adds, removes)`` patches only the affected packed rows in
+  place (the consensus Roaring layout partitions the value space into
+  2^16-value containers precisely so a point mutation touches one
+  chunk), stamps the set with a monotone ``version`` + per-source /
+  per-row dirty versions, re-checks layout drift against
+  ``insights.choose_layout``, and escalates to a full repack only when
+  the drift heuristic fires (or the delta is structural — a new
+  container key).
+- :mod:`.result_cache` — **materialized expression-result cache**: the
+  expression compiler's canonical structural hashes keyed by the leaf
+  ``(set uid, source, version)`` tuple, so unchanged canonical
+  (sub)trees across requests return cached device-resident results
+  (bitmap rows or cardinalities; bounded LRU with a byte budget,
+  HBM-ledger-accounted) instead of re-executed reduces.  Version-bumped
+  leaves invalidate exactly their dependent entries via a leaf -> entry
+  index.
+
+See docs/MUTATION.md for the operator-facing contract (delta API,
+versioning rules, invalidation semantics, repack escalation).
+"""
+
+from .delta import apply_delta, drift_report, host_bitmaps, repack_in_place
+from .result_cache import (ENV_RESULT_CACHE, ResultCache, from_env,
+                           node_key, notify_version_bump, query_key,
+                           serve_and_fill)
+
+__all__ = [
+    "apply_delta", "drift_report", "host_bitmaps", "repack_in_place",
+    "ENV_RESULT_CACHE", "ResultCache", "from_env", "node_key",
+    "notify_version_bump", "query_key", "serve_and_fill",
+]

@@ -1,0 +1,503 @@
+"""Versioned delta ingest: in-place segment patches + repack escalation.
+
+The consensus Roaring layout partitions the value space into 2^16-value
+chunks precisely so a point mutation touches ONE container; the resident
+device packing keeps that property — every (source, key) pair owns one
+8 KiB row of the blocked image.  A delta that only mutates values inside
+existing containers therefore lowers to one tiny compiled program::
+
+    new_rows = (words[rows] | add_masks) & ~remove_masks
+    words    = words.at[rows].set(new_rows)
+
+— a "delta:N" shape (rows padded to a pow2 rung, so the program
+compiles once per rung and ``warmup(rungs=("delta:8",))`` can pre-pay
+it) against the full re-pack's ~1.07 s ``ingest_compile_ms_one_time``.
+
+Escalation.  Three things force the full repack path instead:
+
+- **structural deltas** — an add that creates a container this source
+  doesn't hold (or the first value of a brand-new key): rows must be
+  inserted, which is a re-layout by definition;
+- **non-dense layouts** — the counts/compact residents fold their
+  streams at build time; point-patching those folded forms is a
+  correctness trap, so mutations rebuild them (their use case is
+  capacity tiers queried rarely, per docs/USCENSUS2000_CLIFF.md);
+- **layout drift** — cumulative mutated values since the last pack
+  exceeding ``drift_limit`` (default ``max(DRIFT_MIN_VALUES,
+  DRIFT_FRACTION x pack-time value floor)``): the patched image still
+  answers queries bit-exactly, but its block/layout choices were made
+  for data that no longer exists, so the heuristic schedules a full
+  repack (which re-resolves ``layout="auto"`` through
+  ``insights.choose_layout``).  Production deployments run the
+  escalated repack on a maintenance thread next to the serving pump;
+  here it is synchronous and reported (``mode="repack"``).
+
+Version discipline (the contract the result cache and the engines'
+plan caches key on):
+
+- ``ds.version``        monotone, +1 per successful apply_delta/repack;
+- ``ds.source_versions[i]`` = the version that last touched source i;
+- ``ds.row_versions[r]``    = the version that last patched row r
+  (per-segment dirty stamps; repack re-stamps every row);
+- ``ds.structure_version``  +1 per repack (row layout changed: engines
+  must re-read ``row_src`` and sharded pools must re-place).
+
+Every successful delta notifies the live result caches
+(``result_cache.notify_version_bump``) so exactly the dependent cached
+results drop, and appends to the set's bounded delta journal so a
+``ShardedBatchEngine`` holding a placed copy of the rows can replay the
+same patch one-shard-wide instead of re-placing the pool.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+#: the trace/metric site of every mutation
+SITE = "mutation"
+
+#: drift heuristic floor: deltas smaller than this never fire it
+DRIFT_MIN_VALUES = 65536
+
+#: drift fires past this fraction of the pack-time value floor
+DRIFT_FRACTION = 0.5
+
+#: per-set delta-journal depth; a replayer lagging further re-places
+JOURNAL_DEPTH = 32
+
+WORDS32 = 2048
+
+
+def _normalize_delta(n_sources: int, spec) -> dict:
+    """{source index: sorted unique u32 values}; [] entries dropped."""
+    out: dict = {}
+    if not spec:
+        return out
+    items = spec.items() if isinstance(spec, dict) else spec
+    for src, values in items:
+        src = int(src)
+        if src < 0 or src >= n_sources:
+            raise IndexError(
+                f"delta source index out of range 0..{n_sources - 1}: "
+                f"{src}")
+        v = np.unique(np.asarray(values, dtype=np.uint64))
+        if v.size and int(v[-1]) > 0xFFFFFFFF:
+            raise ValueError(
+                f"delta value out of the u32 universe: {int(v[-1])}")
+        if v.size:
+            out[src] = v.astype(np.uint32)
+    return out
+
+
+def _row_of(ds, src: int, key: int) -> int:
+    """Resident row of (source, key), or -1 when this source holds no
+    container for the key (a structural add)."""
+    k = int(np.searchsorted(ds.keys, np.uint16(key)))
+    if k >= ds.keys.size or int(ds.keys[k]) != int(key):
+        return -1
+    off = int(ds._packed.seg_offsets[k])
+    size = int(ds._packed.seg_sizes[k])
+    rows = np.arange(off, off + size)
+    hit = rows[np.asarray(ds._packed.row_src)[rows] == src]
+    return int(hit[0]) if hit.size else -1
+
+
+def _masks_of(rows_per_value: np.ndarray, low16: np.ndarray,
+              n_rows: int) -> np.ndarray:
+    """u32[n_rows, 2048] bit masks from (per-value local row, low 16
+    bits) — one packbits pass, the delta-sized sibling of
+    ``ops.packing.densify_containers``'s scatter."""
+    out = np.zeros((n_rows, WORDS32), np.uint32)
+    if low16.size:
+        buf = np.zeros(n_rows << 16, np.uint8)
+        buf[(rows_per_value.astype(np.int64) << 16)
+            + low16.astype(np.int64)] = 1
+        out[:] = np.packbits(buf, bitorder="little").view(
+            np.uint32).reshape(n_rows, WORDS32)
+    return out
+
+
+def plan_patch(ds, adds: dict, removes: dict):
+    """Resolve a normalized delta against the resident layout.
+
+    Returns ``(rows, add_masks, rem_masks, structural, touched,
+    n_add, n_rem)`` — ``rows`` i32[P] resident rows in patch order,
+    masks u32[P, 2048]; ``structural`` True when any add targets a
+    (source, key) row the layout doesn't hold (removals of absent
+    containers are no-ops and never escalate)."""
+    slot_of: dict = {}           # (src, key) -> patch slot
+    rows: list = []
+    add_rv, add_lo = [], []      # per-value (slot, low16) streams
+    rem_rv, rem_lo = [], []
+    structural = False
+    touched: set = set()         # srcs whose resident data can change:
+    #                              a removal aimed entirely at absent
+    #                              containers must NOT bump its source's
+    #                              version (no over-invalidation)
+    n_add = n_rem = 0
+    for spec, rv, lo, is_add in ((adds, add_rv, add_lo, True),
+                                 (removes, rem_rv, rem_lo, False)):
+        for src, values in spec.items():
+            if is_add:
+                touched.add(src)
+                n_add += int(values.size)
+            else:
+                n_rem += int(values.size)
+            keys = (values >> np.uint32(16)).astype(np.uint16)
+            for key in np.unique(keys):
+                sub = values[keys == key]
+                slot = slot_of.get((src, int(key)))
+                if slot is None:
+                    row = _row_of(ds, src, int(key))
+                    if row < 0:
+                        if is_add:
+                            structural = True
+                            continue
+                        continue    # removing from an absent container
+                    slot = slot_of[(src, int(key))] = len(rows)
+                    rows.append(row)
+                touched.add(src)
+                rv.append(np.full(sub.size, slot, np.int64))
+                lo.append((sub & np.uint32(0xFFFF)).astype(np.uint32))
+    p = len(rows)
+    rows = np.asarray(rows, np.int32)
+
+    def stack(rv_l, lo_l):
+        if not rv_l:
+            return _masks_of(np.empty(0, np.int64), np.empty(0, np.uint32),
+                             max(p, 1))[:p]
+        return _masks_of(np.concatenate(rv_l), np.concatenate(lo_l),
+                         max(p, 1))[:p]
+
+    return (rows, stack(add_rv, add_lo), stack(rem_rv, rem_lo),
+            structural, touched, n_add, n_rem)
+
+
+# ----------------------------------------------------------- the program
+
+def _pad_row(ds) -> int:
+    """A padding row of the blocked layout (row_src == -1) — the
+    idempotent scatter target delta padding aims at; -1 when the layout
+    has none (then programs compile per exact patch size)."""
+    pad = np.flatnonzero(np.asarray(ds._packed.row_src) < 0)
+    return int(pad[0]) if pad.size else -1
+
+
+def _patch_program(ds, p_pad: int):
+    """AOT-compiled ``(words, rows, add, rem) -> words`` patcher for
+    ``p_pad`` patch rows, cached on the set (the "delta:N" rung).
+    Compile hits/misses ride ``rb_compile_seconds{site="mutation"}`` so
+    warmup pinning works like the expression rungs."""
+    import jax
+
+    from ..obs import cost as obs_cost
+
+    key = (int(ds._n_rows), int(p_pad))
+    t0 = time.perf_counter()
+    cached = ds._delta_programs.get(key)
+    if cached is not None:
+        obs_cost.observe_compile(SITE, "hit", time.perf_counter() - t0)
+        return cached
+
+    def patch(words, rows, masks):
+        # masks u32[P, 2, 2048]: add plane 0, remove plane 1 — one host
+        # upload instead of two (the upload is half the patch wall on
+        # the CPU proxy)
+        cur = words[rows]
+        return words.at[rows].set((cur | masks[:, 0]) & ~masks[:, 1])
+
+    # the image argument DONATES on every backend: the caller reassigns
+    # ds.words to the result, and donation is what makes the patch a
+    # true in-place row write instead of a full-image copy (measured
+    # ~17 us vs ~10 ms for a 64 MiB image on the CPU proxy — donation
+    # works on the CPU backend as of jax 0.4.3x, unlike the pipelined
+    # dispatcher's older TPU/GPU-only assumption).  Consequence: any
+    # stale handle to the pre-delta image (e.g. a chained-probe closure
+    # built before the mutation) dies LOUDLY with a deleted-array error
+    # rather than silently reading stale rows — see docs/MUTATION.md.
+    aval = jax.ShapeDtypeStruct
+    compiled = jax.jit(patch, donate_argnums=(0,)).lower(
+        aval((ds._n_rows, WORDS32), np.uint32),
+        aval((p_pad,), np.int32),
+        aval((p_pad, 2, WORDS32), np.uint32)).compile()
+    obs_cost.observe_compile(SITE, "miss", time.perf_counter() - t0)
+    ds._delta_programs[key] = compiled
+    return compiled
+
+
+def _pad_patch(ds, rows, add, rem):
+    """Pow2-pad a patch to its "delta:N" rung.  Padding entries target a
+    reserved padding row with neutral masks — ``(w | 0) & ~0 == w`` and
+    every duplicate writes the identical value, so the scatter stays
+    deterministic."""
+    from ..ops import packing
+
+    p = int(rows.size)
+    pad_row = _pad_row(ds)
+    p_pad = packing.next_pow2(max(1, p)) if pad_row >= 0 else max(1, p)
+    if p_pad == p:
+        return rows, add, rem, p_pad
+    rows_p = np.full(p_pad, pad_row if pad_row >= 0 else rows[0], np.int32)
+    rows_p[:p] = rows
+    add_p = np.zeros((p_pad, WORDS32), np.uint32)
+    add_p[:p] = add
+    rem_p = np.zeros((p_pad, WORDS32), np.uint32)
+    rem_p[:p] = rem
+    return rows_p, add_p, rem_p, p_pad
+
+
+def warmup_delta(ds, n: int) -> dict:
+    """Pre-compile the in-place patch programs for every pow2 delta
+    rung up to ``n`` rows ("delta:N" in ``warmup(rungs=...)``) so no
+    in-band ``apply_delta`` of up to ``n`` patched rows ever pays its
+    compile (deltas pad to THEIR pow2 rung, so a 2-row delta needs rung
+    2, not 4).  Compile-only — nothing is mutated."""
+    from ..ops import packing
+
+    if ds.layout != "dense":
+        return {"site": SITE, "rung": int(n), "compiled": False,
+                "why": f"{ds.layout} layout deltas repack (no patch "
+                       "program to warm)"}
+    if _pad_row(ds) < 0:
+        # no padding row: deltas compile per exact size — warm n alone
+        _patch_program(ds, max(1, int(n)))
+        return {"site": SITE, "rung": int(n), "rungs": [max(1, int(n))],
+                "compiled": True}
+    top = packing.next_pow2(max(1, int(n)))
+    rungs, p = [], 1
+    while p <= top:
+        _patch_program(ds, p)
+        rungs.append(p)
+        p *= 2
+    return {"site": SITE, "rung": int(n), "rungs": rungs,
+            "compiled": True}
+
+
+# ------------------------------------------------------------ host tier
+
+def host_bitmaps(ds) -> list:
+    """Host copies of the resident sources, rebuilt from what is
+    actually resident (works for any ingest kind) and cached per
+    version — the repack input, the sequential/shadow reference data,
+    and the property-test oracle's twin."""
+    cache = getattr(ds, "_host_cache", None)
+    if cache is not None and cache[0] == ds.version:
+        return cache[1]
+    from ..ops import packing
+
+    words = np.asarray(ds._resident_words("xla"))
+    row_src = np.asarray(ds._packed.row_src)
+    row_seg = np.repeat(np.asarray(ds._packed.blk_seg),
+                        ds.block).astype(np.int64)
+    hosts = []
+    for i in range(ds.n):
+        rows = np.flatnonzero(row_src == i)
+        w = words[rows]
+        cards = (np.unpackbits(w.view(np.uint8), axis=1).sum(axis=1)
+                 if rows.size else np.zeros(0, np.int64))
+        hosts.append(packing.unpack_result(
+            ds.keys[row_seg[rows]], w, cards))
+    ds._host_cache = (ds.version, hosts)
+    return hosts
+
+
+def _host_apply(hosts: list, adds: dict, removes: dict) -> list:
+    """The delta applied as host set algebra (adds first, removes win —
+    the same rule the device masks implement)."""
+    from ..core.bitmap import RoaringBitmap
+
+    out = list(hosts)
+    for src in set(adds) | set(removes):
+        bm = out[src].clone()
+        if src in adds:
+            a = RoaringBitmap()
+            a.add_many(adds[src])
+            bm = bm | a
+        if src in removes:
+            r = RoaringBitmap()
+            r.add_many(removes[src])
+            bm = bm - r
+        out[src] = bm
+    return out
+
+
+# ------------------------------------------------------------- the API
+
+def drift_report(ds, drift_limit: int | None = None) -> dict:
+    """The layout-drift heuristic's current state: cumulative mutated
+    values since the last pack against the escalation limit."""
+    base = int(getattr(ds, "_mutation_base_values", 0))
+    mutated = int(getattr(ds, "_mutated_values", 0))
+    limit = (int(drift_limit) if drift_limit is not None
+             else max(DRIFT_MIN_VALUES, int(DRIFT_FRACTION * base)))
+    return {"mutated_values": mutated, "base_values": base,
+            "limit": limit, "fired": mutated > limit}
+
+
+def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
+                drift_limit: int | None = None) -> dict:
+    """Mutate a resident ``DeviceBitmapSet`` at segment granularity.
+
+    ``adds`` / ``removes`` map source index -> u32 values (a value in
+    both is removed — removes win).  ``repack``: ``"auto"`` patches in
+    place and escalates per the module rules; ``"never"`` raises on a
+    delta that would need one; ``"always"`` forces the full repack
+    path.  Returns a JSON-able report (mode, version, rows_patched,
+    repack_reason, wall_ms, drift).
+    """
+    if repack not in ("auto", "never", "always"):
+        raise ValueError(f"unknown repack policy {repack!r}")
+    t0 = time.perf_counter()
+    adds = _normalize_delta(ds.n, adds)
+    removes = _normalize_delta(ds.n, removes)
+    n_add = sum(int(v.size) for v in adds.values())
+    n_rem = sum(int(v.size) for v in removes.values())
+    with obs_trace.span("mutation.delta", site=SITE, uid=ds.uid,
+                        values_added=n_add, values_removed=n_rem) as sp:
+        if not adds and not removes:
+            sp.tag(mode="noop", version=ds.version)
+            return {"mode": "noop", "version": ds.version,
+                    "rows_patched": 0, "values_added": 0,
+                    "values_removed": 0, "repack_reason": None,
+                    "wall_ms": 0.0, "drift": drift_report(ds, drift_limit)}
+        reason = None
+        rows = add_m = rem_m = None
+        touched = set(adds) | set(removes)
+        if repack == "always":
+            reason = "requested"
+        elif ds.layout != "dense":
+            reason = "layout"
+        else:
+            rows, add_m, rem_m, structural, touched, n_add, n_rem = \
+                plan_patch(ds, adds, removes)
+            if structural:
+                reason = "structural"
+            elif rows.size == 0:
+                # semantic no-op: every removal targeted containers its
+                # source doesn't hold — nothing to patch, no version
+                # bump, no invalidation
+                sp.tag(mode="noop", version=ds.version)
+                return {"mode": "noop", "version": ds.version,
+                        "rows_patched": 0, "values_added": 0,
+                        "values_removed": n_rem, "repack_reason": None,
+                        "wall_ms": round(
+                            (time.perf_counter() - t0) * 1e3, 3),
+                        "drift": drift_report(ds, drift_limit)}
+        # drift is judged on the PROSPECTIVE mutation count but only
+        # committed when the delta actually applies — a repack="never"
+        # refusal must not inflate the counter for work never done
+        mutated0 = int(getattr(ds, "_mutated_values", 0))
+        if reason is None:
+            ds._mutated_values = mutated0 + n_add + n_rem
+            drift = drift_report(ds, drift_limit)
+            if drift["fired"]:
+                reason = "drift"
+        else:
+            drift = drift_report(ds, drift_limit)
+        if reason is not None and repack == "never":
+            ds._mutated_values = mutated0
+            raise ValueError(
+                f"delta needs a full repack ({reason}) but repack="
+                f"'never' was requested")
+
+        if reason is None:
+            hosts0 = getattr(ds, "_host_cache", None)
+            ds.version += 1
+            _patch_rows(ds, rows, add_m, rem_m)
+            for src in touched:
+                ds.source_versions[src] = ds.version
+            ds.row_versions[rows] = ds.version
+            # keep the host twin fresh incrementally when it exists —
+            # the sequential/shadow/oracle tier must never lag the image
+            if hosts0 is not None and hosts0[0] == ds.version - 1:
+                ds._host_cache = (ds.version,
+                                  _host_apply(hosts0[1], adds, removes))
+            else:
+                ds._host_cache = None
+            mode, rows_patched = "patch", int(rows.size)
+        else:
+            hosts = _host_apply(host_bitmaps(ds), adds, removes)
+            repack_in_place(ds, hosts, reason=reason,
+                            touched=touched)
+            mode, rows_patched = "repack", 0
+
+        from . import result_cache
+
+        dropped = result_cache.notify_version_bump(ds.uid, touched)
+        wall = time.perf_counter() - t0
+        obs_metrics.histogram("rb_delta_apply_seconds",
+                              mode=mode).observe(wall)
+        obs_metrics.counter("rb_delta_rows_patched_total").inc(
+            rows_patched)
+        sp.tag(mode=mode, version=ds.version, rows=rows_patched,
+               repack_reason=reason, cache_dropped=dropped)
+        return {"mode": mode, "version": ds.version,
+                "rows_patched": rows_patched, "values_added": n_add,
+                "values_removed": n_rem, "repack_reason": reason,
+                "wall_ms": round(wall * 1e3, 3), "drift": drift}
+
+
+def _patch_rows(ds, rows, add_m, rem_m) -> None:
+    """One compiled in-place patch of the dense resident image, plus the
+    journal entry sharded pool replicas replay (one-shard writes under
+    the tenant-aligned placement)."""
+    import jax
+
+    rows_p, add_p, rem_p, p_pad = _pad_patch(ds, rows, add_m, rem_m)
+    program = _patch_program(ds, p_pad)
+    masks = np.stack((add_p, rem_p), axis=1)
+    ds.words = program(ds.words, jax.numpy.asarray(rows_p),
+                       jax.numpy.asarray(masks))
+    journal = ds._delta_journal
+    journal.append((ds.version, np.asarray(rows, np.int32).copy(),
+                    add_m.copy(), rem_m.copy()))
+    while len(journal) > JOURNAL_DEPTH:
+        dropped_ver = journal.pop(0)[0]
+        ds._journal_dropped_version = max(
+            getattr(ds, "_journal_dropped_version", 0), dropped_ver)
+
+
+def repack_in_place(ds, bitmaps=None, reason: str = "requested",
+                    touched=None) -> dict:
+    """Full re-pack of a resident set IN PLACE: rebuild the packed
+    layout from the current (or given) host sources, releasing the old
+    ledger registration and preserving the set's identity/version
+    lineage.  ``layout="auto"`` re-resolves through
+    ``insights.choose_layout`` — the drift escalation's whole point."""
+    from ..obs import memory as obs_memory
+
+    t0 = time.perf_counter()
+    if bitmaps is None:
+        bitmaps = host_bitmaps(ds)
+    uid, version = ds.uid, ds.version
+    structure = ds.structure_version
+    src_vers = ds.source_versions
+    obs_memory.LEDGER.release(ds._ledger_handle)
+    ds.__init__(bitmaps, layout="auto")
+    # __init__ keeps identity fields it finds present; re-stamp lineage
+    ds.uid = uid
+    ds.version = version + 1
+    ds.structure_version = structure + 1
+    ds.source_versions = src_vers
+    for src in (touched or ()):
+        ds.source_versions[src] = ds.version
+    ds.row_versions = np.full(ds._n_rows, ds.version, np.int64)
+    ds._host_cache = (ds.version, list(bitmaps))
+    # structure changed: journal replay is meaningless across a re-layout
+    ds._delta_journal = []
+    ds._journal_dropped_version = ds.version
+    wall = time.perf_counter() - t0
+    obs_metrics.histogram("rb_delta_apply_seconds",
+                          mode="repack").observe(wall)
+    obs_trace.current().event(
+        "mutation.repack", site=SITE, uid=ds.uid, reason=reason,
+        version=ds.version, structure_version=ds.structure_version,
+        wall_ms=round(wall * 1e3, 2))
+    return {"mode": "repack", "reason": reason, "version": ds.version,
+            "structure_version": ds.structure_version,
+            "wall_ms": round(wall * 1e3, 3)}

@@ -1,0 +1,411 @@
+"""Materialized expression-result cache keyed by (DAG hash x leaf versions).
+
+PR 8's expression compiler already canonicalizes + hash-conses every
+query into a structural DAG; this module turns that hash into a *result*
+cache key by appending each leaf's ``(set uid, source index, source
+version)`` token.  Millions of users repeat the same segments/filters,
+so across requests an unchanged canonical (sub)tree over unchanged data
+is a dictionary hit instead of a re-executed segmented reduce:
+
+- **root-level serving**: every engine's ``execute`` probes the cache
+  per query before planning; hits return the materialized result
+  (cardinality always; the host bitmap for bitmap-form queries) and the
+  query never reaches the planner or the device.  Misses dispatch as
+  before and fill the cache on the way out.
+- **subtree pruning**: ``BatchEngine.plan`` hands the expression
+  compiler a probe; a canonical interior node whose key hits an entry
+  with materialized rows lowers as a pre-computed operand (the
+  ``adhoc`` step shape) instead of a reduce — the segmented reduce for
+  that subtree is pruned from the program entirely.
+
+Correctness leans on the delta subsystem's version discipline
+(:mod:`.delta`): leaf tokens embed ``source_versions[i]``, so a
+version-bumped leaf can never hit a stale entry; the leaf -> entry
+index additionally *drops* exactly the dependent entries on a bump
+(``notify_version_bump``) so stale bytes are reclaimed immediately, not
+at LRU eviction.  Entries are immutable once created, which is what
+makes plan-held references to injected subtree rows safe across
+evictions.
+
+Accounting: the cache is a bounded LRU with a BYTE budget (not an entry
+count — materialized rows are 8 KiB each).  Bytes register with the HBM
+ledger (``kind="result_cache"``), so serving admission's
+resident-bytes check counts cache bytes with zero extra wiring, and
+evictions/invalidations keep the ledger balanced.  Metrics:
+``rb_result_cache_{hits,misses,evictions,bytes}``; every probing
+execute attaches an ``expr.cache`` event (hits/misses) to its span.
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+from collections import OrderedDict
+
+import numpy as np
+
+from ..obs import memory as obs_memory
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+ENV_RESULT_CACHE = "ROARING_TPU_RESULT_CACHE"
+
+#: fixed per-entry bookkeeping estimate (key tuple, index rows, slots)
+ENTRY_OVERHEAD_BYTES = 128
+
+#: live caches, notified on every set's version bump
+_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+# ------------------------------------------------------------------ keys
+
+def _leaf_token(leaf, leaf_token_of):
+    tok = leaf_token_of(int(leaf.index))
+    if tok is None:
+        return None
+    uid, src, ver = tok
+    return ("ref", int(uid), int(src), int(ver)), (int(uid), int(src))
+
+
+def _tokenize(e, leaf_token_of, leaves: set):
+    """Structural token of an ALREADY-canonical expression node, or None
+    when the node is uncacheable (ad-hoc leaves key by object identity,
+    which a cross-request cache must not trust)."""
+    from ..parallel import expr as expr_mod
+
+    if isinstance(e, expr_mod.Ref):
+        got = _leaf_token(e, leaf_token_of)
+        if got is None:
+            return None
+        tok, leaf = got
+        leaves.add(leaf)
+        return tok
+    if isinstance(e, expr_mod.AdHoc):
+        return None
+    if e.op == "empty":
+        return ("empty",)
+    kids = []
+    for c in e.children:
+        t = _tokenize(c, leaf_token_of, leaves)
+        if t is None:
+            return None
+        kids.append(t)
+    return (e.op, tuple(kids))
+
+
+def node_key(node, leaf_token_of):
+    """``(key, leaves)`` of one canonical expression node; ``(None,
+    None)`` when uncacheable.  ``leaf_token_of(index) -> (uid, source,
+    version) | None`` is the engine's resident-set resolver."""
+    leaves: set = set()
+    tok = _tokenize(node, leaf_token_of, leaves)
+    if tok is None:
+        return None, None
+    return tok, frozenset(leaves)
+
+
+def query_key(q, leaf_token_of):
+    """``(key, leaves, form)`` of one ``BatchQuery`` / ``ExprQuery``.
+
+    Flat queries normalize through the SAME canonicalization as
+    expressions (operands as a set, andnot = head minus rest-union), so
+    ``BatchQuery("or", (0, 1))`` and ``ExprQuery(or_(0, 1))`` share one
+    entry.  Returns ``(None, None, form)`` for uncacheable queries —
+    ad-hoc leaves, out-of-range refs (the planner still raises its own
+    typed error), or shapes canonicalization rejects.
+    """
+    from ..parallel import expr as expr_mod
+    from ..parallel.batch_engine import BatchQuery
+
+    if isinstance(q, BatchQuery):
+        ops = sorted({int(i) for i in q.operands})
+        if not ops:
+            return None, None, q.form
+        if q.op == "andnot":
+            head = int(q.operands[0])
+            rest = sorted({int(i) for i in q.operands[1:]})
+            e = expr_mod.Node(
+                "andnot", (expr_mod.Ref(head),
+                           *(expr_mod.Ref(i) for i in rest)))
+        else:
+            e = (expr_mod.Ref(ops[0]) if len(ops) == 1 else
+                 expr_mod.Node(q.op, tuple(expr_mod.Ref(i) for i in ops)))
+    elif isinstance(q, expr_mod.ExprQuery):
+        e = q.expr
+    else:
+        return None, None, getattr(q, "form", "cardinality")
+    try:
+        e = expr_mod.canonicalize(e)
+    except (ValueError, TypeError):
+        # the planner owns rejection (unbounded complement, empty and_):
+        # an uncacheable key must not change WHERE the error raises
+        return None, None, q.form
+    key, leaves = node_key(e, leaf_token_of)
+    return key, leaves, q.form
+
+
+# ----------------------------------------------------------------- cache
+
+class _Entry:
+    __slots__ = ("cardinality", "keys", "words", "cards", "bitmap",
+                 "leaves", "nbytes")
+
+    def __init__(self, cardinality, keys, words, cards, bitmap, leaves):
+        self.cardinality = int(cardinality)
+        self.keys = keys          # u16[K] root keys (None: card-only)
+        self.words = words        # u32[K, 2048] device rows (None: card-only)
+        self.cards = cards        # i32[K] per-key cards (None: card-only)
+        self.bitmap = bitmap      # host materialization (None: card-only)
+        self.leaves = leaves      # frozenset of (uid, source)
+        nbytes = ENTRY_OVERHEAD_BYTES
+        if words is not None:
+            nbytes += int(words.size) * 4 + int(keys.size) * 2 \
+                + int(cards.size) * 4
+        self.nbytes = nbytes
+
+
+class ResultCache:
+    """Byte-budgeted LRU of materialized query results.
+
+    Not thread-safe (the engines are per-instance single-dispatcher).
+    One instance may back any number of engines — keys embed each
+    resident set's process-unique ``uid``, so tenants never collide.
+    """
+
+    def __init__(self, max_bytes: int = 64 << 20, name: str = "result"):
+        if max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self.max_bytes = int(max_bytes)
+        self.name = name
+        self._data: OrderedDict = OrderedDict()
+        self._by_leaf: dict = {}       # (uid, source) -> set of keys
+        self.nbytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self._ledger_handle = obs_memory.LEDGER.register(
+            "result_cache", "device", 0, owner=self)
+        _CACHES.add(self)
+
+    # ---------------------------------------------------------- probing
+
+    def probe(self, key, form: str = "cardinality"):
+        """The materialized :class:`~.batch_engine.BatchResult` for
+        ``key``, or None.  A cardinality-form query hits any entry; a
+        bitmap-form query needs a materialized entry (the cardinality
+        short circuit stores no rows).  Counts hits/misses."""
+        from ..parallel.batch_engine import BatchResult
+
+        e = self._data.get(key)
+        if e is None or (form == "bitmap" and e.bitmap is None):
+            self.misses += 1
+            obs_metrics.counter("rb_result_cache_misses").inc()
+            return None
+        self._data.move_to_end(key)
+        self.hits += 1
+        obs_metrics.counter("rb_result_cache_hits").inc()
+        return BatchResult(
+            cardinality=e.cardinality,
+            bitmap=e.bitmap.clone() if form == "bitmap" else None)
+
+    def would_hit(self, key, form: str = "cardinality") -> bool:
+        """Count-free peek — the serving loop's execute-time predictor
+        asks this for every pool member without skewing the metrics."""
+        if key is None:
+            return False
+        e = self._data.get(key)
+        return e is not None and not (form == "bitmap" and e.bitmap is None)
+
+    def peek_rows(self, key):
+        """``(keys u16, words, cards)`` of a MATERIALIZED entry for the
+        plan-time subtree probe, or None.  Counts hits only (a pruned
+        reduce is a served result; a miss on one of a plan's many
+        interior nodes is not a query-level miss)."""
+        e = self._data.get(key)
+        if e is None or e.words is None:
+            return None
+        self._data.move_to_end(key)
+        self.hits += 1
+        obs_metrics.counter("rb_result_cache_hits").inc()
+        return e.keys, e.words, e.cards
+
+    # ---------------------------------------------------------- filling
+
+    def put(self, key, leaves, result) -> None:
+        """Fill one entry from a dispatched ``BatchResult``.  Bitmap
+        results materialize their device rows (the subtree-injectable
+        form) next to the host bitmap; cardinality results store the
+        count alone (~:data:`ENTRY_OVERHEAD_BYTES`).  An oversized
+        entry (> the whole budget) is refused rather than evicting
+        everything else."""
+        if key is None or result is None:
+            return
+        if result.bitmap is not None:
+            # size gate BEFORE materializing: an entry that can never fit
+            # must not pay the clone + row pack + device upload on every
+            # re-execution of its (uncacheable) query
+            k = result.bitmap.container_count()
+            if ENTRY_OVERHEAD_BYTES + k * (2048 * 4 + 2 + 4) \
+                    > self.max_bytes:
+                return
+        import jax
+
+        keys = words = cards = bitmap = None
+        if result.bitmap is not None:
+            bitmap = result.bitmap.clone()
+            keys = np.asarray(bitmap.keys, np.uint16).copy()
+            if keys.size:
+                from ..ops import packing
+
+                words_np = np.stack([
+                    packing.container_words_u32(c)
+                    for c in bitmap.containers]).astype(np.uint32)
+                cards = np.array([c.cardinality
+                                  for c in bitmap.containers], np.int32)
+                # device-resident: the rows live in HBM (ledger-counted)
+                # so subtree injection and repeated serves never re-pack
+                words = jax.device_put(words_np)
+            else:
+                words = jax.numpy.zeros((0, 2048), jax.numpy.uint32)
+                cards = np.zeros(0, np.int32)
+        entry = _Entry(result.cardinality, keys, words, cards, bitmap,
+                       leaves or frozenset())
+        if entry.nbytes > self.max_bytes:
+            return
+        old = self._data.pop(key, None)
+        if old is not None:
+            self._drop_index(key, old)
+            self.nbytes -= old.nbytes
+        self._data[key] = entry
+        self.nbytes += entry.nbytes
+        for leaf in entry.leaves:
+            self._by_leaf.setdefault(leaf, set()).add(key)
+        while self.nbytes > self.max_bytes and len(self._data) > 1:
+            k, e = self._data.popitem(last=False)
+            self._drop_index(k, e)
+            self.nbytes -= e.nbytes
+            self.evictions += 1
+            obs_metrics.counter("rb_result_cache_evictions").inc()
+        self._account()
+
+    # ----------------------------------------------------- invalidation
+
+    def invalidate(self, uid: int, sources=None) -> int:
+        """Drop every entry depending on resident set ``uid`` (all of it,
+        or only the given source indices) — EXACT invalidation: entries
+        whose leaf sets don't reference a bumped leaf survive.  Returns
+        the number of entries dropped."""
+        if sources is None:
+            leafset = [lf for lf in list(self._by_leaf) if lf[0] == uid]
+        else:
+            leafset = [(uid, int(s)) for s in sources]
+        doomed: set = set()
+        for leaf in leafset:
+            doomed |= self._by_leaf.get(leaf, set())
+        for key in doomed:
+            e = self._data.pop(key, None)
+            if e is None:
+                continue
+            self._drop_index(key, e)
+            self.nbytes -= e.nbytes
+            self.invalidations += 1
+        if doomed:
+            self._account()
+        return len(doomed)
+
+    def _drop_index(self, key, entry) -> None:
+        for leaf in entry.leaves:
+            keys = self._by_leaf.get(leaf)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._by_leaf[leaf]
+
+    # ------------------------------------------------------- accounting
+
+    def _account(self) -> None:
+        obs_metrics.gauge("rb_result_cache_bytes").set(self.nbytes)
+        obs_memory.LEDGER.update(self._ledger_handle, self.nbytes)
+
+    def clear(self) -> None:
+        self._data.clear()
+        self._by_leaf.clear()
+        self.nbytes = 0
+        self._account()
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def stats(self) -> dict:
+        return {"entries": len(self._data), "bytes": self.nbytes,
+                "max_bytes": self.max_bytes, "hits": self.hits,
+                "misses": self.misses, "evictions": self.evictions,
+                "invalidations": self.invalidations}
+
+
+def notify_version_bump(uid: int, sources=None) -> int:
+    """Delta-ingest hook (:mod:`.delta`): drop the dependent entries of
+    a version-bumped set from every live cache.  Version-embedded keys
+    already make stale HITS impossible; this reclaims the bytes."""
+    dropped = 0
+    for cache in list(_CACHES):
+        dropped += cache.invalidate(uid, sources)
+    return dropped
+
+
+# -------------------------------------------------------------- serving
+
+def serve_and_fill(cache, items, key_of, run, site: str):
+    """The shared probe/dispatch/fill loop of the three engines.
+
+    ``items`` are opaque query carriers; ``key_of(item) -> (key, leaves,
+    form)``; ``run(miss_items) -> results`` executes the misses through
+    the engine's existing guarded path.  Returns ``(results, hits)``
+    with results in item order; attaches an ``expr.cache`` event to the
+    current span whenever the cache was consulted."""
+    keyed = [key_of(it) for it in items]
+    results: list = [None] * len(items)
+    miss: list = []
+    for i, (key, _leaves, form) in enumerate(keyed):
+        got = cache.probe(key, form) if key is not None else None
+        if got is None:
+            miss.append(i)
+        else:
+            results[i] = got
+    hits = len(items) - len(miss)
+    obs_trace.current().event("expr.cache", site=site, hits=hits,
+                              misses=len(miss))
+    if miss:
+        out = run([items[i] for i in miss])
+        for i, r in zip(miss, out):
+            results[i] = r
+            key, leaves, _form = keyed[i]
+            if key is not None:
+                cache.put(key, leaves, r)
+    return results, hits
+
+
+# ------------------------------------------------------------ env knob
+
+_env_cache: ResultCache | None = None
+_env_spec: str | None = None
+
+
+def from_env():
+    """The process-shared cache sized by ``ROARING_TPU_RESULT_CACHE``
+    (bytes, K/M/G-suffixed), or None when unset/0 — the engines'
+    default resolver, so a deployment opts in without code."""
+    global _env_cache, _env_spec
+    spec = os.environ.get(ENV_RESULT_CACHE)
+    if spec != _env_spec:
+        _env_spec = spec
+        if not spec:
+            _env_cache = None
+        else:
+            from ..runtime import guard
+
+            nbytes = guard.parse_bytes(spec)
+            _env_cache = (ResultCache(nbytes, name="env")
+                          if nbytes > 0 else None)
+    return _env_cache

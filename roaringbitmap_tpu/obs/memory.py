@@ -73,6 +73,19 @@ class HbmLedger:
             # and the next collector run must not report freed bytes
             self._push_gauges(row[0], row[1])
 
+    def update(self, handle: int, nbytes: int) -> None:
+        """Re-size a live registration in place (idempotent no-op on a
+        released handle) — the seam for growable residents whose bytes
+        change without a rebuild: the mutation result cache
+        (fills/evictions/invalidations) and delta-patched sets.  Gauges
+        push immediately, like ``release``."""
+        with self._lock:
+            row = self._entries.get(handle)
+            if row is None:
+                return
+            self._entries[handle] = (row[0], row[1], int(nbytes))
+        self._push_gauges(row[0], row[1])
+
     def _push_gauges(self, kind: str, layout: str) -> None:
         _metrics.gauge("rb_hbm_resident_bytes", kind=kind,
                        layout=layout).set(self.resident_bytes(kind, layout))

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
 import operator
 import time
 
@@ -50,6 +51,10 @@ def _engine(engine: str) -> str:
 
 #: wide-path engine ladder (runtime.guard appends the sequential rung)
 ENGINE_LADDER = ("pallas", "xla")
+
+#: process-unique resident-set ids (mutation versioning / cache keys);
+#: NOT recycled on GC, unlike id()
+_SET_UIDS = itertools.count(1)
 
 _SEQ_OP = {"or": operator.or_, "and": operator.and_, "xor": operator.xor}
 
@@ -759,10 +764,34 @@ class DeviceBitmapSet:
         self.head_idx = jax.device_put(head_idx)
         #: lazily-built BatchEngine backing evaluate() expression queries
         self._expr_engine = None
+        # mutation identity + version lineage (roaringbitmap_tpu.mutation,
+        # docs/MUTATION.md): uid/version survive an in-place repack (the
+        # repack path re-runs __init__ and re-stamps them), so result-
+        # cache keys and engine plan keys stay honest across the set's
+        # whole mutable lifetime
+        if not hasattr(self, "uid") or len(self.source_versions) != self.n:
+            self.uid = next(_SET_UIDS)
+            self.version = 0
+            self.structure_version = 0
+            self.source_versions = np.zeros(self.n, np.int64)
+        self.row_versions = np.zeros(self._n_rows, np.int64)
+        self._delta_programs = {}
+        self._delta_journal = []
+        self._journal_dropped_version = getattr(
+            self, "_journal_dropped_version", 0)
+        self._host_cache = None
+        #: pack-time value floor feeding the layout-drift heuristic
+        #: (mutation.delta.drift_report): sparse stream values plus a
+        #: >= 4096-value lower bound per dense wire row
+        self._mutation_base_values = (
+            s.total_values + 4096 * int(s.dense_words.shape[0]))
+        self._mutated_values = 0
         # HBM ledger: resident bytes registered now, released when this
-        # set is collected (rb_hbm_resident_bytes{kind,layout} gauges)
-        obs_memory.LEDGER.register("bitmap_set", layout, self.hbm_bytes(),
-                                   owner=self)
+        # set is collected (rb_hbm_resident_bytes{kind,layout} gauges) or
+        # explicitly on an in-place repack (mutation.delta swaps the
+        # registration so repacked bytes never double-count)
+        self._ledger_handle = obs_memory.LEDGER.register(
+            "bitmap_set", layout, self.hbm_bytes(), owner=self)
         # cold-path export (bench.py's ingest_compile_ms_one_time, now a
         # first-class metric): the whole pack + transfer + densify-compile
         # build — a fresh shape on a cold jit cache pays seconds here, a
@@ -1031,6 +1060,42 @@ class DeviceBitmapSet:
         model the obs ledger registers and predict_resident_bytes is
         parity-pinned against)."""
         return int(sum(insights.resident_set_bytes(self).values()))
+
+    # ------------------------------------------------------------ mutation
+
+    def apply_delta(self, adds=None, removes=None, repack: str = "auto",
+                    drift_limit: int | None = None) -> dict:
+        """Mutate this resident set at segment granularity
+        (roaringbitmap_tpu.mutation, docs/MUTATION.md).  ``adds`` /
+        ``removes`` map source index -> u32 values; a dense-layout delta
+        over existing containers patches only the affected packed rows
+        in place (one "delta:N"-rung compiled program — five orders of
+        magnitude under a full re-pack), bumps the monotone ``version``
+        + per-source/per-row dirty stamps, and invalidates exactly the
+        dependent materialized-result cache entries.  Structural deltas
+        (new container keys), non-dense layouts, and the layout-drift
+        heuristic escalate to a full in-place repack (``layout="auto"``
+        re-resolved).  Returns the mutation report."""
+        from ..mutation import delta as mut_delta
+
+        return mut_delta.apply_delta(self, adds, removes, repack=repack,
+                                     drift_limit=drift_limit)
+
+    def host_bitmaps(self) -> list:
+        """Version-fresh host copies of the resident sources (rebuilt
+        from the resident image, cached per ``version``) — the
+        sequential-reference / shadow / repack data tier."""
+        from ..mutation import delta as mut_delta
+
+        return mut_delta.host_bitmaps(self)
+
+    def warmup_delta(self, n: int) -> dict:
+        """Pre-compile the in-place patch program for an ``n``-row delta
+        (the "delta:N" warmup rung) so the first in-band ``apply_delta``
+        never pays its compile."""
+        from ..mutation import delta as mut_delta
+
+        return mut_delta.warmup_delta(self, n)
 
     def chained_wide_or(self, reps: int, engine: str = "auto"):
         """Steady-state throughput probe: `reps` dependent wide-ORs in ONE jit.

@@ -69,6 +69,7 @@ import numpy as np
 
 from ..core.bitmap import RoaringBitmap
 from ..insights import analysis as insights
+from ..mutation import result_cache as mut_cache
 from ..obs import cost as obs_cost
 from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
@@ -352,7 +353,7 @@ class BatchEngine:
     are cached on the instance, keyed by (engine, bucket signatures).
     """
 
-    def __init__(self, ds: DeviceBitmapSet):
+    def __init__(self, ds: DeviceBitmapSet, result_cache="env"):
         if ds._packed.row_src is None:
             raise ValueError(
                 "resident set lacks row_src metadata (repack required)")
@@ -366,9 +367,16 @@ class BatchEngine:
         self._row_src = np.asarray(ds._packed.row_src)
         self._row_seg = np.repeat(np.asarray(ds._packed.blk_seg),
                                   ds.block).astype(np.int32)
+        #: materialized-result reuse (roaringbitmap_tpu.mutation,
+        #: docs/MUTATION.md): "env" resolves ROARING_TPU_RESULT_CACHE
+        #: (None when unset); pass a ResultCache to share one across
+        #: engines, or None to disable
+        self.result_cache = (mut_cache.from_env()
+                             if result_cache == "env" else result_cache)
+        self._ds_structure = ds.structure_version
         self._programs = LRUCache(PROGRAM_CACHE_MAX, name="batch_programs")
         self._plans = LRUCache(PLAN_CACHE_MAX, name="batch_plans")
-        self._hosts = None        # lazy CPU-reference copies of the sources
+        self._qkeys = LRUCache(1024)   # (query, version) -> cache key
         self.split_count = 0      # ResourceExhausted batch halvings served
         self.proactive_split_count = 0  # pre-dispatch HBM-budget halvings
         #: predicted-vs-measured bytes of the most recent device dispatch
@@ -384,6 +392,43 @@ class BatchEngine:
     def from_bitmaps(cls, bitmaps: list, layout: str = "auto",
                      **kw) -> "BatchEngine":
         return cls(DeviceBitmapSet(bitmaps, layout=layout, **kw))
+
+    # ------------------------------------------------------------- mutation
+
+    def _sync_with_ds(self) -> None:
+        """Pick up the resident set's mutations: a structural repack
+        re-laid the rows, so the row maps must re-read (plans keyed on
+        the pre-repack version become unreachable in the LRU; value-only
+        patches change nothing here — the plan key's version component
+        handles them)."""
+        ds = self._ds
+        if ds.structure_version != self._ds_structure:
+            self._ds_structure = ds.structure_version
+            self.keys = ds.keys
+            self._row_src = np.asarray(ds._packed.row_src)
+            self._row_seg = np.repeat(np.asarray(ds._packed.blk_seg),
+                                      ds.block).astype(np.int32)
+
+    def _leaf_token(self, i: int):
+        """Result-cache leaf token of resident source ``i`` — (set uid,
+        source, source version); None out of range (the planner still
+        raises its own typed error)."""
+        ds = self._ds
+        if i < 0 or i >= ds.n:
+            return None
+        return (ds.uid, int(i), int(ds.source_versions[i]))
+
+    def _cache_key_of(self, q):
+        """Result-cache key of one query, memoized per (query, set
+        version): queries are frozen/hashable and leaf versions only
+        move on deltas, so a replayed trace's key computation is a dict
+        hit, not a canonicalization walk."""
+        memo_key = (q, self._ds.version)
+        got = self._qkeys.get(memo_key)
+        if got is None:
+            got = mut_cache.query_key(q, self._leaf_token)
+            self._qkeys.put(memo_key, got)
+        return got
 
     # ------------------------------------------------------------- planning
 
@@ -442,10 +487,33 @@ class BatchEngine:
         riding the SAME bucketing below, and the combine steps compile
         into per-query sections the program fuses after the reduces.
         """
-        key = tuple(queries)
+        self._sync_with_ds()
+        # the set's version is part of the plan key: a delta-patched or
+        # repacked set must never replay a stale plan (stale gathers, or
+        # a cached-subtree injection whose leaf versions moved on)
+        key = (tuple(queries), self._ds.version)
         cached = self._plans.get(key)
         if cached is not None:
             return cached
+        cache_probe = None
+        if self.result_cache is not None:
+            rc = self.result_cache
+
+            def cache_probe(node):
+                # plan-time subtree pruning: a canonical interior node
+                # whose (hash x leaf versions) key holds materialized
+                # rows lowers as a pre-computed operand instead of a
+                # reduce.  BatchEngine dispatches never donate, so the
+                # cache's device rows are safe to hand the program
+                # directly (the pooled engines copy — see multiset).
+                k, _leaves = mut_cache.node_key(node, self._leaf_token)
+                if k is None:
+                    return None
+                got = rc.peek_rows(k)
+                if got is None:
+                    return None
+                keys_c, words_c, _cards = got
+                return keys_c, words_c
         with obs_slo.phase("plan"), \
                 obs_trace.span("batch.plan", q=len(queries)) as sp:
             groups: dict = {}
@@ -467,7 +535,8 @@ class BatchEngine:
             for qid, q in enumerate(queries):
                 if isinstance(q, expr_mod.ExprQuery):
                     sections.append(expr_mod.compile_query(
-                        q, qid, add_item, self._plan_leaf))
+                        q, qid, add_item, self._plan_leaf,
+                        cache_probe=cache_probe))
                 else:
                     add_item(q, qid)
             with obs_trace.span("batch.bucket", groups=len(groups)):
@@ -546,8 +615,13 @@ class BatchEngine:
         signature, and any later jit dispatch of the same signature would
         have paid it anyway."""
         src, kind = self._resident_src()
-        sig = (eng, kind, tuple(b.signature for b in plan),
-               plan.expr_signature)
+        # the resident image's shape is a program operand: a structural
+        # repack (mutation.delta) changes n_rows/stream shapes, and a
+        # bucket-signature-identical plan must not hit a program
+        # compiled against the old image (structure_version moves
+        # exactly when those shapes can)
+        sig = (eng, kind, self._ds.uid, self._ds.structure_version,
+               tuple(b.signature for b in plan), plan.expr_signature)
         if eng == "megakernel":
             # the instruction stream's shape is plan data, not bucket
             # shape: two plans sharing padded bucket signatures can still
@@ -682,8 +756,6 @@ class BatchEngine:
                 return self._execute_once(queries, engine, jit,
                                           inject=False)
             policy = policy or guard.GuardPolicy.from_env()
-            chain = guard.chain_from(
-                resolve_query_engine(engine, queries), ENGINE_LADDER)
             # SLO accounting + per-phase attribution for the whole execute
             # (splits and demotions included; the guard's own per-dispatch
             # context is suppressed under this one)
@@ -693,9 +765,26 @@ class BatchEngine:
                 # recursion): the backend-free-memory default costs an
                 # allocator query, which must not multiply on the
                 # dispatch-floor hot path
-                results = self._dispatch(queries, chain, jit, policy,
-                                         guard.Deadline(policy.deadline),
-                                         guard.resolve_hbm_budget(policy))
+                deadline = guard.Deadline(policy.deadline)
+                budget = guard.resolve_hbm_budget(policy)
+
+                def run_misses(qs):
+                    chain = guard.chain_from(
+                        resolve_query_engine(engine, qs), ENGINE_LADDER)
+                    return self._dispatch(qs, chain, jit, policy,
+                                          deadline, budget)
+
+                if self.result_cache is not None:
+                    # materialized-result reuse: probe per query before
+                    # planning, dispatch only the misses, fill on the
+                    # way out (mutation.result_cache; version-bumped
+                    # leaves can never hit stale entries)
+                    self._sync_with_ds()
+                    results, _hits = mut_cache.serve_and_fill(
+                        self.result_cache, queries, self._cache_key_of,
+                        run_misses, "batch_engine")
+                else:
+                    results = run_misses(queries)
             if not self._first_query_done:
                 # the cold path, first-class (ROADMAP item 3's baseline):
                 # this engine's first execute pays plan + program compile
@@ -890,23 +979,14 @@ class BatchEngine:
     # ----------------------------------------------- CPU sequential rung
 
     def _host_sources(self) -> list:
-        """Host copies of the resident source bitmaps, rebuilt ONCE from
-        the resident image via row_src/row_seg (works for any ingest —
-        objects, serialized bytes, views — because it reads what is
-        actually resident).  This is the data the sequential reference
-        rung and the shadow cross-check run on."""
-        if self._hosts is None:
-            words = np.asarray(self._ds._resident_words("xla"))
-            hosts = []
-            for i in range(self.n):
-                rows = np.flatnonzero(self._row_src == i)
-                w = words[rows]
-                cards = (np.unpackbits(w.view(np.uint8), axis=1).sum(axis=1)
-                         if rows.size else np.zeros(0, np.int64))
-                hosts.append(packing.unpack_result(
-                    self.keys[self._row_seg[rows]], w, cards))
-            self._hosts = hosts
-        return self._hosts
+        """Host copies of the resident source bitmaps, rebuilt from the
+        resident image (works for any ingest — objects, serialized
+        bytes, views — because it reads what is actually resident) and
+        cached per mutation version on the SET (mutation.delta keeps the
+        cache fresh incrementally across delta patches).  This is the
+        data the sequential reference rung and the shadow cross-check
+        run on."""
+        return self._ds.host_bitmaps()
 
     def _sequential_one(self, q):
         """Host-side reference for ONE query, mirroring the batch
@@ -1023,7 +1103,7 @@ class BatchEngine:
         queries = list(queries)
         policy = policy or guard.GuardPolicy.from_env()
         budget = guard.resolve_hbm_budget(policy)
-        plan_hit = tuple(queries) in self._plans
+        plan_hit = (tuple(queries), self._ds.version) in self._plans
         plan = self.plan(queries)
         # explain reports what execute() WOULD do, so it mirrors its
         # chain-start resolution (auto + expressions on TPU starts at
@@ -1031,7 +1111,8 @@ class BatchEngine:
         eng = self._bucket_engine(plan,
                                   resolve_query_engine(engine, queries))
         kind = self._resident_src()[1]
-        prog_sig = (eng, kind, tuple(b.signature for b in plan),
+        prog_sig = (eng, kind, self._ds.uid, self._ds.structure_version,
+                    tuple(b.signature for b in plan),
                     plan.expr_signature)
         if eng == "megakernel":
             prog_sig = prog_sig + (plan.mega.signature,)
@@ -1186,19 +1267,28 @@ class BatchEngine:
         ``rungs`` entries may also be expression shapes — ``"expr"``,
         ``"expr:3"`` or ``("expr", 3)`` pre-compile the fused
         depth-N op-mix programs (parallel.expr.rung_expressions), so a
-        serving loop's first compositional queries boot hot too."""
+        serving loop's first compositional queries boot hot too — or
+        delta shapes (``"delta:8"``): the in-place mutation patch
+        program for an 8-row delta rung (docs/MUTATION.md), so the
+        first in-band ``apply_delta`` never pays its compile."""
         cache_dir = rt_warmup.enable_compile_cache()
         t0 = time.perf_counter()
+        programs = []
         if queries is not None:
             batches = [list(queries)]
         else:
             batches = []
             for r in rungs:
                 kind, n = expr_mod.parse_warmup_rung(r)
+                if kind == "delta":
+                    rep = self._ds.warmup_delta(n)
+                    programs.append({"delta_rung": n,
+                                     "engine": "mutation",
+                                     "compiled": rep["compiled"]})
+                    continue
                 batches.append(
                     expr_mod.rung_expressions(n, self.n) if kind == "expr"
                     else self._rung_queries(n, ops))
-        programs = []
         for batch in batches:
             if not batch:
                 continue

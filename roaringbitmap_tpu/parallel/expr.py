@@ -540,6 +540,10 @@ class ExprSection:
     depth: int = 0
     cse_saved: int = 0
     host_ops: int = 0
+    #: subtrees served from the materialized result cache at plan time
+    #: (mutation.result_cache) — each pruned a reduce/combine lowering
+    #: into a pre-computed operand (the "adhoc" step shape)
+    n_cached: int = 0
 
     @property
     def signature(self):
@@ -576,7 +580,7 @@ def _is_reduce(n: Expr) -> bool:
 
 
 def compile_query(q: ExprQuery, qid: int, plan_reduce,
-                  plan_leaf) -> ExprSection:
+                  plan_leaf, cache_probe=None) -> ExprSection:
     """Compile one :class:`ExprQuery` against an engine's planner.
 
     ``plan_reduce(batch_query, owner)`` registers a pseudo flat query
@@ -586,6 +590,11 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
     internal reduce nodes (consumed in-program, never read back).
     ``plan_leaf(index)`` returns ``(gather_rows, keys)`` for a resident
     leaf, rows in whatever row space the caller's image gather uses.
+    ``cache_probe(node)``, when given, returns ``(keys, words)`` of a
+    materialized cached result for a canonical interior node (the
+    mutation result cache) — the node then lowers as a pre-computed
+    operand (the "adhoc" step shape) and its reduce/combine lowering is
+    pruned from the program entirely.
     """
     from .batch_engine import BatchQuery
 
@@ -667,8 +676,35 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
         def emit(n) -> int | None:
             if n in memo:
                 return memo[n]
-            si = _emit(n)
+            si = emit_cached(n)
+            if si is _MISS:
+                si = _emit(n)
             memo[n] = si
+            return si
+
+        _MISS = object()
+
+        def emit_cached(n):
+            """Cached-subtree injection (mutation.result_cache): a
+            canonical interior node with materialized cached rows
+            lowers as a pre-computed operand step — served, not
+            planned.  Returns ``_MISS`` when the cache has nothing."""
+            if cache_probe is None or not isinstance(n, Node) \
+                    or n.op == "empty":
+                return _MISS
+            hit = cache_probe(n)
+            if hit is None:
+                return _MISS
+            keys_c, words_c = hit
+            sec.n_cached += 1
+            if keys_c.size == 0:
+                # a cached-empty result prunes like any empty operand;
+                # _combine's op-specific identity rules apply unchanged
+                return None
+            si = len(steps)
+            steps.append(("adhoc", int(keys_c.size)))
+            host[f"w{si}"] = words_c
+            keyof[si] = keys_c
             return si
 
         def _emit(n) -> int | None:
@@ -786,7 +822,8 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
         sec.host = host
         sp.tag(kind=sec.kind, reduce_nodes=sec.n_reduce,
                combine_nodes=sec.n_combine, steps=len(steps),
-               root_keys=int(sec.root_keys.size))
+               root_keys=int(sec.root_keys.size),
+               cached_nodes=sec.n_cached)
         return sec
 
 
@@ -1087,10 +1124,15 @@ def rung_expressions(depth: int, n_residents: int,
 def parse_warmup_rung(r):
     """Warmup rung vocabulary shared by the three engines: an int is a
     pow2 operand rung (the flat shapes); ``"expr"``, ``"expr:3"`` or
-    ``("expr", 3)`` is an expression-shape rung at that depth."""
+    ``("expr", 3)`` is an expression-shape rung at that depth;
+    ``"delta:8"`` / ``("delta", 8)`` is a mutation patch-program rung
+    at that many delta rows (docs/MUTATION.md)."""
     if isinstance(r, str) and r.startswith("expr"):
         _, _, d = r.partition(":")
         return "expr", int(d) if d else 2
-    if isinstance(r, tuple) and len(r) == 2 and r[0] == "expr":
-        return "expr", int(r[1])
+    if isinstance(r, str) and r.startswith("delta"):
+        _, _, d = r.partition(":")
+        return "delta", int(d) if d else 8
+    if isinstance(r, tuple) and len(r) == 2 and r[0] in ("expr", "delta"):
+        return r[0], int(r[1])
     return "flat", int(r)

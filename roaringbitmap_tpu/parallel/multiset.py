@@ -85,6 +85,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..insights import analysis as insights
+from ..mutation import result_cache as mut_cache
 from ..obs import cost as obs_cost
 from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
@@ -449,12 +450,20 @@ class MultiSetBatchEngine:
     process upgrades to pooled execution without re-packing anything).
     """
 
-    def __init__(self, sets: list):
+    def __init__(self, sets: list, result_cache="env"):
         if not sets:
             raise ValueError("multi-set engine needs at least one set")
         rt_warmup.enable_compile_cache()   # ROARING_TPU_COMPILE_CACHE
-        self._engines = [s if isinstance(s, BatchEngine) else BatchEngine(s)
-                         for s in sets]
+        #: materialized-result reuse (mutation.result_cache): "env"
+        #: resolves ROARING_TPU_RESULT_CACHE; engines built here share
+        #: it (already-built BatchEngines keep their own), so the S=1
+        #: fast path and the pooled path serve from one cache
+        self.result_cache = (mut_cache.from_env()
+                             if result_cache == "env" else result_cache)
+        self._engines = [
+            s if isinstance(s, BatchEngine)
+            else BatchEngine(s, result_cache=self.result_cache)
+            for s in sets]
         self.n_sets = len(self._engines)
         #: pooled row base per set: set i's resident image occupies rows
         #: [_row_base[i], _row_base[i+1]) of a full-pool concatenation;
@@ -512,16 +521,50 @@ class MultiSetBatchEngine:
             i += n
         return out
 
+    def _sync_with_sets(self) -> None:
+        """Pick up member-set mutations: a structural repack changes a
+        tenant's row count, so the pooled row bases must re-read (the
+        version component of the plan key retires stale plans)."""
+        for i, e in enumerate(self._engines):
+            e._sync_with_ds()
+            self._rows[i] = int(e._row_src.size)
+
+    def _cache_probe_for(self, sid: int):
+        """Plan-time subtree probe for one tenant, or None.  Pooled
+        plans feed the DONATING pipelined dispatcher, so cached rows
+        copy to host here — handing the cache's device buffer to a
+        donated argument would destroy the entry under it."""
+        if self.result_cache is None:
+            return None
+        e = self._engines[sid]
+        rc = self.result_cache
+
+        def probe(node):
+            k, _leaves = mut_cache.node_key(node, e._leaf_token)
+            if k is None:
+                return None
+            got = rc.peek_rows(k)
+            if got is None:
+                return None
+            keys_c, words_c, _cards = got
+            return keys_c, np.asarray(words_c)
+
+        return probe
+
     def _plan_pool(self, pooled) -> _PoolPlan:
         """Pooled plan: per-set row selection, offset remap into the
         referenced-set concatenation, shared shape bucketing.  Cached by
-        the exact (set_id, query) tuple — the prepared-statement pattern
-        across tenants."""
-        key = tuple(pooled)
+        the exact (set_id, query) tuple plus the referenced sets'
+        mutation versions — the prepared-statement pattern across
+        tenants, retired exactly when a tenant's data moves."""
+        self._sync_with_sets()
+        sids = tuple(sorted({sid for sid, _ in pooled}))
+        key = (tuple(pooled),
+               tuple((self._engines[s]._ds.uid,
+                      self._engines[s]._ds.version) for s in sids))
         cached = self._plans.get(key)
         if cached is not None:
             return cached
-        sids = tuple(sorted({sid for sid, _ in pooled}))
         offsets, base = {}, 0
         for sid in sids:
             offsets[sid] = base
@@ -559,7 +602,8 @@ class MultiSetBatchEngine:
                     sections.append(expr_mod.compile_query(
                         q, qid,
                         lambda pq, own, sid=sid: add_item(sid, pq, own),
-                        lambda i, sid=sid: plan_leaf(sid, i)))
+                        lambda i, sid=sid: plan_leaf(sid, i),
+                        cache_probe=self._cache_probe_for(sid)))
                 else:
                     add_item(sid, q, qid)
             with obs_trace.span("multiset.pool", groups=len(groups)):
@@ -726,7 +770,14 @@ class MultiSetBatchEngine:
         such a program must be fed FRESH uploads, never the cached plan
         arrays."""
         donate = donate and _donation_supported()
-        sig = (eng, plan.signature, donate)
+        # referenced residents' structure versions are part of the sig:
+        # a structural repack changes their image/stream shapes, and a
+        # row_sel/bucket-identical plan must not hit a program compiled
+        # against the old operand shapes (mutation.delta)
+        sig = (eng, plan.signature, donate,
+               tuple((self._engines[s]._ds.uid,
+                      self._engines[s]._ds.structure_version)
+                     for s in plan.sids))
         if eng == "megakernel":
             sig = sig + (plan.mega.signature,)
         t_get = time.perf_counter()
@@ -872,27 +923,44 @@ class MultiSetBatchEngine:
                 return self._regroup(flat, lengths)
             t_exec0 = time.perf_counter()
             policy = policy or guard.GuardPolicy.from_env()
-            chain = guard.chain_from(
-                resolve_query_engine(engine, [q for _, q in pooled]),
-                ENGINE_LADDER)
             budget = guard.resolve_hbm_budget(policy)
             deadline = guard.Deadline(policy.deadline)
-            # one in-budget launch — the steady-state serving tick — is
-            # handed to _pipeline as a materialized single so it
-            # dispatches sync with the cached operand arrays; a pool the
-            # budget WILL split stays a live generator, so launch k+1's
-            # halving/planning runs while launch k is on device (the
-            # probe's plan is cached and needed either way)
-            if (budget is None or len(pooled) < 2
-                    or self.predict_dispatch_bytes(pooled, chain[0])
-                    <= budget):
-                launches = [(0, tuple(pooled))]
-            else:
-                launches = ((0, qs) for qs in
-                            self._launch_iter(pooled, chain[0], budget))
-            with obs_slo.query(SITE, deadline_ms=policy.slo_deadline_ms):
-                flat = self._pipeline(launches, chain, jit, policy,
+
+            def run_misses(qs):
+                qs = tuple(qs)
+                chain = guard.chain_from(
+                    resolve_query_engine(engine, [q for _, q in qs]),
+                    ENGINE_LADDER)
+                # one in-budget launch — the steady-state serving tick
+                # — is handed to _pipeline as a materialized single so
+                # it dispatches sync with the cached operand arrays; a
+                # pool the budget WILL split stays a live generator, so
+                # launch k+1's halving/planning runs while launch k is
+                # on device (the probe's plan is cached and needed
+                # either way)
+                if (budget is None or len(qs) < 2
+                        or self.predict_dispatch_bytes(qs, chain[0])
+                        <= budget):
+                    launches = [(0, qs)]
+                else:
+                    launches = ((0, sub) for sub in
+                                self._launch_iter(qs, chain[0], budget))
+                return self._pipeline(launches, chain, jit, policy,
                                       deadline, budget)[0]
+
+            with obs_slo.query(SITE, deadline_ms=policy.slo_deadline_ms):
+                if self.result_cache is not None:
+                    # materialized-result reuse across tenants: probe
+                    # per (set, query) before planning, pool only the
+                    # misses, fill on the way out
+                    self._sync_with_sets()
+                    flat, _hits = mut_cache.serve_and_fill(
+                        self.result_cache, list(pooled),
+                        lambda it: self._engines[it[0]]._cache_key_of(
+                            it[1]),
+                        run_misses, SITE)
+                else:
+                    flat = run_misses(pooled)
             if not self._first_query_done:
                 self._first_query_done = True
                 obs_metrics.histogram(
@@ -1336,17 +1404,26 @@ class MultiSetBatchEngine:
         route.  Compile-only; see ``BatchEngine.warmup``."""
         cache_dir = rt_warmup.enable_compile_cache()
         t0 = time.perf_counter()
+        programs = []
         if pools is None:
             pools = []
             for r in rungs:
                 kind, n = expr_mod.parse_warmup_rung(r)
+                if kind == "delta":
+                    # mutation patch-program rung: one per tenant, so
+                    # no tenant's first in-band apply_delta compiles
+                    for e in self._engines:
+                        rep = e._ds.warmup_delta(n)
+                        programs.append({"delta_rung": n,
+                                         "engine": "mutation",
+                                         "compiled": rep["compiled"]})
+                    continue
                 pools.append([
                     BatchGroup(sid,
                                expr_mod.rung_expressions(n, e.n)
                                if kind == "expr"
                                else e._rung_queries(n, ops))
                     for sid, e in enumerate(self._engines)])
-        programs = []
         for pool in pools:
             pooled, _ = self._flatten(list(pool))
             if not pooled:
@@ -1379,6 +1456,21 @@ class MultiSetBatchEngine:
         return {"site": SITE, "compile_cache_dir": cache_dir,
                 "programs": programs,
                 "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+
+    def count_cache_hits(self, pooled_or_groups) -> int:
+        """How many of a pool's queries the materialized result cache
+        would serve right now — count-free (``would_hit``), so the
+        serving loop's execute-time predictor can scale a cache-hit
+        pool's estimate down without skewing the hit/miss metrics."""
+        if self.result_cache is None:
+            return 0
+        pooled = self._as_pooled(pooled_or_groups)
+        n = 0
+        for sid, q in pooled:
+            key, _leaves, form = self._engines[sid]._cache_key_of(q)
+            if self.result_cache.would_hit(key, form):
+                n += 1
+        return n
 
     def cache_stats(self) -> dict:
         """Pooled plan/program cache observability + the split counters

@@ -195,7 +195,8 @@ class ShardedBatchEngine:
     """
 
     def __init__(self, sets, mesh: Mesh | None = None,
-                 placement: str = "auto", specs: SpecLayout = SPECS):
+                 placement: str = "auto", specs: SpecLayout = SPECS,
+                 result_cache="env"):
         rt_warmup.enable_compile_cache()   # ROARING_TPU_COMPILE_CACHE
         if isinstance(sets, (DeviceBitmapSet, BatchEngine)):
             sets = [sets]
@@ -210,13 +211,15 @@ class ShardedBatchEngine:
         self._mesh_label = f"{self.mesh_shape[0]}x{self.mesh_shape[1]}"
         #: the single-device demotion rung AND the sequential/shadow
         #: reference: the un-sharded pooled engine over the SAME adopted
-        #: BatchEngine instances (shared caches, zero re-packing)
-        self._single = MultiSetBatchEngine(sets)
+        #: BatchEngine instances (shared caches, zero re-packing); the
+        #: materialized result cache is shared through it too
+        self._single = MultiSetBatchEngine(sets, result_cache=result_cache)
         self._engines = self._single._engines
         self.n_sets = len(self._engines)
-        self._rows = [int(e._row_src.size) for e in self._engines]
-        self._base = np.concatenate(
-            ([0], np.cumsum(self._rows))).astype(np.int64)
+        self.result_cache = self._single.result_cache
+        self._requested_placement = placement
+        self._ledger_handle = None
+        self._pool_patch_fn = None
         self._place_pool(placement)
         self._plans = LRUCache(PLAN_CACHE_MAX, name="sharded_plans")
         self._programs = LRUCache(PROGRAM_CACHE_MAX,
@@ -243,39 +246,89 @@ class ShardedBatchEngine:
     #: where the cross-shard gather is the price of residency at all.
     REPLICATE_MAX_BYTES = 64 << 20
 
+    @staticmethod
+    def _aligned_bases(rows: list, rows_per_shard0: int, r_axis: int):
+        """Tenant-aligned row layout for the sharded placement: per-
+        tenant base offsets such that no tenant smaller than a row
+        shard straddles a shard boundary (a tenant's delta patch is
+        then a ONE-shard write — PR 7's named debt).  Tenants larger
+        than a shard necessarily span, but still start shard-aligned.
+        Grows rows_per_shard until the greedy first-fit layout fits the
+        row axis; alignment padding rows stay zero (the reduce
+        identity), like round_blocks padding one level down."""
+        u = max(1, int(rows_per_shard0))
+        while True:
+            bases, cur = [], 0
+            for n in rows:
+                if n and (cur % u) and ((cur % u) + n > u or n > u):
+                    cur = -(-cur // u) * u      # advance to a boundary
+                bases.append(cur)
+                cur += n
+            if cur <= u * r_axis:
+                return bases, u
+            u = -(-cur // r_axis)
+
     def _place_pool(self, placement: str) -> None:
         """Concatenate every tenant's dense row image and place it over
         the mesh: ``sharded`` = rows over the ``rows`` axis (replicated
-        along ``data``) — per-device residency 1/mesh_rows of the pool;
-        ``replicated`` = full copy per device — shard-local gathers;
-        ``auto`` = replicate small pools (REPLICATE_MAX_BYTES), shard
-        big ones.  One-time ingest cost, accounted by the HBM ledger
-        (kind="sharded_pool") at mesh-total bytes; ``shard_balance`` =
-        max/mean live rows per row-shard (1.0 when replicated)."""
+        along ``data``) — per-device residency 1/mesh_rows of the pool,
+        tenant blocks shard-ALIGNED (``_aligned_bases``) so a tenant's
+        delta patch lands in one row shard; ``replicated`` = full copy
+        per device — shard-local gathers; ``auto`` = replicate small
+        pools (REPLICATE_MAX_BYTES), shard big ones.  One-time ingest
+        cost, accounted by the HBM ledger (kind="sharded_pool") at
+        mesh-total bytes; ``shard_balance`` = max/mean live rows per
+        row-shard (1.0 when replicated)."""
         rows_axis = self.mesh_shape[0]
-        total = int(self._base[-1])
-        padded = max(rows_axis, -(-total // rows_axis) * rows_axis)
+        self._rows = [int(e._row_src.size) for e in self._engines]
+        total = sum(self._rows)
         if placement == "auto":
             placement = ("replicated"
                          if total * insights.ROW_BYTES
                          <= self.REPLICATE_MAX_BYTES else "sharded")
         self.placement = placement
+        if placement == "sharded":
+            bases, u = self._aligned_bases(
+                self._rows, -(-max(total, 1) // rows_axis), rows_axis)
+            padded = u * rows_axis
+        else:
+            bases = np.concatenate(
+                ([0], np.cumsum(self._rows)[:-1])).astype(np.int64)
+            padded = max(rows_axis, -(-total // rows_axis) * rows_axis)
+        end = (int(bases[-1]) + self._rows[-1]) if self._rows else 0
+        self._base = np.concatenate(
+            (np.asarray(bases, np.int64), [end]))
         img = np.zeros((padded, WORDS32), np.uint32)
+        live = np.zeros((padded,), bool)
         for e, b in zip(self._engines, self._base[:-1]):
             n = int(e._row_src.size)
             if n:
                 img[int(b):int(b) + n] = np.asarray(
                     e._ds._resident_words("xla"), dtype=np.uint32)
+                live[int(b):int(b) + n] = True
         self.pool_rows_live = total
         self.pool_rows = padded
-        spec = (self._specs.pooled_rows() if placement == "sharded"
-                else self._specs.combined_heads())
+        #: a guaranteed-dead pooled row (alignment/round padding), the
+        #: idempotent scatter target of delta-patch padding; -1 when the
+        #: image is exactly full
+        dead = np.flatnonzero(~live)
+        self._pool_pad_row = int(dead[0]) if dead.size else -1
+        self._pool_spec = (self._specs.pooled_rows()
+                           if placement == "sharded"
+                           else self._specs.combined_heads())
+        self._pool_patch_fn = None     # re-jit against the new spec
         self.pool_words = jax.device_put(
-            img, NamedSharding(self._mesh, spec))
+            img, NamedSharding(self._mesh, self._pool_spec))
+        #: the mutation watermark per tenant: value deltas replay from
+        #: each set's journal (one-shard writes); structural repacks
+        #: re-place the whole pool (_sync_pool)
+        self._placed_versions = [e._ds.version for e in self._engines]
+        self._placed_structures = [e._ds.structure_version
+                                   for e in self._engines]
         if placement == "sharded":
-            per_shard = np.clip(
-                total - np.arange(rows_axis) * (padded // rows_axis),
-                0, padded // rows_axis)
+            rps = padded // rows_axis
+            per_shard = np.bincount(
+                np.flatnonzero(live) // rps, minlength=rows_axis)
             mean = float(per_shard.mean()) if total else 1.0
             self.shard_balance = (float(per_shard.max()) / mean
                                   if mean > 0 else 1.0)
@@ -289,8 +342,87 @@ class ShardedBatchEngine:
             ledger_bytes = padded * insights.ROW_BYTES * self.mesh_devices
         obs_metrics.gauge("rb_shard_balance", site=SITE,
                           mesh=self._mesh_label).set(self.shard_balance)
+        if self._ledger_handle is not None:
+            # re-place (mutation escalation): the old registration must
+            # not double-count under the new image
+            obs_memory.LEDGER.release(self._ledger_handle)
         self._ledger_handle = obs_memory.LEDGER.register(
             "sharded_pool", "dense", ledger_bytes, owner=self)
+
+    # --------------------------------------------------- mutation sync
+
+    def _sync_pool(self) -> None:
+        """Bring the placed pool copy up to date with member-set
+        mutations: value-only deltas replay from each set's bounded
+        journal as in-place pooled patches (tenant-aligned => one-shard
+        writes); a structural repack, or a journal that has already
+        dropped the needed entries, re-places the pool wholesale."""
+        stale = False
+        for i, e in enumerate(self._engines):
+            ds = e._ds
+            if ds.structure_version != self._placed_structures[i]:
+                stale = True
+                break
+            if (ds.version != self._placed_versions[i]
+                    and ds._journal_dropped_version
+                    > self._placed_versions[i]):
+                stale = True
+                break
+        if stale:
+            self._single._sync_with_sets()
+            self._place_pool(self._requested_placement)
+            return
+        for i, e in enumerate(self._engines):
+            ds = e._ds
+            if ds.version == self._placed_versions[i]:
+                continue
+            for ver, rows, add_m, rem_m in ds._delta_journal:
+                if ver <= self._placed_versions[i]:
+                    continue
+                self._patch_pool(int(self._base[i])
+                                 + rows.astype(np.int64), add_m, rem_m)
+            self._placed_versions[i] = ds.version
+
+    def _patch_pool(self, rows, add_m, rem_m) -> None:
+        """One in-place patch of the placed pool image — the pooled twin
+        of ``mutation.delta._patch_rows``'s discipline (donated image,
+        pow2 rung padding against a dead row, add/remove planes stacked
+        into ONE upload), with the sharding preserved (out_shardings
+        pins the pooled spec)."""
+        p = int(rows.size)
+        if self._pool_pad_row >= 0:
+            from ..ops import packing
+
+            p_pad = packing.next_pow2(max(1, p))
+            if p_pad != p:
+                rows_p = np.full(p_pad, self._pool_pad_row, np.int64)
+                rows_p[:p] = rows
+                add_p = np.zeros((p_pad, WORDS32), np.uint32)
+                add_p[:p] = add_m
+                rem_p = np.zeros((p_pad, WORDS32), np.uint32)
+                rem_p[:p] = rem_m
+                rows, add_m, rem_m = rows_p, add_p, rem_p
+        if self._pool_patch_fn is None:
+            sharding = NamedSharding(self._mesh, self._pool_spec)
+
+            def patch(words, r, masks):
+                cur = words[r]
+                return words.at[r].set(
+                    (cur | masks[:, 0]) & ~masks[:, 1])
+
+            # donate the old pool: the patch is an in-place row write,
+            # not a whole-pool copy (mutation.delta's discipline; the
+            # engine reassigns pool_words on every call)
+            self._pool_patch_fn = jax.jit(patch, donate_argnums=(0,),
+                                          out_shardings=sharding)
+        self.pool_words = self._pool_patch_fn(
+            self.pool_words, jnp.asarray(rows.astype(np.int32)),
+            jnp.asarray(np.stack((add_m, rem_m), axis=1)))
+        obs_metrics.counter("rb_sharded_pool_patches_total", site=SITE,
+                            mesh=self._mesh_label).inc()
+        obs_trace.current().event(
+            "mutation.pool_patch", site=SITE, rows=p,
+            mesh=list(self.mesh_shape), placement=self.placement)
 
     @property
     def sets(self) -> list:
@@ -318,11 +450,18 @@ class ShardedBatchEngine:
         return seq, False
 
     def _plan(self, pooled) -> _ShardedPlan:
-        key = tuple(pooled)
+        self._sync_pool()
+        sids = tuple(sorted({sid for sid, _ in pooled}))
+        # referenced tenants' mutation versions key the plan: value
+        # patches keep row placement (gathers are global rows) but may
+        # have served cached-subtree injections whose leaf versions
+        # moved; structural repacks re-lay rows outright
+        key = (tuple(pooled),
+               tuple((self._engines[s]._ds.uid,
+                      self._engines[s]._ds.version) for s in sids))
         cached = self._plans.get(key)
         if cached is not None:
             return cached
-        sids = tuple(sorted({sid for sid, _ in pooled}))
         with obs_slo.phase("plan"), \
                 obs_trace.span("sharded.plan", q=len(pooled),
                                sets=len(sids), mesh=self._mesh_label) as sp:
@@ -358,7 +497,8 @@ class ShardedBatchEngine:
                     sections.append(expr_mod.compile_query(
                         q, qid,
                         lambda pq, own, sid=sid: add_item(sid, pq, own),
-                        lambda i, sid=sid: plan_leaf(sid, i)))
+                        lambda i, sid=sid: plan_leaf(sid, i),
+                        cache_probe=self._single._cache_probe_for(sid)))
                 else:
                     add_item(sid, q, qid)
             with obs_trace.span("sharded.pool", groups=len(groups)):
@@ -573,7 +713,12 @@ class ShardedBatchEngine:
         (donation-capable backends only) donates the per-launch group
         scratch like the PR 5 pipelined dispatcher."""
         donate = donate and _donation_supported()
-        sig = (guard.MESH, plan.signature, donate)
+        # the placed pool's shape/placement is a program operand: a
+        # mutation-escalated re-place (structural repack) can change
+        # both, and a bucket-shape-identical plan must not hit a program
+        # compiled against the old image
+        sig = (guard.MESH, plan.signature, donate, self.placement,
+               self.pool_rows)
         if plan.mega is not None:
             sig = sig + (plan.mega.signature,)
         t_get = time.perf_counter()
@@ -684,12 +829,28 @@ class ShardedBatchEngine:
             policy = policy or guard.GuardPolicy.from_env()
             budget = guard.resolve_hbm_budget(policy)
             deadline = guard.Deadline(policy.deadline)
-            with obs_slo.query(SITE, deadline_ms=policy.slo_deadline_ms):
-                flat = []
-                for qs in self._launch_iter(pooled, budget):
+
+            def run_misses(qs):
+                out = []
+                for sub in self._launch_iter(tuple(qs), budget):
                     res, _rung = self._launch_guarded(
-                        qs, jit, policy, deadline, budget)
-                    flat.extend(res)
+                        sub, jit, policy, deadline, budget)
+                    out.extend(res)
+                return out
+
+            with obs_slo.query(SITE, deadline_ms=policy.slo_deadline_ms):
+                rc = self.result_cache
+                if rc is not None:
+                    from ..mutation import result_cache as mut_cache
+
+                    self._single._sync_with_sets()
+                    flat, _hits = mut_cache.serve_and_fill(
+                        rc, list(pooled),
+                        lambda it: self._engines[it[0]]._cache_key_of(
+                            it[1]),
+                        run_misses, SITE)
+                else:
+                    flat = run_misses(pooled)
             if not self._first_query_done:
                 self._first_query_done = True
                 obs_metrics.histogram(
@@ -900,17 +1061,27 @@ class ShardedBatchEngine:
         replays them from disk."""
         cache_dir = rt_warmup.enable_compile_cache()
         t0 = time.perf_counter()
+        programs = []
         if pools is None:
             pools = []
             for r in rungs:
                 kind, n = expr_mod.parse_warmup_rung(r)
+                if kind == "delta":
+                    # mutation patch rung per tenant (docs/MUTATION.md);
+                    # the pooled image's own patch program jits per
+                    # rung on first replay
+                    for e in self._engines:
+                        rep = e._ds.warmup_delta(n)
+                        programs.append({"delta_rung": n,
+                                         "engine": "mutation",
+                                         "compiled": rep["compiled"]})
+                    continue
                 pools.append([
                     BatchGroup(sid,
                                expr_mod.rung_expressions(n, e.n)
                                if kind == "expr"
                                else e._rung_queries(n, ops))
                     for sid, e in enumerate(self._engines)])
-        programs = []
         for pool in pools:
             groups, _ = self._normalize(pool)
             pooled, _ = self._single._flatten(groups)
@@ -932,6 +1103,13 @@ class ShardedBatchEngine:
             return np.array([r.cardinality for r in out], np.int64)
         return [np.array([r.cardinality for r in rows], np.int64)
                 for rows in out]
+
+    def count_cache_hits(self, groups_or_queries) -> int:
+        """Delegates to the un-sharded pooled engine's counter — the
+        leaf tokens are properties of the shared resident sets, so the
+        answer is placement-independent."""
+        groups, _ = self._normalize(groups_or_queries)
+        return self._single.count_cache_hits(groups)
 
     def cache_stats(self) -> dict:
         """Sharded plan/program cache observability + the split counter

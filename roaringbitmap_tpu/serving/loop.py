@@ -300,6 +300,11 @@ class ServingLoop:
                              queue_depth=len(q), cap=cap)
             req_bytes = self._request_bytes(request)
             budget = guard.resolve_hbm_budget(self.policy.guard)
+            # ledger-resident counts EVERYTHING device-resident — packed
+            # sets, sharded pool copies, AND the materialized result
+            # cache's rows (kind="result_cache"): cached results occupy
+            # the same HBM admitted requests would, so they backpressure
+            # admission exactly like resident data (docs/MUTATION.md)
             resident = obs_memory.LEDGER.resident_bytes()
             headroom = (None if budget is None
                         else int(budget * self.policy.hbm_headroom))
@@ -596,12 +601,22 @@ class ServingLoop:
         when it offers one (calibrated by observed achieved rates after
         the first dispatches), floored by the loop's own EWMA of
         measured pool walls — the model knows device time, the EWMA
-        knows the whole dispatch path."""
+        knows the whole dispatch path.  When the engine carries a
+        materialized result cache, the estimate scales down by the
+        fraction of the pool the cache would serve without dispatching
+        (docs/MUTATION.md): a repeated-expression pool's deadline math
+        must not budget for reduces that will never run."""
+        pooled = self._pooled(tickets)
         fn = getattr(self._engine, "predict_dispatch_seconds", None)
-        est = float(fn(self._pooled(tickets),
+        est = float(fn(pooled,
                        engine=self.policy.engine)) if fn else 0.0
         if self._s_per_q is not None:
             est = max(est, self._s_per_q * len(tickets))
+        hit_fn = getattr(self._engine, "count_cache_hits", None)
+        if hit_fn is not None and tickets:
+            hits = int(hit_fn(pooled))
+            if hits:
+                est *= max(0.0, len(tickets) - hits) / len(tickets)
         return max(est, 1e-4)
 
     def _dispatch(self, tickets: list) -> list:
@@ -763,8 +778,12 @@ class ServingLoop:
 
     def snapshot(self) -> dict:
         """Loop state as plain JSON — the serving half of a health
-        endpoint (``obs.snapshot()`` is the registry half)."""
-        return {
+        endpoint (``obs.snapshot()`` is the registry half).  The
+        ``result_cache`` section reports the engine's materialized
+        result cache when one is attached; its bytes ride the same HBM
+        ledger the admission check reads, so cached rows and resident
+        sets compete for one budget (docs/MUTATION.md)."""
+        out = {
             "level": self.level,
             "level_peak": self.level_peak,
             "pool_target": self._pool_target(),
@@ -774,3 +793,7 @@ class ServingLoop:
             "s_per_query_est": self._s_per_q,
             "stats": dict(self.stats),
         }
+        rc = getattr(self._engine, "result_cache", None)
+        if rc is not None:
+            out["result_cache"] = rc.stats()
+        return out

@@ -155,7 +155,8 @@ def test_s1_pool_routes_through_single_set_path(tenant_bitmaps):
     assert len(eng._plans) == 0 and len(eng._programs) == 0
     # and the single-set engine's own caches served the call
     be = eng._engines[1]
-    assert tuple(queries) in be._plans
+    # plan keys carry the set's mutation version (docs/MUTATION.md)
+    assert (tuple(queries), be._ds.version) in be._plans
     want = be.execute(queries, engine="xla")
     assert [r.cardinality for r in got[0]] == \
         [r.cardinality for r in want]

@@ -1,0 +1,587 @@
+"""Mutable-tenant acceptance (ISSUE 12): versioned delta ingest +
+materialized expression-result cache (roaringbitmap_tpu.mutation,
+docs/MUTATION.md).
+
+Pins:
+- in-place dense-layout patches are bit-exact vs the host oracle across
+  ops, with monotone version / per-source / per-row dirty stamps;
+- escalation rules: structural adds, non-dense layouts, drift, and
+  ``repack="always"`` all take the full-repack path (bit-exact);
+  ``repack="never"`` raises typed;
+- the property stream: N random interleaved ``apply_delta`` / query
+  steps stay bit-exact vs a host ``RoaringBitmap`` oracle across
+  layouts and engine rungs, including under ``ROARING_TPU_FAULTS``;
+- ``warmup(rungs=("delta:N",))`` pre-compiles the patch program so the
+  first in-band ``apply_delta`` is a compile-cache hit;
+- the result cache: root-level serving + fills, flat/expression key
+  sharing, plan-time subtree injection, EXACT leaf invalidation (bump
+  one leaf -> only its dependent entries drop), byte-budget eviction
+  with a balanced HBM ledger;
+- the sharded engine's tenant-aligned row sharding (a tenant's delta
+  patch never straddles a row-shard boundary) + journal-replay pool
+  sync and repack re-place, bit-exact;
+- serving-loop integration: cached pools serve, estimates drop, and the
+  snapshot/admission paths see the cache's ledger bytes;
+- CPU-proxy performance acceptance (slow lane): single-segment
+  ``apply_delta`` >= 100x faster than a full re-pack; replayed
+  repeated-expression trace >= 5x the recompute-path QPS.
+"""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.mutation import ResultCache
+from roaringbitmap_tpu.mutation import delta as mut_delta
+from roaringbitmap_tpu.mutation import result_cache as mut_cache
+from roaringbitmap_tpu.obs import memory as obs_memory
+from roaringbitmap_tpu.obs import metrics as obs_metrics
+from roaringbitmap_tpu.parallel import expr
+from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+from roaringbitmap_tpu.parallel.batch_engine import BatchEngine, BatchQuery
+from roaringbitmap_tpu.parallel.multiset import (BatchGroup,
+                                                 MultiSetBatchEngine,
+                                                 random_multiset_pool)
+from roaringbitmap_tpu.runtime import faults, guard
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def mk_bitmaps(seed, n=5, uni=1 << 17, card=2500):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        rb = RoaringBitmap()
+        rb.add_many(rng.choice(uni, card, replace=False).astype(np.uint32))
+        out.append(rb)
+    return out
+
+
+def host_apply(hosts, adds, removes):
+    out = list(hosts)
+    for src in set(adds) | set(removes):
+        bm = out[src].clone()
+        if src in adds:
+            a = RoaringBitmap()
+            a.add_many(np.asarray(adds[src], np.uint32))
+            bm = bm | a
+        if src in removes:
+            r = RoaringBitmap()
+            r.add_many(np.asarray(removes[src], np.uint32))
+            bm = bm - r
+        out[src] = bm
+    return out
+
+
+def wide_refs(hosts):
+    acc_or = hosts[0].clone()
+    acc_xor = hosts[0].clone()
+    acc_and = hosts[0].clone()
+    for b in hosts[1:]:
+        acc_or = acc_or | b
+        acc_xor = acc_xor ^ b
+        acc_and = acc_and & b
+    return acc_or, acc_xor, acc_and
+
+
+# --------------------------------------------------------- delta ingest
+
+def test_patch_bit_exact_and_versioned():
+    bms = mk_bitmaps(1)
+    ds = DeviceBitmapSet(bms, layout="dense")
+    hosts = list(bms)
+    adds = {0: np.array([11, 12, 13], np.uint32),
+            2: np.array([500, 777], np.uint32)}
+    removes = {1: np.asarray(
+        [v for v in (1, 2, 3) ], np.uint32)}
+    rep = ds.apply_delta(adds=adds, removes=removes)
+    assert rep["mode"] == "patch"
+    assert rep["rows_patched"] >= 1
+    hosts = host_apply(hosts, adds, removes)
+    ro, rx, ra = wide_refs(hosts)
+    assert ds.aggregate("or") == ro
+    assert ds.aggregate("xor") == rx
+    assert ds.aggregate("and") == ra
+    # version lineage: monotone version, touched sources stamped, only
+    # patched rows dirty
+    assert ds.version == 1
+    assert ds.structure_version == 0
+    assert set(np.flatnonzero(ds.source_versions == 1)) == {0, 1, 2}
+    assert int((ds.row_versions == 1).sum()) == rep["rows_patched"]
+    # removes win over adds for a value in both
+    rep2 = ds.apply_delta(adds={0: [99]}, removes={0: [99]})
+    assert rep2["mode"] == "patch"
+    hosts = host_apply(hosts, {0: [99]}, {0: [99]})
+    assert ds.aggregate("or") == wide_refs(hosts)[0]
+    assert 99 not in ds.host_bitmaps()[0]
+    # removals aimed entirely at containers the source doesn't hold are
+    # a semantic NO-OP: no patch, no version bump, no invalidation
+    v0 = ds.version
+    rep3 = ds.apply_delta(removes={0: [(0x7F7F << 16) + 1]})
+    assert rep3["mode"] == "noop" and rep3["rows_patched"] == 0
+    assert ds.version == v0
+
+
+def test_structural_add_escalates_to_repack():
+    bms = mk_bitmaps(2)
+    ds = DeviceBitmapSet(bms, layout="dense")
+    eng = BatchEngine(ds, result_cache=None)
+    queries = [BatchQuery("or", (0, 1, 2)), BatchQuery("xor", (1, 3))]
+    pre = [r.cardinality for r in eng.execute(queries)]
+    assert pre[0] == (bms[0] | bms[1] | bms[2]).cardinality
+    new_key_value = np.uint32((0xBEEF << 16) + 7)
+    rep = ds.apply_delta(adds={1: [int(new_key_value)]})
+    assert rep["mode"] == "repack"
+    assert rep["repack_reason"] == "structural"
+    assert ds.structure_version == 1
+    hosts = host_apply(bms, {1: [int(new_key_value)]}, {})
+    assert ds.aggregate("or") == wide_refs(hosts)[0]
+    # a second value in the SAME (now resident) key patches in place
+    rep2 = ds.apply_delta(adds={1: [int(new_key_value) + 1]})
+    assert rep2["mode"] == "patch"
+    # a repack that GROWS the packed image (many new keys, past the
+    # round_blocks padding) must retire the engine's compiled programs:
+    # a bucket-identical plan against the re-laid image would otherwise
+    # hit an executable compiled for the old operand shape
+    many = {0: [(0xA000 + k) << 16 for k in range(12)]}
+    rep3 = ds.apply_delta(adds=many)
+    assert rep3["mode"] == "repack"
+    hosts = host_apply(hosts, {1: [int(new_key_value) + 1]}, {})
+    hosts = host_apply(hosts, many, {})
+    post = [r.cardinality for r in eng.execute(queries)]
+    assert post[0] == (hosts[0] | hosts[1] | hosts[2]).cardinality
+    assert post[1] == (hosts[1] ^ hosts[3]).cardinality
+
+
+def test_layout_and_drift_escalation_and_never():
+    bms = mk_bitmaps(3)
+    ds = DeviceBitmapSet(bms, layout="counts")
+    rep = ds.apply_delta(adds={0: [5]})
+    assert rep["mode"] == "repack" and rep["repack_reason"] == "layout"
+    hosts = host_apply(bms, {0: [5]}, {})
+    assert ds.aggregate("or") == wide_refs(hosts)[0]
+
+    ds2 = DeviceBitmapSet(mk_bitmaps(4), layout="dense")
+    # a tiny drift limit fires the heuristic on the first delta
+    rep2 = ds2.apply_delta(adds={0: [21]}, drift_limit=0)
+    assert rep2["mode"] == "repack" and rep2["repack_reason"] == "drift"
+    assert rep2["drift"]["fired"]
+
+    ds3 = DeviceBitmapSet(mk_bitmaps(5), layout="dense")
+    with pytest.raises(ValueError, match="repack"):
+        ds3.apply_delta(adds={0: [(0x7777 << 16) + 1]}, repack="never")
+    # the failed call mutated nothing
+    assert ds3.version == 0
+
+
+@pytest.mark.parametrize("layout", ["dense", "counts"])
+@pytest.mark.parametrize("fault_spec", [None, "transient@batch_engine=0.4:1337"])
+def test_property_interleaved_delta_query_stream(layout, fault_spec):
+    """N random interleaved apply_delta/query steps stay bit-exact vs
+    the host oracle — across layouts and (via the guard) engine rungs,
+    including under fault injection."""
+    rng = np.random.default_rng(0xD17A)
+    bms = mk_bitmaps(6, n=4, uni=1 << 16, card=800)
+    ds = DeviceBitmapSet(bms, layout=layout)
+    eng = BatchEngine(ds, result_cache=ResultCache(4 << 20))
+    hosts = list(bms)
+    ctx = faults.inject(fault_spec) if fault_spec else None
+    if ctx:
+        ctx.__enter__()
+    try:
+        for step in range(10):
+            if step % 2 == 0:
+                src = int(rng.integers(4))
+                universe = 1 << 16 if rng.random() < 0.8 else 1 << 18
+                adds = {src: rng.integers(0, universe, 5).astype(np.uint32)}
+                rem_src = int(rng.integers(4))
+                pool = np.asarray(hosts[rem_src].to_array()
+                                  if hasattr(hosts[rem_src], "to_array")
+                                  else [], np.uint32)
+                removes = {}
+                if pool.size:
+                    removes = {rem_src: rng.choice(pool, 3)}
+                ds.apply_delta(adds=adds, removes=removes)
+                hosts = host_apply(hosts, adds, removes)
+            queries = [
+                BatchQuery("or", (0, 1, 2)),
+                BatchQuery("xor", (1, 3), form="bitmap"),
+                BatchQuery("andnot", (2, 0)),
+                expr.ExprQuery(expr.and_(expr.or_(0, 1),
+                                         expr.not_(3))),
+            ]
+            got = eng.execute(queries)
+            exp_or = hosts[0] | hosts[1] | hosts[2]
+            exp_xor = hosts[1] ^ hosts[3]
+            exp_andnot = hosts[2] - hosts[0]
+            exp_e = expr.evaluate_host(
+                expr.and_(expr.or_(0, 1), expr.not_(3)), hosts)
+            assert got[0].cardinality == exp_or.cardinality, step
+            assert got[1].bitmap == exp_xor, step
+            assert got[2].cardinality == exp_andnot.cardinality, step
+            assert got[3].cardinality == exp_e.cardinality, step
+    finally:
+        if ctx:
+            ctx.__exit__(None, None, None)
+
+
+def _compile_misses(site="mutation"):
+    return int(sum(
+        inst.count for name, labels, inst
+        in obs_metrics.REGISTRY.instruments()
+        if name == "rb_compile_seconds" and labels.get("site") == site
+        and labels.get("cache") == "miss"))
+
+
+def test_warmup_delta_rung_cache_hit():
+    bms = mk_bitmaps(7)
+    ds = DeviceBitmapSet(bms, layout="dense")
+    eng = BatchEngine(ds, result_cache=None)
+    rep = eng.warmup(rungs=("delta:4",))
+    assert any(p.get("delta_rung") == 4 for p in rep["programs"])
+    miss0 = _compile_misses()
+    # <= 4 patch rows pad to the warmed pow2 rung: no in-band compile
+    out = ds.apply_delta(adds={0: [7, 9], 1: [70000]})
+    assert out["mode"] == "patch"
+    assert _compile_misses() == miss0
+    assert obs_metrics.REGISTRY.counter(
+        "rb_delta_rows_patched_total").value >= 2
+
+
+# --------------------------------------------------------- result cache
+
+def test_result_cache_serves_flat_and_expr():
+    bms = mk_bitmaps(8)
+    rc = ResultCache(8 << 20)
+    eng = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                      result_cache=rc)
+    q = [BatchQuery("or", (0, 1, 2)),
+         BatchQuery("xor", (1, 3), form="bitmap")]
+    r1 = eng.execute(q)
+    assert rc.stats()["misses"] == 2 and rc.stats()["entries"] == 2
+    r2 = eng.execute(q)
+    assert rc.stats()["hits"] == 2
+    assert [r.cardinality for r in r1] == [r.cardinality for r in r2]
+    assert r2[1].bitmap == r1[1].bitmap
+    # an ExprQuery with the same canonical DAG shares the flat entry
+    r3 = eng.execute([expr.ExprQuery(expr.or_(2, 0, 1))])
+    assert rc.stats()["hits"] == 3
+    assert r3[0].cardinality == r1[0].cardinality
+    # bitmap-form query cannot be served from a cardinality-only entry
+    r4 = eng.execute([BatchQuery("or", (0, 1, 2), form="bitmap")])
+    ref = bms[0] | bms[1] | bms[2]
+    assert r4[0].bitmap == ref
+    # ... but its fill upgrades the entry: cardinality form now hits too
+    assert eng.execute(q)[0].cardinality == ref.cardinality
+
+
+def test_subtree_injection_prunes_reduce():
+    bms = mk_bitmaps(9, n=6)
+    rc = ResultCache(8 << 20)
+    eng = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                      result_cache=rc)
+    eng.execute([expr.ExprQuery(expr.or_(0, 4), form="bitmap")])
+    e = expr.and_(expr.or_(0, 4), expr.not_(5))
+    got = eng.execute([expr.ExprQuery(e)])
+    host = expr.evaluate_host(e, bms)
+    assert got[0].cardinality == host.cardinality
+    plan = eng.plan((expr.ExprQuery(e),))
+    assert plan.exprs[0].n_cached >= 1
+    # the injected plan executes bit-exactly on a later (cached) serve
+    assert eng.execute([expr.ExprQuery(e)])[0].cardinality \
+        == host.cardinality
+
+
+def _cache_ledger_base():
+    """The ledger's result_cache bytes from OTHER live caches: the
+    ledger is process-global, and caches from sibling tests may not be
+    collected yet — assertions below are deltas against this."""
+    import gc
+
+    gc.collect()
+    return obs_memory.LEDGER.resident_bytes("result_cache")
+
+
+def test_exact_invalidation_and_ledger_balance():
+    base = _cache_ledger_base()
+    bms_a, bms_b = mk_bitmaps(10), mk_bitmaps(11)
+    rc = ResultCache(8 << 20)
+    eng_a = BatchEngine(DeviceBitmapSet(bms_a, layout="dense"),
+                        result_cache=rc)
+    eng_b = BatchEngine(DeviceBitmapSet(bms_b, layout="dense"),
+                        result_cache=rc)
+    eng_a.execute([BatchQuery("or", (0, 1)),
+                   BatchQuery("xor", (2, 3), form="bitmap")])
+    eng_b.execute([BatchQuery("or", (0, 1), form="bitmap")])
+    assert rc.stats()["entries"] == 3
+    assert obs_memory.LEDGER.resident_bytes("result_cache") \
+        == base + rc.nbytes
+    # bump ONE leaf: set A source 0 — exactly its dependents drop
+    eng_a._ds.apply_delta(adds={0: [123]})
+    s = rc.stats()
+    assert s["entries"] == 2 and s["invalidations"] == 1
+    # set B's entry and set A's untouched (2,3) entry still hit
+    assert rc.stats()["hits"] == 0
+    eng_b.execute([BatchQuery("or", (0, 1), form="bitmap")])
+    eng_a.execute([BatchQuery("xor", (2, 3), form="bitmap")])
+    assert rc.stats()["hits"] == 2
+    # the dropped entry re-fills with the POST-delta result
+    got = eng_a.execute([BatchQuery("or", (0, 1))])
+    ref = host_apply(bms_a, {0: [123]}, {})[0] | bms_a[1]
+    assert got[0].cardinality == ref.cardinality
+    # ledger balanced after the drop + re-fill
+    assert obs_memory.LEDGER.resident_bytes("result_cache") \
+        == base + rc.nbytes
+
+
+def test_byte_budget_eviction_balances_ledger():
+    bms = mk_bitmaps(12, n=8)
+    # budget fits ~2 materialized bitmap entries of this shape
+    probe_rc = ResultCache(1 << 30)
+    probe = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                        result_cache=probe_rc)
+    probe.execute([BatchQuery("or", (0, 1), form="bitmap")])
+    one_entry = probe_rc.nbytes
+    probe_rc.clear()
+    base = _cache_ledger_base()
+    rc = ResultCache(int(one_entry * 2.5))
+    eng = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                      result_cache=rc)
+    for i in range(6):
+        eng.execute([BatchQuery("or", (i % 7, (i + 1) % 7),
+                                form="bitmap")])
+    s = rc.stats()
+    assert s["evictions"] >= 1
+    assert rc.nbytes <= rc.max_bytes
+    assert obs_memory.LEDGER.resident_bytes("result_cache") \
+        == base + rc.nbytes
+
+
+def test_multiset_cache_and_tenant_invalidation():
+    tenants = [mk_bitmaps(20 + i, n=4, uni=1 << 16, card=900)
+               for i in range(3)]
+    rc = ResultCache(16 << 20)
+    ms = MultiSetBatchEngine(
+        [DeviceBitmapSet(b, layout="dense") for b in tenants],
+        result_cache=rc)
+    pool = random_multiset_pool([4] * 3, 12, seed=5)
+    c1 = [[r.cardinality for r in rows] for rows in ms.execute(pool)]
+    assert [[r.cardinality for r in rows]
+            for rows in ms.execute(pool)] == c1
+    assert rc.stats()["hits"] >= len(c1)
+    assert ms.count_cache_hits(pool) > 0
+    inval0 = rc.stats()["invalidations"]
+    ms._engines[1]._ds.apply_delta(adds={0: [3]})
+    assert rc.stats()["invalidations"] > inval0
+    # post-delta pool is bit-exact vs per-set sequential
+    got = [[r.cardinality for r in rows] for rows in ms.execute(pool)]
+    for gi, g in enumerate(pool):
+        e = ms._engines[g.set_id]
+        assert got[gi] == [e._sequential_one(q).cardinality
+                           for q in g.queries]
+    # an image-growing structural repack must retire the pooled
+    # programs too (the operand-shape half of the plan/program split)
+    ms._engines[0]._ds.apply_delta(
+        adds={1: [(0xB000 + k) << 16 for k in range(12)]})
+    got2 = [[r.cardinality for r in rows] for rows in ms.execute(pool)]
+    for gi, g in enumerate(pool):
+        e = ms._engines[g.set_id]
+        assert got2[gi] == [e._sequential_one(q).cardinality
+                            for q in g.queries]
+
+
+# ------------------------------------------------------- sharded tenant
+
+def test_sharded_tenant_alignment_and_patch_sync():
+    import jax
+    from jax.sharding import Mesh
+
+    from roaringbitmap_tpu.parallel.sharded_engine import \
+        ShardedBatchEngine
+
+    tenants = [mk_bitmaps(30 + i, n=4, uni=1 << 16, card=900)
+               for i in range(3)]
+    ms = MultiSetBatchEngine(
+        [DeviceBitmapSet(b, layout="dense") for b in tenants],
+        result_cache=None)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("rows", "data"))
+    sh = ShardedBatchEngine(ms._engines, mesh=mesh, placement="sharded",
+                            result_cache=None)
+    # residency pin: every tenant not larger than a row shard lives in
+    # exactly ONE shard (PR 7's named debt — one-shard delta writes)
+    u = sh.pool_rows // sh.mesh_shape[0]
+    for sid in range(3):
+        b, n = int(sh._base[sid]), sh._rows[sid]
+        if n and n <= u:
+            assert b // u == (b + n - 1) // u, (sid, b, n, u)
+    pool = random_multiset_pool([4] * 3, 12, seed=6)
+
+    def refs():
+        return [[ms._engines[g.set_id]._sequential_one(q).cardinality
+                 for q in g.queries] for g in pool]
+
+    assert [[r.cardinality for r in rows]
+            for rows in sh.execute(pool)] == refs()
+    patches0 = obs_metrics.REGISTRY.counter(
+        "rb_sharded_pool_patches_total", site="sharded_engine",
+        mesh=sh._mesh_label).value
+    ms._engines[2]._ds.apply_delta(adds={1: [9, 10]},
+                                   removes={0: [1]})
+    assert [[r.cardinality for r in rows]
+            for rows in sh.execute(pool)] == refs()
+    assert obs_metrics.REGISTRY.counter(
+        "rb_sharded_pool_patches_total", site="sharded_engine",
+        mesh=sh._mesh_label).value > patches0
+    # structural repack re-places the pool wholesale, still bit-exact,
+    # ledger swapped (no double count)
+    ms._engines[2]._ds.apply_delta(adds={1: [(0xCAFE << 16) + 3]})
+    assert [[r.cardinality for r in rows]
+            for rows in sh.execute(pool)] == refs()
+    assert obs_memory.LEDGER.resident_bytes("sharded_pool") \
+        == sh.pool_rows * 8192 * sh.mesh_shape[1]
+
+
+# ------------------------------------------------------------- serving
+
+def test_serving_loop_serves_from_cache():
+    from roaringbitmap_tpu.serving import (ServingLoop, ServingPolicy,
+                                           ServingRequest)
+
+    tenants = [mk_bitmaps(40 + i, n=4, uni=1 << 16, card=700)
+               for i in range(2)]
+    rc = ResultCache(16 << 20)
+    ms = MultiSetBatchEngine(
+        [DeviceBitmapSet(b, layout="dense") for b in tenants],
+        result_cache=rc)
+    loop = ServingLoop(ms, ServingPolicy(
+        pool_target=4, default_deadline_ms=60_000.0,
+        guard=guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)))
+    q = BatchQuery("or", (0, 1, 2))
+    done = []
+    for round_i in range(3):
+        for i in range(4):
+            done.append(loop.submit(ServingRequest(
+                i % 2, q, tenant=f"t{i % 2}")))
+        loop.drain()
+    assert all(t.status == "done" for t in done)
+    assert rc.stats()["hits"] > 0
+    ref0 = ms._engines[0]._sequential_one(q).cardinality
+    ref1 = ms._engines[1]._sequential_one(q).cardinality
+    for t in done:
+        assert t.result.cardinality == (ref0 if t.request.set_id == 0
+                                        else ref1)
+    snap = loop.snapshot()
+    assert snap["result_cache"]["hits"] == rc.stats()["hits"]
+    # a fully-cached pool's execute-time estimate floors out: the
+    # predictor scales by the would-hit fraction (count_cache_hits)
+    assert loop._estimate_seconds([done[0]]) <= 2e-4
+
+
+# ------------------------------------------------------------ obs/trace
+
+def test_mutation_spans_and_cache_events(tmp_path):
+    trace_path = tmp_path / "trace.jsonl"
+    obs.enable(str(trace_path))
+    try:
+        bms = mk_bitmaps(50)
+        rc = ResultCache(8 << 20)
+        eng = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                          result_cache=rc)
+        eng.execute([BatchQuery("or", (0, 1))])
+        eng.execute([BatchQuery("or", (0, 1))])
+        eng._ds.apply_delta(adds={0: [2]})
+        eng._ds.apply_delta(adds={1: [(0xD00D << 16) + 1]})
+    finally:
+        obs.disable()
+    import json
+
+    spans = [json.loads(l) for l in open(trace_path)]
+    deltas = [s for s in spans if s["name"] == "mutation.delta"]
+    assert {s["tags"]["mode"] for s in deltas} == {"patch", "repack"}
+    for s in deltas:
+        assert isinstance(s["tags"]["version"], int)
+    cache_evs = [ev for s in spans for ev in s["events"]
+                 if ev.get("name") == "expr.cache"]
+    assert any(ev["hits"] >= 1 for ev in cache_evs)
+    assert all(isinstance(ev["hits"], int)
+               and isinstance(ev["misses"], int) for ev in cache_evs)
+    # the dump validates against the trace schema checker
+    import sys
+    sys.path.insert(0, "tools")
+    import check_trace
+
+    assert check_trace.validate(str(trace_path)) == []
+
+
+# ------------------------------------------------------ slow acceptance
+
+@pytest.mark.slow
+def test_delta_vs_repack_100x():
+    """Acceptance: single-segment apply_delta >= 100x faster than a full
+    re-pack on the CPU proxy, bit-exact vs the host oracle.  Same shape
+    as the bench mutation lane's delta cell (repack is ~8M values of
+    honest pack work; the warmed patch is a flat ~0.4 ms)."""
+    import time
+
+    from roaringbitmap_tpu.utils import datasets
+
+    bms = datasets.synthetic_bitmaps(64, seed=90, universe=1 << 25,
+                                     density=0.03)
+    ds = DeviceBitmapSet(bms, layout="dense")
+    ds.warmup_delta(1)
+    ds.apply_delta(adds={0: [1]})        # warm the whole patch path
+    # min-of-reps, the bench methodology: a single draw under CI load
+    # is not the marginal being claimed
+    delta_s = float("inf")
+    for i in range(10):
+        t0 = time.perf_counter()
+        ds.apply_delta(adds={3: [i + 2]})
+        delta_s = min(delta_s, time.perf_counter() - t0)
+    hosts = ds.host_bitmaps()
+    t0 = time.perf_counter()
+    ds2 = DeviceBitmapSet(hosts, layout="dense")
+    repack_s = time.perf_counter() - t0
+    ref = wide_refs(hosts)[0]
+    assert ds.aggregate("or") == ref
+    assert ds2.aggregate("or") == ref
+    ratio = repack_s / delta_s
+    assert ratio >= 100, (delta_s, repack_s, ratio)
+
+
+@pytest.mark.slow
+def test_cache_vs_recompute_5x_qps():
+    """Acceptance: a replayed repeated-expression trace serves >= 5x the
+    recompute-path QPS from the result cache."""
+    import time
+
+    bms = mk_bitmaps(61, n=8, uni=1 << 20, card=20000)
+    trace = expr.random_expr_pool(8, 24, depth=3, seed=3)
+
+    def replay(engine, rounds=6):
+        engine.execute(trace)            # warm compiles + (maybe) fill
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            engine.execute(trace)
+        wall = time.perf_counter() - t0
+        return rounds * len(trace) / wall
+
+    cold = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                       result_cache=None)
+    qps_recompute = replay(cold)
+    warm = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                       result_cache=ResultCache(64 << 20))
+    qps_cached = replay(warm)
+    # bit-exactness of the cached replay
+    ref = [r.cardinality for r in cold.execute(trace)]
+    got = [r.cardinality for r in warm.execute(trace)]
+    assert got == ref
+    assert qps_cached >= 5 * qps_recompute, (qps_cached, qps_recompute)

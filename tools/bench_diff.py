@@ -69,9 +69,15 @@ import sys
 #: ``serving.x{R}`` cells ([p50_ms, p99_ms, slo_attainment, shed_rate])
 #: and the ``overload_attainment`` headline — attainment is gated HIGHER
 #: (via ``attain``); the cells' latency entries ride the ``_ms`` rule.
+#: The mutation lane (bench.py mutation_phase, ISSUE 12) adds
+#: ``delta_vs_repack_x`` (single-segment in-place patch speedup over a
+#: full re-pack, via ``vs_repack``) and ``cache_vs_recompute_x``
+#: (materialized-result-cache replay QPS over the recompute path, via
+#: ``vs_recompute``); its ``delta_ms`` / ``repack_ms`` cells ride the
+#: ``_ms`` rule.
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
-          "fused_vs", "mega_vs", "attain")
+          "fused_vs", "mega_vs", "vs_repack", "vs_recompute", "attain")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
          "shard_balance", "warm_restart")
 #: checked before HIGHER/LOWER: lanes whose good direction is genuinely

@@ -411,6 +411,101 @@ def serving_smoke() -> int:
     return 0 if ok else 1
 
 
+def mutation_smoke() -> int:
+    """Mutation-subsystem smoke (ISSUE 12, docs/MUTATION.md): (a) a
+    random in-place delta is bit-exact vs the host oracle across
+    or/xor/and, with patch AND escalated-repack modes both exercised
+    and typed-only failure (``repack="never"`` raises); (b) the
+    materialized result cache serves repeated queries bit-exactly and a
+    version bump invalidates EXACTLY the dependent entries, with the
+    HBM ledger balanced after the drop.  Nothing silent: every contract
+    is an explicit check.  Returns 0 when all hold, 1 otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.mutation import ResultCache
+    from roaringbitmap_tpu.obs import memory as obs_memory
+    from roaringbitmap_tpu.parallel import BatchEngine, BatchQuery
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+
+    rng = np.random.default_rng(0x12A)
+    bms = [RoaringBitmap.from_values(
+        np.unique(rng.integers(0, 1 << 17, 1800).astype(np.uint32)))
+        for _ in range(6)]
+    ds = DeviceBitmapSet(bms, layout="dense")
+    checks: dict = {}
+
+    def oracle(hosts):
+        o = x = a = hosts[0].clone()
+        o, x, a = hosts[0].clone(), hosts[0].clone(), hosts[0].clone()
+        for b in hosts[1:]:
+            o, x, a = o | b, x ^ b, a & b
+        return o, x, a
+
+    hosts = list(bms)
+    adds = {1: rng.integers(0, 1 << 17, 16).astype(np.uint32)}
+    removes = {2: np.unique(rng.integers(0, 1 << 17, 8)
+                            ).astype(np.uint32)}
+    rep = ds.apply_delta(adds=adds, removes=removes)
+    arb = RoaringBitmap()
+    arb.add_many(adds[1])
+    rrb = RoaringBitmap()
+    rrb.add_many(removes[2])
+    hosts[1] = hosts[1] | arb
+    hosts[2] = hosts[2] - rrb
+    o, x, a = oracle(hosts)
+    checks["patch_mode"] = rep["mode"] == "patch"
+    checks["patch_bit_exact"] = (ds.aggregate("or") == o
+                                 and ds.aggregate("xor") == x
+                                 and ds.aggregate("and") == a)
+    new_val = int((0xF1F0 << 16) + 1)
+    rep2 = ds.apply_delta(adds={0: [new_val]})
+    hosts[0] = hosts[0].clone()
+    hosts[0].add(new_val)
+    checks["repack_mode"] = (rep2["mode"] == "repack"
+                             and rep2["repack_reason"] == "structural")
+    checks["repack_bit_exact"] = ds.aggregate("or") == oracle(hosts)[0]
+    try:
+        ds.apply_delta(adds={0: [(0xF2F0 << 16) + 1]}, repack="never")
+        checks["typed_never"] = False
+    except ValueError:
+        checks["typed_never"] = True
+
+    rc = ResultCache(8 << 20)
+    eng_a = BatchEngine(DeviceBitmapSet(bms[:3], layout="dense"),
+                        result_cache=rc)
+    eng_b = BatchEngine(DeviceBitmapSet(bms[3:], layout="dense"),
+                        result_cache=rc)
+    qa = [BatchQuery("or", (0, 1)), BatchQuery("xor", (1, 2),
+                                               form="bitmap")]
+    qb = [BatchQuery("or", (0, 2), form="bitmap")]
+    first = [r.cardinality for r in eng_a.execute(qa)]
+    eng_b.execute(qb)
+    second = [r.cardinality for r in eng_a.execute(qa)]
+    checks["cache_bit_exact"] = (first == second
+                                 and first[0] == (bms[0] | bms[1]
+                                                  ).cardinality)
+    checks["cache_hits"] = rc.stats()["hits"] >= 2
+    entries0 = rc.stats()["entries"]
+    eng_a._ds.apply_delta(adds={1: [5]})
+    s = rc.stats()
+    # exactly the two entries referencing set A source 1 drop; set B's
+    # entry survives, and the ledger mirrors the cache's bytes
+    checks["exact_invalidation"] = (
+        entries0 == 3 and s["entries"] == 1 and s["invalidations"] == 2)
+    checks["ledger_balanced"] = (
+        obs_memory.LEDGER.resident_bytes("result_cache") >= rc.nbytes
+        and rc.nbytes > 0)
+    post = [r.cardinality for r in eng_a.execute(qa)]
+    hosts_a = eng_a._ds.host_bitmaps()
+    checks["post_invalidation_bit_exact"] = (
+        post[0] == (hosts_a[0] | hosts_a[1]).cardinality)
+    ok = all(checks.values())
+    print(json.dumps({"smoke_mutation": checks, "ok": ok}))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -447,6 +542,11 @@ def main() -> int:
                     help="first run the serving-loop robustness smoke "
                          "(typed shed/reject, bit-exact served results, "
                          "ledger baseline; exit 1 on violation)")
+    ap.add_argument("--smoke-mutation", action="store_true",
+                    help="first run the mutation smoke (bit-exact delta "
+                         "patch + escalated repack, exact result-cache "
+                         "invalidation, balanced ledger, nothing "
+                         "silent; exit 1 on violation)")
     args = ap.parse_args()
 
     if args.smoke_sharded:
@@ -459,6 +559,10 @@ def main() -> int:
             return rc
     if args.smoke_expr:
         rc = expr_smoke()
+        if rc:
+            return rc
+    if args.smoke_mutation:
+        rc = mutation_smoke()
         if rc:
             return rc
 

@@ -163,6 +163,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _sharded_semantics([s for _, s in spans])
         errors += _expr_semantics([s for _, s in spans])
         errors += _serving_semantics([s for _, s in spans])
+        errors += _mutation_semantics([s for _, s in spans])
     return errors
 
 
@@ -241,6 +242,57 @@ def _workload_semantics(spans: list[dict],
                                  complete=True)
     errors += _expr_semantics(spans, require=budget_semantics)
     errors += _serving_semantics(spans, require=budget_semantics)
+    errors += _mutation_semantics(spans, require=budget_semantics)
+    return errors
+
+
+def _mutation_semantics(spans: list[dict],
+                        require: bool = False) -> list[str]:
+    """The mutation subsystem's span/event vocabulary
+    (roaringbitmap_tpu.mutation, docs/MUTATION.md).  Arbitrary dumps
+    validate the ``mutation.delta`` span and ``expr.cache`` event
+    schemas wherever they appear; ``require`` (the --workload run, which
+    drives one in-place patch, one escalated repack, and a cache-served
+    re-execute) additionally demands both delta modes and at least one
+    cache hit."""
+    errors: list[str] = []
+    deltas = [s for s in spans if s.get("name") == "mutation.delta"]
+    for s in deltas:
+        tags = s.get("tags") or {}
+        if tags.get("mode") not in ("patch", "repack", "noop"):
+            errors.append(f"mutation.delta span with bad mode: {tags!r}")
+        if not isinstance(tags.get("version"), int) \
+                or tags["version"] < 0:
+            errors.append(f"mutation.delta span without a numeric "
+                          f"version tag: {tags!r}")
+        if tags.get("mode") == "patch" and (
+                not isinstance(tags.get("rows"), int)
+                or tags["rows"] < 1):
+            errors.append(f"patch-mode mutation.delta span without a "
+                          f"positive rows tag: {tags!r}")
+        for field in ("values_added", "values_removed"):
+            if not isinstance(tags.get(field), int) or tags[field] < 0:
+                errors.append(f"mutation.delta span without a numeric "
+                              f"{field} tag: {tags!r}")
+    caches = [ev for s in spans for ev in s.get("events", [])
+              if ev.get("name") == "expr.cache"]
+    for ev in caches:
+        for field in ("hits", "misses"):
+            if not isinstance(ev.get(field), int) or ev[field] < 0:
+                errors.append(f"expr.cache event without a numeric "
+                              f"{field}: {ev!r}")
+    if require:
+        modes = {(s.get("tags") or {}).get("mode") for s in deltas}
+        if "patch" not in modes:
+            errors.append("no patch-mode mutation.delta span — the "
+                          "in-place delta workload case did not record")
+        if "repack" not in modes:
+            errors.append("no repack-mode mutation.delta span — the "
+                          "escalated repack workload case did not "
+                          "record")
+        if not any(ev.get("hits", 0) >= 1 for ev in caches):
+            errors.append("no expr.cache event with hits >= 1 — the "
+                          "result-cache workload case did not record")
     return errors
 
 
@@ -683,6 +735,33 @@ def run_workload(path: str) -> None:
                   for rows in sharded.execute(ms_pool)]
         assert sh_got == ms_clean, "2x2 mesh dispatch diverged from the "\
             "single-device pool"
+
+        # mutation lane (ISSUE 12): an in-place delta patch, a
+        # structural escalation to repack, and a result-cache-served
+        # re-execute — the mutation.delta spans + expr.cache events the
+        # semantics checks above pin, bit-exact vs the host oracle
+        from roaringbitmap_tpu.mutation import ResultCache
+        from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+
+        mut_bms = datasets.synthetic_bitmaps(6, seed=77,
+                                             universe=1 << 16,
+                                             density=0.01)
+        mut_eng = BatchEngine.from_bitmaps(mut_bms, layout="dense")
+        mut_eng.result_cache = ResultCache(8 << 20)
+        mut_q = [BatchQuery("or", (0, 1, 2))]
+        mut_eng.execute(mut_q)
+        mut_eng.execute(mut_q)               # the cache hit
+        rep = mut_eng._ds.apply_delta(adds={0: [3, 4]})
+        assert rep["mode"] == "patch", rep
+        rep2 = mut_eng._ds.apply_delta(
+            adds={0: [(0xEE00 << 16) + 1]})  # new key: escalates
+        assert rep2["mode"] == "repack", rep2
+        got = mut_eng.execute(mut_q)[0].cardinality
+        want = mut_eng._ds.host_bitmaps()[0] \
+            | mut_eng._ds.host_bitmaps()[1] \
+            | mut_eng._ds.host_bitmaps()[2]
+        assert got == want.cardinality, \
+            "post-delta batch diverged from the host oracle"
 
         # serving lane (ISSUE 10): an OVERLOADED continuous-batching
         # burst over the same tenants — a tiny per-tenant queue cap

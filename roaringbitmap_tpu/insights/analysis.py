@@ -698,3 +698,75 @@ def recommend_device_layout(bitmaps, hbm_budget_bytes: int = 512 << 20) -> dict:
         "dense_blowup": round(ratio, 2),
         "why": why,
     }
+
+
+# ------------------------------------------------ lattice recommendation
+
+def recommend_lattice(trace_path: str, slack_x: float = 1.0) -> dict:
+    """Derive a traffic profile for the closed program-signature lattice
+    (runtime.lattice, docs/LATTICE.md) from an observed trace dump.
+
+    Scans a ``ROARING_TPU_TRACE`` JSONL file for the planner spans'
+    ``need_q`` / ``need_rows`` / ``need_keys`` tags (every
+    ``batch.plan`` / ``multiset.plan`` / ``sharded.plan`` records the
+    pre-snap concrete needs), the per-set pooled-row need
+    (``multiset.plan``'s ``need_pool`` — the quantity the lattice's
+    pool rungs actually cover, pre-pad), and the fused expressions'
+    depths (``expr.compile``).  Each observed value set becomes a SPARSE rung
+    list — the pow2 coverings of what traffic actually requested, which
+    bounds both the vocabulary size and the warmup compile count while
+    still covering every observed shape.  ``slack_x`` scales the maxima
+    before covering (headroom for traffic slightly past the observed
+    trace).  Returns ``{"profile": str, "points": int, "observed":
+    {...}}`` — feed ``profile`` to ``warmup(profile=...)`` or
+    ``ROARING_TPU_WARMUP_PROFILE``.
+    """
+    import json as _json
+
+    from ..ops import packing as _packing
+    from ..runtime import lattice as _lattice
+
+    qs, rows, keys, pools, depths = set(), set(), set(), set(), set()
+    with open(trace_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                span = _json.loads(line)
+            except ValueError:
+                continue
+            name, tags = span.get("name"), span.get("tags", {})
+            if name in ("batch.plan", "multiset.plan", "sharded.plan"):
+                if tags.get("need_q"):
+                    qs.add(int(tags["need_q"]))
+                if tags.get("need_rows"):
+                    rows.add(int(tags["need_rows"]))
+                if tags.get("need_keys"):
+                    keys.add(int(tags["need_keys"]))
+                if tags.get("need_pool"):
+                    pools.add(int(tags["need_pool"]))
+            elif name == "expr.compile" and tags.get("kind") == "fused":
+                depths.add(int(tags.get("depth") or 2))
+
+    def rungs(values, fallback):
+        if not values:
+            return (fallback,)
+        scaled = {_packing.next_pow2(max(1, int(v * slack_x)))
+                  for v in values}
+        return tuple(sorted(scaled))
+
+    lat = _lattice.Lattice(
+        q=rungs(qs, 16), rows=rungs(rows, 16), keys=rungs(keys, 1),
+        pool=rungs(pools, 16),
+        # a trace does not record result forms per dispatch, so both
+        # heads planes compile — the cardinality-only short circuit and
+        # the bitmap plane are distinct program shapes either way
+        heads=(False, True),
+        expr=(0,) + tuple(sorted(depths)))
+    return {"profile": lat.to_profile(),
+            "points": lat.n_points(pooled=True),
+            "observed": {"q": sorted(qs), "rows": sorted(rows),
+                         "keys": sorted(keys),
+                         "pool_rows": sorted(pools),
+                         "expr_depths": sorted(depths)}}

@@ -234,6 +234,18 @@ def snapshot_delta(before: dict, after: dict) -> dict:
 #: the process-wide registry every instrumentation site shares
 REGISTRY = Registry()
 
+
+def compile_miss_total() -> int:
+    """Process-wide program-compile count: the sum of
+    ``rb_compile_seconds{cache="miss"}`` observations across sites —
+    the witness every zero-compile gate diffs (the serving loop's
+    estimator, the lattice smoke/bench lanes, tests)."""
+    return int(sum(
+        inst.count
+        for name, labels, inst in REGISTRY.instruments()
+        if name == "rb_compile_seconds"
+        and labels.get("cache") == "miss"))
+
 counter = REGISTRY.counter
 gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram

@@ -475,10 +475,25 @@ def _assemble(mode: str, buckets, sections, slot_of_reduce, leaf_row,
     slots_pad = packing.next_pow2(max(1, n_slots))
     out_pad = packing.next_pow2(n_out) if n_out else 0
     card_pad = packing.next_pow2(max(1, n_card))
+    from ..runtime import lattice as rt_lattice
+
+    n_real = len(em.ops)
+    if rt_lattice.active() is not None:
+        # the lattice snap, instruction-stream level (docs/LATTICE.md):
+        # pow2 already bounds each dimension, but floor-quantizing the
+        # small end too makes near-identical DAG variants share one
+        # program shape — padding steps is pure NOPs against the dead
+        # slot, padding slots is unread VMEM
+        slots_pad = max(slots_pad, 4)
+        card_pad = max(card_pad, 8)
+        if out_pad:
+            out_pad = max(out_pad, 8)
+        while len(em.ops) < 16:
+            em.emit(NOP)
     host = em.finish(slots_pad, out_pad, card_pad)
     host["extra"] = extra
     return MegaPlan(
-        mode=mode, n_steps=len(em.ops),
+        mode=mode, n_steps=n_real,
         steps_pad=int(host["opc"].shape[0]),
         n_slots=n_slots, slots_pad=slots_pad,
         out_pad=out_pad, card_pad=card_pad, host=host,

@@ -77,6 +77,7 @@ from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..ops import dense, kernels, megakernel, packing
 from ..runtime import faults, guard
+from ..runtime import lattice as rt_lattice
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
 from . import expr as expr_mod
@@ -206,15 +207,30 @@ class _Bucket(_DeviceOperandCache):
                 self.needs_words)
 
 
-def plan_bucket(op: str, items) -> _Bucket:
+def plan_bucket(op: str, items, pad_to=None) -> _Bucket:
     """Build one shape-specialized bucket from ``items``: [(qid, query,
     gather_rows, seg_local, keys_q, key_keep, head_rows)] sharing
     (op, operand-count rung).  Row indices are whatever space the caller
     planned in — set-local for BatchEngine, pooled (offset-remapped) for
-    MultiSetBatchEngine — the bucket just records them for the gather."""
+    MultiSetBatchEngine — the bucket just records them for the gather.
+
+    ``pad_to`` is the lattice snap (runtime.lattice): a ``(q, rows,
+    keys, heads)`` covering point every bucket of the plan pads up to —
+    the padding queries/rows/slots are exactly the dead-entry shapes the
+    pow2 padding below already produces, just more of them, so the
+    program shape comes from the CLOSED vocabulary instead of the exact
+    traffic.  ``n_steps`` then closes over the padded row rung (extra
+    doubling passes are exact: after k passes row i holds the reduction
+    of its segment rows [i, i + 2^k), converged segments are fixpoints
+    for or/and and disjoint-range-exact for xor)."""
     qn = packing.next_pow2(len(items))
     r_pad = packing.next_pow2(max(1, max(it[2].size for it in items)))
     k_pad = packing.next_pow2(max(1, max(it[4].size for it in items)))
+    force_heads = False
+    if pad_to is not None:
+        q_l, r_l, k_l, force_heads = pad_to
+        qn, r_pad, k_pad = (max(qn, q_l), max(r_pad, r_l),
+                            max(k_pad, k_l))
     gather = np.zeros((qn, r_pad), np.int32)
     valid = np.zeros((qn, r_pad), bool)
     seg_local = np.full((qn, r_pad), k_pad, np.int32)
@@ -263,9 +279,66 @@ def plan_bucket(op: str, items) -> _Bucket:
     return _Bucket(
         op=op, qids=[it[0] for it in items],
         keys=[it[4] for it in items], q=qn, r_pad=r_pad, k_pad=k_pad,
-        n_steps=dense.n_steps_for(max_group),
-        needs_words=any(it[1].form == "bitmap" for it in items),
+        n_steps=(dense.n_steps_for(r_pad) if pad_to is not None
+                 else dense.n_steps_for(max_group)),
+        needs_words=(force_heads
+                     or any(it[1].form == "bitmap" for it in items)),
         host=host)
+
+
+def snap_plan_groups(lat, groups, sections, has_bitmap: bool, counter,
+                     empty_keys, placement: str = "auto",
+                     pool: int = 0):
+    """Lattice snap of a grouped plan (shared by all three engines):
+    compute the covering :class:`~..runtime.lattice.ProgramSignature` of
+    the concrete needs, and plant one DEAD bucket per op of the covering
+    op set that traffic did not request (a single all-padding pseudo
+    query, owner-less so readback skips it) so the plan's bucket tuple
+    is fully determined by the point.  Returns ``(pad_to, point)`` —
+    ``(None, None)`` when no lattice is active or any dimension is
+    beyond the vocabulary (the plan then keeps its exact pow2 shapes
+    and its first compile is an escape).  ``pool`` is the pooled
+    engine's per-set row-selection need — EVERY dimension is judged
+    here, atomically, BEFORE any dead bucket mutates the plan: a
+    failed snap must leave no owner-less pseudo slots behind (``pool``
+    < 0 marks an un-coverable pool, e.g. a zero-row tenant)."""
+    if lat is None or not groups or pool < 0:
+        return None, None
+    q_need = max(len(items) for items in groups.values())
+    rows_need = max((it[2].size for items in groups.values()
+                     for it in items), default=1)
+    keys_need = max((it[4].size for items in groups.values()
+                     for it in items), default=1)
+    expr_depth = max((sec.depth for sec in sections
+                      if sec.kind == "fused"), default=0)
+    point = lat.snap(ops=[op for op, _ in groups], q=q_need,
+                     rows=rows_need, keys=keys_need, heads=has_bitmap,
+                     expr=expr_depth, placement=placement, pool=pool)
+    if point is None:
+        return None, None
+    for op in point.ops:
+        if (op, 0) in groups:
+            continue
+        pid = counter[0]
+        counter[0] += 1
+        groups[(op, 0)] = [(
+            pid, BatchQuery(op, ()), np.empty(0, np.int64),
+            np.empty(0, np.int32), empty_keys,
+            np.empty(0, bool) if op == "and" else None,
+            np.empty(0, np.int64) if op == "andnot" else None)]
+    return (point.q, point.rows, point.keys, point.heads), point
+
+
+def plan_padding(buckets, groups) -> tuple:
+    """(padding_bytes, padded_fraction) of a snapped plan: the gather
+    cells the padded bucket shapes stream beyond the rows traffic
+    actually referenced — the measured price of the bounded vocabulary
+    (``rb_lattice_padding_bytes`` / the memory-event fraction)."""
+    real = sum(it[2].size for items in groups.values() for it in items)
+    padded = sum(b.q * b.r_pad for b in buckets)
+    pad_rows = max(0, padded - real)
+    return (pad_rows * insights.ROW_BYTES,
+            pad_rows / max(1, padded))
 
 
 class BatchPlan(list):
@@ -277,7 +350,7 @@ class BatchPlan(list):
     reduce nodes fused expressions plant in the buckets."""
 
     def __init__(self, buckets=(), exprs=(), owner=None, n_queries=0,
-                 mega=None):
+                 mega=None, point=None, padding=(0, 0.0)):
         super().__init__(buckets)
         self.exprs = list(exprs)
         self.owner = owner if owner is not None else {}
@@ -286,6 +359,12 @@ class BatchPlan(list):
         #: when the plan has fused sections; the megakernel rung demotes
         #: when it is None or past its VMEM/SMEM budget
         self.mega = mega
+        #: the covering lattice point (runtime.lattice.ProgramSignature)
+        #: when an active lattice snapped this plan; None = exact shapes
+        self.point = point
+        #: (padding_bytes, padded_fraction) of the snap — the measured
+        #: price of the bounded vocabulary, stamped on memory events
+        self.padding = padding
 
     @property
     def fused(self) -> list:
@@ -488,10 +567,13 @@ class BatchEngine:
         into per-query sections the program fuses after the reduces.
         """
         self._sync_with_ds()
+        lat = rt_lattice.active()
         # the set's version is part of the plan key: a delta-patched or
         # repacked set must never replay a stale plan (stale gathers, or
-        # a cached-subtree injection whose leaf versions moved on)
-        key = (tuple(queries), self._ds.version)
+        # a cached-subtree injection whose leaf versions moved on).  The
+        # lattice token retires plans across activations/warmup pins —
+        # a snapped and an exact plan of the same queries must not alias
+        key = (tuple(queries), self._ds.version, rt_lattice.plan_token())
         cached = self._plans.get(key)
         if cached is not None:
             return cached
@@ -525,7 +607,13 @@ class BatchEngine:
                 pid = counter[0]
                 counter[0] += 1
                 rows, segs, keys_q, keep, hrows = self._plan_query(pq)
-                rung = packing.next_pow2(max(1, len(set(pq.operands))))
+                # under an active lattice, same-op queries share ONE
+                # bucket regardless of operand rung: the rung split
+                # exists to limit padding, and the lattice trades that
+                # padding for a closed signature space
+                rung = (0 if lat is not None
+                        else packing.next_pow2(
+                            max(1, len(set(pq.operands)))))
                 groups.setdefault((pq.op, rung), []).append(
                     (pid, pq, rows, segs, keys_q, keep, hrows))
                 if own is not None:
@@ -539,9 +627,22 @@ class BatchEngine:
                         cache_probe=cache_probe))
                 else:
                     add_item(q, qid)
+            pad_to, point = snap_plan_groups(
+                lat, groups, sections,
+                any(getattr(q, "form", None) == "bitmap"
+                    for q in queries),
+                counter, self.keys[:0], placement="single")
+            sp.tag(need_q=max((len(i) for i in groups.values()),
+                              default=0),
+                   need_rows=max((it[2].size for i in groups.values()
+                                  for it in i), default=0),
+                   need_keys=max((it[4].size for i in groups.values()
+                                  for it in i), default=0))
             with obs_trace.span("batch.bucket", groups=len(groups)):
-                buckets = [plan_bucket(op, items)
+                buckets = [plan_bucket(op, items, pad_to=pad_to)
                            for (op, _), items in sorted(groups.items())]
+            padding = (plan_padding(buckets, groups)
+                       if point is not None else (0, 0.0))
             expr_mod.finalize_sections(sections, buckets)
             # the one-kernel program assembles from the buckets' and
             # sections' HOST arrays, so it must build before the
@@ -564,9 +665,10 @@ class BatchEngine:
                 mega.device_arrays()
                 mega.host = None
             plan = BatchPlan(buckets, exprs=sections, owner=owner,
-                             n_queries=len(queries), mega=mega)
+                             n_queries=len(queries), mega=mega,
+                             point=point, padding=padding)
             sp.tag(buckets=len(plan), exprs=len(sections),
-                   mega=mega is not None)
+                   mega=mega is not None, snapped=point is not None)
         self._plans.put(key, plan)
         return plan
 
@@ -676,6 +778,11 @@ class BatchEngine:
                 src, self._launch_arrays(plan, eng)).compile()
             compile_s = time.perf_counter() - t0
             obs_cost.observe_compile("batch_engine", "miss", compile_s)
+            # post-warmup, a sealed lattice expects steady state to
+            # compile NOTHING: this compile is an escape — counted,
+            # traced, and visible to the serving predictor
+            rt_lattice.note_compile("batch_engine", eng, plan.point,
+                                    compile_s)
             predicted = insights.predict_batch_dispatch_bytes(
                 b_sigs, kind, self._ds._n_rows, eng)
             if plan.exprs:
@@ -914,6 +1021,14 @@ class BatchEngine:
                         int(stats1["peak_bytes_in_use"])
                         - int(stats0.get("peak_bytes_in_use", 0)))
             mem["engine"], mem["q"] = eng, len(queries)
+            if plan.point is not None:
+                # bounded-vocabulary accounting: the dead cells this
+                # dispatch streamed because its shapes were snapped up
+                # to the lattice (docs/LATTICE.md "Padding math")
+                pb, pf = plan.padding
+                mem["lattice_padding_bytes"] = int(pb)
+                mem["lattice_padding_fraction"] = round(pf, 6)
+                rt_lattice.record_padding("batch_engine", int(pb), pf)
             self.last_dispatch_memory = mem
             sp.event("batch.memory", **mem)
             # cost/roofline accounting: the program's static cost analysis
@@ -1103,7 +1218,8 @@ class BatchEngine:
         queries = list(queries)
         policy = policy or guard.GuardPolicy.from_env()
         budget = guard.resolve_hbm_budget(policy)
-        plan_hit = (tuple(queries), self._ds.version) in self._plans
+        plan_hit = (tuple(queries), self._ds.version,
+                    rt_lattice.plan_token()) in self._plans
         plan = self.plan(queries)
         # explain reports what execute() WOULD do, so it mirrors its
         # chain-start resolution (auto + expressions on TPU starts at
@@ -1249,9 +1365,65 @@ class BatchEngine:
         k = max(1, min(int(rung), self.n))
         return [BatchQuery(op, tuple(range(k))) for op in ops]
 
+    def _compile_lattice_points(self, lat, engine: str) -> int:
+        """Compile every lattice point of the single-set vocabulary:
+        flat points pin a representative mini-batch to the TARGET shape
+        (``Lattice.pin``), expression shape-classes compile the
+        ``rung_expressions`` representatives (their signatures recorded
+        as warmed), delta rungs pre-compile the mutation patch
+        programs.  Returns the compiled-point count."""
+        points = lat.enumerate_points(pooled=False)
+        # the warmed vocabulary must FIT the program cache, or steady
+        # state re-pays evicted compiles as phantom escapes
+        self._programs.maxsize = max(self._programs.maxsize,
+                                     2 * len(points) + 8)
+        compiled = 0
+        for point in points:
+            if point.delta:
+                self._ds.warmup_delta(point.delta)
+                compiled += 1
+                continue
+            if point.expr:
+                batch = expr_mod.rung_expressions(point.expr, self.n)
+            else:
+                batch = [BatchQuery(op, (0,)) for op in point.ops]
+            with lat.pin(point):
+                plan = self.plan(batch)
+                for sec in plan.exprs:
+                    lat.note_expr(sec.signature)
+                eng = self._bucket_engine(plan, engine)
+                self._program(plan, eng)
+                mega_eng = self._bucket_engine(plan, "megakernel")
+                if mega_eng == "megakernel" and eng != "megakernel":
+                    self._program(plan, mega_eng)
+            compiled += 1
+        return compiled
+
+    def _warmup_lattice(self, profile, engine: str,
+                        cache_dir: str | None) -> dict:
+        """The ``warmup(profile=...)`` tentpole: activate the lattice,
+        pre-compile its WHOLE vocabulary through the persistent compile
+        cache, then seal it — from here on, steady state compiles
+        nothing and any compile is a counted/traced escape
+        (docs/LATTICE.md "Boot recipe")."""
+        t0 = time.perf_counter()
+        lat = rt_lattice.activate(profile)
+        with obs_trace.span("lattice.warmup", site="batch_engine",
+                            points=lat.n_points(),
+                            profile=lat.to_profile()) as sp:
+            compiled = self._compile_lattice_points(lat, engine)
+            lat.seal()
+            sp.tag(compiled=compiled, sealed=True)
+        return {"site": "batch_engine", "compile_cache_dir": cache_dir,
+                "lattice": {"profile": lat.to_profile(),
+                            "points": lat.n_points(),
+                            "compiled": compiled, "sealed": True},
+                "programs": [],
+                "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+
     def warmup(self, rungs=(1, 2, 4, 8),
                ops=("or", "and", "xor", "andnot"),
-               engine: str = "auto", queries=None) -> dict:
+               engine: str = "auto", queries=None, profile=None) -> dict:
         """Pre-compile the batch programs a known workload will hit, so a
         process boots hot (ROADMAP item 3's rung-warmup half; the other
         half is the ``ROARING_TPU_COMPILE_CACHE`` persistent cache this
@@ -1270,8 +1442,16 @@ class BatchEngine:
         serving loop's first compositional queries boot hot too — or
         delta shapes (``"delta:8"``): the in-place mutation patch
         program for an 8-row delta rung (docs/MUTATION.md), so the
-        first in-band ``apply_delta`` never pays its compile."""
+        first in-band ``apply_delta`` never pays its compile.
+
+        ``profile=`` switches to the closed-lattice boot path
+        (``ROARING_TPU_WARMUP_PROFILE`` / docs/LATTICE.md): activate the
+        lattice the profile describes, pre-compile its whole vocabulary,
+        and SEAL it — post-warmup steady state compiles nothing, and any
+        later compile is an escape (``rb_lattice_escapes_total``)."""
         cache_dir = rt_warmup.enable_compile_cache()
+        if profile is not None:
+            return self._warmup_lattice(profile, engine, cache_dir)
         t0 = time.perf_counter()
         programs = []
         if queries is not None:

@@ -823,7 +823,7 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
         sp.tag(kind=sec.kind, reduce_nodes=sec.n_reduce,
                combine_nodes=sec.n_combine, steps=len(steps),
                root_keys=int(sec.root_keys.size),
-               cached_nodes=sec.n_cached)
+               cached_nodes=sec.n_cached, depth=sec.depth)
         return sec
 
 

@@ -93,6 +93,7 @@ from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..ops import kernels, megakernel, packing
 from ..runtime import errors, faults, guard
+from ..runtime import lattice as rt_lattice
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
 from ..ops import dense
@@ -101,7 +102,8 @@ from .aggregation import DeviceBitmapSet, _engine
 from .batch_engine import (ENGINE_LADDER, PLAN_CACHE_MAX, PROGRAM_CACHE_MAX,
                            WORDS32, _RED_OP, BatchEngine, BatchQuery,
                            BatchResult, bucket_body, plan_bucket,
-                           query_desc, resolve_query_engine)
+                           plan_padding, query_desc, resolve_query_engine,
+                           snap_plan_groups)
 
 #: the guard/trace/metric site of every pooled dispatch
 SITE = "multiset"
@@ -213,6 +215,13 @@ class _PoolPlan:
     #: the pool has fused sections; its host stream stays alive for the
     #: pipelined dispatcher's fresh (donated) re-uploads
     mega: object = None
+    #: covering lattice point (runtime.lattice) when an active lattice
+    #: snapped this pool — the plan then references EVERY resident set
+    #: with a uniform padded row selection, so the program signature is
+    #: drawn from the closed vocabulary; None = exact shapes
+    point: object = None
+    #: (padding_bytes, padded_fraction) of the snap
+    padding: tuple = (0, 0.0)
     _row_sel_dev: dict = dataclasses.field(default_factory=dict)
 
     def row_sel_dev(self, sid: int):
@@ -558,10 +567,18 @@ class MultiSetBatchEngine:
         mutation versions — the prepared-statement pattern across
         tenants, retired exactly when a tenant's data moves."""
         self._sync_with_sets()
-        sids = tuple(sorted({sid for sid, _ in pooled}))
+        lat = rt_lattice.active()
+        # the TENANT-MIX dimension of pool-shape churn: without a
+        # lattice, every distinct referenced-set subset is a distinct
+        # program arity; under one, every pool references EVERY resident
+        # set (unreferenced tenants contribute a minimal padded row
+        # selection), so the mix stops being a signature dimension
+        sids = (tuple(range(self.n_sets)) if lat is not None
+                else tuple(sorted({sid for sid, _ in pooled})))
         key = (tuple(pooled),
                tuple((self._engines[s]._ds.uid,
-                      self._engines[s]._ds.version) for s in sids))
+                      self._engines[s]._ds.version) for s in sids),
+               rt_lattice.plan_token())
         cached = self._plans.get(key)
         if cached is not None:
             return cached
@@ -586,7 +603,9 @@ class MultiSetBatchEngine:
                 rows = rows + off
                 if hrows is not None:
                     hrows = hrows + off
-                rung = packing.next_pow2(max(1, len(set(pq.operands))))
+                rung = (0 if lat is not None
+                        else packing.next_pow2(
+                            max(1, len(set(pq.operands)))))
                 groups.setdefault((pq.op, rung), []).append(
                     (pid, pq, rows, segs, keys_q, keep, hrows))
                 if own is not None:
@@ -606,8 +625,49 @@ class MultiSetBatchEngine:
                         cache_probe=self._cache_probe_for(sid)))
                 else:
                     add_item(sid, q, qid)
+            # the pooled-row dimension must be judged WITH the shape
+            # snap (atomically, before dead buckets mutate the plan):
+            # the per-set selection need is computable from the raw
+            # item gathers — the same refs the compaction below unions
+            pool_need = -1
+            if lat is not None:
+                if all(self._rows[s] >= 1 for s in sids):
+                    refs = [it[2] for items in groups.values()
+                            for it in items]
+                    refs += [it[6] for items in groups.values()
+                             for it in items if it[6] is not None]
+                    refs += [v.ravel() for sec in sections
+                             if sec.kind == "fused" and sec.host
+                             for k, v in sec.host.items()
+                             if k.startswith("g")]
+                    # global row 0 ALWAYS joins the downstream union:
+                    # padded bucket cells (dead queries/rows, dead op
+                    # buckets, andnot head pads) gather index 0, so the
+                    # need judged here must include it or a pool sitting
+                    # exactly on a rung boundary would overflow it after
+                    # padding (off-vocabulary program despite a snap)
+                    refs.append(np.zeros(1, np.int64))
+                    allr = np.unique(np.concatenate(
+                        [np.asarray(r).ravel() for r in refs]))
+                    pool_need = 1
+                    for sid in sids:
+                        off = offsets[sid]
+                        pool_need = max(pool_need, int(
+                            ((allr >= off)
+                             & (allr < off + self._rows[sid])).sum()))
+            pad_to, point = snap_plan_groups(
+                lat, groups, sections,
+                any(q.form == "bitmap" for _, q in pooled),
+                counter, self._engines[0].keys[:0], placement="single",
+                pool=pool_need)
+            sp.tag(need_q=max((len(i) for i in groups.values()),
+                              default=0),
+                   need_rows=max((it[2].size for i in groups.values()
+                                  for it in i), default=0),
+                   need_keys=max((it[4].size for i in groups.values()
+                                  for it in i), default=0))
             with obs_trace.span("multiset.pool", groups=len(groups)):
-                buckets = [plan_bucket(op, items)
+                buckets = [plan_bucket(op, items, pad_to=pad_to)
                            for (op, _), items in sorted(groups.items())]
                 # compact the pooled row space: every gather row the
                 # pool references, once, sorted — per-set selections
@@ -625,6 +685,47 @@ class MultiSetBatchEngine:
                              else np.zeros(1, np.int64))
                 if pool_rows.size == 0:
                     pool_rows = np.zeros(1, np.int64)
+                row_sel_raw = {}
+                for sid in sids:
+                    off = offsets[sid]
+                    in_set = pool_rows[(pool_rows >= off)
+                                       & (pool_rows < off
+                                          + self._rows[sid])]
+                    row_sel_raw[sid] = (in_set - off).astype(np.int32)
+                # the pooled-row need, PRE-pad — what a lattice's pool
+                # rungs cover; insights.recommend_lattice reads it off
+                # the plan span
+                sp.tag(need_pool=int(max(
+                    (s.size for s in row_sel_raw.values()), default=1)))
+                # lattice pool-rows dimension: every set's row selection
+                # pads to ONE covering rung (dead slots re-gather the
+                # set's row 0, which no bucket references), so the
+                # pooled image height — a program operand shape — comes
+                # from the closed vocabulary.  ``pos`` maps compact
+                # pooled positions to their padded homes.  The rung was
+                # judged atomically with the shape snap above (the point
+                # cannot be abandoned here — dead buckets are already
+                # planted); never under-pad, results must stay exact.
+                if point is not None:
+                    B = max(point.pool, max(
+                        s.size for s in row_sel_raw.values()))
+                    row_sel, parts, base = {}, [], 0
+                    for sid in sids:
+                        sel = row_sel_raw[sid]
+                        padded_sel = np.zeros(B, np.int32)
+                        padded_sel[:sel.size] = sel
+                        row_sel[sid] = padded_sel
+                        parts.append(base + np.arange(sel.size,
+                                                      dtype=np.int64))
+                        base += B
+                    pos = (np.concatenate(parts) if parts
+                           else np.zeros(0, np.int64))
+                    n_pool = base
+                    point = dataclasses.replace(point, pool=B)
+                else:
+                    row_sel = row_sel_raw
+                    pos = np.arange(pool_rows.size, dtype=np.int64)
+                    n_pool = int(pool_rows.size)
                 # remap the (host-only, not yet uploaded) bucket gathers
                 # into pooled positions — device twins materialize lazily
                 # at first dispatch, and only for the rung that needs
@@ -633,22 +734,15 @@ class MultiSetBatchEngine:
                 for b in buckets:
                     for k in ("gather", "head_gather"):
                         if k in b.host:
-                            b.host[k] = np.searchsorted(
-                                pool_rows, b.host[k]).astype(np.int32)
+                            b.host[k] = pos[np.searchsorted(
+                                pool_rows, b.host[k])].astype(np.int32)
                 for sec in sections:
                     if sec.kind != "fused" or not sec.host:
                         continue
                     for k in list(sec.host):
                         if k.startswith("g"):
-                            sec.host[k] = np.searchsorted(
-                                pool_rows, sec.host[k]).astype(np.int32)
-                row_sel = {}
-                for sid in sids:
-                    off = offsets[sid]
-                    in_set = pool_rows[(pool_rows >= off)
-                                       & (pool_rows < off
-                                          + self._rows[sid])]
-                    row_sel[sid] = (in_set - off).astype(np.int32)
+                            sec.host[k] = pos[np.searchsorted(
+                                pool_rows, sec.host[k])].astype(np.int32)
             expr_mod.finalize_sections(sections, buckets)
             # the one-kernel program assembles from the REMAPPED host
             # gathers (pooled row space), after finalize resolved the
@@ -661,13 +755,22 @@ class MultiSetBatchEngine:
                          / max(1, sum(b.q for b in buckets)))
             obs_metrics.gauge("rb_multiset_pool_occupancy",
                               site=SITE).set(occupancy)
+            padding = (0, 0.0)
+            if point is not None:
+                pb, _pf = plan_padding(buckets, groups)
+                pool_pad = (n_pool - int(pool_rows.size))
+                pb += pool_pad * insights.ROW_BYTES
+                total = sum(b.q * b.r_pad for b in buckets) + n_pool
+                padding = (pb, (pb / insights.ROW_BYTES) / max(1, total))
             sp.tag(buckets=len(buckets), occupancy=round(occupancy, 4),
-                   pool_rows=int(pool_rows.size), exprs=len(sections))
+                   pool_rows=n_pool, exprs=len(sections),
+                   snapped=point is not None)
         plan = _PoolPlan(buckets=buckets,
                          op_groups=_merge_op_groups(buckets),
                          sids=sids, row_sel=row_sel,
-                         n_pool_rows=int(pool_rows.size),
-                         exprs=sections, owner=owner, mega=mega)
+                         n_pool_rows=n_pool,
+                         exprs=sections, owner=owner, mega=mega,
+                         point=point, padding=padding)
         self._plans.put(key, plan)
         return plan
 
@@ -872,6 +975,7 @@ class MultiSetBatchEngine:
                 operands).compile()
             compile_s = time.perf_counter() - t0
             obs_cost.observe_compile(SITE, "miss", compile_s)
+            rt_lattice.note_compile(SITE, eng, plan.point, compile_s)
             predicted = self._predict(plan, eng)
             measured = obs_memory.compiled_memory(compiled)
             cost = obs_cost.compiled_cost(compiled)
@@ -1235,6 +1339,11 @@ class MultiSetBatchEngine:
                 SITE, predicted["peak_bytes"], measured)
             mem["engine"], mem["q"] = eng, len(pooled)
             mem["sets"] = len(plan.sids)
+            if plan.point is not None:
+                pb, pf = plan.padding
+                mem["lattice_padding_bytes"] = int(pb)
+                mem["lattice_padding_fraction"] = round(pf, 6)
+                rt_lattice.record_padding(SITE, int(pb), pf)
             self.last_dispatch_memory = mem
             sp.event("multiset.memory", **mem)
             if sync:
@@ -1342,9 +1451,15 @@ class MultiSetBatchEngine:
         with obs_slo.phase("readback"), \
                 obs_trace.span("multiset.readback", engine=eng,
                                q=len(pooled)):
+            # the owner map is required whenever the plan carries
+            # owner-less pseudo slots: expression reduce nodes AND the
+            # lattice's dead op buckets (their pids have no query)
             results = assemble_pooled_results(
                 self._bucket_outputs(plan, outs, eng), pooled,
-                plan.rb_meta, owner=plan.owner if plan.exprs else None)
+                plan.rb_meta,
+                owner=(plan.owner if (plan.exprs
+                                      or plan.point is not None)
+                       else None))
             expr_mod.assemble_section_results(
                 plan.exprs, expr_outs, results,
                 lambda qid: pooled[qid][1].form)
@@ -1392,17 +1507,93 @@ class MultiSetBatchEngine:
         return [np.array([r.cardinality for r in rows], dtype=np.int64)
                 for rows in self.execute(groups, engine=engine)]
 
+    def _compile_lattice_points(self, lat, engine: str) -> int:
+        """Compile the POOLED half of the lattice vocabulary: each flat
+        point pins a representative two-tenant mini-pool (single-tenant
+        pools route through the per-set engines, warmed separately), so
+        the compiled program carries the point's padded bucket shapes,
+        the all-sets operand arity, and the pinned pooled-row rung.
+        Expression shape-classes compile their representative DAGs;
+        delta rungs pre-compile every tenant's patch programs."""
+        if self.n_sets < 2:
+            return 0
+        points = lat.enumerate_points(pooled=True)
+        self._programs.maxsize = max(self._programs.maxsize,
+                                     2 * len(points) + 8)
+        compiled = 0
+        for point in points:
+            if point.delta:
+                for e in self._engines:
+                    e._ds.warmup_delta(point.delta)
+                compiled += 1
+                continue
+            if point.expr:
+                # expressions sized PER TENANT: a non-first tenant may
+                # hold fewer residents than set 0, and its refs must
+                # stay in its own operand range
+                pool = [BatchGroup(0, expr_mod.rung_expressions(
+                            point.expr, self._engines[0].n)),
+                        BatchGroup(1, expr_mod.rung_expressions(
+                            point.expr, self._engines[1].n)[:1])]
+            else:
+                pool = [BatchGroup(0, [BatchQuery(op, (0,))
+                                       for op in point.ops]),
+                        BatchGroup(1, [BatchQuery(point.ops[0], (0,))])]
+            pooled, _ = self._flatten(pool)
+            with lat.pin(point):
+                plan = self._plan_pool(pooled)
+                for sec in plan.exprs:
+                    lat.note_expr(sec.signature)
+                eng = self._pool_engine(plan, engine)
+                self._program(plan, eng)
+                if _donation_supported():
+                    self._program(plan, eng, donate=True)
+                mega_eng = self._pool_engine(plan, "megakernel")
+                if mega_eng == "megakernel" and eng != "megakernel":
+                    self._program(plan, mega_eng)
+            compiled += 1
+        return compiled
+
+    def _warmup_lattice(self, profile, engine: str,
+                        cache_dir: str | None) -> dict:
+        """``warmup(profile=...)`` over the pooled engine: activate the
+        lattice, warm every adopted per-set engine's vocabulary (the
+        S=1 execute route), warm the pooled vocabulary, seal."""
+        t0 = time.perf_counter()
+        lat = rt_lattice.activate(profile)
+        with obs_trace.span("lattice.warmup", site=SITE,
+                            points=lat.n_points(pooled=True),
+                            profile=lat.to_profile()) as sp:
+            compiled = 0
+            for e in self._engines:
+                compiled += e._compile_lattice_points(lat, engine)
+            compiled += self._compile_lattice_points(lat, engine)
+            lat.seal()
+            sp.tag(compiled=compiled, sealed=True)
+        return {"site": SITE, "compile_cache_dir": cache_dir,
+                "lattice": {"profile": lat.to_profile(),
+                            "points": lat.n_points(pooled=True),
+                            "compiled": compiled, "sealed": True},
+                "programs": [],
+                "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+
     def warmup(self, rungs=(1, 2, 4, 8),
                ops=("or", "and", "xor", "andnot"),
-               engine: str = "auto", pools=None) -> dict:
+               engine: str = "auto", pools=None, profile=None) -> dict:
         """Pre-compile pooled programs for known pow2 operand rungs (one
         pool per rung: every tenant contributes each op over its first
         ``rung`` residents), or for explicit ``pools=`` (the exact
         serving shapes — those then hit the plan AND program caches on
         their first real execute).  A pool referencing one set warms
         that set's single-set engine instead, matching the S=1 execute
-        route.  Compile-only; see ``BatchEngine.warmup``."""
+        route.  Compile-only; see ``BatchEngine.warmup``.
+
+        ``profile=`` switches to the closed-lattice boot path
+        (docs/LATTICE.md): per-set AND pooled vocabularies pre-compile,
+        then the lattice seals — steady state compiles nothing."""
         cache_dir = rt_warmup.enable_compile_cache()
+        if profile is not None:
+            return self._warmup_lattice(profile, engine, cache_dir)
         t0 = time.perf_counter()
         programs = []
         if pools is None:

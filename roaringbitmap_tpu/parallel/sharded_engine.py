@@ -86,13 +86,14 @@ from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..ops import dense, megakernel, packing
 from ..runtime import faults, guard
+from ..runtime import lattice as rt_lattice
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
 from . import expr as expr_mod
 from .aggregation import DeviceBitmapSet
 from .batch_engine import (PLAN_CACHE_MAX, PROGRAM_CACHE_MAX, WORDS32,
                            _RED_OP, BatchEngine, BatchQuery, plan_bucket,
-                           query_desc)
+                           plan_padding, query_desc, snap_plan_groups)
 from .multiset import (BatchGroup, MultiSetBatchEngine, _donation_supported,
                        _merge_op_groups, assemble_pooled_results)
 from .sharding import SPECS, SpecLayout, _butterfly_combine, _intern_mesh, \
@@ -161,6 +162,11 @@ class _ShardedPlan:
     #: the fused combine passes run as ONE pallas grid kernel on the
     #: replicated post-butterfly side; None when absent or past budget
     mega: object = None
+    #: covering lattice point (runtime.lattice) when an active lattice
+    #: snapped this plan; None = exact shapes
+    point: object = None
+    #: (padding_bytes, padded_fraction) of the snap
+    padding: tuple = (0, 0.0)
     _arrays: list | None = None   # device twins, uploaded lazily
     _mega_arrays: dict | None = None
 
@@ -174,7 +180,13 @@ class _ShardedPlan:
 
     @property
     def signature(self):
-        return (self.sids, self.n_pads,
+        # the sharded pool image is the FULL placed concat, so gathers
+        # are global rows and the program never depends on WHICH tenants
+        # a pool references — under a lattice the tenant mix therefore
+        # drops out of the signature (the snapped shapes already close
+        # every operand dimension); exact plans keep it conservatively
+        return (self.sids if self.point is None else ("lattice",),
+                self.n_pads,
                 tuple(g.sig for g in self.op_groups),
                 self.expr_signature)
 
@@ -451,6 +463,7 @@ class ShardedBatchEngine:
 
     def _plan(self, pooled) -> _ShardedPlan:
         self._sync_pool()
+        lat = rt_lattice.active()
         sids = tuple(sorted({sid for sid, _ in pooled}))
         # referenced tenants' mutation versions key the plan: value
         # patches keep row placement (gathers are global rows) but may
@@ -458,7 +471,8 @@ class ShardedBatchEngine:
         # moved; structural repacks re-lay rows outright
         key = (tuple(pooled),
                tuple((self._engines[s]._ds.uid,
-                      self._engines[s]._ds.version) for s in sids))
+                      self._engines[s]._ds.version) for s in sids),
+               rt_lattice.plan_token())
         cached = self._plans.get(key)
         if cached is not None:
             return cached
@@ -479,7 +493,9 @@ class ShardedBatchEngine:
                 rows = rows + off
                 if hrows is not None:
                     hrows = hrows + off
-                rung = packing.next_pow2(max(1, len(set(pq.operands))))
+                rung = (0 if lat is not None
+                        else packing.next_pow2(
+                            max(1, len(set(pq.operands)))))
                 groups.setdefault((pq.op, rung), []).append(
                     (pid, pq, rows, segs, keys_q, keep, hrows))
                 if own is not None:
@@ -501,8 +517,19 @@ class ShardedBatchEngine:
                         cache_probe=self._single._cache_probe_for(sid)))
                 else:
                     add_item(sid, q, qid)
+            pad_to, point = snap_plan_groups(
+                lat, groups, sections,
+                any(q.form == "bitmap" for _, q in pooled),
+                counter, self._engines[0].keys[:0],
+                placement=self.placement)
+            sp.tag(need_q=max((len(i) for i in groups.values()),
+                              default=0),
+                   need_rows=max((it[2].size for i in groups.values()
+                                  for it in i), default=0),
+                   need_keys=max((it[4].size for i in groups.values()
+                                  for it in i), default=0))
             with obs_trace.span("sharded.pool", groups=len(groups)):
-                buckets = [plan_bucket(op, items)
+                buckets = [plan_bucket(op, items, pad_to=pad_to)
                            for (op, _), items in sorted(groups.items())]
                 op_groups = _merge_op_groups(buckets)
                 padded, n_pads = [], []
@@ -538,13 +565,16 @@ class ShardedBatchEngine:
                     expr_mod.expr_bucket_ids(fused))
                 if not mega.fits():
                     mega = None
+            padding = (plan_padding(buckets, groups)
+                       if point is not None else (0, 0.0))
             sp.tag(buckets=len(buckets), op_groups=len(op_groups),
                    flat_rows=int(sum(n_pads)), exprs=len(sections),
-                   mega=mega is not None)
+                   mega=mega is not None, snapped=point is not None)
         plan = _ShardedPlan(buckets=buckets, op_groups=op_groups,
                             sids=sids, padded=padded,
                             n_pads=tuple(n_pads),
-                            exprs=sections, owner=owner, mega=mega)
+                            exprs=sections, owner=owner, mega=mega,
+                            point=point, padding=padding)
         self._plans.put(key, plan)
         return plan
 
@@ -785,6 +815,8 @@ class ShardedBatchEngine:
                 self.pool_words, operands).compile()
             compile_s = time.perf_counter() - t0
             obs_cost.observe_compile(SITE, "miss", compile_s)
+            rt_lattice.note_compile(SITE, guard.MESH, plan.point,
+                                    compile_s)
             predicted = self._predict(plan)
             measured = obs_memory.compiled_memory(compiled)
             cost = obs_cost.compiled_cost(compiled)
@@ -958,6 +990,11 @@ class ShardedBatchEngine:
             mem["mesh"] = list(self.mesh_shape)
             mem["per_shard_predicted_bytes"] = predicted["per_shard_bytes"]
             mem["mesh_total_predicted_bytes"] = predicted["peak_bytes"]
+            if plan.point is not None:
+                pb, pf = plan.padding
+                mem["lattice_padding_bytes"] = int(pb)
+                mem["lattice_padding_fraction"] = round(pf, 6)
+                rt_lattice.record_padding(SITE, int(pb), pf)
             self.last_dispatch_memory = mem
             sp.event("sharded.memory", **mem)
             word_ops = insights.predict_batch_dispatch_word_ops(
@@ -1020,7 +1057,9 @@ class ShardedBatchEngine:
                                mesh=self._mesh_label):
             results = assemble_pooled_results(
                 self._group_outputs(plan, outs), pooled, plan.rb_meta,
-                owner=plan.owner if plan.exprs else None)
+                owner=(plan.owner if (plan.exprs
+                                      or plan.point is not None)
+                       else None))
             expr_mod.assemble_section_results(
                 plan.exprs, expr_outs, results,
                 lambda qid: pooled[qid][1].form)
@@ -1051,15 +1090,74 @@ class ShardedBatchEngine:
 
     # --------------------------------------------------------- conveniences
 
+    def _compile_lattice_points(self, lat) -> int:
+        """Compile the mesh half of the lattice vocabulary: one SPMD
+        program per flat point (a pinned representative pool — the
+        sharded image is the full static concat, so the tenant mix never
+        enters the signature), the representative expression DAGs, and
+        every tenant's delta-patch rungs."""
+        points = lat.enumerate_points(pooled=False)
+        self._programs.maxsize = max(self._programs.maxsize,
+                                     2 * len(points) + 8)
+        compiled = 0
+        second = 1 % self.n_sets
+        for point in points:
+            if point.delta:
+                for e in self._engines:
+                    e._ds.warmup_delta(point.delta)
+                compiled += 1
+                continue
+            if point.expr:
+                qs = expr_mod.rung_expressions(point.expr,
+                                               self._engines[0].n)
+                pool = [BatchGroup(0, qs)]
+            else:
+                pool = [BatchGroup(0, [BatchQuery(op, (0,))
+                                       for op in point.ops]),
+                        BatchGroup(second,
+                                   [BatchQuery(point.ops[0], (0,))])]
+            pooled, _ = self._single._flatten(pool)
+            with lat.pin(point):
+                plan = self._plan(tuple(pooled))
+                for sec in plan.exprs:
+                    lat.note_expr(sec.signature)
+                self._program(plan, donate=_donation_supported())
+            compiled += 1
+        return compiled
+
+    def _warmup_lattice(self, profile, cache_dir: str | None) -> dict:
+        """``warmup(profile=...)`` over the mesh: activate, pre-compile
+        the mesh vocabulary, seal (docs/LATTICE.md).  The single-device
+        demotion rung compiles only on a mesh fault — such a compile is
+        an escape by design: an incident, not steady state."""
+        t0 = time.perf_counter()
+        lat = rt_lattice.activate(profile)
+        with obs_trace.span("lattice.warmup", site=SITE,
+                            points=lat.n_points(),
+                            profile=lat.to_profile()) as sp:
+            compiled = self._compile_lattice_points(lat)
+            lat.seal()
+            sp.tag(compiled=compiled, sealed=True)
+        return {"site": SITE, "compile_cache_dir": cache_dir,
+                "mesh": list(self.mesh_shape),
+                "lattice": {"profile": lat.to_profile(),
+                            "points": lat.n_points(),
+                            "compiled": compiled, "sealed": True},
+                "programs": [],
+                "wall_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+
     def warmup(self, rungs=(1, 2, 4, 8),
                ops=("or", "and", "xor", "andnot"),
-               pools=None) -> dict:
+               pools=None, profile=None) -> dict:
         """Pre-compile mesh programs for known pow2 operand rungs (or
         explicit ``pools=``) — ``BatchEngine.warmup`` one level up; the
         persistent compile cache (``ROARING_TPU_COMPILE_CACHE``) makes
         the compiles survive restarts, so a re-booted serving process
-        replays them from disk."""
+        replays them from disk.  ``profile=`` switches to the
+        closed-lattice boot path (docs/LATTICE.md)."""
         cache_dir = rt_warmup.enable_compile_cache()
+        if profile is not None:
+            return self._warmup_lattice(profile, cache_dir)
         t0 = time.perf_counter()
         programs = []
         if pools is None:

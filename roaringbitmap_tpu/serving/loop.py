@@ -65,6 +65,7 @@ from ..parallel import expr as expr_mod
 from ..parallel.batch_engine import BatchQuery, query_desc
 from ..parallel.multiset import BatchGroup
 from ..runtime import errors, faults, guard
+from ..runtime import lattice as rt_lattice
 from ..runtime.cache import LRUCache
 
 _log = logging.getLogger("roaringbitmap_tpu.serving")
@@ -243,6 +244,15 @@ class ServingLoop:
     under it.
     """
 
+    #: how many consecutive pools the compile-majority ("chronic churn")
+    #: estimator may dominate before compiled walls stop calibrating it:
+    #: without a cap, a churn burst inflates the service-time estimate
+    #: for as long as the churn lasts AND mass-sheds everything behind
+    #: it — after the cap, compiles are treated as one-time again and
+    #: the estimate re-converges to measured pool walls.  A completed
+    #: lattice warmup resets the window outright (docs/LATTICE.md).
+    CHRONIC_CAP = 8
+
     def __init__(self, engine, policy: ServingPolicy | None = None):
         self._engine = engine
         self.policy = policy or ServingPolicy.from_env()
@@ -254,6 +264,12 @@ class ServingLoop:
         self._req_bytes = LRUCache(1024, name="serving_req_bytes")
         self._walls: deque = deque(maxlen=8)  # (s_per_query, compiled)
         self._s_per_q: float | None = None
+        self._chronic_run = 0        # consecutive chronic-majority pools
+        #: a completed lattice warmup sealed the vocabulary: steady
+        #: state compiles nothing, so the predictor never charges
+        #: compile time to pools — an escape is an anomaly, not the
+        #: service time (docs/LATTICE.md "Escape semantics")
+        self._lattice_warmed = rt_lattice.sealed_active()
         #: the assembled pool's precise predicted bytes, computed once by
         #: _trim_to_budget and consumed by the next _dispatch's span tag
         self._assembled_bytes: int | None = None
@@ -665,11 +681,22 @@ class ServingLoop:
         # CHRONIC (a pool-shape churn the caches cannot absorb) they ARE
         # the service time and must be believed — so keep (wall,
         # compiled?) samples and take the median of the warm ones unless
-        # the window is majority-compiled
+        # the window is majority-compiled.  Two bounds on that belief:
+        # the chronic window is CAPPED (CHRONIC_CAP consecutive pools —
+        # endless churn must not inflate estimates forever), and after a
+        # completed lattice warmup it is DISABLED outright: a sealed
+        # vocabulary compiles nothing in steady state, so any compile is
+        # an escape (rb_lattice_escapes_total), never the service time.
         compiled = self._compile_misses() != miss0
         self._walls.append((wall / max(1, len(tickets)), compiled))
         warm = [w for w, c in self._walls if not c]
-        chronic = 2 * sum(c for _, c in self._walls) > len(self._walls)
+        majority = (2 * sum(c for _, c in self._walls)
+                    > len(self._walls))
+        chronic = (not self._lattice_warmed and majority
+                   and self._chronic_run < self.CHRONIC_CAP)
+        self._chronic_run = ((self._chronic_run + 1)
+                             if majority and not self._lattice_warmed
+                             else 0)
         vals = sorted(w for w, _ in self._walls) if (chronic or not warm) \
             else sorted(warm)
         self._s_per_q = vals[len(vals) // 2]
@@ -689,15 +716,10 @@ class ServingLoop:
 
     @staticmethod
     def _compile_misses() -> int:
-        """Process-wide program-compile count (the
-        ``rb_compile_seconds{cache="miss"}`` observations) — the witness
-        that a dispatch paid a one-time compile and its wall must not
+        """Process-wide program-compile count — the witness that a
+        dispatch paid a one-time compile and its wall must not
         calibrate the steady-state estimator."""
-        return int(sum(
-            inst.count
-            for name, labels, inst in obs_metrics.REGISTRY.instruments()
-            if name == "rb_compile_seconds"
-            and labels.get("cache") == "miss"))
+        return obs_metrics.compile_miss_total()
 
     def _group(self, tickets: list):
         """Tickets -> BatchGroups by set_id (first-appearance order) +
@@ -767,6 +789,29 @@ class ServingLoop:
                      extra={"rb_site": SITE, "rb_event": "degrade",
                             "rb_level": level})
 
+    # -------------------------------------------------------------- warmup
+
+    def warmup(self, profile=None, rungs=None, **kw) -> dict:
+        """Boot-time warmup through the pooled engine.  ``profile=``
+        runs the closed-lattice path (``engine.warmup(profile=...)`` —
+        docs/LATTICE.md): the whole vocabulary pre-compiles and the
+        lattice seals, after which this loop's predictor never charges
+        compile time to a pool (any compile is an escape).  Either way
+        the service-time estimator RESETS — warmup walls are compile
+        walls, and a fresh window re-converges to measured pool walls
+        in a handful of pools."""
+        if profile is not None:
+            rep = self._engine.warmup(profile=profile, **kw)
+        elif rungs is not None:
+            rep = self._engine.warmup(rungs=rungs, **kw)
+        else:
+            rep = self._engine.warmup(**kw)
+        self._walls.clear()
+        self._s_per_q = None
+        self._chronic_run = 0
+        self._lattice_warmed = rt_lattice.sealed_active()
+        return rep
+
     # -------------------------------------------------------------- health
 
     def _queue_gauge(self, tenant: str | None = None) -> None:
@@ -796,4 +841,10 @@ class ServingLoop:
         rc = getattr(self._engine, "result_cache", None)
         if rc is not None:
             out["result_cache"] = rc.stats()
+        lat = rt_lattice.active()
+        if lat is not None:
+            out["lattice"] = {"sealed": lat.sealed,
+                              "escapes": lat.escapes,
+                              "warmed": self._lattice_warmed,
+                              "points": lat.n_points(pooled=True)}
         return out

@@ -1,0 +1,423 @@
+"""Closed program-signature lattice (ISSUE 13, docs/LATTICE.md).
+
+What is pinned here:
+
+- snap covering + idempotence over the vocabulary, and the env-knob
+  profile round trip (``ROARING_TPU_WARMUP_PROFILE``);
+- padded-vs-exact BIT-EXACT parity across (op x layout x engine rung)
+  and on the 2x2 mesh — the lattice trades padding for a closed
+  vocabulary, never results;
+- plan-shape closure: different traffic mixes (ops present, operand
+  rungs, tenant subsets) land on ONE compiled program per lattice
+  point, and post-warmup steady state compiles NOTHING (the serving
+  loop proves it under the fault clock);
+- escape semantics: an out-of-vocabulary shape after seal is counted
+  (``rb_lattice_escapes_total``), traced (``lattice.escape``), and
+  still bit-exact;
+- padding accounting: ``rb_lattice_padding_bytes`` moves and the
+  per-dispatch padded fraction stays under the pinned bound;
+- the serving predictor: a completed lattice warmup resets the
+  service-time estimator, and the compile-majority ("chronic churn")
+  window is capped so endless churn cannot inflate estimates forever.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import obs
+from roaringbitmap_tpu.insights import analysis as insights
+from roaringbitmap_tpu.obs import metrics as obs_metrics
+from roaringbitmap_tpu.obs import trace as obs_trace
+from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
+                                                     BatchQuery,
+                                                     random_query_pool)
+from roaringbitmap_tpu.parallel.multiset import (BatchGroup,
+                                                 MultiSetBatchEngine,
+                                                 random_multiset_pool)
+from roaringbitmap_tpu.parallel.sharded_engine import (ShardedBatchEngine,
+                                                       default_mesh)
+from roaringbitmap_tpu.runtime import faults, guard
+from roaringbitmap_tpu.runtime import lattice as rt_lattice
+from roaringbitmap_tpu.serving import (ServingLoop, ServingPolicy,
+                                       ServingRequest)
+from roaringbitmap_tpu.utils import datasets
+
+#: sparse rung lists: 2 points per engine family, every test shape
+#: covered (8 residents, one key segment at this universe)
+PROFILE = "q=16,;rows=16,;keys=2,;heads=both;pool=16,"
+
+NOSLEEP = guard.GuardPolicy(backoff_base=0.0, sleep=lambda _s: None)
+
+
+_misses = obs_metrics.compile_miss_total
+
+
+def _escapes() -> int:
+    return int(sum(
+        inst.value
+        for name, _labels, inst in obs_metrics.REGISTRY.instruments()
+        if name == "rb_lattice_escapes_total"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_lattice(monkeypatch):
+    """Every test starts and ends lattice-free and fault-free: the
+    lattice is process state, and the CI fault shard's env schedule
+    would demote rungs mid-test and turn zero-compile pins flaky."""
+    monkeypatch.delenv("ROARING_TPU_FAULTS", raising=False)
+    monkeypatch.delenv(rt_lattice.ENV_PROFILE, raising=False)
+    rt_lattice.deactivate()
+    yield
+    rt_lattice.deactivate()
+
+
+@pytest.fixture(scope="module")
+def bitmaps():
+    return datasets.synthetic_bitmaps(8, seed=0x13, universe=1 << 17,
+                                      density=0.01)
+
+
+@pytest.fixture(scope="module")
+def tenants():
+    return [datasets.synthetic_bitmaps(8, seed=0x20 + i,
+                                       universe=1 << 16, density=0.008)
+            for i in range(4)]
+
+
+# ------------------------------------------------------------ vocabulary
+
+def test_snap_covering_and_idempotent():
+    lat = rt_lattice.Lattice.from_profile(
+        "q=8,64;rows=32;keys=4;pool=128,;heads=both;expr=2")
+    p = lat.snap(ops=("or", "and"), q=9, rows=5, keys=3, heads=False)
+    # covering: every dimension >= the need, drawn from the rung lists
+    assert p.q == 64 and p.rows == 8 and p.keys == 4
+    assert set(("or", "and")) <= set(p.ops)
+    assert lat.contains(p)
+    assert p in lat.enumerate_points()
+    # idempotence: snapping a lattice point is the identity
+    p2 = lat.snap(ops=p.ops, q=p.q, rows=p.rows, keys=p.keys,
+                  heads=p.heads)
+    assert p2 == p
+    # beyond the maxima -> out of vocabulary, not a wrong covering
+    assert lat.snap(ops=("or",), q=65, rows=1, keys=1,
+                    heads=False) is None
+    assert lat.snap(ops=("or",), q=1, rows=1, keys=5,
+                    heads=False) is None
+
+
+def test_profile_env_knob_round_trip(monkeypatch):
+    spec = "q=8,64;rows=16,;keys=1,;pool=32,;heads=cardinality;expr=0"
+    lat = rt_lattice.Lattice.from_profile(spec)
+    # to_profile/from_profile is the identity on vocabularies
+    assert rt_lattice.Lattice.from_profile(lat.to_profile()) == lat
+    # the env knob activates the same lattice
+    monkeypatch.setenv(rt_lattice.ENV_PROFILE, spec)
+    got = rt_lattice.refresh_from_env()
+    assert got == lat
+    assert rt_lattice.active() == lat
+    # bare ceiling expands to the pow2 ladder; explicit lists stay sparse
+    assert rt_lattice.Lattice.from_profile("q=8").q == (1, 2, 4, 8)
+    assert rt_lattice.Lattice.from_profile("q=8,").q == (8,)
+
+
+def test_enumerate_is_finite_and_pool_dim_is_pooled_only():
+    lat = rt_lattice.Lattice.from_profile(PROFILE)
+    flat = lat.enumerate_points()
+    pooled = lat.enumerate_points(pooled=True)
+    assert len(flat) == 2          # one op set x 1q x 1r x 1k x 2 heads
+    assert len(pooled) == 2        # x 1 pool rung
+    assert all(p.pool == 0 for p in flat)
+    assert all(p.pool == 16 for p in pooled)
+
+
+# ----------------------------------------------------- bit-exact parity
+
+@pytest.mark.parametrize("layout", ["dense", "counts"])
+@pytest.mark.parametrize("engine", ["xla", "xla-vmap", "pallas"])
+def test_padded_vs_exact_parity(bitmaps, layout, engine):
+    """Snapped plans are BIT-EXACT vs the exact-shape plans for every
+    op, both result forms, across layouts and engine rungs — padding
+    is dead work by construction (identity rows, dead segments,
+    owner-less dead buckets)."""
+    pool = [BatchQuery(op, ops_, form=form)
+            for op, ops_ in (("or", (0, 1, 2)), ("and", (1, 2, 3)),
+                             ("xor", (0, 3)), ("andnot", (0, 1, 4)))
+            for form in ("cardinality", "bitmap")]
+    eng = BatchEngine.from_bitmaps(bitmaps, layout=layout)
+    exact = eng.execute(pool, engine=engine, fallback=False)
+    rt_lattice.activate(PROFILE)
+    snapped = eng.execute(pool, engine=engine, fallback=False)
+    plan = eng.plan(tuple(pool))
+    assert plan.point is not None, "parity run must actually snap"
+    for e, s, q in zip(exact, snapped, pool):
+        assert e.cardinality == s.cardinality
+        if q.form == "bitmap":
+            assert e.bitmap == s.bitmap
+
+
+def test_sharded_padded_parity(tenants):
+    mesh = default_mesh(data=2)
+    eng = ShardedBatchEngine.from_bitmap_sets(tenants, mesh=mesh)
+    pool = random_multiset_pool([8] * 4, 10, seed=0x51)
+    exact = eng.execute(pool, fallback=False)
+    rt_lattice.activate(PROFILE)
+    snapped = eng.execute(pool, fallback=False)
+    assert [[r.cardinality for r in rows] for rows in exact] == \
+        [[r.cardinality for r in rows] for rows in snapped]
+
+
+# ------------------------------------------------------- shape closure
+
+def test_plan_closure_one_program_for_diverse_flat_traffic(bitmaps):
+    """Distinct op mixes and operand rungs all snap to one covering
+    point -> ONE compiled program serves them all."""
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    rt_lattice.activate(PROFILE)
+    mixes = [[BatchQuery("or", (0, 1))],
+             [BatchQuery("and", (0, 1, 2, 3)), BatchQuery("xor", (1, 2))],
+             [BatchQuery("andnot", (2, 0)), BatchQuery("or", (3, 4, 5)),
+              BatchQuery("or", (0, 2, 4, 6))]]
+    for pool in mixes:
+        eng.execute(pool, engine="xla")
+    assert len(eng._programs) == 1, \
+        "diverse flat traffic must share one snapped program"
+    points = {eng.plan(tuple(p)).point for p in mixes}
+    assert len(points) == 1
+
+
+def test_multiset_tenant_mix_closure(tenants):
+    """Different referenced-tenant subsets are one program under the
+    lattice: every pool references every set with a uniform padded row
+    selection."""
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    rt_lattice.activate(PROFILE)
+    pools = [[BatchGroup(0, [BatchQuery("or", (0, 1))]),
+              BatchGroup(2, [BatchQuery("and", (1, 2))])],
+             [BatchGroup(1, [BatchQuery("xor", (0, 3))]),
+              BatchGroup(3, [BatchQuery("or", (2, 4))])],
+             [BatchGroup(0, [BatchQuery("andnot", (0, 2))]),
+              BatchGroup(1, [BatchQuery("or", (1, 5))]),
+              BatchGroup(2, [BatchQuery("and", (0, 1, 2))])]]
+    for pool in pools:
+        flat = eng.execute(pool, engine="xla")
+        # parity against the per-set sequential reference
+        for g, rows in zip(pool, flat):
+            for q, r in zip(g.queries, rows):
+                ref = eng._engines[g.set_id]._sequential_one(q)
+                assert r.cardinality == ref.cardinality
+    assert len(eng._programs) == 1, \
+        "tenant-mix diversity must not grow the pooled program cache"
+
+
+def test_warmup_zero_compile_steady_state(bitmaps):
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    rep = eng.warmup(profile=PROFILE)
+    assert rep["lattice"]["sealed"] is True
+    lat = rt_lattice.active()
+    assert lat is not None and lat.sealed
+    m0, e0 = _misses(), _escapes()
+    for seed in (1, 2, 3):
+        pool = random_query_pool(8, 12, seed=seed, max_operands=5)
+        got = eng.execute(pool)
+        ref = eng._execute_sequential(pool)
+        assert [r.cardinality for r in got] == \
+            [r.cardinality for r in ref]
+    assert _misses() == m0, "warmed lattice steady state compiled"
+    assert _escapes() == e0 and lat.escapes == 0
+
+
+# --------------------------------------------------------- escapes
+
+def test_escape_counted_and_traced(bitmaps, tmp_path):
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    eng.warmup(profile=PROFILE)
+    lat = rt_lattice.active()
+    path = tmp_path / "lattice_trace.jsonl"
+    obs_trace.enable(str(path))
+    try:
+        # 17 same-op queries > the q=16 rung: out of vocabulary
+        big = [BatchQuery("or", (0, 1)) for _ in range(17)]
+        got = eng.execute(big)
+        ref = eng._execute_sequential(big)
+        assert [r.cardinality for r in got] == \
+            [r.cardinality for r in ref], "escapes must stay bit-exact"
+    finally:
+        obs_trace.disable()
+    assert lat.escapes >= 1
+    assert _escapes() >= 1
+    events = [ev for line in path.read_text().splitlines()
+              for ev in json.loads(line).get("events", [])
+              if ev.get("name") == "lattice.escape"]
+    assert events, "escape compile must emit a lattice.escape event"
+    ev = events[0]
+    assert ev["site"] == "batch_engine"
+    assert ev["in_vocabulary"] is False
+    assert isinstance(ev["compile_ms"], (int, float))
+
+
+# --------------------------------------------------------- padding
+
+def test_padding_fraction_bounded_and_metered(bitmaps):
+    eng = BatchEngine.from_bitmaps(bitmaps, layout="dense")
+    eng.warmup(profile=PROFILE)
+    pool = random_query_pool(8, 12, seed=9, max_operands=5)
+    eng.execute(pool)
+    mem = eng.last_dispatch_memory
+    assert mem["lattice_padding_bytes"] > 0
+    assert 0.0 <= mem["lattice_padding_fraction"] <= 0.97
+    padded = int(sum(
+        inst.value
+        for name, labels, inst in obs_metrics.REGISTRY.instruments()
+        if name == "rb_lattice_padding_bytes"
+        and labels.get("site") == "batch_engine"))
+    assert padded >= mem["lattice_padding_bytes"]
+
+
+# --------------------------------------------------- serving loop
+
+def _loop(engine, **kw) -> ServingLoop:
+    kw.setdefault("pool_target", 8)
+    kw.setdefault("default_deadline_ms", 600_000.0)
+    kw.setdefault("max_queue", 4096)
+    kw.setdefault("guard", NOSLEEP)
+    return ServingLoop(engine, ServingPolicy(**kw))
+
+
+def test_serving_zero_compile_steady_state_fault_clock(tenants):
+    """The acceptance shape: a warmed-lattice loop replays a diverse
+    stream on the fault clock and compiles NOTHING — p99 stops
+    depending on traffic novelty because novelty stops existing."""
+    faults.reset_clock()
+    engine = MultiSetBatchEngine.from_bitmap_sets(tenants,
+                                                  layout="dense")
+    loop = _loop(engine)
+    rep = loop.warmup(profile=PROFILE)
+    assert rep["lattice"]["sealed"] and loop._lattice_warmed
+    assert loop._s_per_q is None and not loop._walls
+    rng = np.random.default_rng(0x77)
+    ops = ("or", "and", "xor", "andnot")
+    reqs = [ServingRequest(
+        int(rng.integers(4)),
+        BatchQuery(ops[int(rng.integers(4))],
+                   tuple(int(x) for x in rng.choice(
+                       8, size=int(rng.integers(2, 6)), replace=False))),
+        tenant=f"t{int(rng.integers(4))}") for _ in range(96)]
+    m0, e0 = _misses(), _escapes()
+    tickets = loop.replay((i * 1e-3, r) for i, r in enumerate(reqs))
+    assert all(t.ok for t in tickets)
+    assert _misses() == m0, "warmed serving steady state compiled"
+    assert _escapes() == e0
+    snap = loop.snapshot()
+    assert snap["lattice"] == {"sealed": True, "escapes": 0,
+                               "warmed": True, "points": 2}
+    for t in tickets[::13]:
+        ref = engine._engines[t.request.set_id]._sequential_one(t.query)
+        assert t.result.cardinality == ref.cardinality
+
+
+def test_chronic_window_capped_and_warmup_resets(tenants):
+    """The PR 10 predictor fix: chronic compile-majority windows stop
+    calibrating the estimator after CHRONIC_CAP consecutive pools, and
+    a completed lattice warmup resets the window outright."""
+    faults.reset_clock()
+    engine = MultiSetBatchEngine.from_bitmap_sets(tenants,
+                                                  layout="dense")
+    loop = _loop(engine)
+    # churn: every pool is a novel program shape (op x operand-rung
+    # matrix, rungs 2/4/8), so every dispatch compiles and the window
+    # is compile-majority
+    for i in range(loop.CHRONIC_CAP + 3):
+        op = ("or", "and", "xor", "andnot")[i % 4]
+        size = (2, 3, 5)[i // 4]
+        t = loop.submit(ServingRequest(i % 4,
+                                       BatchQuery(op, tuple(range(size)))))
+        loop.pump(force=True)
+        assert t.ok
+    assert all(c for _, c in loop._walls)
+    # capped: the run counter saturated past the cap, so the chronic
+    # branch is off even though the window is still compile-majority
+    assert loop._chronic_run > loop.CHRONIC_CAP
+    # a completed lattice warmup resets the estimator state
+    loop.warmup(profile=PROFILE)
+    assert not loop._walls and loop._s_per_q is None
+    assert loop._chronic_run == 0 and loop._lattice_warmed
+    # post-warmup: compiled pools never calibrate the estimate — an
+    # escape's wall is excluded as long as any warm sample exists
+    t = loop.submit(ServingRequest(0, BatchQuery("or", (0, 1))))
+    loop.pump(force=True)
+    assert t.ok and not loop._walls[-1][1]   # in-lattice, no compile
+
+
+def test_pool_rung_overflow_falls_back_exact(tenants):
+    """A pool whose per-set row-selection need exceeds the pool rung
+    vocabulary must fall back to EXACT shapes atomically — no dead
+    buckets half-planted, no owner-less pseudo slots at readback (the
+    review-found crash), results bit-exact."""
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    rt_lattice.activate("q=16,;rows=16,;keys=2,;heads=both;pool=2,")
+    pool = [BatchGroup(0, [BatchQuery("or", (0, 1, 2, 3))]),
+            BatchGroup(1, [BatchQuery("or", (0, 1))])]
+    rows = eng.execute(pool, engine="xla")
+    for g, rr in zip(pool, rows):
+        for q, r in zip(g.queries, rr):
+            ref = eng._engines[g.set_id]._sequential_one(q)
+            assert r.cardinality == ref.cardinality
+    plan = eng._plan_pool(eng._flatten(pool)[0])
+    assert plan.point is None
+    assert sum(len(b.qids) for b in plan.buckets) == 2, \
+        "a refused snap must plant no dead pseudo slots"
+
+
+def test_pool_rung_boundary_includes_padding_row(tenants):
+    """Padded bucket cells always gather global row 0, so a pool whose
+    raw need sits exactly on the rung must be judged WITH that row —
+    either it snaps to a rung that covers the padded selection (staying
+    in vocabulary) or it is refused atomically, never an off-vocabulary
+    snapped shape."""
+    eng = MultiSetBatchEngine.from_bitmap_sets(tenants, layout="dense")
+    # operands (1,2,3,4): raw selection 4 rows + the padding row 0 = 5
+    pool = [BatchGroup(0, [BatchQuery("or", (1, 2, 3, 4))]),
+            BatchGroup(1, [BatchQuery("or", (1, 2))])]
+    rt_lattice.activate("q=16,;rows=16,;keys=2,;heads=both;pool=4,")
+    plan = eng._plan_pool(eng._flatten(pool)[0])
+    assert plan.point is None, \
+        "rung 4 cannot cover the 4-row-plus-padding-row selection"
+    rt_lattice.activate("q=16,;rows=16,;keys=2,;heads=both;pool=8,")
+    plan = eng._plan_pool(eng._flatten(pool)[0])
+    assert plan.point is not None and plan.point.pool == 8
+    assert all(sel.size == 8 for sel in plan.row_sel.values())
+    rows = eng.execute(pool, engine="xla")
+    for g, rr in zip(pool, rows):
+        for q, r in zip(g.queries, rr):
+            ref = eng._engines[g.set_id]._sequential_one(q)
+            assert r.cardinality == ref.cardinality
+
+
+# ------------------------------------------------- recommend_lattice
+
+def test_recommend_lattice_covers_observed_trace(tenants, tmp_path):
+    path = tmp_path / "observed.jsonl"
+    obs_trace.enable(str(path))
+    try:
+        engine = MultiSetBatchEngine.from_bitmap_sets(tenants,
+                                                      layout="dense")
+        for seed in (1, 2):
+            engine.execute(random_multiset_pool([8] * 4, 10, seed=seed),
+                           engine="xla")
+    finally:
+        obs_trace.disable()
+    rec = insights.recommend_lattice(str(path))
+    assert rec["points"] >= 1 and rec["observed"]["q"]
+    # the pooled-row dimension must be OBSERVED, not a fallback — the
+    # trace above ran multi-tenant pools
+    assert rec["observed"]["pool_rows"]
+    lat = rt_lattice.Lattice.from_profile(rec["profile"])
+    # the recommended vocabulary covers every observed shape
+    assert lat.snap(ops=rt_lattice.OPS,
+                    q=max(rec["observed"]["q"]),
+                    rows=max(rec["observed"]["rows"]),
+                    keys=max(rec["observed"]["keys"]),
+                    heads=True) is not None

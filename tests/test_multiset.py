@@ -30,6 +30,7 @@ from roaringbitmap_tpu.parallel import (BatchEngine, BatchGroup, BatchQuery,
                                         DeviceBitmapSet, MultiSetBatchEngine)
 from roaringbitmap_tpu.parallel.multiset import random_multiset_pool
 from roaringbitmap_tpu.runtime import faults, guard
+from roaringbitmap_tpu.runtime import lattice as rt_lattice
 
 S_SIZES = (8, 6, 8)     # bitmaps per tenant set
 
@@ -156,7 +157,9 @@ def test_s1_pool_routes_through_single_set_path(tenant_bitmaps):
     # and the single-set engine's own caches served the call
     be = eng._engines[1]
     # plan keys carry the set's mutation version (docs/MUTATION.md)
-    assert (tuple(queries), be._ds.version) in be._plans
+    # plus the lattice token (docs/LATTICE.md; None while inactive)
+    assert (tuple(queries), be._ds.version,
+            rt_lattice.plan_token()) in be._plans
     want = be.execute(queries, engine="xla")
     assert [r.cardinality for r in got[0]] == \
         [r.cardinality for r in want]

@@ -75,11 +75,19 @@ import sys
 #: (materialized-result-cache replay QPS over the recompute path, via
 #: ``vs_recompute``); its ``delta_ms`` / ``repack_ms`` cells ride the
 #: ``_ms`` rule.
+#: The closed-lattice lane (bench.py lattice_phase, ISSUE 13) adds
+#: ``lattice.warmed.{compiles,escapes,p50_ms,p99_ms,padding_fraction}``
+#: and the ``lattice_p99_over_p50`` / ``padding_byte_fraction`` /
+#: ``compiles_warm`` headlines — all gated LOWER (``escapes`` /
+#: ``padding`` / ``p99_over_p50`` / ``compiles`` fragments); the cold
+#: control's compile count (``compiles_cold``) is NEUTRAL like the
+#: other control arms (it measures the disease, not the cure).
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
           "fused_vs", "mega_vs", "vs_repack", "vs_recompute", "attain")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
-         "shard_balance", "warm_restart")
+         "shard_balance", "warm_restart", "escapes", "padding",
+         "p99_over_p50", "compiles")
 #: checked before HIGHER/LOWER: lanes whose good direction is genuinely
 #: ambiguous.  host_overlapped_ms scales with total host time in BOTH
 #: directions (more overlap at fixed host_ms is good, but so is less
@@ -96,7 +104,8 @@ LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
 #: at overload is a policy outcome, not a quality axis (more shedding
 #: with higher survivor attainment can be the better trade); the
 #: ``x4`` cells' serving direction signal is ``slo_attainment``.
-NEUTRAL = ("host_overlapped", "phase_ms", "noshed", "shed_rate")
+NEUTRAL = ("host_overlapped", "phase_ms", "noshed", "shed_rate",
+           "compiles_cold")
 
 
 def salvage_tail_json(tail: str) -> dict | None:

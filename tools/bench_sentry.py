@@ -411,6 +411,74 @@ def serving_smoke() -> int:
     return 0 if ok else 1
 
 
+def lattice_smoke() -> int:
+    """Closed-lattice smoke (ISSUE 13, docs/LATTICE.md): a diverse-
+    tenant trace (>= 32 distinct pool shapes) replayed through a
+    warmed-lattice serving loop must compile ZERO new programs, record
+    zero escapes, and serve bit-exactly vs an unwarmed control engine.
+    Returns 0 when every contract holds, 1 otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    from roaringbitmap_tpu.obs import metrics as obs_metrics
+    from roaringbitmap_tpu.parallel import (BatchQuery,
+                                            MultiSetBatchEngine)
+    from roaringbitmap_tpu.runtime import faults, guard
+    from roaringbitmap_tpu.runtime import lattice as rt_lattice
+    from roaringbitmap_tpu.serving import (ServingLoop, ServingPolicy,
+                                           ServingRequest)
+    from roaringbitmap_tpu.utils import datasets
+
+    misses = obs_metrics.compile_miss_total
+
+    faults.reset_clock()
+    s, per_tenant = 4, 8
+    tenants = [datasets.synthetic_bitmaps(
+        per_tenant, seed=0x7A + i, universe=1 << 16, density=0.008)
+        for i in range(s)]
+    rng = np.random.default_rng(0x1A5E)
+    ops = ("or", "and", "xor", "andnot")
+    reqs, shapes = [], set()
+    for i in range(96):
+        op = ops[int(rng.integers(4))]
+        operands = tuple(int(x) for x in rng.choice(
+            per_tenant, size=int(rng.integers(2, 6)), replace=False))
+        sid = int(rng.integers(s))
+        reqs.append(ServingRequest(sid, BatchQuery(op, operands),
+                                   tenant=f"t{sid}"))
+        shapes.add((sid, op, operands))
+    checks: dict = {"distinct_shapes": len(shapes) >= 32}
+
+    # unwarmed control: the same trace through a lattice-free engine
+    rt_lattice.deactivate()
+    control = MultiSetBatchEngine.from_bitmap_sets(tenants,
+                                                   layout="dense")
+    want = [control._engines[r.set_id]._sequential_one(
+        r.query).cardinality for r in reqs]
+
+    engine = MultiSetBatchEngine.from_bitmap_sets(tenants,
+                                                  layout="dense")
+    loop = ServingLoop(engine, ServingPolicy(
+        pool_target=8, max_queue=4096, default_deadline_ms=600_000.0,
+        guard=guard.GuardPolicy(backoff_base=0.0, sleep=lambda _s: None)))
+    rep = loop.warmup(profile="q=8,;rows=8,;keys=1,;heads=both;pool=8,")
+    checks["sealed"] = bool(rep["lattice"]["sealed"])
+    m0 = misses()
+    tickets = loop.replay((i * 1e-3, r) for i, r in enumerate(reqs))
+    checks["all_served"] = all(t.ok for t in tickets)
+    checks["zero_new_compiles"] = misses() == m0
+    checks["zero_escapes"] = rt_lattice.escape_total() == 0
+    checks["bit_exact_vs_control"] = all(
+        t.ok and t.result.cardinality == w
+        for t, w in zip(tickets, want))
+    rt_lattice.deactivate()
+    ok = all(checks.values())
+    print(json.dumps({"smoke_lattice": checks,
+                      "compiled_points": rep["lattice"]["compiled"],
+                      "ok": ok}))
+    return 0 if ok else 1
+
+
 def mutation_smoke() -> int:
     """Mutation-subsystem smoke (ISSUE 12, docs/MUTATION.md): (a) a
     random in-place delta is bit-exact vs the host oracle across
@@ -547,6 +615,11 @@ def main() -> int:
                          "patch + escalated repack, exact result-cache "
                          "invalidation, balanced ledger, nothing "
                          "silent; exit 1 on violation)")
+    ap.add_argument("--smoke-lattice", action="store_true",
+                    help="first run the closed-lattice smoke (warmed "
+                         "diverse-tenant replay compiles zero programs, "
+                         "zero escapes, bit-exact vs unwarmed control; "
+                         "exit 1 on violation)")
     args = ap.parse_args()
 
     if args.smoke_sharded:
@@ -563,6 +636,10 @@ def main() -> int:
             return rc
     if args.smoke_mutation:
         rc = mutation_smoke()
+        if rc:
+            return rc
+    if args.smoke_lattice:
+        rc = lattice_smoke()
         if rc:
             return rc
 

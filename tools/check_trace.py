@@ -69,7 +69,17 @@ and a wide aggregation — then (2) validates every emitted line:
   twins with per-shard predicted bytes.  On arbitrary dumps the
   ``batch.shard`` / ``sharded.memory`` event schemas are validated
   wherever they appear (presence is a --workload-only demand, the PR 5
-  convention).
+  convention);
+- closed-lattice semantics (ISSUE 13, docs/LATTICE.md): the
+  ``lattice.warmup`` span tags (positive ``points``, a ``profile``
+  string, ``sealed=true``, a ``compiled`` count) and every
+  ``lattice.escape`` event's schema (``site`` / ``engine`` /
+  ``in_vocabulary`` / ``compile_ms``) are validated on arbitrary
+  dumps, plus range checks on the memory events'
+  ``lattice_padding_fraction``; the --workload run warms a small
+  vocabulary and then forces ONE deliberate out-of-lattice query,
+  asserting it executes bit-exactly, emits a traced escape, AND moves
+  ``rb_lattice_escapes_total`` — an escape is never silent.
 
 Validation-only mode (``python tools/check_trace.py <path>``) checks an
 existing dump, e.g. one captured from a serving process.
@@ -164,6 +174,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _expr_semantics([s for _, s in spans])
         errors += _serving_semantics([s for _, s in spans])
         errors += _mutation_semantics([s for _, s in spans])
+        errors += _lattice_semantics([s for _, s in spans])
     return errors
 
 
@@ -243,6 +254,66 @@ def _workload_semantics(spans: list[dict],
     errors += _expr_semantics(spans, require=budget_semantics)
     errors += _serving_semantics(spans, require=budget_semantics)
     errors += _mutation_semantics(spans, require=budget_semantics)
+    errors += _lattice_semantics(spans, require=budget_semantics)
+    return errors
+
+
+def _lattice_semantics(spans: list[dict],
+                       require: bool = False) -> list[str]:
+    """Closed-lattice vocabulary (ISSUE 13, docs/LATTICE.md): validate
+    the ``lattice.warmup`` span tags and every ``lattice.escape``
+    event's schema wherever they appear; ``require`` (the --workload
+    run, which warms a lattice and then forces one deliberate
+    out-of-lattice query) additionally demands both exist — an escape
+    must be traced and metered, never silent."""
+    errors: list[str] = []
+    warmups = [s for s in spans if s.get("name") == "lattice.warmup"]
+    for s in warmups:
+        tags = s.get("tags", {})
+        if not isinstance(tags.get("points"), int) or tags["points"] < 1:
+            errors.append(f"lattice.warmup span without a positive "
+                          f"points tag: {tags!r}")
+        if not isinstance(tags.get("profile"), str):
+            errors.append(f"lattice.warmup span without a profile tag: "
+                          f"{tags!r}")
+        if tags.get("sealed") is not True:
+            errors.append(f"lattice.warmup span did not seal the "
+                          f"lattice: {tags!r}")
+        if not isinstance(tags.get("compiled"), int):
+            errors.append(f"lattice.warmup span without a compiled "
+                          f"count: {tags!r}")
+    escapes = [ev for s in spans for ev in s.get("events", [])
+               if ev.get("name") == "lattice.escape"]
+    for ev in escapes:
+        if not isinstance(ev.get("site"), str) or not ev["site"]:
+            errors.append(f"lattice.escape event without a site: {ev!r}")
+        if not isinstance(ev.get("engine"), str):
+            errors.append(f"lattice.escape event without an engine: "
+                          f"{ev!r}")
+        if not isinstance(ev.get("in_vocabulary"), bool):
+            errors.append(f"lattice.escape event without the "
+                          f"in_vocabulary verdict: {ev!r}")
+        if not isinstance(ev.get("compile_ms"), (int, float)) \
+                or ev["compile_ms"] < 0:
+            errors.append(f"lattice.escape event without a compile_ms "
+                          f"cost: {ev!r}")
+    # padding accounting rides the memory events of snapped dispatches
+    for s in spans:
+        for ev in s.get("events", []):
+            if "lattice_padding_fraction" not in ev:
+                continue
+            f = ev["lattice_padding_fraction"]
+            if not isinstance(f, (int, float)) or not 0.0 <= f <= 1.0:
+                errors.append(f"memory event with out-of-range "
+                              f"lattice_padding_fraction: {ev!r}")
+    if require:
+        if not warmups:
+            errors.append("no lattice.warmup span — the workload's "
+                          "lattice boot was not traced")
+        if not escapes:
+            errors.append("no lattice.escape event — the workload's "
+                          "deliberate out-of-lattice query was not "
+                          "traced")
     return errors
 
 
@@ -803,6 +874,46 @@ def run_workload(path: str) -> None:
                 t.request.query)
             assert t.result.cardinality == ref.cardinality, \
                 "serving result diverged from the sequential reference"
+
+        # closed-lattice lane (ISSUE 13): warm a small vocabulary on a
+        # FRESH engine (lattice.warmup span), serve an in-lattice batch
+        # compile-free, then force ONE deliberate out-of-lattice query
+        # — it must execute bit-exactly, emit a lattice.escape event,
+        # and move rb_lattice_escapes_total (traced AND metered, never
+        # silent; the semantics checks above pin both schemas)
+        from roaringbitmap_tpu.obs import metrics as obs_metrics
+        from roaringbitmap_tpu.runtime import lattice as rt_lattice
+
+        def lattice_escape_metric() -> int:
+            return int(sum(
+                inst.value for name, _l, inst
+                in obs_metrics.REGISTRY.instruments()
+                if name == "rb_lattice_escapes_total"))
+
+        lat_eng = BatchEngine.from_bitmaps(mut_bms, layout="dense")
+        try:
+            lat_eng.warmup(
+                profile="q=8,;rows=8,;keys=1,;heads=both;pool=8,")
+            in_lattice = [BatchQuery("or", (0, 1)),
+                          BatchQuery("and", (1, 2, 3))]
+            got_in = [r.cardinality for r in lat_eng.execute(in_lattice)]
+            ref_in = [r.cardinality
+                      for r in lat_eng._execute_sequential(in_lattice)]
+            assert got_in == ref_in, "in-lattice batch diverged"
+            assert rt_lattice.escape_total() == 0, \
+                "in-lattice traffic escaped"
+            e0 = lattice_escape_metric()
+            # 9 same-op queries > the q=8 rung: out of vocabulary
+            oov = [BatchQuery("or", (0, 1)) for _ in range(9)]
+            got_oov = [r.cardinality for r in lat_eng.execute(oov)]
+            ref_oov = [r.cardinality
+                       for r in lat_eng._execute_sequential(oov)]
+            assert got_oov == ref_oov, "out-of-lattice batch diverged"
+            assert lattice_escape_metric() > e0, \
+                "out-of-lattice compile was not metered on " \
+                "rb_lattice_escapes_total"
+        finally:
+            rt_lattice.deactivate()
     finally:
         obs.disable()
 

@@ -1116,6 +1116,210 @@ def warm_restart_cell_main() -> None:
         "cache_entries": n_entries}))
 
 
+def pod_phase() -> dict:
+    """Pod-scale serving lane (ISSUE 14, docs/POD.md): two cells.
+
+    (a) A SIMULATED 2-host pod in an 8-device dry-run subprocess —
+    pod-vs-single routed QPS over one request stream, the consistent-
+    routing overhead per request, and the host-drop recovery wall (fail
+    a host with tickets queued, measure until every affected ticket
+    re-served from the replica — the ``reroute`` rung's price).
+
+    (b) A REAL 2-process cluster (jax.distributed over localhost, the
+    tests/test_multihost.py harness): each process serves exactly its
+    routed partition of one fixed stream; the aggregate QPS of the two
+    OS processes against a 1-process control is the routing-partitioned
+    scale-out the pod front door buys on ANY backend (cross-process
+    collective dispatch itself needs a TPU pod — the standing debt)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--pod-cell"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            timeout=1200, env=_dryrun_env(8),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        out = {"error": f"pod cell failed: {type(e).__name__}: {e}"}
+    out["cluster2"] = pod_cluster_probe()
+    return out
+
+
+def pod_cell_main() -> None:
+    """Subprocess body for pod_phase's simulated cells (8 CPU devices)."""
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.parallel import (BatchQuery, DeviceBitmapSet,
+                                            MultiSetBatchEngine, podmesh)
+    from roaringbitmap_tpu.runtime import guard
+    from roaringbitmap_tpu.serving import (PodFrontDoor, ServingLoop,
+                                           ServingPolicy, ServingRequest)
+
+    rng = np.random.default_rng(0x90D2)
+    s = 3
+    sets = [DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 17, 1500).astype(np.uint32)))
+        for _ in range(6)], layout="dense") for _ in range(s)]
+    shapes = [("or", (0, 1, 2)), ("and", (1, 2, 3)), ("xor", (0, 2, 4)),
+              ("andnot", (0, 1, 3)), ("or", (3, 4)), ("and", (0, 5))]
+
+    def requests(n, seed):
+        r = np.random.default_rng(seed)
+        return [ServingRequest(
+            int(r.integers(s)),
+            BatchQuery(*shapes[int(r.integers(len(shapes)))]),
+            tenant=f"t{int(r.integers(s))}") for _ in range(n)]
+
+    def policy():
+        return ServingPolicy(
+            pool_target=8, default_deadline_ms=600_000.0, max_queue=4096,
+            guard=guard.GuardPolicy(backoff_base=0.0,
+                                    sleep=lambda _s: None))
+
+    n = 192
+    single = ServingLoop(MultiSetBatchEngine(sets), policy())
+    single.replay((0.0, r) for r in requests(n, 5))       # warm
+    t0 = time.perf_counter()
+    ts = single.replay((0.0, r) for r in requests(n, 6))
+    single_qps = sum(t.ok for t in ts) / (time.perf_counter() - t0)
+    pod = podmesh.PodMesh.simulate(2)
+    # skewed rates: tenant 0 lands in the replicated-N regime, so the
+    # host-drop cell below exercises the replica path, not the single
+    # demotion
+    plan = podmesh.place(sets, pod, qps=[8.0, 1.0, 1.0])
+    fd = PodFrontDoor(sets, pod=pod, plan=plan, policy=policy())
+    fd.replay((0.0, r) for r in requests(n, 5))           # warm
+    t0 = time.perf_counter()
+    ts = fd.replay((0.0, r) for r in requests(n, 6))
+    pod_qps = sum(t.ok for t in ts) / (time.perf_counter() - t0)
+    assert all(t.ok for t in ts), "pod replay left non-served tickets"
+    t0 = time.perf_counter()
+    reps = 4096
+    for i in range(reps):
+        podmesh.route(plan, i % s, (0, 1))
+    route_us = (time.perf_counter() - t0) / reps * 1e6
+    # host-drop recovery: queue the replicated tenant's traffic on its
+    # routed host, drop that host, measure the wall until every ticket
+    # re-served from the replica (cold-replica compiles included — that
+    # IS the recovery price a real incident pays)
+    victim = fd.owner_host(0)
+    drop = [fd.submit(ServingRequest(0, BatchQuery(*shapes[i % 4]),
+                                     tenant="t0")) for i in range(24)]
+    t0 = time.perf_counter()
+    fd.fail_host(victim)
+    fd.drain()
+    recovery_ms = (time.perf_counter() - t0) * 1e3
+    assert all(t.ok for t in drop), "host-drop left non-served tickets"
+    print(json.dumps({
+        "tenants": s, "hosts": 2,
+        "regimes": plan.regime_counts(),
+        "single_qps": round(single_qps, 1),
+        "pod_qps": round(pod_qps, 1),
+        "pod_vs_single_x": round(pod_qps / max(single_qps, 1e-9), 3),
+        "route_us": round(route_us, 3),
+        "host_drop_recovery_ms": round(recovery_ms, 1),
+        "reroutes": fd.stats["reroutes"],
+        "note": ("simulated pod on one process: virtual hosts share "
+                 "the machine, QPS measures routing overhead, not "
+                 "scale-out")}))
+
+
+def pod_cluster_probe() -> dict:
+    """The 2-process cluster cell: two jax.distributed workers each
+    serving their routed partition of one fixed stream, against a
+    1-process control serving all of it."""
+    import socket
+
+    def free_port() -> int:
+        with socket.socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            return sock.getsockname()[1]
+
+    def run_workers(nproc: int):
+        port = free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--pod-worker",
+             str(i), str(port), str(nproc)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=_dryrun_env(1),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+            for i in range(nproc)]
+        rows = []
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            if p.returncode != 0:
+                raise RuntimeError(f"pod worker rc={p.returncode}")
+            rows.append(json.loads(
+                out.decode().strip().splitlines()[-1]))
+        return rows
+
+    try:
+        pair = run_workers(2)
+        solo = run_workers(1)[0]
+        agg_qps = round(sum(r["served"] for r in pair)
+                        / max(max(r["wall_s"] for r in pair), 1e-9), 1)
+        return {
+            "bringup_ms": [r["bringup_ms"] for r in pair],
+            "served_per_host": [r["served"] for r in pair],
+            "pod2_qps": agg_qps,
+            "single_qps": solo["qps"],
+            "cluster2_vs_single_x": round(
+                agg_qps / max(solo["qps"], 1e-9), 3),
+            "routes_agree": pair[0]["routes"] == pair[1]["routes"],
+        }
+    except Exception as e:
+        return {"error": f"cluster cell failed: {type(e).__name__}: {e}"}
+
+
+def pod_worker_main(pid: int, port: str, nproc: int) -> None:
+    """Subprocess body for pod_cluster_probe: join the cluster, build
+    the shared tenant universe, serve exactly this host's routed share
+    of the fixed stream."""
+    t_boot = time.perf_counter()
+    if nproc > 1:
+        from roaringbitmap_tpu.parallel import multihost
+
+        multihost.initialize(f"127.0.0.1:{port}", num_processes=nproc,
+                             process_id=pid)
+    bringup_ms = (time.perf_counter() - t_boot) * 1e3
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.parallel import (BatchQuery, DeviceBitmapSet,
+                                            podmesh)
+    from roaringbitmap_tpu.runtime import guard
+    from roaringbitmap_tpu.serving import (PodFrontDoor, ServingPolicy,
+                                           ServingRequest)
+
+    rng = np.random.default_rng(0x90D3)
+    s = 4
+    sets = [DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 16, 900).astype(np.uint32)))
+        for _ in range(6)], layout="dense") for _ in range(s)]
+    pod = (podmesh.PodMesh.detect() if nproc > 1
+           else podmesh.PodMesh.simulate(1))
+    plan = podmesh.place(sets, pod)
+    fd = PodFrontDoor(sets, pod=pod, plan=plan, policy=ServingPolicy(
+        pool_target=8, default_deadline_ms=600_000.0, max_queue=4096,
+        guard=guard.GuardPolicy(backoff_base=0.0, sleep=lambda _s: None)))
+    shapes = [("or", (0, 1, 2)), ("and", (1, 2, 3)), ("xor", (0, 2)),
+              ("andnot", (0, 1, 3))]
+    reqs = [ServingRequest(i % s, BatchQuery(*shapes[i % len(shapes)]),
+                           tenant=f"t{i % s}") for i in range(240)]
+    mine = [r for r in reqs
+            if fd.owner_host(r.set_id) in fd._loops]
+    for r in mine[:32]:
+        fd.submit(r)
+    fd.drain()                                            # warm
+    t0 = time.perf_counter()
+    tickets = [fd.submit(r) for r in mine]
+    fd.drain()
+    wall = time.perf_counter() - t0
+    assert all(t.ok for t in tickets), "pod worker left non-served"
+    print(json.dumps({
+        "pid": pid, "bringup_ms": round(bringup_ms, 1),
+        "served": len(mine), "wall_s": round(wall, 4),
+        "qps": round(len(mine) / max(wall, 1e-9), 1),
+        "routes": [str(fd.owner_host(i)) for i in range(s)]}))
+
+
 #: hard byte cap on the final stdout summary line.  The driver captures a
 #: BOUNDED tail of stdout (ADVICE r5: the r05 summary still came back
 #: "parsed": null with the JSON head truncated), so the line must fit a
@@ -1130,7 +1334,7 @@ SUMMARY_MAX_BYTES = 2048
 #: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
 #: they are trend inputs for the sentry, not driver-gate fields, and the
 #: full doc always keeps them
-SUMMARY_DROP_ORDER = ("phase_ms", "cost", "lattice", "mutation",
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "pod", "lattice", "mutation",
                       "serving", "sharded", "expression",
                       "marginal_us_spread", "multiset", "batched_qps",
                       "marginal_us_median", "unit", "backend",
@@ -1283,6 +1487,18 @@ def build_summary(out: dict, full_path: str) -> dict:
     la = out.get("lattice") or {}
     if la.get("headline"):
         s["lattice"] = dict(la["headline"])
+    # pod lane, compact: routed-vs-single QPS, routing overhead,
+    # host-drop recovery, and the 2-process cluster scale-out ratio
+    # (bench.py pod_phase, docs/POD.md)
+    po = out.get("pod") or {}
+    if "pod_vs_single_x" in po:
+        po_lane = {"pod_vs_single_x": po["pod_vs_single_x"],
+                   "route_us": po["route_us"],
+                   "host_drop_recovery_ms": po["host_drop_recovery_ms"]}
+        c2 = po.get("cluster2") or {}
+        if "cluster2_vs_single_x" in c2:
+            po_lane["cluster2_vs_single_x"] = c2["cluster2_vs_single_x"]
+        s["pod"] = po_lane
     return s
 
 
@@ -1395,6 +1611,12 @@ def main() -> None:
                          "dry-run subprocess and exit")
     ap.add_argument("--warm-restart-cell", action="store_true",
                     help="internal: one warm-restart probe run and exit")
+    ap.add_argument("--pod-cell", action="store_true",
+                    help="internal: run the simulated-pod cells in a "
+                         "CPU dry-run subprocess and exit")
+    ap.add_argument("--pod-worker", nargs=3, metavar=("PID", "PORT", "N"),
+                    help="internal: one pod-cluster worker (process id, "
+                         "coordinator port, process count) and exit")
     args = ap.parse_args()
 
     if args.spread_cell:
@@ -1405,6 +1627,13 @@ def main() -> None:
         return
     if args.warm_restart_cell:
         warm_restart_cell_main()
+        return
+    if args.pod_worker:
+        pod_worker_main(int(args.pod_worker[0]), args.pod_worker[1],
+                        int(args.pod_worker[2]))
+        return
+    if args.pod_cell:
+        pod_cell_main()
         return
 
     # stdout hygiene: everything during the run (library prints, warnings
@@ -1446,6 +1675,7 @@ def main() -> None:
     sharded = sharded_phase()
     mutation = mutation_phase()
     lattice = lattice_phase()
+    pod = pod_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
     # exactly once.  A single steady-state marginal at VMEM-resident
@@ -1503,6 +1733,7 @@ def main() -> None:
     out["sharded"] = sharded
     out["mutation"] = mutation
     out["lattice"] = lattice
+    out["pod"] = pod
 
     # full document to disk; stdout gets ONLY the compact summary as its
     # final line (the driver's bounded tail capture must parse it)

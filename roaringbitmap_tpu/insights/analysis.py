@@ -542,6 +542,95 @@ def predict_sharded_dispatch_bytes(bucket_sigs: list, pool_rows: int,
     }
 
 
+# ----------------------------------------------------- pod placement model
+
+def plan_pod_placement(tenant_bytes, n_hosts: int,
+                       budget_per_host: int | None = None,
+                       qps=None, replicate_max_bytes: int = 64 << 20,
+                       hot_share_x: float = 2.0) -> dict:
+    """Pure tenant->host placement math for the pod data plane
+    (parallel.podmesh / docs/POD.md) — the footprint-model extension of
+    PR 7's two-regime ``placement="auto"`` split to three regimes over
+    ``n_hosts`` hosts.  Deterministic in its inputs (every pod host
+    computes the identical plan without coordination).
+
+    - ``tenant_bytes[i]``: resident footprint of tenant ``i``
+      (:func:`resident_set_bytes` / :func:`predict_resident_bytes`);
+    - ``budget_per_host``: per-host HBM budget (None = unknown);
+    - ``qps[i]``: observed query rate (any proportional unit; the
+      serving loop's per-tenant admission counters are the natural
+      feed).  None or all-zero = no rate data, nothing replicates.
+
+    Regimes, judged in order per tenant:
+
+    1. **sharded** — ``bytes > capacity_threshold`` where the threshold
+       is half the per-host budget when one resolves (a tenant that
+       would dominate a host's HBM belongs on the pod-spanning mesh),
+       else ``replicate_max_bytes``;
+    2. **replicated-N** — rate share >= ``hot_share_x`` × the uniform
+       share AND small enough to copy (``<= replicate_max_bytes``):
+       N = ``clamp(ceil(share * n_hosts) + 1, 2, n_hosts)`` full copies
+       so the hot tenant's traffic spreads without a cross-host hop;
+    3. **local** — greedy least-loaded byte balancing (descending size
+       first-fit, ties to the lowest host id).
+
+    Returns ``{"regimes", "hosts", "bytes_per_host", "over_budget",
+    "capacity_threshold"}``; a single-host pod degenerates to
+    ``local`` everywhere (nothing to spread).
+    """
+    t_bytes = [int(b) for b in tenant_bytes]
+    n_hosts = max(1, int(n_hosts))
+    s = len(t_bytes)
+    cap = (int(budget_per_host) // 2 if budget_per_host
+           else int(replicate_max_bytes))
+    shares = None
+    if qps is not None and s:
+        q = [max(0.0, float(x)) for x in qps]
+        total = sum(q)
+        if total > 0:
+            shares = [x / total for x in q]
+    regimes = ["local"] * s
+    hosts: list = [()] * s
+    loads = [0] * n_hosts
+    if n_hosts > 1:
+        for sid in range(s):
+            if t_bytes[sid] > cap:
+                regimes[sid] = "sharded"
+            elif (shares is not None
+                  and shares[sid] >= hot_share_x / s
+                  and t_bytes[sid] <= replicate_max_bytes):
+                ceil_share = int(shares[sid] * n_hosts)
+                if shares[sid] * n_hosts > ceil_share:
+                    ceil_share += 1
+                n = min(n_hosts, max(2, ceil_share + 1))
+                regimes[sid] = f"replicated-{n}"
+    for sid in range(s):
+        if regimes[sid] == "sharded":
+            hosts[sid] = tuple(range(n_hosts))
+            share = t_bytes[sid] // n_hosts
+            loads = [b + share for b in loads]
+
+    def assign(sid, n_copies):
+        order = sorted(range(n_hosts), key=lambda h: (loads[h], h))
+        picked = tuple(sorted(order[:n_copies]))
+        for h in picked:
+            loads[h] += t_bytes[sid]
+        hosts[sid] = picked
+
+    by_size = sorted(range(s), key=lambda i: (-t_bytes[i], i))
+    for sid in by_size:
+        if regimes[sid].startswith("replicated"):
+            assign(sid, int(regimes[sid].split("-")[1]))
+    for sid in by_size:
+        if regimes[sid] == "local":
+            assign(sid, 1)
+    over = bool(budget_per_host
+                and any(b > int(budget_per_host) for b in loads))
+    return {"regimes": regimes, "hosts": [list(h) for h in hosts],
+            "bytes_per_host": loads, "over_budget": over,
+            "capacity_threshold": cap}
+
+
 # ------------------------------------------------- adaptive layout default
 #
 # The uscensus2000 cliff (docs/USCENSUS2000_CLIFF.md) is a LAYOUT

@@ -23,18 +23,25 @@ with two coupled halves:
   HBM-ledger-accounted) instead of re-executed reduces.  Version-bumped
   leaves invalidate exactly their dependent entries via a leaf -> entry
   index.
+- :mod:`.maintenance` — **async maintenance worker**: escalated repacks
+  queue to a per-host daemon thread (``apply_delta(..., worker=w)`` ->
+  ``mode="repack_queued"``, deferred commit) instead of stalling the
+  serving pump; the pre-delta image serves bit-exactly until the commit
+  lands and the engines re-sync.
 
 See docs/MUTATION.md for the operator-facing contract (delta API,
 versioning rules, invalidation semantics, repack escalation).
 """
 
 from .delta import apply_delta, drift_report, host_bitmaps, repack_in_place
+from .maintenance import MaintenanceWorker
 from .result_cache import (ENV_RESULT_CACHE, ResultCache, from_env,
                            node_key, notify_version_bump, query_key,
                            serve_and_fill)
 
 __all__ = [
     "apply_delta", "drift_report", "host_bitmaps", "repack_in_place",
+    "MaintenanceWorker",
     "ENV_RESULT_CACHE", "ResultCache", "from_env", "node_key",
     "notify_version_bump", "query_key", "serve_and_fill",
 ]

@@ -51,6 +51,7 @@ same patch one-shard-wide instead of re-placing the pool.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -340,7 +341,7 @@ def drift_report(ds, drift_limit: int | None = None) -> dict:
 
 
 def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
-                drift_limit: int | None = None) -> dict:
+                drift_limit: int | None = None, worker=None) -> dict:
     """Mutate a resident ``DeviceBitmapSet`` at segment granularity.
 
     ``adds`` / ``removes`` map source index -> u32 values (a value in
@@ -349,6 +350,14 @@ def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
     delta that would need one; ``"always"`` forces the full repack
     path.  Returns a JSON-able report (mode, version, rows_patched,
     repack_reason, wall_ms, drift).
+
+    ``worker`` (a ``mutation.maintenance.MaintenanceWorker``) moves an
+    escalated repack OFF this thread: the call returns immediately with
+    ``mode="repack_queued"`` and the set keeps serving the pre-delta
+    image bit-exactly until the worker commits (deferred commit — the
+    job re-reads the then-current host sources, so interleaved value
+    patches are never lost; ``worker.drain()`` is the barrier).  In-
+    place patches never queue — they are the fast path already.
     """
     if repack not in ("auto", "never", "always"):
         raise ValueError(f"unknown repack policy {repack!r}")
@@ -420,6 +429,17 @@ def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
             else:
                 ds._host_cache = None
             mode, rows_patched = "patch", int(rows.size)
+        elif worker is not None:
+            # deferred commit (docs/MUTATION.md "Async maintenance"):
+            # the job recomputes the post-delta sources against the
+            # THEN-current state, so value patches that land between
+            # queue and commit survive; invalidation happens at commit.
+            # Escalations accumulate per set and one commit drains them
+            # all — a burst of M escalating deltas pays ONE repack wall,
+            # not M (only the first queues a job; later ones ride it).
+            _queue_escalation(ds, worker, adds, removes, reason,
+                              set(touched))
+            mode, rows_patched = "repack_queued", 0
         else:
             hosts = _host_apply(host_bitmaps(ds), adds, removes)
             repack_in_place(ds, hosts, reason=reason,
@@ -428,7 +448,8 @@ def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
 
         from . import result_cache
 
-        dropped = result_cache.notify_version_bump(ds.uid, touched)
+        dropped = (0 if mode == "repack_queued" else
+                   result_cache.notify_version_bump(ds.uid, touched))
         wall = time.perf_counter() - t0
         obs_metrics.histogram("rb_delta_apply_seconds",
                               mode=mode).observe(wall)
@@ -440,6 +461,44 @@ def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
                 "rows_patched": rows_patched, "values_added": n_add,
                 "values_removed": n_rem, "repack_reason": reason,
                 "wall_ms": round(wall * 1e3, 3), "drift": drift}
+
+
+def _queue_escalation(ds, worker, adds, removes, reason, touched) -> None:
+    """Accumulate one escalated delta on the set's pending list and
+    queue the commit job if none is riding — the job drains the WHOLE
+    list at commit time against the then-current host sources (deltas
+    applied in arrival order, adds-first/removes-win per delta), runs
+    one combined ``repack_in_place``, and invalidates once.  An append
+    racing a drain either lands in the popped batch or queues the next
+    job — never lost, never doubled (the pending-list lock decides)."""
+    pend = getattr(ds, "_pending_escalations", None)
+    if pend is None:
+        pend = ds._pending_escalations = []
+        ds._pending_escalations_lock = threading.Lock()
+    with ds._pending_escalations_lock:
+        pend.append((adds, removes, reason, touched))
+        first = len(pend) == 1
+    if not first:
+        return
+
+    def _commit():
+        from . import result_cache as rc
+
+        with ds._pending_escalations_lock:
+            batch = list(ds._pending_escalations)
+            ds._pending_escalations.clear()
+        if not batch:
+            return
+        hosts = host_bitmaps(ds)
+        t_all: set = set()
+        for a, r, _why, t_set in batch:
+            hosts = _host_apply(hosts, a, r)
+            t_all |= t_set
+        repack_in_place(ds, hosts, reason=batch[-1][2], touched=t_all)
+        rc.notify_version_bump(ds.uid, t_all)
+
+    worker.submit(_commit, kind="repack",
+                  desc=f"uid={ds.uid} reason={reason}")
 
 
 def _patch_rows(ds, rows, add_m, rem_m) -> None:

@@ -1,0 +1,161 @@
+"""Async maintenance worker: escalated repacks off the serving path.
+
+PR 12's named debt: ``apply_delta`` ran the escalated full repack
+(structural adds, non-dense layouts, layout drift) synchronously — a
+~second-scale ``ingest_compile_ms_one_time`` wall INSIDE the mutation
+call, stalling whatever thread drives the serving pump.  This module
+moves it to a per-host maintenance thread, the production shape
+docs/MUTATION.md always named.
+
+Semantics: **deferred commit**.  ``apply_delta(..., worker=w)`` on an
+escalating delta records the delta on the set's pending list, enqueues
+the repack job (only the first of a burst queues one — later
+escalations ride it, so M escalating deltas pay ONE repack wall), and
+returns ``mode="repack_queued"`` — the set's ``version`` does NOT bump
+yet.  The commit recomputes the post-delta host sources AT COMMIT TIME
+(then-current state, pending deltas applied in arrival order), which is
+what makes interleaved value patches safe.
+Until the worker commits, every engine keeps serving the PRE-delta
+image, which is bit-exact at the pre-delta version: the version-keyed
+plan/result caches make a stale mix impossible, and value deltas keep
+patching + journal-replaying through the same machinery as ever.  The
+commit (on the worker thread) runs ``repack_in_place`` + the result-
+cache invalidation exactly like the synchronous path, bumps
+``version``/``structure_version``, and the engines' existing
+``_sync_with_ds`` / ``_sync_pool`` machinery picks the new layout up on
+their next plan.  ``worker.drain()`` is the barrier (tests, graceful
+shutdown).
+
+Thread safety: jobs run one at a time on the worker thread; passing the
+serving loop's lock (``MaintenanceWorker(lock=loop._lock)`` — what the
+pod front door does per host) serializes commits against that loop's
+pump, so a repack never rewrites a layout mid-plan.  A job that raises
+is recorded (``last_error``, ``rb_maintenance_failures_total``) and the
+queue keeps moving — a failed repack leaves the pre-delta image serving,
+typed and visible, never a torn state.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+_log = logging.getLogger("roaringbitmap_tpu.mutation")
+
+SITE = "maintenance"
+
+
+class MaintenanceWorker:
+    """One daemon maintenance thread + job queue (escalated repacks;
+    any zero-argument callable is accepted)."""
+
+    def __init__(self, lock=None, start: bool = True,
+                 name: str = "rb-maintenance"):
+        self._queue: queue_mod.Queue = queue_mod.Queue()
+        self._lock = lock
+        self._stop = threading.Event()
+        self._idle = threading.Condition()
+        #: jobs submitted but not yet finished — counted at submit()
+        #: and decremented after the job runs, so pending() can never
+        #: read 0 in the window between a dequeue and the job body
+        #: (the drain() barrier depends on that)
+        self._pending = 0
+        self.jobs_done = 0
+        self.jobs_failed = 0
+        self.last_error: Exception | None = None
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        if start:
+            self._thread.start()
+
+    # -------------------------------------------------------------- API
+
+    def submit(self, job, kind: str = "repack", desc: str = "") -> None:
+        """Enqueue one maintenance job (runs in submission order)."""
+        with self._idle:
+            self._pending += 1
+        self._queue.put((job, kind, desc))
+        obs_metrics.counter("rb_maintenance_jobs_total",
+                            kind=kind).inc()
+        obs_metrics.gauge("rb_maintenance_queue_depth").set(
+            self.pending())
+
+    def pending(self) -> int:
+        return self._pending
+
+    def drain(self, timeout: float = 60.0) -> int:
+        """Block until every queued job committed (the mutation
+        barrier); returns the number of jobs completed so far.  When the
+        worker thread is not running (``start=False`` — deterministic
+        single-threaded tests), the queue is processed inline on the
+        caller's thread instead."""
+        if not self._thread.is_alive():
+            while not self._queue.empty():
+                item = self._queue.get()
+                try:
+                    self._run_one(*item)
+                finally:
+                    with self._idle:
+                        self._pending -= 1
+            return self.jobs_done
+        deadline = time.monotonic() + timeout
+        with self._idle:
+            while self.pending() and time.monotonic() < deadline:
+                self._idle.wait(0.01)
+        if self.pending():
+            raise TimeoutError(
+                f"{SITE}: {self.pending()} job(s) still pending after "
+                f"{timeout:g}s")
+        return self.jobs_done
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        if drain and self._thread.is_alive():
+            self.drain(timeout=timeout)
+        self._stop.set()
+        self._queue.put(None)     # wake the thread
+        if self._thread.is_alive():
+            self._thread.join(timeout=10.0)
+
+    # ---------------------------------------------------------- internals
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                continue
+            try:
+                self._run_one(*item)
+            finally:
+                with self._idle:
+                    self._pending -= 1
+                    self._idle.notify_all()
+                obs_metrics.gauge("rb_maintenance_queue_depth").set(
+                    self.pending())
+
+    def _run_one(self, job, kind: str, desc: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            if self._lock is not None:
+                with self._lock:
+                    job()
+            else:
+                job()
+            self.jobs_done += 1
+            obs_trace.current().event(
+                "mutation.maintenance", site=SITE, kind=kind, desc=desc,
+                wall_ms=round((time.perf_counter() - t0) * 1e3, 2),
+                ok=True)
+        except Exception as exc:   # stay alive; stay visible
+            self.jobs_failed += 1
+            self.last_error = exc
+            obs_metrics.counter("rb_maintenance_failures_total",
+                                error_class=type(exc).__name__).inc()
+            obs_trace.current().event(
+                "mutation.maintenance", site=SITE, kind=kind, desc=desc,
+                ok=False, error_class=type(exc).__name__)
+            _log.exception("%s: job %s (%s) failed", SITE, kind, desc)

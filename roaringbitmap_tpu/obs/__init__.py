@@ -59,6 +59,17 @@ def snapshot() -> dict:
     doc["trace"] = {"enabled": trace.enabled(), "path": trace.path()}
     doc["hbm"] = memory.LEDGER.snapshot()
     doc["cost"] = cost.TRACKER.snapshot()
+    # multihost bootstrap state (parallel.multihost: coordinator, host
+    # id, pre-flight probe latency — the slow-coordinator early
+    # warning).  Only when that module is already loaded: snapshot()
+    # must not drag the parallel package in for obs-only users.
+    import sys
+
+    mh = sys.modules.get("roaringbitmap_tpu.parallel.multihost")
+    if mh is not None:
+        info = mh.snapshot()
+        if info:
+            doc["multihost"] = info
     return doc
 
 

@@ -1,14 +1,15 @@
-from . import (aggregation, batch_engine, expr, multiset, sharded_engine,
-               sharding)
+from . import (aggregation, batch_engine, expr, multiset, podmesh,
+               sharded_engine, sharding)
 from .aggregation import DeviceBitmapSet
 from .batch_engine import BatchEngine, BatchQuery, BatchResult
 from .expr import ExprQuery
 from .multiset import BatchGroup, MultiSetBatchEngine
+from .podmesh import PlacementPlan, PodMesh
 from .sharded_engine import ShardedBatchEngine, default_mesh
 from .sharding import SPECS, SpecLayout
 
-__all__ = ["aggregation", "batch_engine", "expr", "multiset",
+__all__ = ["aggregation", "batch_engine", "expr", "multiset", "podmesh",
            "sharded_engine", "sharding", "DeviceBitmapSet", "BatchEngine",
            "BatchQuery", "BatchResult", "BatchGroup", "ExprQuery",
            "MultiSetBatchEngine", "ShardedBatchEngine", "default_mesh",
-           "SPECS", "SpecLayout"]
+           "SPECS", "SpecLayout", "PodMesh", "PlacementPlan"]

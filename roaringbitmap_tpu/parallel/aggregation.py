@@ -1064,7 +1064,7 @@ class DeviceBitmapSet:
     # ------------------------------------------------------------ mutation
 
     def apply_delta(self, adds=None, removes=None, repack: str = "auto",
-                    drift_limit: int | None = None) -> dict:
+                    drift_limit: int | None = None, worker=None) -> dict:
         """Mutate this resident set at segment granularity
         (roaringbitmap_tpu.mutation, docs/MUTATION.md).  ``adds`` /
         ``removes`` map source index -> u32 values; a dense-layout delta
@@ -1075,11 +1075,15 @@ class DeviceBitmapSet:
         dependent materialized-result cache entries.  Structural deltas
         (new container keys), non-dense layouts, and the layout-drift
         heuristic escalate to a full in-place repack (``layout="auto"``
-        re-resolved).  Returns the mutation report."""
+        re-resolved).  Returns the mutation report.  ``worker`` (a
+        ``mutation.maintenance.MaintenanceWorker``) defers an escalated
+        repack to the maintenance thread — ``mode="repack_queued"``,
+        pre-delta image serves bit-exactly until the commit."""
         from ..mutation import delta as mut_delta
 
         return mut_delta.apply_delta(self, adds, removes, repack=repack,
-                                     drift_limit=drift_limit)
+                                     drift_limit=drift_limit,
+                                     worker=worker)
 
     def host_bitmaps(self) -> list:
         """Version-fresh host copies of the resident sources (rebuilt

@@ -36,6 +36,21 @@ import numpy as np
 ENV_COORD_TIMEOUT = "ROARING_TPU_COORD_TIMEOUT_S"
 DEFAULT_COORD_TIMEOUT = 120.0
 
+#: last bootstrap's observable state (obs.snapshot()'s "multihost"
+#: section): coordinator address, process id, the pre-flight TCP probe's
+#: latency, and the outcome — a SLOW coordinator is visible here (and on
+#: the rb_multihost_probe_seconds gauge) before it ever times out
+_STATE: dict = {}
+
+
+def snapshot() -> dict:
+    """The last ``initialize`` attempt's state as plain JSON ({} when
+    never called): coordinator, process_id, probe_ms (pre-flight TCP
+    probe latency — the slow-coordinator early warning), timeout_s,
+    status ("probing" / "initializing" / "initialized" / "failed"),
+    and process_count once joined."""
+    return dict(_STATE)
+
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
@@ -73,6 +88,10 @@ def initialize(coordinator_address: str | None = None,
                 f"process_id {process_id if process_id is not None else '<auto>'}")
 
     deadline = time.monotonic() + timeout
+    _STATE.clear()
+    _STATE.update(coordinator=coordinator_address or "<auto-detected>",
+                  process_id=process_id, timeout_s=timeout,
+                  probe_ms=None, status="probing")
     with obs_trace.span(
             "multihost.initialize",
             coordinator=coordinator_address or "<auto-detected>",
@@ -91,6 +110,7 @@ def initialize(coordinator_address: str | None = None,
                                    describe, errors)
             import jax
 
+            _STATE["status"] = "initializing"
             # the handshake gets whatever the probe left of the ONE budget
             remaining = max(deadline - time.monotonic(), 1.0)
             kw = {}
@@ -113,9 +133,13 @@ def initialize(coordinator_address: str | None = None,
                 # protection.
                 jax.distributed.initialize(coordinator_address,
                                            num_processes, process_id)
+            _STATE.update(status="initialized",
+                          process_count=int(jax.process_count()))
         except errors.CoordinatorTimeout:
+            _STATE["status"] = "failed"
             raise
         except Exception as exc:
+            _STATE["status"] = "failed"
             fault = errors.classify(exc)
             if isinstance(fault, (errors.CoordinatorTimeout,
                                   errors.TransientDeviceError)):
@@ -136,15 +160,26 @@ def _probe_coordinator(address: str, timeout: float, deadline: float,
     host = host.strip("[]")   # bracketed IPv6 literals ([::1]:8476)
     if not host or not port_s.isdigit():
         return  # unparseable (unix socket, exotic scheme): let jax try
+    from ..obs import metrics as obs_metrics
+
+    t0 = time.monotonic()
     delay = 0.1
     while True:
         budget = deadline - time.monotonic()
         try:
             with socket.create_connection((host, int(port_s)),
                                           timeout=max(0.1, min(2.0, budget))):
+                probe_s = time.monotonic() - t0
+                # the slow-coordinator early warning: a probe that took
+                # most of its budget predicts a handshake that will too
+                _STATE["probe_ms"] = round(probe_s * 1e3, 3)
+                obs_metrics.gauge("rb_multihost_probe_seconds").set(
+                    probe_s)
                 return
         except OSError as exc:
             if time.monotonic() >= deadline:
+                _STATE["probe_ms"] = round(
+                    (time.monotonic() - t0) * 1e3, 3)
                 raise errors.CoordinatorTimeout(
                     f"multihost.initialize: {describe()} unreachable "
                     f"within {timeout:g}s: {exc}") from exc

@@ -1,0 +1,397 @@
+"""Pod-scale topology + tenant placement for the multi-host data plane.
+
+Everything through the sharded engine fits one process; "millions of
+users" does not.  This module is the topology half of the pod serving
+stack (ROADMAP item 2; ``serving.frontdoor`` is the traffic half): it
+joins ``multihost.initialize``'s process bootstrap with the
+``ShardedBatchEngine``'s mesh execution model, and decides **where
+tenants live**.
+
+Topology
+--------
+A :class:`PodMesh` is an ordered list of hosts, each owning a device
+group.  Two construction modes, one vocabulary:
+
+- **detected** (``PodMesh.detect()`` after ``multihost.initialize``):
+  one host per jax process, devices grouped by ``process_index`` — the
+  real pod.  Only the local host's devices are addressable; global
+  arrays are placed with :func:`global_put` (each host feeds exactly its
+  addressable shard — the pjit multi-process model, PAPERS.md §2).
+- **simulated** (``PodMesh.simulate(n)``): the visible devices are
+  partitioned into ``n`` host groups — the CPU dry-run twin, same
+  program text, used by the tests/bench/CI lanes exactly like PR 7's
+  virtual 8-device mesh.  ``ROARING_TPU_POD_HOSTS`` sets the default
+  simulated host count.
+
+``host_mesh(h)`` is one host's (rows x data) mesh; ``pod_mesh()`` spans
+every alive host (the capacity regime's mesh).  Collective dispatch over
+a detected multi-process mesh needs a backend with cross-process
+collectives (TPU pods; the CPU backend refuses — see
+:func:`supports_pod_dispatch`), so on the CI proxy the pod-spanning mesh
+is exercised through the simulated pod and the real-pod capture rides
+the standing TPU debt (docs/POD.md).
+
+Placement
+---------
+The container-partitioned layout (PAPERS.md [1]) is what makes placement
+cheap: a tenant is a contiguous block of 8 KiB rows, so it moves,
+replicates, and routes as a unit.  :func:`place` extends PR 7's
+``placement="auto"`` two-regime split with a third regime, per tenant:
+
+========================= =============================================
+regime                    meaning
+========================= =============================================
+``sharded``               capacity: the tenant's rows split across ALL
+                          hosts (the pod-spanning ShardedBatchEngine,
+                          ``placement="sharded"``) — bigger than one
+                          host's comfortable share
+``replicated-N``          throughput: a hot small tenant holds a full
+                          copy on N hosts, any of which serves it
+                          locally; N scales with its observed
+                          query-rate share (serving metrics)
+``local``                 the default: one host, chosen by greedy
+                          least-loaded byte balancing
+========================= =============================================
+
+The decision inputs are the HBM ledger / guard budget (per-host bytes)
+and the ``insights`` footprint model (``plan_pod_placement`` holds the
+pure math); the resulting :class:`PlacementPlan` is deterministic, and
+routing over it is **consistent**: :func:`route` rendezvous-hashes the
+tenant over its placement hosts, so losing a host only moves that
+host's tenants (docs/POD.md "Routing").
+
+Observability: ``pod.place`` spans, ``rb_pod_tenants{regime}`` /
+``rb_pod_placement_bytes{host}`` / ``rb_pod_hosts`` metrics; the
+front door adds the routing/reroute vocabulary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import zlib
+
+import numpy as np
+
+from ..insights import analysis as insights
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+#: the trace/metric site of pod placement + routing
+SITE = "pod"
+
+ENV_POD_HOSTS = "ROARING_TPU_POD_HOSTS"
+ENV_REPLICATE_MAX = "ROARING_TPU_POD_REPLICATE_MAX"
+ENV_HOT_SHARE = "ROARING_TPU_POD_HOT_SHARE"
+
+#: tenants larger than this never replicate (per-copy cost); also the
+#: capacity-regime threshold when no per-host budget resolves — the
+#: same 64 MiB knee the sharded engine's placement="auto" uses
+REPLICATE_MAX_BYTES = 64 << 20
+
+#: a tenant whose query-rate share is >= HOT_SHARE_X times the uniform
+#: share reads hot (replication candidate)
+HOT_SHARE_X = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInfo:
+    """One pod host: a device group owned by one process (detected) or
+    one slice of the visible devices (simulated)."""
+
+    host_id: int
+    process_index: int
+    devices: tuple
+    #: True when this process can address the host's devices (always in
+    #: a simulated pod; exactly one host in a detected pod)
+    local: bool
+
+
+class PodMesh:
+    """Ordered host list + liveness, the pod's topology handle.
+
+    Liveness is advisory (the front door marks hosts down on classified
+    host-loss faults and routing skips them); ``mark_up`` restores a
+    recovered host.  Meshes are built on demand from the CURRENT alive
+    set, so a pod-spanning mesh after a host loss covers the survivors.
+    """
+
+    def __init__(self, hosts: list, local_host: int = 0):
+        if not hosts:
+            raise ValueError("a pod needs at least one host")
+        self.hosts = list(hosts)
+        self.local_host = int(local_host)
+        self._down: set = set()
+
+    # ------------------------------------------------------- construction
+
+    @classmethod
+    def detect(cls, n_hosts: int | None = None) -> "PodMesh":
+        """The runtime's pod: one host per jax process when
+        ``multihost.initialize`` ran (devices grouped by
+        ``process_index``), else a simulated pod over the visible
+        devices (``n_hosts``, default ``ROARING_TPU_POD_HOSTS`` or 2)."""
+        import jax
+
+        if jax.process_count() > 1:
+            by_proc: dict[int, list] = {}
+            for d in jax.devices():
+                by_proc.setdefault(getattr(d, "process_index", 0),
+                                   []).append(d)
+            hosts = [HostInfo(h, pid, tuple(by_proc[pid]),
+                              local=(pid == jax.process_index()))
+                     for h, pid in enumerate(sorted(by_proc))]
+            local = next(h.host_id for h in hosts if h.local)
+            return cls(hosts, local_host=local)
+        if n_hosts is None:
+            n_hosts = int(os.environ.get(ENV_POD_HOSTS, "2"))
+        return cls.simulate(n_hosts)
+
+    @classmethod
+    def simulate(cls, n_hosts: int, devices=None) -> "PodMesh":
+        """An in-process pod: the visible devices partitioned into
+        ``n_hosts`` contiguous groups (every host addressable — the CPU
+        dry-run twin of a detected pod)."""
+        import jax
+
+        devices = list(devices if devices is not None else jax.devices())
+        n_hosts = int(n_hosts)
+        if n_hosts < 1 or n_hosts > len(devices):
+            raise ValueError(
+                f"cannot simulate {n_hosts} hosts over {len(devices)} "
+                f"devices")
+        per = len(devices) // n_hosts
+        hosts = [HostInfo(h, 0, tuple(devices[h * per:(h + 1) * per]),
+                          local=True)
+                 for h in range(n_hosts)]
+        return cls(hosts, local_host=0)
+
+    # ------------------------------------------------------------ liveness
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self.hosts)
+
+    def alive(self) -> tuple:
+        return tuple(h.host_id for h in self.hosts
+                     if h.host_id not in self._down)
+
+    def is_alive(self, host_id: int) -> bool:
+        return host_id not in self._down
+
+    def mark_down(self, host_id: int) -> None:
+        self._down.add(int(host_id))
+        self._push_gauges()
+
+    def mark_up(self, host_id: int) -> None:
+        self._down.discard(int(host_id))
+        self._push_gauges()
+
+    def _push_gauges(self) -> None:
+        obs_metrics.gauge("rb_pod_hosts", state="alive").set(
+            len(self.alive()))
+        obs_metrics.gauge("rb_pod_hosts", state="down").set(
+            len(self._down))
+
+    # -------------------------------------------------------------- meshes
+
+    def host_mesh(self, host_id: int, specs=None, data: int = 1):
+        """One host's (rows x data) mesh over its own device group —
+        what a per-host sharded engine runs on."""
+        from .sharded_engine import default_mesh
+
+        return default_mesh(list(self.hosts[host_id].devices),
+                            data=data,
+                            **({"specs": specs} if specs else {}))
+
+    def pod_mesh(self, specs=None, data: int = 1):
+        """The pod-spanning (rows x data) mesh over every ALIVE host's
+        devices, host-major ordered so each host's rows are contiguous
+        along the row axis (the butterfly's heavy traffic stays
+        host-pure wherever the factorization allows, the
+        ``multihost.global_mesh`` argument)."""
+        from .sharded_engine import default_mesh
+
+        devices = [d for h in self.hosts
+                   if h.host_id not in self._down for d in h.devices]
+        return default_mesh(devices, data=data,
+                            **({"specs": specs} if specs else {}))
+
+    def snapshot(self) -> dict:
+        return {"n_hosts": self.n_hosts,
+                "alive": list(self.alive()),
+                "down": sorted(self._down),
+                "local_host": self.local_host,
+                "devices_per_host": [len(h.devices) for h in self.hosts],
+                "multi_process": any(not h.local for h in self.hosts)}
+
+
+def supports_pod_dispatch() -> bool:
+    """Whether the backend can EXECUTE computations over a multi-process
+    mesh.  Single-process pods (simulated, or one-host detected) always
+    can; multi-process pods need cross-process collectives, which the
+    CPU backend does not implement ("Multiprocess computations aren't
+    implemented on the CPU backend") — there the capacity regime
+    demotes typed to per-host placement and the real pod-spanning
+    dispatch rides the standing TPU debt (docs/POD.md)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return True
+    return jax.default_backend() not in ("cpu",)
+
+
+def global_put(arr, sharding):
+    """Place a host array under ``sharding`` across the pod: plain
+    ``device_put`` in a single process; in a multi-process pod each host
+    feeds exactly its ADDRESSABLE shards via
+    ``jax.make_array_from_callback`` (the pjit multi-process note —
+    no host ever materializes another host's slice on device)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+# ------------------------------------------------------------- placement
+
+@dataclasses.dataclass(frozen=True)
+class PlacementPlan:
+    """One deterministic tenant->host assignment: ``regimes[sid]`` is
+    ``"sharded"`` / ``"replicated-N"`` / ``"local"``; ``hosts[sid]`` the
+    host ids holding that tenant (all hosts for the sharded regime)."""
+
+    regimes: tuple
+    hosts: tuple
+    bytes_per_host: tuple
+    over_budget: bool = False
+    capacity_threshold: int = 0
+    #: capacity tenants demoted to local because the backend cannot
+    #: dispatch over a multi-process mesh (CPU pod; typed, never silent)
+    demoted_capacity: tuple = ()
+
+    @property
+    def n_tenants(self) -> int:
+        return len(self.regimes)
+
+    def hosts_of(self, sid: int) -> tuple:
+        return self.hosts[sid]
+
+    def regime(self, sid: int) -> str:
+        return self.regimes[sid]
+
+    def sharded_sids(self) -> tuple:
+        return tuple(s for s, r in enumerate(self.regimes)
+                     if r == "sharded")
+
+    def regime_counts(self) -> dict:
+        out: dict = {}
+        for r in self.regimes:
+            key = r.split("-")[0]
+            out[key] = out.get(key, 0) + 1
+        return out
+
+    def table(self) -> dict:
+        """The routing table as plain JSON (snapshot / docs)."""
+        return {str(s): {"regime": self.regimes[s],
+                         "hosts": list(self.hosts[s])}
+                for s in range(self.n_tenants)}
+
+
+def tenant_bytes_of(sets) -> list:
+    """Per-tenant resident footprint, bytes — the insights model's
+    component walk over each resident set (``DeviceBitmapSet`` /
+    ``BatchEngine`` accepted)."""
+    out = []
+    for s in sets:
+        ds = getattr(s, "_ds", s)
+        out.append(int(sum(insights.resident_set_bytes(ds).values())))
+    return out
+
+
+def place(sets, pod: PodMesh, budget_per_host: int | None = None,
+          qps=None, replicate_max_bytes: int | None = None,
+          hot_share_x: float | None = None) -> PlacementPlan:
+    """Plan tenant placement over ``pod`` from the footprint model + the
+    per-host HBM budget + optional per-tenant query rates (the serving
+    metrics feed; ``None`` = no rate data, nothing replicates).
+
+    ``budget_per_host`` defaults to the guard's resolved HBM budget
+    (``ROARING_TPU_HBM_BUDGET`` / backend free memory); the pure
+    decision math is ``insights.plan_pod_placement``.  Emits the
+    ``pod.place`` span + ``rb_pod_*`` placement metrics."""
+    from ..runtime import guard
+
+    if budget_per_host is None:
+        budget_per_host = guard.resolve_hbm_budget()
+    if replicate_max_bytes is None:
+        replicate_max_bytes = int(os.environ.get(
+            ENV_REPLICATE_MAX, REPLICATE_MAX_BYTES))
+    if hot_share_x is None:
+        hot_share_x = float(os.environ.get(ENV_HOT_SHARE, HOT_SHARE_X))
+    t_bytes = tenant_bytes_of(sets)
+    with obs_trace.span("pod.place", site=SITE, hosts=pod.n_hosts,
+                        tenants=len(t_bytes)) as sp:
+        raw = insights.plan_pod_placement(
+            t_bytes, pod.n_hosts, budget_per_host=budget_per_host,
+            qps=qps, replicate_max_bytes=replicate_max_bytes,
+            hot_share_x=hot_share_x)
+        regimes = list(raw["regimes"])
+        hosts = [tuple(h) for h in raw["hosts"]]
+        demoted = []
+        loads = [int(b) for b in raw["bytes_per_host"]]
+        if "sharded" in regimes and not supports_pod_dispatch():
+            # a CPU multi-process pod cannot dispatch the pod-spanning
+            # mesh: demote capacity tenants to local placement, typed —
+            # they still serve (one host each), they just cannot span
+            for sid, r in enumerate(regimes):
+                if r != "sharded":
+                    continue
+                share = t_bytes[sid] // pod.n_hosts
+                loads = [b - share for b in loads]
+                anchor = min(range(pod.n_hosts), key=lambda h: loads[h])
+                loads[anchor] += t_bytes[sid]
+                regimes[sid] = "local"
+                hosts[sid] = (anchor,)
+                demoted.append(sid)
+        plan = PlacementPlan(
+            regimes=tuple(regimes), hosts=tuple(hosts),
+            bytes_per_host=tuple(loads),
+            over_budget=bool(raw["over_budget"]),
+            capacity_threshold=int(raw["capacity_threshold"]),
+            demoted_capacity=tuple(demoted))
+        counts = plan.regime_counts()
+        for regime in ("sharded", "replicated", "local"):
+            obs_metrics.gauge("rb_pod_tenants", regime=regime).set(
+                counts.get(regime, 0))
+        for h, b in enumerate(plan.bytes_per_host):
+            obs_metrics.gauge("rb_pod_placement_bytes",
+                              host=str(h)).set(b)
+        pod._push_gauges()
+        sp.tag(regimes=counts, over_budget=plan.over_budget,
+               capacity_threshold=plan.capacity_threshold,
+               bytes_per_host=list(plan.bytes_per_host),
+               demoted_capacity=len(demoted))
+    return plan
+
+
+# --------------------------------------------------------------- routing
+
+def route(plan: PlacementPlan, sid: int, alive, salt: int = 0) -> int | None:
+    """Consistent tenant routing: the rendezvous (highest-random-weight)
+    winner among the tenant's ALIVE placement hosts.  Deterministic
+    across processes (same plan + alive set => same answer everywhere —
+    the property that lets every host route without coordination), and
+    consistent under host loss: removing a host only re-routes the
+    tenants that host was serving.  ``None`` when no placement host is
+    alive (the front door's single-host demotion case)."""
+    alive = set(alive)
+    candidates = [h for h in plan.hosts_of(sid) if h in alive]
+    if not candidates:
+        return None
+    return max(candidates,
+               key=lambda h: (zlib.crc32(f"{sid}/{h}/{salt}".encode()),
+                              -h))

@@ -90,6 +90,7 @@ from ..runtime import lattice as rt_lattice
 from ..runtime import warmup as rt_warmup
 from ..runtime.cache import LRUCache
 from . import expr as expr_mod
+from . import podmesh
 from .aggregation import DeviceBitmapSet
 from .batch_engine import (PLAN_CACHE_MAX, PROGRAM_CACHE_MAX, WORDS32,
                            _RED_OP, BatchEngine, BatchQuery, plan_bucket,
@@ -329,7 +330,11 @@ class ShardedBatchEngine:
                            if placement == "sharded"
                            else self._specs.combined_heads())
         self._pool_patch_fn = None     # re-jit against the new spec
-        self.pool_words = jax.device_put(
+        # global placement: device_put in one process; on a detected
+        # multi-process pod each host feeds exactly its ADDRESSABLE
+        # shards (podmesh.global_put / make_array_from_callback — the
+        # pjit multi-process model, docs/POD.md)
+        self.pool_words = podmesh.global_put(
             img, NamedSharding(self._mesh, self._pool_spec))
         #: the mutation watermark per tenant: value deltas replay from
         #: each set's journal (one-shard writes); structural repacks
@@ -375,9 +380,14 @@ class ShardedBatchEngine:
             if ds.structure_version != self._placed_structures[i]:
                 stale = True
                 break
-            if (ds.version != self._placed_versions[i]
-                    and ds._journal_dropped_version
-                    > self._placed_versions[i]):
+            if ds.version == self._placed_versions[i]:
+                continue
+            if (ds._journal_dropped_version > self._placed_versions[i]
+                    or jax.process_count() > 1):
+                # journal lag — or a detected multi-process pod, where
+                # the in-place patch program cannot take host-local
+                # operands: re-place wholesale (each host feeds its
+                # addressable shard again)
                 stale = True
                 break
         if stale:
@@ -587,7 +597,7 @@ class ShardedBatchEngine:
         repl = NamedSharding(self._mesh, self._specs.replicated())
 
         def upload(host):
-            return {k: jax.device_put(
+            return {k: podmesh.global_put(
                 v, shard_v if k in ("gather", "valid", "flat_seg")
                 else repl) for k, v in host.items()}
 
@@ -597,10 +607,10 @@ class ShardedBatchEngine:
             # — leaf gather indices included — places replicated, like
             # the andnot head_gather precedent above
             if f:
-                return {k: jax.device_put(v, repl)
+                return {k: podmesh.global_put(v, repl)
                         for k, v in sec.host.items()}
             if sec.arrays is None:
-                sec.arrays = {k: jax.device_put(v, repl)
+                sec.arrays = {k: podmesh.global_put(v, repl)
                               for k, v in sec.host.items()}
             return sec.arrays
 
@@ -609,11 +619,11 @@ class ShardedBatchEngine:
             # section operands wholesale; replicated like everything
             # on the post-butterfly side
             if f:
-                return [{k: jax.device_put(v, repl)
+                return [{k: podmesh.global_put(v, repl)
                          for k, v in plan.mega.host.items()}]
             if plan._mega_arrays is None:
                 plan._mega_arrays = {
-                    k: jax.device_put(v, repl)
+                    k: podmesh.global_put(v, repl)
                     for k, v in plan.mega.host.items()}
             return [plan._mega_arrays]
 

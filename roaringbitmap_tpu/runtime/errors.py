@@ -71,6 +71,16 @@ class CoordinatorTimeout(RoaringRuntimeError):
     demotable = True
 
 
+class HostLost(CoordinatorTimeout):
+    """A pod host stopped answering (process death, network partition,
+    preemption): the host-granular form of :class:`CoordinatorTimeout`.
+    Raised typed by the pod front door (serving.frontdoor) when it marks
+    a host down; the message names the host id.  Retryable/demotable
+    like its base — the pod ladder's ``reroute`` rung serves the
+    affected tenants from a replica or the single-host fallback
+    (docs/POD.md "Host loss")."""
+
+
 class ShadowMismatch(RoaringRuntimeError):
     """Shadow cross-check found an engine result diverging from the CPU
     sequential reference: silent corruption — always fatal, never retried

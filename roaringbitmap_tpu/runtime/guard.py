@@ -57,6 +57,16 @@ SEQUENTIAL = "sequential"
 MESH = "mesh"
 SINGLE_DEVICE = "single"
 
+#: the pod front door's top rung, above the mesh ladder
+#: (serving.frontdoor, docs/POD.md): a classified host-loss fault
+#: (CoordinatorTimeout / HostLost) first RE-ROUTES the affected tenants
+#: to an alive replica — same data, different host, zero recompute —
+#: before any engine demotion happens; tenants with no replica demote to
+#: single-host mode (the authoritative un-sharded pooled engine).  The
+#: full pod ladder reads reroute -> mesh -> single -> sequential, every
+#: rung bit-exact and typed like the chains below it.
+REROUTE = "reroute"
+
 #: sentinel a ResourceExhausted splitter returns to decline (fall through
 #: to demotion)
 NO_SPLIT = object()

@@ -25,8 +25,11 @@ Everything reports through the existing vocabulary: ``serving.admit`` /
 ``rb_slo_missed_total``, with guard demotions unchanged underneath.
 """
 
-from .loop import (AdmissionRejected, RequestShed, ServingLoop,
-                   ServingPolicy, ServingRequest, TenantPolicy, Ticket)
+from .frontdoor import PodFrontDoor
+from .loop import (AdmissionRejected, PumpDriver, RequestShed,
+                   ServingLoop, ServingPolicy, ServingRequest,
+                   TenantPolicy, Ticket)
 
 __all__ = ["ServingLoop", "ServingPolicy", "ServingRequest",
-           "TenantPolicy", "Ticket", "AdmissionRejected", "RequestShed"]
+           "TenantPolicy", "Ticket", "AdmissionRejected", "RequestShed",
+           "PodFrontDoor", "PumpDriver"]

@@ -1,0 +1,608 @@
+"""Per-host serving front door for the pod data plane (docs/POD.md).
+
+``parallel.podmesh`` decides WHERE tenants live; this module moves the
+traffic: a :class:`PodFrontDoor` owns one :class:`~.loop.ServingLoop`
+per pod host (each over exactly the tenants placed there), routes every
+arriving request to its tenant's host with **consistent rendezvous
+routing**, forwards mis-routed arrivals, keeps the weighted fair share
+**cross-host** through a small host-state gossip, and degrades typed
+when a host drops — the ``reroute`` rung of the pod ladder
+(``reroute -> mesh -> single -> sequential``, ``runtime.guard.REROUTE``).
+
+Execution model
+---------------
+- **local / replicated-N tenants** serve from per-host pooled engines
+  (``MultiSetBatchEngine`` by default, a per-host-mesh
+  ``ShardedBatchEngine`` with ``host_engine="sharded"``).  Replicas are
+  full per-host copies (the container-partitioned layout makes a tenant
+  a contiguous row block — it replicates as a unit), so any placement
+  host serves the tenant locally.
+- **sharded (capacity) tenants** serve from ONE pod-spanning
+  ``ShardedBatchEngine`` (``placement="sharded"`` over
+  ``PodMesh.pod_mesh()``): the pooled/expression query path runs
+  ``shard_map``/``pjit`` over the multi-process mesh, each host feeding
+  only its addressable shard (``podmesh.global_put``).  On backends
+  without cross-process collectives the placement planner already
+  demoted these tenants (``podmesh.supports_pod_dispatch``).
+
+Routing.  ``route = rendezvous(set_id, alive placement hosts)`` — every
+host computes the same answer without coordination, and a host loss
+re-routes only that host's tenants.  A request arriving at the wrong
+host (``submit(via_host=...)``) is forwarded to its routed host and
+counted (``rb_pod_forwards_total``) — never served from stale local
+state, never dropped.
+
+Cross-host fair share.  Each loop runs the PR 10 weighted stride
+scheduler; the front door gossips the per-tenant virtual times between
+hosts each pump (element-wise max merge — monotone, idempotent,
+order-free), so a tenant keeps exactly one global share no matter how
+many hosts its traffic lands on, and a reroute cannot reset its place
+in line.  In a detected multi-process pod the same state rides the
+existing coordination channel (the jax distributed KV store),
+best-effort.
+
+Host loss.  A classified ``CoordinatorTimeout``/``HostLost`` — from the
+fault-injection seam (``ROARING_TPU_FAULTS`` scope ``pod`` or
+``host<N>``), from a failed dispatch, or from ``fail_host()`` — marks
+the host down and walks the ``reroute`` rung: every affected ticket
+(queued AND just-failed) re-routes to an alive replica, or demotes to
+**single-host mode** (the authoritative un-sharded pooled engine) when
+no replica exists; only when that also fails does the typed error stand.
+Nothing is silent: every hop is a ``pod.reroute`` span +
+``rb_pod_reroutes_total{to}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..parallel import podmesh
+from ..parallel.aggregation import DeviceBitmapSet
+from ..parallel.batch_engine import BatchEngine
+from ..parallel.multiset import MultiSetBatchEngine
+from ..parallel.sharded_engine import ShardedBatchEngine
+from ..runtime import errors, faults, guard
+from .loop import ServingLoop, ServingPolicy, Ticket
+
+_log = logging.getLogger("roaringbitmap_tpu.serving")
+
+#: the trace/metric/fault site of pod routing (podmesh.SITE's twin)
+SITE = podmesh.SITE
+
+#: pseudo-host id of the pod-spanning capacity engine's loop
+CAPACITY = "capacity"
+#: pseudo-host id of the single-host demotion loop
+SINGLE = guard.SINGLE_DEVICE
+
+
+class PodFrontDoor:
+    """Route + serve an arrival stream over a pod of per-host loops.
+
+    ``sets`` is the tenant universe (``DeviceBitmapSet`` /
+    ``BatchEngine`` / raw bitmap lists), indexed by global ``set_id``
+    exactly like the single-host engines.  ``pod`` defaults to
+    ``PodMesh.detect()`` (``n_hosts`` sizes a simulated pod); ``plan``
+    defaults to ``podmesh.place`` over the footprint model + optional
+    ``qps`` rates.  One front door instance runs per host process; in a
+    simulated pod it owns every host's loop."""
+
+    def __init__(self, sets, pod: podmesh.PodMesh | None = None,
+                 n_hosts: int | None = None,
+                 policy: ServingPolicy | None = None,
+                 plan: podmesh.PlacementPlan | None = None,
+                 qps=None, host_engine: str = "multiset",
+                 result_cache="env"):
+        if host_engine not in ("multiset", "sharded"):
+            raise ValueError(f"unknown host_engine {host_engine!r}")
+        self._sets = [self._as_set(s) for s in sets]
+        self.pod = pod or podmesh.PodMesh.detect(n_hosts)
+        self.policy = policy or ServingPolicy.from_env()
+        self.plan = plan or podmesh.place(self._sets, self.pod, qps=qps)
+        self._host_engine = host_engine
+        self._result_cache = result_cache
+        self._lock = threading.RLock()
+        #: pod-global per-tenant stride state (the gossip board): the
+        #: element-wise max merge of every host loop's _vtime
+        self._vtime_board: dict = {}
+        self._loops: dict = {}        # host_id -> ServingLoop
+        self._local_sid: dict = {}    # (host_id, global sid) -> local
+        self._cap_loop: ServingLoop | None = None
+        self._cap_sid: dict = {}
+        self._single_loop: ServingLoop | None = None
+        self._route_counts: dict = {}   # sid -> admitted (rate stats)
+        self._rate_t0 = faults.clock()
+        self.stats = {"routed": 0, "forwarded": 0, "reroutes": 0,
+                      "host_drops": 0, "single_demotions": 0}
+        self._build()
+
+    @staticmethod
+    def _as_set(s) -> DeviceBitmapSet:
+        if isinstance(s, DeviceBitmapSet):
+            return s
+        if isinstance(s, BatchEngine):
+            return s._ds
+        return DeviceBitmapSet(s, layout="auto")
+
+    # ------------------------------------------------------------ assembly
+
+    def _build(self) -> None:
+        cap_sids = self.plan.sharded_sids()
+        if cap_sids:
+            mesh = self.pod.pod_mesh()
+            eng = ShardedBatchEngine(
+                [self._sets[s] for s in cap_sids], mesh=mesh,
+                placement="sharded", result_cache=self._result_cache)
+            self._cap_loop = ServingLoop(eng, self.policy)
+            self._cap_sid = {sid: i for i, sid in enumerate(cap_sids)}
+        for h in (hi.host_id for hi in self.pod.hosts if hi.local):
+            sids = [s for s in range(self.plan.n_tenants)
+                    if self.plan.regime(s) != "sharded"
+                    and h in self.plan.hosts_of(s)]
+            if not sids:
+                continue
+            local_sets = []
+            for s in sids:
+                ds = self._sets[s]
+                if self.plan.hosts_of(s)[0] == h:
+                    local_sets.append(ds)     # the authoritative copy
+                else:
+                    # replica: a full per-host copy rebuilt from the
+                    # authoritative host tier (a real pod re-ingests
+                    # from storage; the ledger counts it either way)
+                    local_sets.append(DeviceBitmapSet(
+                        ds.host_bitmaps(), layout=ds.layout))
+            if self._host_engine == "sharded":
+                eng = ShardedBatchEngine(
+                    local_sets, mesh=self.pod.host_mesh(h),
+                    placement="auto", result_cache=self._result_cache)
+            else:
+                eng = MultiSetBatchEngine(
+                    local_sets, result_cache=self._result_cache)
+            self._loops[h] = ServingLoop(eng, self.policy)
+            self._local_sid.update(
+                {(h, s): i for i, s in enumerate(sids)})
+
+    # ------------------------------------------------------------- routing
+
+    def owner_host(self, set_id: int):
+        """The host this tenant's requests route to right now:
+        ``CAPACITY`` for sharded-regime tenants (the pod-spanning
+        engine), else the rendezvous winner among alive placement hosts,
+        ``None`` when none is alive (single-host demotion territory).
+        Deterministic across processes."""
+        if self.plan.regime(set_id) == "sharded":
+            return CAPACITY
+        return podmesh.route(self.plan, set_id, self.pod.alive())
+
+    def routes_local(self, set_id: int) -> bool:
+        """Whether this process can serve the tenant's routed host — the
+        SPMD filter a detected-pod driver uses to split one request
+        stream across host processes."""
+        h = self.owner_host(set_id)
+        if h == CAPACITY:
+            return self._cap_loop is not None
+        return h in self._loops or h is None
+
+    def submit(self, request, via_host=None,
+               arrival: float | None = None) -> Ticket:
+        """Route + admit one request.  ``via_host`` models the arrival
+        host (a load balancer that guessed wrong): when it differs from
+        the routed host the request is FORWARDED — counted, traced,
+        served identically.  Typed ``AdmissionRejected`` on refusal,
+        including ``reason="remote_host"`` when the routed host is not
+        addressable from this process (a detected pod peer owns it)."""
+        with self._lock:
+            sid = int(request.set_id)
+            if not 0 <= sid < len(self._sets):
+                raise IndexError(
+                    f"set_id out of range 0..{len(self._sets) - 1}: "
+                    f"{sid}")
+            h = self.owner_host(sid)
+            regime = self.plan.regime(sid)
+            forwarded = via_host is not None and via_host != h
+            with obs_trace.span(
+                    "pod.route", site=SITE, set_id=sid,
+                    tenant=request.tenant, host=str(h), regime=regime,
+                    forwarded=forwarded) as sp:
+                self.stats["routed"] += 1
+                self._route_counts[sid] = \
+                    self._route_counts.get(sid, 0) + 1
+                obs_metrics.counter("rb_pod_routes_total",
+                                    host=str(h)).inc()
+                if forwarded:
+                    self.stats["forwarded"] += 1
+                    obs_metrics.counter("rb_pod_forwards_total").inc()
+                if h is None:
+                    # every placement host is down: single-host mode
+                    # straight from admission (the reroute rung's
+                    # terminal demotion, typed + traced)
+                    sp.tag(demoted=SINGLE)
+                    t = self._single(request, arrival)
+                elif h == CAPACITY:
+                    local = dataclasses.replace(
+                        request, set_id=self._cap_sid[sid])
+                    t = self._cap_loop.submit(local, arrival=arrival)
+                else:
+                    loop = self._loops.get(h)
+                    if loop is None:
+                        from .loop import AdmissionRejected
+
+                        raise AdmissionRejected(
+                            f"{SITE}: request for tenant {sid} routes "
+                            f"to host {h}, owned by another process",
+                            "remote_host", host=h)
+                    local = dataclasses.replace(
+                        request, set_id=self._local_sid[(h, sid)])
+                    t = loop.submit(local, arrival=arrival)
+            if getattr(t, "pod_host", None) is None:
+                t.pod_host = h
+            t.pod_sid = sid
+            t.pod_forwarded = forwarded
+            t.pod_rerouted = getattr(t, "pod_rerouted", False)
+            return t
+
+    def _single(self, request, arrival, ticket: Ticket | None = None):
+        """Single-host mode: the authoritative un-sharded pooled engine
+        over EVERY tenant (global set-id space) — the pod ladder's rung
+        under ``reroute``.  Built lazily on first demotion."""
+        if self._single_loop is None:
+            self._single_loop = ServingLoop(
+                MultiSetBatchEngine(self._sets,
+                                    result_cache=self._result_cache),
+                self.policy)
+        self.stats["single_demotions"] += 1
+        obs_metrics.counter("rb_pod_reroutes_total", to=SINGLE).inc()
+        if ticket is not None:
+            ticket.request = dataclasses.replace(
+                ticket.request, set_id=ticket.pod_sid)
+            return self._single_loop.adopt(ticket)
+        t = self._single_loop.submit(request, arrival=arrival)
+        t.pod_host = SINGLE
+        return t
+
+    # ------------------------------------------------------------- pumping
+
+    def _local_hosts(self):
+        return [h for h in self._loops if self.pod.is_alive(h)]
+
+    def pump(self, force: bool = False) -> list:
+        """Gossip, then pump every alive local loop (+ the capacity and
+        single-host loops); returns completed tickets.  The host-loss
+        injection seam sits here: a ``coordinator`` fault at scope
+        ``pod`` / ``host<N>`` (``ROARING_TPU_FAULTS``) marks that host
+        down and the reroute rung serves its tickets elsewhere."""
+        with self._lock:
+            self._gossip()
+            out: list = []
+            fplan = faults.active()
+            for h in self._local_hosts():
+                # the host-loss injection seam: only coordinator-kind
+                # rules fire here (transient/oom/... keep exercising
+                # the engine seams inside each loop, where they belong)
+                if fplan is not None and fplan.pick(
+                        SITE, f"host{h}",
+                        kinds=("coordinator",)) is not None:
+                    self._host_down(h, errors.HostLost(
+                        f"{SITE}: injected host loss at host{h} "
+                        f"(ROARING_TPU_FAULTS)"))
+                    continue
+                out.extend(self._after_pump(
+                    h, self._loops[h].pump(force)))
+            if self._cap_loop is not None:
+                out.extend(self._after_pump(
+                    CAPACITY, self._cap_loop.pump(force)))
+            if self._single_loop is not None:
+                out.extend(self._single_loop.pump(force))
+            self._push_gauges()
+            return out
+
+    def drain(self) -> list:
+        """Force every queued request out (the stream-end flush)."""
+        with self._lock:
+            out: list = []
+            for _ in range(64):      # reroutes requeue; bound the walk
+                if not self.backlog():
+                    break
+                got = self.pump(force=True)
+                out.extend(got)
+                if not got:
+                    break
+            return out
+
+    def replay(self, arrivals) -> list:
+        """Timed arrival replay on the fault clock — routed through
+        this front door via the shared ``loop.replay_stream`` driver."""
+        from .loop import replay_stream
+
+        return replay_stream(self, arrivals)
+
+    def backlog(self) -> int:
+        loops = list(self._loops.values())
+        if self._cap_loop is not None:
+            loops.append(self._cap_loop)
+        if self._single_loop is not None:
+            loops.append(self._single_loop)
+        return sum(lp._backlog() for lp in loops)
+
+    def _after_pump(self, h, completed: list) -> list:
+        """Walk one loop's completed tickets: a pool failure classified
+        as host loss drops the host (the reroute rung re-serves the
+        tickets); everything else passes through."""
+        out, lost = [], []
+        for t in completed:
+            if (t.status == "failed"
+                    and isinstance(t.error, errors.CoordinatorTimeout)
+                    and not getattr(t, "pod_rerouted", False)):
+                lost.append(t)
+            else:
+                out.append(t)
+        if lost:
+            fault = lost[0].error
+            if h == CAPACITY:
+                # the pod-spanning engine's own guard already walked
+                # mesh -> single -> sequential; a host-loss fault that
+                # STILL escaped demotes the tickets to single-host mode
+                for t in lost:
+                    self._reroute(t, h, "capacity_host_loss")
+            else:
+                self._host_down(h, fault, failed=lost)
+        return out
+
+    # ----------------------------------------------------------- host loss
+
+    def fail_host(self, host_id: int, fault=None) -> None:
+        """Mark a host lost (operator/test hook — the injected-fault and
+        dispatch-failure paths land in the same place): its queued and
+        failed tickets walk the reroute rung now."""
+        with self._lock:
+            self._host_down(
+                host_id,
+                fault or errors.HostLost(
+                    f"{SITE}: host {host_id} marked lost"))
+
+    def _host_down(self, h, fault, failed=()) -> None:
+        if self.pod.is_alive(h):
+            self.pod.mark_down(h)
+            self.stats["host_drops"] += 1
+            obs_metrics.counter("rb_pod_host_drops_total").inc()
+            obs_trace.current().event(
+                "pod.host_down", site=SITE, host=h,
+                error_class=type(fault).__name__)
+            _log.warning("%s: host %s down (%s); rerouting", SITE, h,
+                         fault)
+        loop = self._loops.get(h)
+        stranded = list(failed)
+        if loop is not None:
+            stranded.extend(loop.evict_queued())
+        for t in stranded:
+            self._reroute(t, h, "host_down")
+
+    def _reroute(self, t: Ticket, from_h, reason: str) -> None:
+        """One ticket up the ``reroute`` rung: alive replica first,
+        single-host mode second; the ticket keeps its arrival stamp and
+        deadline (queue age survives), its stride position survives via
+        the gossiped vtime board, and every hop is traced + counted.
+        The rung does not ping-pong between flapping hosts: a SECOND
+        host loss sends a still-queued ticket straight to single-host
+        mode (the terminal, host-less loop), and an already-rerouted
+        ticket that failed again keeps its typed failure."""
+        sid = getattr(t, "pod_sid", None)
+        if sid is None:
+            return
+        if getattr(t, "pod_rerouted", False):
+            if t.status != "queued":
+                return             # typed failure stands
+            with obs_trace.span("pod.reroute", site=SITE, set_id=sid,
+                                from_host=str(from_h), to=SINGLE,
+                                reason=reason, rung=guard.REROUTE):
+                self.stats["reroutes"] += 1
+                self._single(None, None, ticket=t)
+                t.pod_host = SINGLE
+            return
+        t.pod_rerouted = True
+        # host-down callers already marked from_h dead, so route() over
+        # the alive set cannot hand the ticket back; a rebalance may
+        # legitimately re-route to the SAME (alive, rebuilt) host
+        to = podmesh.route(self.plan, sid, self.pod.alive())
+        with obs_trace.span("pod.reroute", site=SITE, set_id=sid,
+                            from_host=str(from_h),
+                            to=(str(to) if to is not None else SINGLE),
+                            reason=reason, rung=guard.REROUTE):
+            self.stats["reroutes"] += 1
+            t.status = "queued"
+            t.error = None
+            t.result = None
+            if to is not None and (to, sid) in self._local_sid:
+                obs_metrics.counter("rb_pod_reroutes_total",
+                                    to="replica").inc()
+                t.request = dataclasses.replace(
+                    t.request, set_id=self._local_sid[(to, sid)])
+                t.pod_host = to
+                self._loops[to].adopt(t)
+            else:
+                self._single(None, None, ticket=t)
+                t.pod_host = SINGLE
+
+    # -------------------------------------------------------------- gossip
+
+    def _gossip(self) -> dict:
+        """Exchange host stride state: element-wise max of every loop's
+        per-tenant virtual time through the pod board (monotone,
+        idempotent — gossip order cannot matter), written back so every
+        host schedules against the GLOBAL share.  In a detected pod the
+        board additionally rides the jax coordination KV store,
+        best-effort (a missing/old peer entry just means one stale
+        round)."""
+        board = self._vtime_board
+        loops = list(self._loops.values())
+        if self._cap_loop is not None:
+            loops.append(self._cap_loop)
+        if self._single_loop is not None:
+            loops.append(self._single_loop)
+        for lp in loops:
+            for tenant, v in lp._vtime.items():
+                if v > board.get(tenant, 0.0):
+                    board[tenant] = v
+        board = self._gossip_kv(board)
+        for lp in loops:
+            for tenant, v in board.items():
+                if tenant in lp._vtime and v > lp._vtime[tenant]:
+                    lp._vtime[tenant] = v
+        self._vtime_board = board
+        return board
+
+    def _gossip_kv(self, board: dict) -> dict:
+        """Multi-process half of the gossip: publish this host's board
+        on the coordination channel and merge the peers'.  No-op in a
+        simulated pod; every failure path is swallowed (gossip is an
+        optimization, never a correctness dependency)."""
+        if not any(not h.local for h in self.pod.hosts):
+            return board
+        try:  # pragma: no cover - needs a live multi-process cluster
+            import json
+
+            from jax._src import distributed
+
+            client = getattr(distributed.global_state, "client", None)
+            if client is None:
+                return board
+            me = self.pod.local_host
+            payload = json.dumps(board, sort_keys=True)
+            try:
+                client.key_value_set(f"rb/pod/vtime/{me}", payload,
+                                     allow_overwrite=True)
+            except TypeError:   # old jaxlib without allow_overwrite
+                client.key_value_set(f"rb/pod/vtime/{me}", payload)
+            except Exception:
+                pass
+            try:
+                peers = client.key_value_dir_get("rb/pod/vtime/")
+            except Exception:
+                return board
+            for _key, val in peers or ():
+                try:
+                    other = json.loads(val)
+                except Exception:
+                    continue
+                for tenant, v in other.items():
+                    if float(v) > board.get(tenant, 0.0):
+                        board[tenant] = float(v)
+        except Exception:
+            pass
+        return board
+
+    # ----------------------------------------------------------- mutation
+
+    def apply_delta(self, set_id: int, adds=None, removes=None,
+                    repack: str = "auto", worker=None) -> list:
+        """The pod write path: apply one delta to the authoritative set
+        AND every placed replica (bit-exact twins; the capacity pool
+        syncs through its journal replay).  ``worker`` forwards to each
+        copy's ``apply_delta`` (the per-host maintenance thread —
+        escalated repacks commit asynchronously, docs/MUTATION.md)."""
+        with self._lock:
+            sid = int(set_id)
+            reports = [self._sets[sid].apply_delta(
+                adds, removes, repack=repack, worker=worker)]
+            if self.plan.regime(sid) != "sharded":
+                for h in self.plan.hosts_of(sid)[1:]:
+                    loop = self._loops.get(h)
+                    if loop is None:
+                        continue
+                    replica = loop._engine._engines[
+                        self._local_sid[(h, sid)]]._ds
+                    reports.append(replica.apply_delta(
+                        adds, removes, repack=repack, worker=worker))
+            return reports
+
+    # ----------------------------------------------- warmup / rebalance
+
+    def warmup(self, profile=None, rungs=None, **kw) -> dict:
+        """Boot-time warmup PER HOST (plus the capacity engine), so a
+        routed steady state still compiles nothing: every host loop
+        pre-compiles its own vocabulary (``profile=`` runs the
+        closed-lattice boot on each — docs/LATTICE.md)."""
+        reports: dict = {}
+        for h, lp in self._loops.items():
+            reports[str(h)] = lp.warmup(profile=profile, rungs=rungs,
+                                        **kw)
+        if self._cap_loop is not None:
+            reports[CAPACITY] = self._cap_loop.warmup(
+                profile=profile, rungs=rungs, **kw)
+        return reports
+
+    def tenant_rates(self) -> list:
+        """Admitted requests/sec per tenant since the last rate reset —
+        the serving-metrics feed of the placement planner's
+        ``replicated-N`` regime."""
+        dt = max(1e-9, faults.clock() - self._rate_t0)
+        return [self._route_counts.get(s, 0) / dt
+                for s in range(len(self._sets))]
+
+    def rebalance(self, qps=None) -> dict:
+        """Re-plan placement from observed query rates (default: this
+        front door's own ``tenant_rates``) and REBUILD the host loops
+        when the plan changed.  Queued tickets survive: they re-route
+        through the fresh plan.  Returns ``{"changed", "plan"}``."""
+        with self._lock:
+            qps = qps if qps is not None else self.tenant_rates()
+            new = podmesh.place(self._sets, self.pod, qps=qps)
+            changed = (new.regimes != self.plan.regimes
+                       or new.hosts != self.plan.hosts)
+            if changed:
+                stranded = [t for lp in self._loops.values()
+                            for t in lp.evict_queued()]
+                if self._cap_loop is not None:
+                    stranded.extend(self._cap_loop.evict_queued())
+                self.plan = new
+                self._loops.clear()
+                self._local_sid.clear()
+                self._cap_loop = None
+                self._cap_sid = {}
+                self._build()
+                for t in stranded:
+                    t.pod_rerouted = False
+                    self._reroute(t, getattr(t, "pod_host", None),
+                                  "rebalance")
+            self._route_counts.clear()
+            self._rate_t0 = faults.clock()
+            return {"changed": changed, "plan": new.table()}
+
+    # -------------------------------------------------------------- health
+
+    def _push_gauges(self) -> None:
+        for h, lp in self._loops.items():
+            obs_metrics.gauge("rb_pod_queue_depth",
+                              host=str(h)).set(lp._backlog())
+        if self._cap_loop is not None:
+            obs_metrics.gauge("rb_pod_queue_depth", host=CAPACITY).set(
+                self._cap_loop._backlog())
+
+    def start_pump(self, interval_s: float | None = None):
+        """The threaded always-on driver over the whole pod front door
+        (``ServingLoop.start_pump``'s twin)."""
+        from .loop import PumpDriver
+
+        return PumpDriver(self, interval_s=interval_s).start()
+
+    def snapshot(self) -> dict:
+        """Pod health as plain JSON: topology + placement + routing
+        stats + every loop's own snapshot."""
+        out = {
+            "pod": self.pod.snapshot(),
+            "placement": self.plan.table(),
+            "regimes": self.plan.regime_counts(),
+            "stats": dict(self.stats),
+            "backlog": self.backlog(),
+            "hosts": {str(h): lp.snapshot()
+                      for h, lp in self._loops.items()},
+        }
+        if self._cap_loop is not None:
+            out["hosts"][CAPACITY] = self._cap_loop.snapshot()
+        if self._single_loop is not None:
+            out["hosts"][SINGLE] = self._single_loop.snapshot()
+        return out

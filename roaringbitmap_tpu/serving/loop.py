@@ -55,6 +55,7 @@ import dataclasses
 import inspect
 import logging
 import os
+import threading
 from collections import deque
 
 from ..obs import memory as obs_memory
@@ -192,6 +193,36 @@ class ServingPolicy:
 _DEFAULT_TENANT = TenantPolicy()
 
 
+def replay_stream(target, arrivals) -> list:
+    """Replay a timed arrival stream against anything exposing
+    ``submit(request, arrival=)`` / ``pump()`` / ``drain()`` — the
+    ``ServingLoop`` and the pod front door share this one driver.
+
+    ``(at_s, request)`` pairs carry nondecreasing offsets from stream
+    start, in fault-clock seconds.  The clock fast-forwards through
+    idle gaps; when the target has fallen behind (an execute outlasted
+    the inter-arrival gap) the request is submitted late but back-dated
+    to its scheduled arrival — queue age is real.  Returns one ticket
+    per arrival in arrival order (rejected arrivals get a ``rejected``
+    ticket with the typed error attached), after a final ``drain``."""
+    t0 = faults.clock()
+    tickets: list = []
+    for at_s, req in arrivals:
+        sched = t0 + float(at_s)
+        now = faults.clock()
+        if sched > now:
+            faults.advance_clock(sched - now)
+        try:
+            t = target.submit(req, arrival=sched)
+        except AdmissionRejected as exc:
+            t = Ticket(request=req, enqueued_at=sched,
+                       status="rejected", error=exc)
+        tickets.append(t)
+        target.pump()
+    target.drain()
+    return tickets
+
+
 @dataclasses.dataclass
 class Ticket:
     """One admitted (or rejected) request's lifecycle record — the
@@ -256,6 +287,10 @@ class ServingLoop:
     def __init__(self, engine, policy: ServingPolicy | None = None):
         self._engine = engine
         self.policy = policy or ServingPolicy.from_env()
+        #: serializes submit/pump/drain against the threaded pump driver
+        #: (PumpDriver) — the loop stays logically single-threaded, the
+        #: lock just decides whose turn it is
+        self._lock = threading.RLock()
         self.n_sets = len(engine._engines)
         self._queues: dict[str, deque] = {}
         self._vtime: dict[str, float] = {}   # weighted-stride scheduler
@@ -293,6 +328,11 @@ class ServingLoop:
         ``arrival`` back-dates the fault-clock arrival stamp (a replay
         driver that fell behind its stream passes the scheduled time);
         deadlines run from arrival, so queue age counts against them."""
+        with self._lock:
+            return self._submit_locked(request, arrival)
+
+    def _submit_locked(self, request: ServingRequest,
+                       arrival: float | None) -> Ticket:
         now = faults.clock()
         arrival = now if arrival is None else min(arrival, now)
         deadline_ms = (request.deadline_ms
@@ -380,6 +420,10 @@ class ServingLoop:
         """Assemble + dispatch every ready pool; returns the completed
         (done/shed/failed) tickets.  ``force`` dispatches partial pools
         regardless of fill/deadline readiness (the drain path)."""
+        with self._lock:
+            return self._pump_locked(force)
+
+    def _pump_locked(self, force: bool) -> list:
         self._update_ladder(self._backlog())
         out: list = []
         while True:
@@ -396,42 +440,62 @@ class ServingLoop:
     def drain(self) -> list:
         """Force every queued request out (dispatch or shed) — the
         stream-end flush."""
-        out: list = []
-        while self._backlog():
-            got = self.pump(force=True)
-            out.extend(got)
-            if not got:      # defensive: nothing moved, nothing will
-                break
-        return out
+        with self._lock:   # _backlog iterates the queues dict: a
+            out: list = []  # concurrent submit must not resize it
+            while self._backlog():
+                got = self.pump(force=True)
+                out.extend(got)
+                if not got:  # defensive: nothing moved, nothing will
+                    break
+            return out
 
     def replay(self, arrivals) -> list:
-        """Replay a timed arrival stream: ``(at_s, request)`` pairs with
-        nondecreasing offsets from stream start, in fault-clock seconds.
-        The clock fast-forwards through idle gaps; when the loop has
-        fallen behind (a pool execute outlasted the inter-arrival gap)
-        the request is submitted late but back-dated to its scheduled
-        arrival — queue age is real.  Returns one ticket per arrival in
-        arrival order (rejected arrivals get a ``rejected`` ticket with
-        the typed error attached), after a final ``drain``."""
-        t0 = faults.clock()
-        tickets: list = []
-        for at_s, req in arrivals:
-            sched = t0 + float(at_s)
-            now = faults.clock()
-            if sched > now:
-                faults.advance_clock(sched - now)
-            try:
-                t = self.submit(req, arrival=sched)
-            except AdmissionRejected as exc:
-                t = Ticket(request=req, enqueued_at=sched,
-                           status="rejected", error=exc)
-            tickets.append(t)
-            self.pump()
-        self.drain()
-        return tickets
+        """Timed arrival replay on the fault clock — see
+        :func:`replay_stream` (the shared driver; the pod front door
+        uses the same one)."""
+        return replay_stream(self, arrivals)
 
     def _backlog(self) -> int:
         return sum(len(q) for q in self._queues.values())
+
+    # ----------------------------------------------- pod ticket hand-off
+
+    def adopt(self, ticket: Ticket) -> Ticket:
+        """Enqueue an existing QUEUED ticket into this loop — the pod
+        front door's re-route path (docs/POD.md "Host loss").  The
+        ticket keeps its identity, arrival stamp, and deadline (queue
+        age survives the move); this loop takes over its pending-bytes
+        accounting.  The caller must have rewritten ``ticket.request``
+        to this loop's set-id space first."""
+        if ticket.status != "queued":
+            raise ValueError(
+                f"only queued tickets can be adopted, got "
+                f"{ticket.status!r}")
+        with self._lock:
+            tenant = ticket.request.tenant
+            self._queues.setdefault(tenant, deque()).append(ticket)
+            self._vtime.setdefault(
+                tenant, max(self._vtime.values(), default=0.0))
+            self._pending_bytes += ticket.pending_bytes
+            self._queue_gauge(tenant)
+        return ticket
+
+    def evict_queued(self) -> list:
+        """Remove and return every queued ticket, oldest first per
+        tenant — the pod front door's host-down path: the caller
+        re-routes them to a replica (``adopt``) or fails them typed.
+        Tickets stay ``queued``; this loop's pending-byte accounting
+        drops them."""
+        with self._lock:
+            out: list = []
+            for q in self._queues.values():
+                while q:
+                    t = q.popleft()
+                    self._pending_bytes -= t.pending_bytes
+                    out.append(t)
+            self._queue_gauge()
+            out.sort(key=lambda t: (t.enqueued_at, t.seq))
+            return out
 
     def _pool_target(self) -> int:
         t = self.policy.pool_target
@@ -812,6 +876,14 @@ class ServingLoop:
         self._lattice_warmed = rt_lattice.sealed_active()
         return rep
 
+    def start_pump(self, interval_s: float | None = None) -> "PumpDriver":
+        """Start the threaded pump-on-timer driver (PR 10's named debt):
+        a daemon thread drives ``pump()`` every ``interval_s`` so
+        deadline-pressure dispatch fires without any caller thread — the
+        front door is actually always-on.  Returns the started
+        :class:`PumpDriver`; call its ``stop()`` when done."""
+        return PumpDriver(self, interval_s=interval_s).start()
+
     # -------------------------------------------------------------- health
 
     def _queue_gauge(self, tenant: str | None = None) -> None:
@@ -848,3 +920,78 @@ class ServingLoop:
                               "warmed": self._lattice_warmed,
                               "points": lat.n_points(pooled=True)}
         return out
+
+
+class PumpDriver:
+    """Threaded pump-on-timer: the production ``pump()`` driver (PR 10
+    left the loop caller-driven by design; this closes that debt).
+
+    A daemon thread calls ``loop.pump()`` every ``interval_s`` — default
+    half the policy's ``dispatch_margin_ms`` so the deadline-pressure
+    dispatch rule can never miss its margin by more than a tick — making
+    the front door actually always-on: submitted requests dispatch on
+    fill OR deadline without any caller thread touching the loop again.
+    ``loop`` is anything exposing ``pump()`` (``ServingLoop``,
+    ``serving.frontdoor.PodFrontDoor``); the loop's internal lock
+    serializes the driver against concurrent ``submit`` callers.
+
+    Fault-clock compatible: each tick stamps ``faults.clock()``, and
+    ``kick()`` wakes the thread immediately — a test advances the fault
+    clock, kicks, and observes deterministic deadline expiry with zero
+    real sleeping beyond the thread hand-off.  A pump that raises an
+    unclassified (programming) error is recorded on ``last_error`` and
+    counted (``rb_serving_pump_errors_total``) — the driver survives,
+    the error stays visible, nothing is silent."""
+
+    def __init__(self, loop, interval_s: float | None = None):
+        if interval_s is None:
+            margin_ms = getattr(getattr(loop, "policy", None),
+                                "dispatch_margin_ms", 5.0)
+            interval_s = max(5e-4, margin_ms / 2e3)
+        self._loop = loop
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="rb-serving-pump", daemon=True)
+        self.ticks = 0
+        self.completed = 0
+        self.last_tick_at: float | None = None
+        self.last_error: Exception | None = None
+
+    def start(self) -> "PumpDriver":
+        self._thread.start()
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread.is_alive()
+
+    def kick(self) -> None:
+        """Wake the pump thread now (tests advance the fault clock then
+        kick; producers kick after a burst to skip the tick latency)."""
+        self._wake.set()
+
+    def stop(self, drain: bool = False) -> None:
+        """Stop the thread (joins it); ``drain=True`` then flushes the
+        remaining backlog synchronously on the caller's thread."""
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=30.0)
+        if drain:
+            self._loop.drain()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.last_tick_at = faults.clock()
+            try:
+                done = self._loop.pump()
+                self.ticks += 1
+                self.completed += len(done)
+            except Exception as exc:  # keep pumping; stay visible
+                self.last_error = exc
+                obs_metrics.counter("rb_serving_pump_errors_total",
+                                    error_class=type(exc).__name__).inc()
+                _log.exception("%s: pump driver tick failed", SITE)
+            self._wake.wait(self.interval_s)
+            self._wake.clear()

@@ -1,0 +1,736 @@
+"""Pod-scale data plane (parallel/podmesh.py + serving/frontdoor.py,
+docs/POD.md): tenant placement regimes, consistent routing, mis-route
+forwarding, cross-host fair share, host-drop degradation through the
+``reroute`` rung, the threaded pump driver, the async maintenance
+worker, and the 2-process CPU-cluster bring-up (tests/test_multihost.py
+extended — placement/routing agreement across real processes, each host
+feeding only its addressable shard).
+
+The in-process tests run a SIMULATED pod over the suite's 8 virtual CPU
+devices — the same dry-run strategy as the sharded engine's mesh tests;
+cross-process collective dispatch needs a real TPU pod backend and rides
+the standing TPU debt (``podmesh.supports_pod_dispatch``)."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.insights import analysis as insights
+from roaringbitmap_tpu.parallel import (BatchQuery, DeviceBitmapSet,
+                                        MultiSetBatchEngine, expr, podmesh)
+from roaringbitmap_tpu.runtime import errors, faults, guard
+from roaringbitmap_tpu.serving import (PodFrontDoor, ServingLoop,
+                                       ServingPolicy, ServingRequest,
+                                       TenantPolicy)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NOSLEEP = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)
+EASY_MS = 300_000.0
+
+MIB = 1 << 20
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    faults.reset_clock()
+    yield
+    obs.disable()
+    obs.reset()
+    faults.reset_clock()
+
+
+@pytest.fixture(scope="module")
+def tenant_sets():
+    rng = np.random.default_rng(0x90D)
+    out = []
+    for s in range(3):
+        out.append(DeviceBitmapSet(
+            [RoaringBitmap.from_values(np.unique(
+                rng.integers(0, 1 << 16, 700).astype(np.uint32)))
+             for _ in range(5)], layout="dense"))
+    return out
+
+
+@pytest.fixture(scope="module")
+def reference(tenant_sets):
+    return MultiSetBatchEngine(tenant_sets)
+
+
+#: mixed-regime plan over 2 hosts: tenant 0 capacity-sharded (the
+#: pod-spanning mesh), tenant 1 replicated on both (rendezvous winner:
+#: host 1), tenant 2 local to host 0
+MIXED_PLAN = podmesh.PlacementPlan(
+    regimes=("sharded", "replicated-2", "local"),
+    hosts=((0, 1), (0, 1), (0,)),
+    bytes_per_host=(0, 0))
+
+
+def _policy(**kw) -> ServingPolicy:
+    kw.setdefault("guard", NOSLEEP)
+    kw.setdefault("default_deadline_ms", EASY_MS)
+    kw.setdefault("pool_target", 4)
+    return ServingPolicy(**kw)
+
+
+def _front_door(tenant_sets, plan=MIXED_PLAN, n_hosts=2, **kw):
+    return PodFrontDoor(tenant_sets, pod=podmesh.PodMesh.simulate(n_hosts),
+                        plan=plan, policy=_policy(), **kw)
+
+
+def _requests(n, n_sets=3, seed=0xA12):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        sid = int(rng.integers(n_sets))
+        form = "bitmap" if i % 3 == 0 else "cardinality"
+        if i % 7 == 3:
+            q = expr.ExprQuery(
+                expr.and_(expr.or_(0, 1), expr.not_(2)), form=form)
+        else:
+            op = ("or", "and", "xor", "andnot")[int(rng.integers(4))]
+            q = BatchQuery(op, (0, 1, 2), form=form)
+        out.append(ServingRequest(sid, q, tenant=f"t{sid}"))
+    return out
+
+
+def _assert_exact(reference, t):
+    assert t.status == "done", (t.status, t.error)
+    ref = reference._engines[t.pod_sid]._sequential_one(t.query)
+    assert t.result.cardinality == ref.cardinality
+    if t.query.form == "bitmap":
+        assert t.result.bitmap == ref
+
+
+# ------------------------------------------------------- placement planner
+
+def test_plan_pod_placement_regimes():
+    """The three-regime decision matrix: capacity tenants shard, hot
+    small tenants replicate N-wide, the rest balance locally."""
+    #          big        hot-small  cold      cold
+    t_bytes = [100 * MIB, 4 * MIB,   8 * MIB,  8 * MIB]
+    raw = insights.plan_pod_placement(
+        t_bytes, 4, budget_per_host=64 * MIB,
+        qps=[1.0, 12.0, 1.0, 1.0])
+    assert raw["regimes"][0] == "sharded"
+    assert raw["hosts"][0] == [0, 1, 2, 3]
+    assert raw["regimes"][1].startswith("replicated-")
+    n = int(raw["regimes"][1].split("-")[1])
+    assert 2 <= n <= 4 and len(raw["hosts"][1]) == n
+    assert raw["regimes"][2] == raw["regimes"][3] == "local"
+    # locals land on distinct least-loaded hosts
+    assert raw["hosts"][2] != raw["hosts"][3]
+    assert not raw["over_budget"]
+
+
+def test_plan_pod_placement_degenerate_and_budget():
+    # single host: everything local, nothing to spread
+    raw = insights.plan_pod_placement([MIB, 200 * MIB], 1,
+                                      budget_per_host=64 * MIB)
+    assert raw["regimes"] == ["local", "local"]
+    # uniform traffic is never "hot": nothing replicates without skew
+    raw = insights.plan_pod_placement([4 * MIB] * 3, 2,
+                                      qps=[1.0, 1.0, 1.0])
+    assert raw["regimes"] == ["local"] * 3
+    # over-budget is reported, not hidden
+    raw = insights.plan_pod_placement([30 * MIB] * 4, 2,
+                                      budget_per_host=72 * MIB,
+                                      qps=[8.0, 1.0, 1.0, 1.0])
+    assert raw["regimes"][0].startswith("replicated")
+    assert raw["over_budget"]
+
+
+def test_place_resolves_from_footprint_model(tenant_sets):
+    pod = podmesh.PodMesh.simulate(2)
+    plan = podmesh.place(tenant_sets, pod)
+    assert plan.n_tenants == 3
+    assert all(r == "local" for r in plan.regimes)   # no rate data
+    assert sum(plan.bytes_per_host) == sum(
+        podmesh.tenant_bytes_of(tenant_sets))
+    # rates flip the hot tenant to replicated-N
+    plan2 = podmesh.place(tenant_sets, pod, qps=[50.0, 1.0, 1.0])
+    assert plan2.regime(0).startswith("replicated-")
+    assert len(plan2.hosts_of(0)) >= 2
+
+
+def test_route_is_consistent_under_host_loss():
+    """Rendezvous property: losing a host only moves the tenants that
+    host was serving; every survivor keeps its route."""
+    plan = podmesh.PlacementPlan(
+        regimes=tuple(["local"] * 32),
+        hosts=tuple((0, 1, 2, 3) for _ in range(32)),
+        bytes_per_host=(0, 0, 0, 0))
+    before = {s: podmesh.route(plan, s, (0, 1, 2, 3)) for s in range(32)}
+    assert len(set(before.values())) > 1      # spread, not clumped
+    after = {s: podmesh.route(plan, s, (0, 1, 3)) for s in range(32)}
+    for s in range(32):
+        if before[s] != 2:
+            assert after[s] == before[s], f"tenant {s} moved needlessly"
+        else:
+            assert after[s] in (0, 1, 3)
+    assert podmesh.route(plan, 0, ()) is None
+
+
+# ------------------------------------------------------------ parity path
+
+def test_pod_parity_bit_exact_matrix(tenant_sets, reference):
+    """The acceptance matrix: (op x placement regime x flat/expression x
+    bitmap/cardinality) through the routed pod front door, bit-exact vs
+    the single-host engine — including the capacity tenant through the
+    pod-spanning sharded mesh."""
+    fd = _front_door(tenant_sets)
+    tickets = [fd.submit(r) for r in _requests(28)]
+    fd.drain()
+    hosts = {t.pod_host for t in tickets}
+    assert "capacity" in hosts and len(hosts) >= 3   # all regimes served
+    for t in tickets:
+        _assert_exact(reference, t)
+    snap = fd.snapshot()
+    assert snap["stats"]["routed"] == 28
+    assert snap["backlog"] == 0
+    assert set(snap["placement"]) == {"0", "1", "2"}
+
+
+def test_misroute_forwarding(tenant_sets, reference):
+    """A request arriving at the wrong host forwards to its routed host
+    — counted, traced, served identically."""
+    fd = _front_door(tenant_sets)
+    before = fd.stats["forwarded"]
+    # tenant 2 is local to host 0: arrival at host 1 must forward
+    t = fd.submit(ServingRequest(2, BatchQuery("or", (0, 1)),
+                                 tenant="t2"), via_host=1)
+    assert t.pod_forwarded and t.pod_host == 0
+    # arrival at the right host does not
+    t2 = fd.submit(ServingRequest(2, BatchQuery("or", (0, 1)),
+                                  tenant="t2"), via_host=0)
+    assert not t2.pod_forwarded
+    fd.drain()
+    assert fd.stats["forwarded"] == before + 1
+    _assert_exact(reference, t)
+    _assert_exact(reference, t2)
+
+
+# --------------------------------------------------------------- host loss
+
+def test_host_drop_reroutes_to_replica(tenant_sets, reference):
+    """The ``reroute`` rung under ROARING_TPU_FAULTS on the fault clock:
+    an injected host loss marks the host down mid-stream and every
+    affected ticket re-serves from a replica or single-host mode —
+    typed events only, nothing silent, bit-exact results."""
+    fd = _front_door(tenant_sets)
+    tickets = [fd.submit(r) for r in _requests(16, seed=0xB0B)]
+    # replicated tenant 1 routes to host 1, local tenant 2 to host 0
+    assert {t.pod_host for t in tickets} == {0, 1, "capacity"}
+    rerouted = [t for t in tickets if t.pod_host == 1]
+    t0 = faults.clock()
+    with faults.inject("coordinator@host1=1.0:9"):
+        fd.pump()                      # host 1 drops here
+        out = fd.drain()
+    assert faults.clock() >= t0
+    assert not fd.pod.is_alive(1) and fd.pod.is_alive(0)
+    assert fd.stats["host_drops"] == 1
+    assert fd.stats["reroutes"] == len(rerouted) > 0
+    # nothing silent: every ticket completed or carries a typed error
+    assert all(t.status == "done" or t.error is not None
+               for t in tickets)
+    for t in tickets:
+        _assert_exact(reference, t)
+    # the replicated tenant re-served from its host-0 replica
+    assert all(t.pod_host == 0 for t in rerouted)
+    assert all(t.pod_host in (0, "capacity") for t in tickets)
+    assert len(out) >= fd.stats["reroutes"]
+
+
+def test_host_drop_without_replica_demotes_to_single(tenant_sets,
+                                                     reference):
+    """A tenant whose ONLY placement host dies demotes to single-host
+    mode (the authoritative pooled engine) instead of failing — and a
+    submit AFTER the drop routes straight there."""
+    plan = podmesh.PlacementPlan(
+        regimes=("local", "local", "local"),
+        hosts=((0,), (0,), (1,)), bytes_per_host=(0, 0))
+    fd = _front_door(tenant_sets, plan=plan)
+    queued = [fd.submit(ServingRequest(0, BatchQuery("xor", (0, 1, 2)),
+                                       tenant="t0"))
+              for _ in range(3)]
+    fd.fail_host(0)
+    late = fd.submit(ServingRequest(1, BatchQuery("and", (0, 1)),
+                                    tenant="t1"))
+    assert late.pod_host == "single"
+    fd.drain()
+    for t in queued + [late]:
+        _assert_exact(reference, t)
+    assert fd.stats["single_demotions"] >= 4
+    assert fd.stats["host_drops"] == 1
+
+
+def test_capacity_failure_demotes_tickets_to_single(tenant_sets,
+                                                    reference):
+    """A host-loss fault that escapes even the capacity engine's own
+    mesh->single->sequential ladder walks the pod reroute rung into
+    single-host mode rather than standing as a pool failure."""
+    fd = _front_door(tenant_sets)
+    t = fd.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                 tenant="t0"))
+    # simulate the escaped failure the serving loop would hand back
+    fd._cap_loop.evict_queued()
+    t.status = "failed"
+    t.error = errors.HostLost("pod: capacity dispatch lost its mesh")
+    out = fd._after_pump("capacity", [t])
+    assert out == []                   # consumed by the reroute rung
+    fd.drain()
+    _assert_exact(reference, t)
+    assert t.pod_host == "single"
+
+
+def test_reroute_fires_once_typed(tenant_sets):
+    """The rung does not ping-pong: a ticket that already rerouted keeps
+    its typed failure."""
+    fd = _front_door(tenant_sets)
+    t = fd.submit(ServingRequest(2, BatchQuery("or", (0, 1)),
+                                 tenant="t2"))
+    fd._loops[1].evict_queued()
+    t.status = "failed"
+    t.error = errors.HostLost("pod: host 1 lost")
+    t.pod_rerouted = True              # second strike
+    out = fd._after_pump(1, [t])
+    assert out == [t] and t.status == "failed"
+    assert isinstance(t.error, errors.CoordinatorTimeout)
+
+
+# --------------------------------------------------------- fair share
+
+def test_cross_host_fair_share_survives_reroute(tenant_sets):
+    """Stride state is pod-global: after a host drop moves tenant b onto
+    tenant a's host, the very first merged pool still splits slots by
+    weight — b neither monopolizes (no vtime reset) nor starves."""
+    plan = podmesh.PlacementPlan(
+        regimes=("local", "local", "local"),
+        hosts=((0,), (1, 0), (1,)), bytes_per_host=(0, 0))
+    pol = _policy(pool_target=6, tenants={
+        "t0": TenantPolicy(weight=2.0), "t1": TenantPolicy(weight=1.0)})
+    fd = PodFrontDoor(tenant_sets, pod=podmesh.PodMesh.simulate(2),
+                      plan=plan, policy=pol)
+    for _ in range(12):
+        fd.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                 tenant="t0"))
+        fd.submit(ServingRequest(1, BatchQuery("or", (0, 1)),
+                                 tenant="t1"))
+    fd._gossip()
+    fd.fail_host(1)                    # t1's queue adopts onto host 0
+    picked = fd._loops[0]._pick(6)
+    by: dict = {}
+    for t in picked:
+        by[t.request.tenant] = by.get(t.request.tenant, 0) + 1
+    assert by == {"t0": 4, "t1": 2}, by
+
+
+def test_gossip_merges_vtime_monotone(tenant_sets):
+    fd = _front_door(tenant_sets)
+    fd._loops[0]._vtime.update({"a": 5.0, "b": 1.0})
+    fd._loops[1]._vtime.update({"a": 2.0, "c": 3.0})
+    board = fd._gossip()
+    assert board["a"] == 5.0 and board["b"] == 1.0 and board["c"] == 3.0
+    assert fd._loops[1]._vtime["a"] == 5.0      # pushed up, never down
+    assert fd._gossip()["a"] == 5.0             # idempotent
+
+
+# ------------------------------------------------------- pump-on-timer
+
+def test_pump_driver_serves_without_caller(tenant_sets, reference):
+    """PR 10's named debt: the daemon pump thread makes the loop
+    actually always-on — submit, wait, served."""
+    loop = ServingLoop(MultiSetBatchEngine(tenant_sets),
+                       _policy(pool_target=4))
+    drv = loop.start_pump(interval_s=0.002)
+    try:
+        tickets = [loop.submit(ServingRequest(
+            i % 3, BatchQuery("or", (0, 1)), tenant=f"t{i % 3}"))
+            for i in range(8)]
+        drv.kick()
+        deadline = time.monotonic() + 60
+        while (any(t.status == "queued" for t in tickets)
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+    finally:
+        drv.stop(drain=True)
+    assert drv.last_error is None
+    assert drv.ticks >= 1 and drv.completed >= 8
+    for t in tickets:
+        assert t.status == "done"
+        ref = reference._engines[t.request.set_id]._sequential_one(
+            t.query)
+        assert t.result.cardinality == ref.cardinality
+    assert not drv.running
+
+
+def test_pump_driver_fault_clock_deadline(tenant_sets):
+    """Fault-clock compatibility: advancing the virtual clock and
+    kicking the driver sheds an expired request deterministically —
+    no real waiting is involved in the expiry."""
+    loop = ServingLoop(MultiSetBatchEngine(tenant_sets),
+                       _policy(pool_target=64))   # never fills
+    drv = loop.start_pump(interval_s=0.002)
+    try:
+        t = loop.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                       tenant="t0", deadline_ms=10.0))
+        faults.advance_clock(0.5)       # virtual: the deadline passed
+        drv.kick()
+        deadline = time.monotonic() + 60
+        while t.status == "queued" and time.monotonic() < deadline:
+            drv.kick()
+            time.sleep(0.002)
+    finally:
+        drv.stop()
+    assert t.status == "shed" and t.error.reason == "expired"
+
+
+def test_pod_front_door_pump_driver(tenant_sets, reference):
+    """The always-on driver over the whole routed pod: each regime's
+    loop fills its pool target and the daemon thread dispatches it with
+    no caller involvement."""
+    fd = _front_door(tenant_sets)
+    drv = fd.start_pump(interval_s=0.002)
+    try:
+        # 8 requests per tenant: every per-host loop (and the capacity
+        # loop) fills the pool target of 4 at least twice
+        tickets = [fd.submit(ServingRequest(
+            sid, BatchQuery(("or", "and", "xor", "andnot")[i % 4],
+                            (0, 1, 2)), tenant=f"t{sid}"))
+            for sid in range(3) for i in range(8)]
+        drv.kick()
+        deadline = time.monotonic() + 120
+        while (any(t.status == "queued" for t in tickets)
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+    finally:
+        drv.stop(drain=True)
+    assert drv.last_error is None
+    for t in tickets:
+        _assert_exact(reference, t)
+
+
+def test_rebalance_replans_and_requeues_without_demotion(tenant_sets,
+                                                        reference):
+    """``rebalance`` re-plans from observed/given rates and rebuilds the
+    host loops; queued tickets re-route through the FRESH plan — onto a
+    (possibly identical) alive host, never spuriously into single-host
+    mode."""
+    plan = podmesh.PlacementPlan(
+        regimes=("local", "local", "local"),
+        hosts=((0,), (0,), (1,)), bytes_per_host=(0, 0))
+    fd = _front_door(tenant_sets, plan=plan)
+    tickets = [fd.submit(ServingRequest(
+        sid, BatchQuery("or", (0, 1)), tenant=f"t{sid}"))
+        for sid in (0, 1, 2, 0)]
+    rep = fd.rebalance(qps=[50.0, 1.0, 1.0])
+    assert rep["changed"]
+    assert fd.plan.regime(0).startswith("replicated-")
+    fd.drain()
+    for t in tickets:
+        _assert_exact(reference, t)
+    # every requeued ticket landed on a real host loop
+    assert fd.stats["single_demotions"] == 0
+    assert all(t.pod_host in (0, 1) for t in tickets)
+    assert fd.stats["reroutes"] == len(tickets)
+
+
+def test_warmup_runs_per_host(tenant_sets):
+    """``warmup`` pre-compiles every host's own vocabulary (plus the
+    capacity engine's), so a routed steady state still compiles
+    nothing on any host."""
+    fd = _front_door(tenant_sets)
+    reports = fd.warmup(rungs=(2,))
+    assert set(reports) == {"0", "1", "capacity"}
+    for rep in reports.values():
+        assert "wall_ms" in rep
+
+
+# ------------------------------------------------- maintenance worker
+
+def _fresh_set(seed=0x3A5, n=3, size=500):
+    rng = np.random.default_rng(seed)
+    return DeviceBitmapSet(
+        [RoaringBitmap.from_values(np.unique(
+            rng.integers(0, 1 << 15, size).astype(np.uint32)))
+         for _ in range(n)], layout="dense")
+
+
+def test_maintenance_defers_escalated_repack():
+    """PR 12's named debt: a structural delta with a worker attached
+    returns immediately (mode="repack_queued"), the pre-delta image
+    keeps serving bit-exactly, and drain() commits the repack with the
+    version/structure bump + cache invalidation."""
+    from roaringbitmap_tpu.mutation import MaintenanceWorker
+
+    ds = _fresh_set()
+    eng = MultiSetBatchEngine([ds])
+    q = BatchQuery("or", (0, 1, 2))
+    before = eng._engines[0]._sequential_one(q).cardinality
+    w = MaintenanceWorker()
+    try:
+        new_vals = np.array([0x7F010001, 0x7F020002], np.uint32)
+        rep = ds.apply_delta(adds={0: new_vals}, worker=w)
+        assert rep["mode"] == "repack_queued"
+        assert rep["repack_reason"] == "structural"
+        # deferred commit: pre-delta image serves, version unmoved
+        assert ds.version == 0
+        got = eng.execute([(0, [q])])[0][0].cardinality
+        assert got == before
+        w.drain()
+        assert ds.version == 1 and ds.structure_version == 1
+        hosts = ds.host_bitmaps()
+        assert all(int(v) in hosts[0] for v in new_vals)
+        got = eng.execute([(0, [q])])[0][0].cardinality
+        assert got == eng._engines[0]._sequential_one(q).cardinality
+        assert got == before + 2
+        assert w.jobs_done == 1 and w.jobs_failed == 0
+    finally:
+        w.stop()
+
+
+def test_maintenance_interleaved_patch_survives_commit():
+    """A value patch landing between queue and commit is never lost:
+    the commit recomputes the post-delta sources from the then-current
+    state."""
+    from roaringbitmap_tpu.mutation import MaintenanceWorker
+
+    ds = _fresh_set(seed=0x3A6)
+    w = MaintenanceWorker(start=False)    # inline drain: deterministic
+    ds.apply_delta(adds={0: np.array([0x7F030001], np.uint32)}, worker=w)
+    # in-place patch while the repack is queued (existing container)
+    patched = int(ds.host_bitmaps()[1].to_array()[0])
+    ds.apply_delta(removes={1: np.array([patched], np.uint32)},
+                   worker=w)
+    w.drain()
+    hosts = ds.host_bitmaps()
+    assert 0x7F030001 in hosts[0]
+    assert patched not in hosts[1]
+    w.stop()
+
+
+def test_maintenance_coalesces_escalation_bursts():
+    """A burst of escalating deltas pays ONE repack: only the first
+    queues a commit job, the rest ride its pending list — and every
+    delta's values land."""
+    from roaringbitmap_tpu.mutation import MaintenanceWorker
+
+    ds = _fresh_set(seed=0x3A7)
+    w = MaintenanceWorker(start=False)    # inline drain: deterministic
+    vals = [0x7F040001, 0x7F050002, 0x7F060003]
+    for i, v in enumerate(vals):
+        rep = ds.apply_delta(adds={i: np.array([v], np.uint32)},
+                             worker=w)
+        assert rep["mode"] == "repack_queued"
+    w.drain()
+    assert w.jobs_done == 1               # one combined commit
+    assert ds.version == 1 and ds.structure_version == 1
+    hosts = ds.host_bitmaps()
+    for i, v in enumerate(vals):
+        assert v in hosts[i]
+    w.stop()
+
+
+def test_double_host_loss_lands_in_single_not_stranded(tenant_sets,
+                                                       reference):
+    """A ticket rerouted once whose NEW host also dies goes to the
+    terminal single-host loop — never stranded queued, never silent."""
+    plan = podmesh.PlacementPlan(
+        regimes=("replicated-2", "local", "local"),
+        hosts=((0, 1), (0,), (1,)), bytes_per_host=(0, 0))
+    fd = _front_door(tenant_sets, plan=plan)
+    t = fd.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                 tenant="t0"))
+    first = t.pod_host
+    fd.fail_host(first)                   # hop 1: the replica
+    assert t.status == "queued" and t.pod_host == 1 - first
+    fd.fail_host(1 - first)               # hop 2: terminal single
+    assert t.pod_host == "single"
+    fd.drain()
+    _assert_exact(reference, t)
+
+
+def test_maintenance_failed_job_is_visible_not_fatal():
+    from roaringbitmap_tpu.mutation import MaintenanceWorker
+
+    w = MaintenanceWorker()
+    try:
+        w.submit(lambda: 1 / 0, kind="repack", desc="doomed")
+        w.drain()
+        assert w.jobs_failed == 1
+        assert isinstance(w.last_error, ZeroDivisionError)
+        done = []
+        w.submit(lambda: done.append(1))
+        w.drain()
+        assert done == [1]              # the queue keeps moving
+    finally:
+        w.stop()
+
+
+# ------------------------------------------- multihost probe satellite
+
+def test_probe_latency_surfaces_in_obs_snapshot():
+    """The pre-flight TCP probe's latency + coordinator identity land in
+    obs.snapshot()["multihost"] — a slow coordinator is visible before
+    it times out."""
+    from roaringbitmap_tpu.parallel import multihost
+
+    srv = socket.socket()
+    try:
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        multihost._STATE.clear()
+        multihost._STATE.update(coordinator=f"127.0.0.1:{port}",
+                                process_id=1, timeout_s=5.0,
+                                probe_ms=None, status="probing")
+        multihost._probe_coordinator(
+            f"127.0.0.1:{port}", 5.0, time.monotonic() + 5.0,
+            lambda: "probe-test", errors)
+    finally:
+        srv.close()
+    snap = obs.snapshot()
+    assert "multihost" in snap
+    info = snap["multihost"]
+    assert info["coordinator"].endswith(str(port))
+    assert isinstance(info["probe_ms"], float) and info["probe_ms"] >= 0
+    assert info["process_id"] == 1
+    gauges = snap.get("gauges", {})
+    assert any("rb_multihost_probe_seconds" in str(k) for k in gauges)
+
+
+def test_failed_bootstrap_records_typed_state():
+    from roaringbitmap_tpu.parallel import multihost
+
+    with faults.inject("coordinator@multihost=1.0:11"):
+        with pytest.raises(errors.CoordinatorTimeout):
+            multihost.initialize("10.9.9.9:1", num_processes=2,
+                                 process_id=0, timeout=3)
+    info = obs.snapshot()["multihost"]
+    assert info["status"] == "failed"
+    assert info["coordinator"] == "10.9.9.9:1"
+
+
+# ------------------------------------------- 2-process cluster harness
+
+_POD_WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+sys.path.insert(0, {repo!r})
+pid, port = int(sys.argv[1]), sys.argv[2]
+from roaringbitmap_tpu.parallel import multihost
+multihost.initialize(f"127.0.0.1:{{port}}", num_processes=2,
+                     process_id=pid)
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.parallel import (BatchQuery, DeviceBitmapSet,
+                                        MultiSetBatchEngine, podmesh)
+from roaringbitmap_tpu.runtime import guard
+from roaringbitmap_tpu.serving import (PodFrontDoor, ServingPolicy,
+                                       ServingRequest)
+
+assert jax.process_count() == 2
+# the probe satellite: bootstrap state rides obs.snapshot(), and the
+# non-coordinator rank records its pre-flight probe latency
+mh = obs.snapshot()["multihost"]
+assert mh["status"] == "initialized", mh
+assert mh["process_count"] == 2, mh
+if pid == 1:
+    assert isinstance(mh["probe_ms"], float), mh
+
+pod = podmesh.PodMesh.detect()
+assert pod.n_hosts == 2, pod.snapshot()
+assert pod.hosts[pid].local and not pod.hosts[1 - pid].local
+assert pod.local_host == pid
+assert not podmesh.supports_pod_dispatch()   # CPU pod: no collectives
+
+# each host feeds ONLY its addressable shard of a globally-placed array
+mesh = pod.pod_mesh()
+img = np.arange(2 * 8, dtype=np.uint32).reshape(2, 8)
+arr = podmesh.global_put(img, NamedSharding(mesh, P("rows", None)))
+shards = arr.addressable_shards
+assert len(shards) == 1, shards
+assert shards[0].data.shape == (1, 8), shards[0].data.shape
+assert (np.asarray(shards[0].data) == img[shards[0].index]).all()
+
+# identical tenant universe on both hosts (same seed): the placement
+# plan and every route agree across processes with zero coordination
+rng = np.random.default_rng(3)
+sets = [DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+    rng.integers(0, 1 << 16, 400).astype(np.uint32)))
+    for _ in range(4)], layout="dense") for _ in range(4)]
+plan = podmesh.place(sets, pod)
+routes = [podmesh.route(plan, s, pod.alive()) for s in range(4)]
+print("POD2_PLAN", pid, list(plan.regimes), [list(h) for h in plan.hosts],
+      routes)
+
+# per-host front door: this process serves exactly its routed share
+fd = PodFrontDoor(sets, pod=pod, plan=plan, policy=ServingPolicy(
+    pool_target=4, default_deadline_ms=600000.0,
+    guard=guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)))
+ref = MultiSetBatchEngine(sets)
+served = 0
+for i in range(16):
+    sid = i % 4
+    if fd.owner_host(sid) not in fd._loops:
+        continue
+    t = fd.submit(ServingRequest(
+        sid, BatchQuery(("or", "and", "xor", "andnot")[i % 4], (0, 1)),
+        tenant=f"t{{sid}}"))
+    fd.drain()
+    r = ref._engines[sid]._sequential_one(t.request.query)
+    assert t.status == "done" and t.result.cardinality == r.cardinality
+    served += 1
+assert served > 0
+fd._gossip()          # the KV gossip path must never throw
+print("POD2_OK", pid, served)
+""".format(repo=REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_pod_bringup(tmp_path):
+    """The real 2-process cluster (tests/test_multihost.py extended):
+    bootstrap + probe snapshot, PodMesh.detect host ownership,
+    addressable-shard feeding, cross-process placement/routing
+    agreement, and per-host routed serving parity."""
+    worker = tmp_path / "pod_worker.py"
+    worker.write_text(_POD_WORKER)
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "ROARING_TPU_FAULTS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+        assert f"POD2_OK {i}" in out
+    # the plan + route lines must agree verbatim across processes
+    plans = [[ln.split(" ", 2)[2] for ln in out.splitlines()
+              if ln.startswith("POD2_PLAN")][0] for out in outs]
+    assert plans[0] == plans[1], plans

@@ -82,9 +82,16 @@ import sys
 #: ``padding`` / ``p99_over_p50`` / ``compiles`` fragments); the cold
 #: control's compile count (``compiles_cold``) is NEUTRAL like the
 #: other control arms (it measures the disease, not the cure).
+#: The pod lane (bench.py pod_phase, ISSUE 14, docs/POD.md) adds
+#: ``pod.pod_vs_single_x`` (routed front-door QPS over the single loop,
+#: via ``pod_vs``) and ``pod.cluster2_vs_single_x`` (2-process
+#: aggregate over the 1-process control, via ``cluster2_vs``) — both
+#: HIGHER; ``route_us`` and ``host_drop_recovery_ms`` ride the generic
+#: ``_us`` / ``_ms`` LOWER fragments.
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
-          "fused_vs", "mega_vs", "vs_repack", "vs_recompute", "attain")
+          "fused_vs", "mega_vs", "vs_repack", "vs_recompute", "attain",
+          "pod_vs", "cluster2_vs")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
          "shard_balance", "warm_restart", "escapes", "padding",
          "p99_over_p50", "compiles")

@@ -63,6 +63,14 @@ compiler, one launch) must match the host-side sequential evaluator
 exactly, clean and through a forced pallas demotion — pinning the
 ``expression.d{D}_q{Q}.fused_qps`` / ``fused_vs_node_x`` bench lanes'
 correctness before their trend is gated.
+
+``--smoke-pod`` (ISSUE 14, docs/POD.md) prepends the pod front-door
+smoke: a routed 2-host simulated pod serving a mixed stream must
+forward mis-routed arrivals, degrade a forced host drop through the
+``reroute`` rung with typed errors only (zero silent failures), and
+serve every routed result bit-exactly vs the sequential reference —
+pinning the ``pod.*`` bench lanes' correctness before their trend is
+gated.
 """
 
 from __future__ import annotations
@@ -574,6 +582,64 @@ def mutation_smoke() -> int:
     return 0 if ok else 1
 
 
+def pod_smoke() -> int:
+    """Pod front-door smoke (ISSUE 14, docs/POD.md): a routed
+    2-host simulated pod serving a mixed stream — mis-routed arrivals
+    forward, a forced host drop degrades through the ``reroute`` rung
+    with typed errors only (nothing silent), and every routed result is
+    bit-exact vs the sequential reference.  Returns 0 when every
+    contract holds, 1 otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.parallel import (BatchQuery, DeviceBitmapSet,
+                                            MultiSetBatchEngine, podmesh)
+    from roaringbitmap_tpu.runtime import errors, faults, guard
+    from roaringbitmap_tpu.serving import (PodFrontDoor, ServingPolicy,
+                                           ServingRequest)
+
+    rng = np.random.default_rng(0x90D5)
+    sets = [DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 16, 800).astype(np.uint32)))
+        for _ in range(5)], layout="dense") for _ in range(3)]
+    plan = podmesh.PlacementPlan(
+        regimes=("replicated-2", "local", "local"),
+        hosts=((0, 1), (0,), (1,)), bytes_per_host=(0, 0))
+    fd = PodFrontDoor(
+        sets, pod=podmesh.PodMesh.simulate(2), plan=plan,
+        policy=ServingPolicy(
+            pool_target=4, default_deadline_ms=600_000.0,
+            guard=guard.GuardPolicy(backoff_base=0.0,
+                                    sleep=lambda s: None)))
+    ref = MultiSetBatchEngine(sets)
+    ops = ("or", "and", "xor", "andnot")
+    tickets = [fd.submit(ServingRequest(
+        i % 3, BatchQuery(ops[i % 4], (0, 1, 2)), tenant=f"t{i % 3}"),
+        via_host=i % 2) for i in range(16)]
+    victim = next(h for h in (0, 1)
+                  if any(t.pod_host == h for t in tickets))
+    with faults.inject(f"coordinator@host{victim}=1.0:13"):
+        fd.pump()                        # the host drop fires here
+        fd.drain()
+    checks: dict = {}
+    checks["host_dropped_typed"] = (fd.stats["host_drops"] == 1
+                                    and not fd.pod.is_alive(victim))
+    checks["rerouted"] = fd.stats["reroutes"] > 0
+    checks["forwarded"] = fd.stats["forwarded"] > 0
+    checks["nothing_silent"] = all(
+        t.status == "done" or isinstance(
+            t.error, errors.RoaringRuntimeError) for t in tickets)
+    served = [t for t in tickets if t.status == "done"]
+    checks["all_served"] = len(served) == len(tickets)
+    checks["bit_exact"] = all(
+        t.result.cardinality == ref._engines[t.pod_sid]._sequential_one(
+            t.query).cardinality for t in served)
+    ok = all(checks.values())
+    print(json.dumps({"smoke_pod": checks, "ok": ok}))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -615,6 +681,12 @@ def main() -> int:
                          "patch + escalated repack, exact result-cache "
                          "invalidation, balanced ledger, nothing "
                          "silent; exit 1 on violation)")
+    ap.add_argument("--smoke-pod", action="store_true",
+                    help="first run the pod front-door smoke (typed "
+                         "host-loss degradation through the reroute "
+                         "rung, mis-route forwarding, zero silent "
+                         "failures, bit-exact routed results; exit 1 "
+                         "on violation)")
     ap.add_argument("--smoke-lattice", action="store_true",
                     help="first run the closed-lattice smoke (warmed "
                          "diverse-tenant replay compiles zero programs, "
@@ -636,6 +708,10 @@ def main() -> int:
             return rc
     if args.smoke_mutation:
         rc = mutation_smoke()
+        if rc:
+            return rc
+    if args.smoke_pod:
+        rc = pod_smoke()
         if rc:
             return rc
     if args.smoke_lattice:

@@ -175,6 +175,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _serving_semantics([s for _, s in spans])
         errors += _mutation_semantics([s for _, s in spans])
         errors += _lattice_semantics([s for _, s in spans])
+        errors += _pod_semantics([s for _, s in spans])
     return errors
 
 
@@ -255,6 +256,72 @@ def _workload_semantics(spans: list[dict],
     errors += _serving_semantics(spans, require=budget_semantics)
     errors += _mutation_semantics(spans, require=budget_semantics)
     errors += _lattice_semantics(spans, require=budget_semantics)
+    errors += _pod_semantics(spans, require=budget_semantics)
+    return errors
+
+
+def _pod_semantics(spans: list[dict], require: bool = False) -> list[str]:
+    """The pod data plane's span vocabulary (parallel.podmesh +
+    serving.frontdoor, docs/POD.md).  Arbitrary dumps validate the
+    ``pod.place`` / ``pod.route`` / ``pod.reroute`` span schemas
+    wherever they appear; ``require`` (the --workload run, which routes
+    a simulated 2-host pod and forces one host drop) additionally
+    demands all three — a host loss must be traced, never silent."""
+    errors: list[str] = []
+    places = [s for s in spans if s.get("name") == "pod.place"]
+    for s in places:
+        tags = s.get("tags") or {}
+        if not isinstance(tags.get("hosts"), int) or tags["hosts"] < 1:
+            errors.append(f"pod.place span without a positive hosts "
+                          f"tag: {tags!r}")
+        if not isinstance(tags.get("tenants"), int) \
+                or tags["tenants"] < 0:
+            errors.append(f"pod.place span without a tenants count: "
+                          f"{tags!r}")
+        if not isinstance(tags.get("regimes"), dict):
+            errors.append(f"pod.place span without a regimes "
+                          f"histogram: {tags!r}")
+        bph = tags.get("bytes_per_host")
+        if not isinstance(bph, list) or not all(
+                isinstance(b, (int, float)) and b >= 0 for b in bph):
+            errors.append(f"pod.place span without non-negative "
+                          f"bytes_per_host: {tags!r}")
+    routes = [s for s in spans if s.get("name") == "pod.route"]
+    for s in routes:
+        tags = s.get("tags") or {}
+        if not isinstance(tags.get("set_id"), int) or tags["set_id"] < 0:
+            errors.append(f"pod.route span without a set_id: {tags!r}")
+        if not tags.get("host"):
+            errors.append(f"pod.route span without a host: {tags!r}")
+        if not isinstance(tags.get("forwarded"), bool):
+            errors.append(f"pod.route span without the forwarded "
+                          f"verdict: {tags!r}")
+        if not tags.get("regime"):
+            errors.append(f"pod.route span without a regime: {tags!r}")
+    reroutes = [s for s in spans if s.get("name") == "pod.reroute"]
+    for s in reroutes:
+        tags = s.get("tags") or {}
+        if not isinstance(tags.get("set_id"), int) or tags["set_id"] < 0:
+            errors.append(f"pod.reroute span without a set_id: {tags!r}")
+        if not tags.get("to"):
+            errors.append(f"pod.reroute span without a destination: "
+                          f"{tags!r}")
+        if not tags.get("reason"):
+            errors.append(f"pod.reroute span without a reason: {tags!r}")
+        if tags.get("rung") != "reroute":
+            errors.append(f"pod.reroute span not tagged with the "
+                          f"reroute rung: {tags!r}")
+    if require:
+        if not places:
+            errors.append("no pod.place span — the workload's pod "
+                          "placement was not traced")
+        if not any((s.get("tags") or {}).get("forwarded") is True
+                   for s in routes):
+            errors.append("no forwarded pod.route span — the workload's "
+                          "mis-routed arrival did not record")
+        if not reroutes:
+            errors.append("no pod.reroute span — the workload's forced "
+                          "host drop did not record")
     return errors
 
 
@@ -330,7 +397,8 @@ def _mutation_semantics(spans: list[dict],
     deltas = [s for s in spans if s.get("name") == "mutation.delta"]
     for s in deltas:
         tags = s.get("tags") or {}
-        if tags.get("mode") not in ("patch", "repack", "noop"):
+        if tags.get("mode") not in ("patch", "repack", "repack_queued",
+                                    "noop"):
             errors.append(f"mutation.delta span with bad mode: {tags!r}")
         if not isinstance(tags.get("version"), int) \
                 or tags["version"] < 0:
@@ -914,6 +982,42 @@ def run_workload(path: str) -> None:
                 "rb_lattice_escapes_total"
         finally:
             rt_lattice.deactivate()
+
+        # pod lane (ISSUE 14, docs/POD.md): a simulated 2-host pod over
+        # the same tenant universe — one mis-routed arrival (forwarded),
+        # then a forced host drop whose tickets walk the reroute rung;
+        # the pod.place / pod.route / pod.reroute schemas + presence are
+        # what the semantics checks above pin, bit-exact throughout
+        from roaringbitmap_tpu.parallel import podmesh
+        from roaringbitmap_tpu.serving import PodFrontDoor
+
+        pod_plan = podmesh.PlacementPlan(
+            regimes=("replicated-2", "local", "local"),
+            hosts=((0, 1), (0,), (1,)), bytes_per_host=(0, 0))
+        fd = PodFrontDoor(
+            [e._ds for e in ms._engines],
+            pod=podmesh.PodMesh.simulate(2), plan=pod_plan,
+            policy=ServingPolicy(
+                pool_target=4, default_deadline_ms=600_000.0,
+                guard=rt_guard.GuardPolicy(backoff_base=0.0,
+                                           sleep=lambda s: None)))
+        podmesh.place([e._ds for e in ms._engines], fd.pod)
+        pod_tickets = [fd.submit(ServingRequest(
+            i % 3, BatchQuery("or", (0, 1, 2)), tenant=f"t{i % 3}"),
+            via_host=1 - (i % 2)) for i in range(8)]
+        victim = next(h for h in (0, 1)
+                      if any(t.pod_host == h for t in pod_tickets))
+        fd.fail_host(victim)
+        fd.drain()
+        for t in pod_tickets:
+            assert t.status == "done", (t.status, t.error)
+            ref = ms._engines[t.pod_sid]._sequential_one(t.query)
+            assert t.result.cardinality == ref.cardinality, \
+                "routed pod result diverged from the sequential " \
+                "reference"
+        assert fd.stats["forwarded"] > 0, "no arrival was forwarded"
+        assert fd.stats["reroutes"] > 0, \
+            "the forced host drop rerouted nothing"
     finally:
         obs.disable()
 

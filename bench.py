@@ -86,6 +86,7 @@ EXPR_Q = (8, 64)                # expression pool sizes per cell
 SERVING_RATES = (0.5, 2.0, 4.0)  # serving lane arrival-rate multiples of
 #                                  the measured sustainable rate (ISSUE 10)
 SERVING_N = 400                  # arrivals per sweep cell
+OLAP_Q = (8, 32)                 # fused analytics pool sizes (ISSUE 15)
 
 
 def load_cpu_baseline(dataset: str) -> tuple[float | None, dict]:
@@ -954,6 +955,130 @@ def lattice_phase() -> dict:
     return out
 
 
+def olap_phase() -> dict:
+    """Device-native analytics lane (ISSUE 15, docs/ANALYTICS.md): fused
+    filter-then-aggregate OLAP pools — ``sum_`` / ``top_k`` roots over
+    set-algebra x value-predicate found sets — in ONE engine launch, vs
+    the TWO-PHASE baseline the lane replaces (filter dispatch, bitmap
+    readback, re-densify over the column keys, second aggregate
+    dispatch; ``analytics.two_phase_execute``).  Every cell asserts the
+    fused pool bit-equal to the two-phase run AND the host
+    BSI/RangeBitmap oracle before timing; ``fused_vs_twophase_x`` is the
+    acceptance headline (>= 2x on the CPU proxy).  The warmed sub-cell
+    replays the same traffic with NEW predicate values through a sealed
+    ``bsi=<depth>`` lattice and must compile NOTHING (zero escapes) —
+    the zero-post-warmup-compile half of the acceptance pin."""
+    import numpy as np
+
+    from roaringbitmap_tpu.analytics import BsiColumn, two_phase_execute
+    from roaringbitmap_tpu.obs import metrics as obs_metrics
+    from roaringbitmap_tpu.ops.packing import next_pow2
+    from roaringbitmap_tpu.parallel import expr
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+    from roaringbitmap_tpu.parallel.batch_engine import BatchEngine
+    from roaringbitmap_tpu.runtime import lattice as rt_lattice
+    from roaringbitmap_tpu.utils import datasets
+
+    rng = np.random.default_rng(0x01A9)
+    n, uni, vmax = 8, 1 << 16, 9000
+    bms = datasets.synthetic_bitmaps(n, seed=150, universe=uni,
+                                     density=0.006)
+    # result cache OFF on both arms: the lane measures execution, not
+    # the mutation cache (which would turn the replay into dict hits)
+    eng = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                      result_cache=None)
+    ids = np.unique(rng.integers(0, uni, 20000)).astype(np.uint32)
+    col = BsiColumn("price", ids,
+                    rng.integers(0, vmax, ids.size).astype(np.int64))
+    eng._ds.attach_column(col)
+
+    def pool_of(q: int, seed: int) -> list:
+        """Mixed aggregate-rooted OLAP pool: sum_/top_k over fused
+        (set-algebra AND value-range) found sets — the
+        ``count((A|B) & range_(price, lo, hi))`` class."""
+        r = np.random.default_rng(seed)
+        out = []
+        for i in range(q):
+            a, b = r.choice(n, size=2, replace=False)
+            lo = int(r.integers(0, vmax // 2))
+            hi = lo + int(r.integers(200, vmax // 2))
+            found = expr.and_(expr.or_(int(a), int(b)),
+                              expr.range_("price", lo, hi))
+            if i % 2:
+                out.append(expr.ExprQuery(expr.sum_("price",
+                                                    found=found)))
+            else:
+                out.append(expr.ExprQuery(
+                    expr.top_k("price", 8, found=found), form="bitmap"))
+        return out
+
+    def results_of(rows) -> list:
+        return [(r.cardinality, r.value,
+                 None if r.bitmap is None else r.bitmap.cardinality)
+                for r in rows]
+
+    out: dict = {"resident_bitmaps": n, "column_rows": int(ids.size),
+                 "column_depth_pad": col.depth_pad}
+    for q in OLAP_Q:
+        pool = pool_of(q, 0xA0 + q)
+        fused = eng.execute(pool)
+        tp = two_phase_execute(eng, pool)
+        assert results_of(fused) == results_of(tp), \
+            f"fused/two-phase divergence (Q={q})"
+        # host-oracle pin: the fused answers vs the host BSI evaluator
+        for qq, r in zip(pool, fused):
+            card, value, bm = expr.evaluate_host_agg(
+                qq.expr, bms, {"price": col})
+            assert (r.cardinality, r.value) == (card, value), q
+            if qq.form == "bitmap":
+                assert r.bitmap == bm, q
+        t_fused = best_of(lambda: eng.execute(pool))
+        t_two = best_of(lambda: two_phase_execute(eng, pool), reps=3)
+        out[f"q{q}"] = {
+            "fused_qps": round(q / t_fused, 1),
+            "twophase_qps": round(q / t_two, 1),
+            "fused_vs_twophase_x": round(t_two / t_fused, 2)}
+
+    # warmed replay: a sealed bsi=<depth> lattice must serve NEW
+    # predicate values / k compile-free (the lattice satellite's claim,
+    # mirrored from lattice_phase onto analytics traffic)
+    warm_eng = BatchEngine(DeviceBitmapSet(bms, layout="dense"),
+                           result_cache=None)
+    warm_eng._ds.attach_column(col)
+    prof = (f"q=4,;rows={next_pow2(n)};keys=8;"
+            f"ops=or,and,xor,andnot;heads=both;expr=2;"
+            f"bsi={col.depth_pad},")
+    rep = warm_eng.warmup(profile=prof)
+    m0 = obs_metrics.compile_miss_total()
+    e0 = rt_lattice.escape_total()
+    # single-query replay — the prepared-statement pattern the lattice
+    # closes over (one OLAP request per arrival): warmed SHAPES, new
+    # predicate values / operand pairs / k every iteration
+    warm_walls = []
+    for i in range(6):
+        for q in pool_of(4, 0xB0 + i):
+            t0 = time.perf_counter()
+            warm_eng.execute([q])
+            warm_walls.append((time.perf_counter() - t0) * 1e3)
+    warmed_compiles = obs_metrics.compile_miss_total() - m0
+    escapes = rt_lattice.escape_total() - e0
+    rt_lattice.deactivate()
+    out["warmed"] = {
+        "profile": prof,
+        "points": rep["lattice"]["points"],
+        "warmed_compiles": warmed_compiles,
+        "escapes": escapes,
+        "replay_p50_ms": round(sorted(warm_walls)[len(warm_walls) // 2],
+                               3)}
+    q_max = max(OLAP_Q)
+    out["headline"] = {
+        "fused_vs_twophase_x": out[f"q{q_max}"]["fused_vs_twophase_x"],
+        "meets_2x": out[f"q{q_max}"]["fused_vs_twophase_x"] >= 2.0,
+        "warmed_compiles": warmed_compiles,
+        "zero_compile_warmed": warmed_compiles == 0 and escapes == 0}
+    return out
+
+
 def _dryrun_env(n_devices: int = 8) -> dict:
     """A CPU dry-run environment for subprocess cells: forced host
     platform device count, TPU plugin never initialised (the
@@ -1334,8 +1459,8 @@ SUMMARY_MAX_BYTES = 2048
 #: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
 #: they are trend inputs for the sentry, not driver-gate fields, and the
 #: full doc always keeps them
-SUMMARY_DROP_ORDER = ("phase_ms", "cost", "pod", "lattice", "mutation",
-                      "serving", "sharded", "expression",
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "olap", "pod", "lattice",
+                      "mutation", "serving", "sharded", "expression",
                       "marginal_us_spread", "multiset", "batched_qps",
                       "marginal_us_median", "unit", "backend",
                       "north_star")
@@ -1487,6 +1612,21 @@ def build_summary(out: dict, full_path: str) -> dict:
     la = out.get("lattice") or {}
     if la.get("headline"):
         s["lattice"] = dict(la["headline"])
+    # analytics OLAP lane, compact: [fused_qps, twophase_qps, ratio]
+    # per Q cell + the fused-vs-two-phase headline and the warmed
+    # zero-compile claim (bench.py olap_phase, docs/ANALYTICS.md)
+    ol = out.get("olap") or {}
+    ol_lanes = {}
+    for key, row in ol.items():
+        if isinstance(row, dict) and "fused_qps" in row:
+            ol_lanes[key] = [row["fused_qps"], row["twophase_qps"],
+                             row["fused_vs_twophase_x"]]
+    if ol_lanes:
+        head = ol.get("headline") or {}
+        ol_lanes["fused_vs_twophase_x"] = head.get("fused_vs_twophase_x")
+        ol_lanes["warmed_compiles"] = head.get("warmed_compiles")
+        ol_lanes["zero_compile_warmed"] = head.get("zero_compile_warmed")
+        s["olap"] = ol_lanes
     # pod lane, compact: routed-vs-single QPS, routing overhead,
     # host-drop recovery, and the 2-process cluster scale-out ratio
     # (bench.py pod_phase, docs/POD.md)
@@ -1675,6 +1815,7 @@ def main() -> None:
     sharded = sharded_phase()
     mutation = mutation_phase()
     lattice = lattice_phase()
+    olap = olap_phase()
     pod = pod_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
@@ -1733,6 +1874,7 @@ def main() -> None:
     out["sharded"] = sharded
     out["mutation"] = mutation
     out["lattice"] = lattice
+    out["olap"] = olap
     out["pod"] = pod
 
     # full document to disk; stdout gets ONLY the compact summary as its

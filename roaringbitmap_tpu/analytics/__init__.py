@@ -1,0 +1,21 @@
+"""Device-native analytics lane: BSI + RangeBitmap value queries as
+first-class engine ops fused with the expression DAG (ROADMAP item 5,
+docs/ANALYTICS.md).
+
+Attach a column to a tenant (``DeviceBitmapSet.attach_column``), then
+filter-then-aggregate in ONE launch through any engine::
+
+    from roaringbitmap_tpu.analytics import BsiColumn
+    from roaringbitmap_tpu.parallel import expr
+
+    ds.attach_column(BsiColumn("price", row_ids, prices))
+    eng.execute([expr.ExprQuery(
+        expr.sum_("price",
+                  found=expr.and_(expr.or_(0, 1),
+                                  expr.range_("price", lo, hi))))])
+"""
+
+from .column import BsiColumn, RangeColumn
+from .two_phase import two_phase_execute
+
+__all__ = ["BsiColumn", "RangeColumn", "two_phase_execute"]

@@ -1,0 +1,399 @@
+"""Tenant-attachable value columns: the resident artifacts of the
+device-native analytics lane (ROADMAP item 5, docs/ANALYTICS.md).
+
+A column binds a value domain to a tenant's row-id universe twice:
+
+- a **host oracle** — the existing host tiers verbatim
+  (``bsi.slice_index.RoaringBitmapSliceIndex`` for sparse columns,
+  ``core.rangebitmap.RangeBitmap`` for dense row-indexed ones) — the
+  bit-exact reference every fused engine path is pinned against;
+- a **device artifact** — the slice planes densified once over the
+  column's container-key set and padded to a pow2 depth
+  (``u32[S_pad, K, 2048]`` + the existence plane ``u32[K, 2048]``),
+  HBM-ledger-registered (kind ``bsi_column`` / ``range_column``) and
+  shipped into engine programs as NON-donated operands, so predicate
+  values never force a recompile and pipelined donation can never
+  destroy a resident column.
+
+Columns carry the mutation lineage discipline of
+:mod:`..mutation.delta`: a process-unique ``uid`` (shared counter with
+``DeviceBitmapSet``, so result-cache leaves never collide), a monotone
+``version`` bumped per :meth:`apply_delta`, and a
+``structure_version`` bumped when the packed shapes move (padded depth
+or key count) — engine plan keys embed the former, program signatures
+close over the latter through the compiled step shapes.  A delta
+notifies every live result cache (``notify_version_bump``) so entries
+whose keys carry this column's ``(uid, version)`` leaf drop exactly.
+
+Padding to pow2 depth is exact by construction: a padded zero plane
+with a zero predicate bit leaves every O'Neil/Kaser state update at
+the identity (analytics.plane), and it is what makes the lattice's
+``bsi=<depth>`` shape-classes a closed vocabulary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bsi.device import _densify
+from ..bsi.slice_index import (Operation, RoaringBitmapSliceIndex,
+                               clamp_range_bounds, kaser_top_k,
+                               minmax_decision, trim_smallest)
+from ..core.bitmap import RoaringBitmap, and_ as rb_and, andnot as rb_andnot
+from ..core.rangebitmap import RangeBitmap
+from ..obs import memory as obs_memory
+from ..obs import trace as obs_trace
+from ..ops import packing
+from . import plane
+
+WORDS32 = packing.WORDS32
+
+#: canonical predicate ops the IR accepts (parallel.expr.cmp / range_)
+PRED_OPS = ("eq", "neq", "lt", "le", "gt", "ge", "range")
+
+_BSI_OP = {"eq": Operation.EQ, "neq": Operation.NEQ, "lt": Operation.LT,
+           "le": Operation.LE, "gt": Operation.GT, "ge": Operation.GE,
+           "range": Operation.RANGE}
+
+
+def _next_uid() -> int:
+    # shared counter with DeviceBitmapSet: result-cache leaves key on
+    # (uid, source) and must never collide across sets and columns
+    from ..parallel.aggregation import _SET_UIDS
+
+    return next(_SET_UIDS)
+
+
+class _ColumnBase:
+    """Shared packing / versioning / ledger spine of both column kinds."""
+
+    kind = "column"
+
+    def _init_identity(self, name: str) -> None:
+        self.name = str(name)
+        self.uid = _next_uid()
+        self.version = 0
+        self.structure_version = 0
+        self._dev = None
+        self._ledger = None
+
+    def _pack(self, ebm_bitmap: RoaringBitmap, slice_bitmaps) -> None:
+        """Densify the existence plane + slices over the ebm's key set,
+        pad the slice axis to a pow2 depth (zero planes are exact
+        no-ops under zero predicate bits), keep host twins (the
+        sharded engine re-places them replicated) and cache the
+        single-device upload lazily."""
+        keys = np.asarray(ebm_bitmap.keys, np.uint16).copy()
+        depth = len(slice_bitmaps)
+        depth_pad = packing.next_pow2(max(1, depth))
+        ebm_np = (_densify(ebm_bitmap, keys) if keys.size
+                  else np.zeros((0, WORDS32), np.uint32))
+        slices_np = np.zeros((depth_pad,) + ebm_np.shape, np.uint32)
+        for i, s in enumerate(slice_bitmaps):
+            if keys.size:
+                slices_np[i] = _densify(s, keys)
+        old_shape = (getattr(self, "depth_pad", None),
+                     getattr(self, "keys", np.zeros(0)).size)
+        self.keys = keys
+        self.depth = depth
+        self.depth_pad = depth_pad
+        self.ebm_np = ebm_np
+        self.slices_np = slices_np
+        self._dev = None
+        if old_shape != (None, 0) and old_shape != (depth_pad, keys.size):
+            self.structure_version += 1
+        if self._ledger is None:
+            self._ledger = obs_memory.LEDGER.register(
+                self.kind, "dense", self.hbm_bytes(), owner=self)
+        else:
+            obs_memory.LEDGER.update(self._ledger, self.hbm_bytes())
+
+    def hbm_bytes(self) -> int:
+        return int(self.slices_np.nbytes + self.ebm_np.nbytes)
+
+    def device_operands(self):
+        """``(slices, ebm)`` device twins, uploaded once per structure
+        version — the per-dispatch program operands (never donated)."""
+        if self._dev is None:
+            import jax
+
+            self._dev = (jax.device_put(self.slices_np),
+                         jax.device_put(self.ebm_np))
+        return self._dev
+
+    def _bits(self, value: int):
+        return np.asarray(plane.predicate_bits(value, self.depth_pad))
+
+    def _note_delta(self, mode: str) -> None:
+        self.version += 1
+        from ..mutation import result_cache as mut_cache
+
+        dropped = mut_cache.notify_version_bump(self.uid)
+        obs_trace.current().event(
+            "analytics.delta", col=self.name, uid=self.uid, kind=self.kind,
+            mode=mode, version=self.version,
+            structure_version=self.structure_version,
+            cache_dropped=dropped, hbm_bytes=self.hbm_bytes())
+
+    # ----------------------------------------------------- two-phase lane
+    def device_agg(self, kind: str, found: RoaringBitmap, k: int = 0):
+        """The TWO-PHASE baseline's second launch (bench olap lane): a
+        read-back found bitmap re-densifies over the column keys and
+        runs the aggregate as its own device dispatch — exactly the
+        readback + re-upload the fused path deletes."""
+        import jax
+        import jax.numpy as jnp
+
+        slices, ebm = self.device_operands()
+        fw = (jnp.asarray(_densify(found, self.keys)) if self.keys.size
+              else ebm)
+        if kind == "sum":
+            cards = np.asarray(jax.jit(plane.sum_cards)(slices, fw))
+            total = sum((1 << i) * int(cards[i].sum())
+                        for i in range(self.depth))
+            return total, found.cardinality
+        ft = fw & ebm
+        words = np.asarray(jax.jit(plane.topk_words)(
+            slices, ft, jnp.int32(k)))
+        cards = np.asarray(plane.popcount(jnp.asarray(words)))
+        return trim_smallest(
+            packing.unpack_result(self.keys, words, cards), k)
+
+
+class BsiColumn(_ColumnBase):
+    """Sparse value column over arbitrary 32-bit row ids, backed by the
+    host ``RoaringBitmapSliceIndex`` (the oracle) and its padded device
+    slice planes.  Values in [0, 2^31 - 1] (the BSI tier's range)."""
+
+    kind = "bsi_column"
+
+    def __init__(self, name: str, column_ids, values):
+        self._init_identity(name)
+        self.host = RoaringBitmapSliceIndex.from_pairs(
+            np.asarray(column_ids, np.uint32),
+            np.asarray(values, np.int64))
+        self._repack()
+        with obs_trace.span("analytics.column", col=self.name,
+                            kind=self.kind, uid=self.uid,
+                            depth=self.depth, depth_pad=self.depth_pad,
+                            keys=int(self.keys.size),
+                            hbm_bytes=self.hbm_bytes()):
+            pass
+
+    @classmethod
+    def from_bsi(cls, name: str, bsi: RoaringBitmapSliceIndex
+                 ) -> "BsiColumn":
+        out = cls.__new__(cls)
+        out._init_identity(name)
+        out.host = bsi.clone()
+        out._repack()
+        return out
+
+    def _repack(self) -> None:
+        self.min_value = self.host.min_value
+        self.max_value = self.host.max_value
+        self._pack(self.host.ebm, self.host.slices)
+
+    # -------------------------------------------------------- planning
+    def scan_plan(self, op: str, lo: int, hi: int = 0):
+        """Plan-time lowering of one predicate: ``("empty",)`` /
+        ``("all",)`` (the min/max pruning fast paths, shared with the
+        host comparator so both prune identically) or ``("scan", tag,
+        bits, bits2)`` with the clamped bounds decomposed into the
+        padded-depth bit arrays the traced scan consumes."""
+        bop = _BSI_OP[op]
+        if self.host.ebm.is_empty() or self.keys.size == 0:
+            # predicate leaves evaluate over the existence plane (found
+            # = ebM), so an empty column answers empty for EVERY op,
+            # NEQ included (ebM \ eq == empty)
+            return ("empty",)
+        decision = minmax_decision(bop, lo, hi, self.min_value,
+                                   self.max_value)
+        if decision == "empty":
+            return ("empty",)
+        if decision == "all":
+            return ("all",)
+        lo, hi = clamp_range_bounds(bop, lo, hi, self.min_value,
+                                    self.max_value)
+        return ("scan", f"bsi:{bop.value}", self._bits(lo),
+                self._bits(hi))
+
+    # ----------------------------------------------------- host oracle
+    def host_filter(self, op: str, lo: int, hi: int = 0) -> RoaringBitmap:
+        return self.host.compare(_BSI_OP[op], lo, hi)
+
+    def host_sum(self, found: RoaringBitmap | None):
+        return self.host.sum(found)
+
+    def host_top_k(self, k: int, found: RoaringBitmap | None
+                   ) -> RoaringBitmap:
+        fs = (self.host.ebm if found is None
+              else rb_and(self.host.ebm, found))
+        return self.host.top_k(min(int(k), fs.cardinality), fs)
+
+    def apply_delta(self, set_values=None, removes=()) -> dict:
+        """Mutate the column in place: ``removes`` drop rows from every
+        plane, ``set_values`` ({row_id: value} or (ids, values)) upsert
+        — then the device artifact repacks, the version bumps, and
+        every dependent result-cache entry drops exactly."""
+        with obs_trace.span("analytics.delta_apply", col=self.name,
+                            kind=self.kind):
+            removes = list(removes)
+            if removes:
+                rm = RoaringBitmap.from_values(
+                    np.asarray(removes, np.uint32))
+                self.host.ebm = rb_andnot(self.host.ebm, rm)
+                self.host.slices = [rb_andnot(s, rm)
+                                    for s in self.host.slices]
+                if self.host.ebm.is_empty():
+                    self.host.min_value = self.host.max_value = 0
+                else:
+                    self.host._recompute_min_max()
+            n_set = 0
+            if set_values:
+                if isinstance(set_values, dict):
+                    pairs = sorted(set_values.items())
+                else:
+                    ids, vals = set_values
+                    pairs = list(zip(np.asarray(ids).tolist(),
+                                     np.asarray(vals).tolist()))
+                self.host.set_values(pairs)
+                n_set = len(pairs)
+            self._repack()
+            self._note_delta("patch")
+        return {"set": n_set, "removed": len(removes),
+                "version": self.version,
+                "structure_version": self.structure_version}
+
+
+class RangeColumn(_ColumnBase):
+    """Dense row-indexed value column (rows 0..N-1), backed by the host
+    ``RangeBitmap`` (the threshold oracle; full u64 value range) and the
+    stored value vector (the aggregate oracle)."""
+
+    kind = "range_column"
+
+    def __init__(self, name: str, values):
+        self._init_identity(name)
+        self.values = np.asarray(values, np.int64).copy()
+        if self.values.size and int(self.values.min()) < 0:
+            raise ValueError("range column values must be >= 0")
+        self._rebuild()
+        with obs_trace.span("analytics.column", col=self.name,
+                            kind=self.kind, uid=self.uid,
+                            depth=self.depth, depth_pad=self.depth_pad,
+                            keys=int(self.keys.size),
+                            hbm_bytes=self.hbm_bytes()):
+            pass
+
+    def _rebuild(self) -> None:
+        mx = int(self.values.max()) if self.values.size else 0
+        app = RangeBitmap.appender(mx)
+        for v in self.values.tolist():
+            app.add(int(v))
+        self.host = app.build()
+        self.min_value = int(self.values.min()) if self.values.size else 0
+        self.max_value = mx
+        self.rows = int(self.values.size)
+        all_rows = (RoaringBitmap.from_range(0, self.rows)
+                    if self.rows else RoaringBitmap())
+        self._pack(all_rows, self.host.slices)
+
+    # -------------------------------------------------------- planning
+    def scan_plan(self, op: str, lo: int, hi: int = 0):
+        """RangeBitmap guard semantics (core.rangebitmap): thresholds
+        outside the stored domain short-circuit exactly like the host
+        tier, everything else lowers to the lte/gte/eq/neq/between
+        double-evaluation scan family."""
+        if self.rows == 0 or self.keys.size == 0:
+            return ("empty",)
+        mx = self.max_value
+        if op == "lt":
+            if lo <= 0:
+                return ("empty",)
+            op, lo = "le", lo - 1
+        elif op == "gt":
+            op, lo = "ge", lo + 1
+        if op == "le":
+            if lo < 0:
+                return ("empty",)
+            if lo >= mx:
+                return ("all",)
+            return ("scan", "range:lte", self._bits(lo), self._bits(0))
+        if op == "ge":
+            if lo <= 0:
+                return ("all",)
+            if lo > mx:
+                return ("empty",)
+            return ("scan", "range:gte", self._bits(lo), self._bits(0))
+        if op == "eq":
+            if lo < 0 or lo > mx:
+                return ("empty",)
+            return ("scan", "range:eq", self._bits(lo), self._bits(0))
+        if op == "neq":
+            if lo < 0 or lo > mx:
+                return ("all",)
+            return ("scan", "range:neq", self._bits(lo), self._bits(0))
+        if op == "range":
+            a, b = max(lo, 0), min(hi, mx)
+            if a > mx or hi < 0 or a > b:
+                return ("empty",)
+            if a <= 0 and b >= mx:
+                return ("all",)
+            return ("scan", "range:between", self._bits(a),
+                    self._bits(b))
+        raise ValueError(f"unknown predicate op {op!r}")
+
+    # ----------------------------------------------------- host oracle
+    def host_filter(self, op: str, lo: int, hi: int = 0) -> RoaringBitmap:
+        rb = self.host
+        if op == "le":
+            return rb.lte(lo)
+        if op == "lt":
+            return rb.lt(lo)
+        if op == "ge":
+            return rb.gte(lo)
+        if op == "gt":
+            return rb.gt(lo)
+        if op == "eq":
+            return rb.eq(lo)
+        if op == "neq":
+            return rb.neq(lo)
+        if op == "range":
+            return rb.between(lo, hi)
+        raise ValueError(f"unknown predicate op {op!r}")
+
+    def host_sum(self, found: RoaringBitmap | None):
+        if found is None:
+            return int(self.values.sum()), self.rows
+        rows = found.to_array()
+        valid = rows < self.rows
+        return (int(self.values[rows[valid]].sum()),
+                found.cardinality)
+
+    def host_top_k(self, k: int, found: RoaringBitmap | None
+                   ) -> RoaringBitmap:
+        universe = (RoaringBitmap.from_range(0, self.rows)
+                    if self.rows else RoaringBitmap())
+        fs = universe if found is None else rb_and(universe, found)
+        return kaser_top_k(self.host.slices, fs,
+                           min(int(k), fs.cardinality))
+
+    def apply_delta(self, updates: dict) -> dict:
+        """Patch row values in place ({row: value}); the host oracle
+        and the device planes rebuild, the version bumps, dependent
+        cache entries drop exactly."""
+        with obs_trace.span("analytics.delta_apply", col=self.name,
+                            kind=self.kind):
+            for row, value in updates.items():
+                row = int(row)
+                if row < 0 or row >= self.rows:
+                    raise IndexError(
+                        f"row {row} out of range 0..{self.rows - 1}")
+                if int(value) < 0:
+                    raise ValueError("range column values must be >= 0")
+                self.values[row] = int(value)
+            self._rebuild()
+            self._note_delta("patch")
+        return {"set": len(updates), "version": self.version,
+                "structure_version": self.structure_version}

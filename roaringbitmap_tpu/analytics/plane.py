@@ -1,0 +1,65 @@
+"""Traced slice-plane scan cores for the device-native analytics lane.
+
+The expression compiler (parallel.expr) lowers a value predicate —
+``range_(col, lo, hi)`` / ``cmp(col, op, v)`` — to ONE ``vscan`` step
+whose traced body lives here: a descending O'Neil pass over the
+column's base-2 slice planes (``bsi.device.oneil_scan`` /
+``oneil_scan2``) producing a key-aligned ``u32[K, 2048]`` row block
+that feeds the existing or/and/xor/andnot combine passes of the same
+compiled program.  Aggregate roots (``sum_`` / ``top_k``) reuse the
+weighted-popcount contraction and the Kaser scan the device BSI tier
+already proves bit-exact.
+
+Scan tags are ``"<kind>:<op>"`` strings — ``kind`` selects the
+comparator family (``bsi`` = the O'Neil comparator with EQ/NEQ/LT/LE/
+GT/GE/RANGE semantics, ``range`` = the RangeBitmap threshold family
+lte/gte/eq/neq/between), ``op == "all"`` short-circuits to the
+existence plane.  The tag is static program data (one compiled
+program per tag x padded depth x key count); predicate VALUES ride as
+bit-array operands, so warmed analytics traffic replaying new values
+compiles nothing (docs/ANALYTICS.md).
+"""
+
+from __future__ import annotations
+
+from ..bsi.device import (_compare_res, _range_res, _topk_res,
+                          predicate_bits)
+from ..ops.dense import popcount
+
+#: comparator-family ops a ``vscan`` step may carry (plus "all")
+BSI_OPS = ("EQ", "NEQ", "LT", "LE", "GT", "GE", "RANGE")
+RANGE_OPS = ("lte", "gte", "eq", "neq", "between")
+
+
+def scan_words(tag: str, slices, ebm, bits, bits2):
+    """Traced value-predicate scan: one descending pass over the
+    padded slice planes -> ``u32[K, 2048]`` result words over the
+    column's key space.  Padded zero planes (pow2 depth closure) are
+    exact no-ops: their predicate bits are 0, so every state update
+    reduces to the identity."""
+    kind, _, op = tag.partition(":")
+    if op == "all":
+        return ebm
+    if kind == "bsi":
+        return _compare_res(op, slices, ebm, bits, bits2, ebm)
+    if kind == "range":
+        return _range_res(op, slices, ebm, bits, bits2, ebm)
+    raise ValueError(f"unknown scan tag {tag!r}")
+
+
+def sum_cards(slices, found_on_col):
+    """Per-(slice, key) popcounts of ``slices ∩ found`` — ``i32[S, K]``,
+    each cell <= 2^16 so i32 never overflows; the 2^i weighting happens
+    in host Python ints (bsi.device.DeviceBSI.sum's discipline)."""
+    return popcount(slices & found_on_col[None, :, :], axis=-1)
+
+
+def topk_words(slices, found, k):
+    """Kaser top-K scan over the found set (``k`` is a TRACED scalar so
+    one compiled program serves every k at a given depth); the final
+    tie trim happens host-side at readback."""
+    return _topk_res(slices, found, k)
+
+
+__all__ = ["scan_words", "sum_cards", "topk_words", "predicate_bits",
+           "BSI_OPS", "RANGE_OPS"]

@@ -1,0 +1,51 @@
+"""The two-phase baseline the fused analytics lane is measured against.
+
+Before this lane, an OLAP request had to run as two dispatches with a
+host round trip between them: (1) evaluate the filter expression
+through the engine with a BITMAP-form root (the result rows read back
+and unpacked on the host), then (2) re-densify that bitmap over the
+column's key set and run the aggregate as its own launch
+(``Column.device_agg``).  The fused path deletes the readback, the
+re-upload, and the second dispatch floor — ``bench.py``'s ``olap``
+lane reports the ratio as ``fused_vs_twophase_x``.
+"""
+
+from __future__ import annotations
+
+
+def two_phase_execute(engine, queries, engine_rung: str = "auto"):
+    """Execute aggregate-rooted ExprQuerys the pre-analytics way: one
+    bitmap-form engine dispatch for the found set, readback, then one
+    ``device_agg`` dispatch per query.  Bit-exact with the fused path
+    by construction; only the launch count and the host round trips
+    differ."""
+    from ..parallel import expr as expr_mod
+    from ..parallel.batch_engine import BatchResult
+
+    out = []
+    for q in queries:
+        if not isinstance(q, expr_mod.ExprQuery):
+            raise ValueError("two_phase_execute takes ExprQuerys")
+        e = expr_mod.canonicalize(q.expr)
+        if not isinstance(e, expr_mod.Agg):
+            raise ValueError(
+                "two_phase_execute models filter-then-aggregate: the "
+                "root must be sum_/top_k")
+        col = engine._column(e.col)
+        if e.found is None:
+            found = col.host_filter("ge", 0)    # the whole stored domain
+        else:
+            # phase 1: the filter expression as its own dispatch, rows
+            # materialized back to the host
+            found = engine.execute(
+                [expr_mod.ExprQuery(e.found, form="bitmap")],
+                engine=engine_rung)[0].bitmap
+        if e.kind == "sum":
+            total, count = col.device_agg("sum", found)
+            out.append(BatchResult(cardinality=count, value=total))
+        else:
+            bm = col.device_agg("topk", found, k=e.k)
+            out.append(BatchResult(
+                cardinality=bm.cardinality,
+                bitmap=bm if q.form == "bitmap" else None))
+    return out

@@ -173,6 +173,13 @@ class DeviceBSI:
         self._ebm_host = (bsi.ebm.clone() if hasattr(bsi.ebm, "clone")
                           else bsi.ebm.to_bitmap())
         self.keys, self.ebm, self.slices = _pack_index(bsi.ebm, bsi.slices)
+        # HBM ledger registration with a GC-release finalizer, matching
+        # DeviceBitmapSet: the packed planes are resident device bytes
+        # and must show in rb_hbm_resident_bytes / obs.snapshot()["hbm"]
+        from ..obs import memory as obs_memory
+
+        obs_memory.LEDGER.register("bsi", "dense", self.hbm_bytes(),
+                                   owner=self)
 
     def hbm_bytes(self) -> int:
         return int(self.ebm.nbytes + self.slices.nbytes)
@@ -389,6 +396,11 @@ class DeviceRangeBitmap:
         self.depth = len(rb.slices)
         all_rows = RoaringBitmap.from_range(0, self.rows)
         self.keys, self.ebm, self.slices = _pack_index(all_rows, rb.slices)
+        # ledger-registered like DeviceBSI (GC finalizer releases)
+        from ..obs import memory as obs_memory
+
+        obs_memory.LEDGER.register("rangebitmap", "dense",
+                                   self.hbm_bytes(), owner=self)
 
     def hbm_bytes(self) -> int:
         return int(self.ebm.nbytes + self.slices.nbytes)

@@ -83,6 +83,14 @@ def minmax_decision(op: Operation, start: int, end: int,
     elif op is Operation.NEQ:
         if mn == mx:
             return "empty" if mn == start else "all"
+        if start < mn or start > mx:
+            # no stored value can equal an out-of-band predicate, so NEQ
+            # matches every stored row.  Without this rung the O'Neil
+            # scan truncates the predicate to bit_count bits (a negative
+            # or > max value aliases a stored one) while the padded
+            # analytics scan decomposes it exactly — the two tiers would
+            # answer differently.
+            return "all"
     elif op is Operation.RANGE:
         if start <= mn and end >= mx:
             return "all"
@@ -143,6 +151,39 @@ def read_vlong(buf: memoryview, pos: int) -> tuple[int, int]:
         v = (v << 8) | buf[pos]
         pos += 1
     return (v ^ -1) if negative else v, pos
+
+
+def trim_smallest(bm: RoaringBitmap, k: int) -> RoaringBitmap:
+    """Drop the smallest row ids until ``bm`` holds k rows — the Kaser
+    tie rule, shared by the host scan and the device readbacks
+    (analytics columns, the fused ``top_k`` assembly)."""
+    excess = bm.cardinality - k
+    if excess > 0:
+        for v in bm.to_array()[:excess]:
+            bm.remove(int(v))
+    return bm
+
+
+def kaser_top_k(slices, found: RoaringBitmap, k: int) -> RoaringBitmap:
+    """Kaser top-K over an arbitrary slice-bitmap stack
+    (BitSliceIndexBase.topK :303-341, generalized so the analytics
+    ``RangeColumn`` oracle — > 31-bit value domains the BSI tier
+    rejects — shares the one implementation): the rows holding the k
+    largest values within ``found``, ties trimmed smallest-id-first."""
+    g = RoaringBitmap()
+    e = found
+    for i in range(len(slices) - 1, -1, -1):
+        x = rb_or(g, rb_and(e, slices[i]))
+        n = x.cardinality
+        if n > k:
+            e = rb_and(e, slices[i])
+        elif n < k:
+            g = x
+            e = rb_andnot(e, slices[i])
+        else:
+            e = rb_and(e, slices[i])
+            break
+    return trim_smallest(rb_or(g, e), k)
 
 
 def _write_vint(out: bytearray, v: int) -> None:
@@ -492,25 +533,7 @@ class RoaringBitmapSliceIndex:
         if k < 0 or k > fixed.cardinality:
             raise ValueError(
                 f"TopK param error,cardinality:{fixed.cardinality} k:{k}")
-        g = RoaringBitmap()
-        e = fixed
-        for i in range(self.bit_count() - 1, -1, -1):
-            x = rb_or(g, rb_and(e, self.slices[i]))
-            n = x.cardinality
-            if n > k:
-                e = rb_and(e, self.slices[i])
-            elif n < k:
-                g = x
-                e = rb_andnot(e, self.slices[i])
-            else:
-                e = rb_and(e, self.slices[i])
-                break
-        f = rb_or(g, e)
-        excess = f.cardinality - k
-        if excess > 0:
-            drop = f.to_array()[:excess]
-            for v in drop:
-                f.remove(int(v))
+        f = kaser_top_k(self.slices, fixed, k)
         assert f.cardinality == k, "bugs found when compute topK"
         return f
 

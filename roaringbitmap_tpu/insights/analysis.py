@@ -323,7 +323,21 @@ def _expr_step_rows(step) -> tuple:
                                      if not aligned)
     if kind == "reduce":
         return kind, None, int(step[3]), 0
+    if kind == "vscan":
+        return kind, step[2], int(step[4]), 0
+    if kind == "vagg":
+        return kind, step[1], int(step[6]), 0 if step[3] else 1
     return kind, None, int(step[1]), 0
+
+
+def _value_step_depth(step) -> int:
+    """Padded slice depth of one analytics step signature (0 for
+    non-analytics steps)."""
+    if step[0] == "vscan":
+        return int(step[3])
+    if step[0] == "vagg":
+        return int(step[5])
+    return 0
 
 
 def predict_expr_dispatch_bytes(expr_sigs, engine: str) -> dict:
@@ -339,9 +353,13 @@ def predict_expr_dispatch_bytes(expr_sigs, engine: str) -> dict:
       K-row copy per key-UNaligned child (the alignment gather);
     - the root outputs i32 per-key cards always, and its K result rows
       only for bitmap-form roots — the cardinality-only short circuit
-      is visible here as output_bytes shrinking by ``K * ROW_BYTES``.
+      is visible here as output_bytes shrinking by ``K * ROW_BYTES``;
+    - an analytics ``vscan`` streams its column's ``S_pad x K`` slice
+      planes plus one K-row result; a ``vagg`` streams the planes, one
+      aligned found copy, and its compact output (per-slice cards for
+      sum, K result rows for topk) — docs/ANALYTICS.md "Budget math".
     """
-    leaf = combine = outputs = 0
+    leaf = combine = outputs = scan = 0
     for sig in expr_sigs:
         kind, bitmap_form, steps, _root, root_k = sig
         if kind != "fused":
@@ -361,11 +379,26 @@ def predict_expr_dispatch_bytes(expr_sigs, engine: str) -> dict:
                 leaf += k * ROW_BYTES
             elif skind == "combine":
                 combine += (1 + copies) * k * ROW_BYTES
-        outputs += root_k * 4
-        if bitmap_form:
-            outputs += root_k * ROW_BYTES
-    total = leaf + combine + outputs
+            elif skind == "vscan":
+                depth = _value_step_depth(step)
+                scan += (depth + 1) * k * ROW_BYTES
+            elif skind == "vagg":
+                depth = _value_step_depth(step)
+                scan += (depth + copies) * k * ROW_BYTES
+                if step[1] == "sum":
+                    outputs += depth * k * 4
+                else:
+                    outputs += k * ROW_BYTES + k * 4
+        if not any(step[0] == "vagg" for step in steps):
+            # aggregate roots already costed their own compact output
+            # above — the root cards/rows below are the BITMAP root's
+            # (eval_section returns the agg pair INSTEAD of a popcount)
+            outputs += root_k * 4
+            if bitmap_form:
+                outputs += root_k * ROW_BYTES
+    total = leaf + combine + outputs + scan
     return {"leaf_bytes": leaf, "combine_bytes": combine,
+            "scan_bytes": scan,
             "output_bytes": outputs, "peak_bytes": total}
 
 
@@ -390,7 +423,18 @@ def predict_expr_word_ops(expr_sigs, engine: str) -> int:
                 total += k * words * copies
                 if op == "andnot":
                     total += k * words
-        total += root_k * words                     # root popcount
+            elif skind in ("vscan", "vagg"):
+                # one elementwise pass per slice plane (the O'Neil /
+                # Kaser scan carries ~3 word ops per plane per word),
+                # plus the aggregate's popcount sweep
+                depth = _value_step_depth(step)
+                total += 3 * depth * k * words
+                if skind == "vagg":
+                    total += (depth + copies + 1) * k * words
+        if not any(step[0] == "vagg" for step in steps):
+            # agg roots replace the root popcount (counted in the vagg
+            # branch's own sweep above)
+            total += root_k * words                 # root popcount
     return int(total)
 
 
@@ -407,12 +451,27 @@ def expr_node_report(sig) -> list:
             b, w = k * ROW_BYTES, 0
         elif skind == "reduce":
             b, w = 0, 0                  # costed in its bucket's row
+        elif skind in ("vscan", "vagg"):
+            depth = _value_step_depth(step)
+            w = 3 * depth * k * words
+            if skind == "vagg":
+                # mirror predict_expr_dispatch_bytes: planes + aligned
+                # found copy, plus the aggregate's compact output
+                # (per-slice cards for sum, K result rows for topk)
+                b = (depth + copies) * k * ROW_BYTES
+                b += depth * k * 4 if op == "sum" else k * ROW_BYTES + k * 4
+                w += (depth + copies + 1) * k * words
+            else:
+                b = (depth + 1 + copies) * k * ROW_BYTES
         else:
             _, _, children, _ = step
             b = (1 + copies) * k * ROW_BYTES
             w = k * words * (max(1, len(children) - 1) + copies
                              + (1 if op == "andnot" else 0))
-        if si == root:
+        if si == root and skind != "vagg":
+            # a vagg root's compact output + popcount sweep are in its
+            # own row above (eval_section returns the agg pair, no
+            # separate root popcount)
             b += root_k * 4 + (root_k * ROW_BYTES if bitmap_form else 0)
             w += root_k * words
         rows.append({"kind": skind, "op": op, "keys": k,
@@ -816,6 +875,7 @@ def recommend_lattice(trace_path: str, slack_x: float = 1.0) -> dict:
     from ..runtime import lattice as _lattice
 
     qs, rows, keys, pools, depths = set(), set(), set(), set(), set()
+    bsis = set()
     with open(trace_path) as f:
         for line in f:
             line = line.strip()
@@ -836,7 +896,14 @@ def recommend_lattice(trace_path: str, slack_x: float = 1.0) -> dict:
                 if tags.get("need_pool"):
                     pools.add(int(tags["need_pool"]))
             elif name == "expr.compile" and tags.get("kind") == "fused":
-                depths.add(int(tags.get("depth") or 2))
+                if tags.get("bsi_depth"):
+                    # analytics shape-class: slice depth pow2 x the
+                    # predicate classes that depth's scans enumerate
+                    bsis.add(int(tags["bsi_depth"]))
+                    if tags.get("depth"):
+                        depths.add(int(tags["depth"]))
+                else:
+                    depths.add(int(tags.get("depth") or 2))
 
     def rungs(values, fallback):
         if not values:
@@ -852,10 +919,14 @@ def recommend_lattice(trace_path: str, slack_x: float = 1.0) -> dict:
         # heads planes compile — the cardinality-only short circuit and
         # the bitmap plane are distinct program shapes either way
         heads=(False, True),
-        expr=(0,) + tuple(sorted(depths)))
+        expr=(0,) + tuple(sorted(depths)),
+        # analytics depths are already pow2-padded at pack time — the
+        # observed values ARE the rungs
+        bsi=tuple(sorted(bsis)))
     return {"profile": lat.to_profile(),
             "points": lat.n_points(pooled=True),
             "observed": {"q": sorted(qs), "rows": sorted(rows),
                          "keys": sorted(keys),
                          "pool_rows": sorted(pools),
-                         "expr_depths": sorted(depths)}}
+                         "expr_depths": sorted(depths),
+                         "bsi_depths": sorted(bsis)}}

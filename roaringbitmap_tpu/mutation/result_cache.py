@@ -67,7 +67,21 @@ def _leaf_token(leaf, leaf_token_of):
     return ("ref", int(uid), int(src), int(ver)), (int(uid), int(src))
 
 
-def _tokenize(e, leaf_token_of, leaves: set):
+def _col_token(name, col_token_of, leaves: set):
+    """Column leaf token — ``(uid, version)`` from the engine's column
+    resolver; the ``(uid, -1)`` leaf makes ``apply_delta`` on a column
+    invalidate exactly its dependent entries."""
+    if col_token_of is None:
+        return None
+    tok = col_token_of(str(name))
+    if tok is None:
+        return None
+    uid, ver = tok
+    leaves.add((int(uid), -1))
+    return int(uid), int(ver)
+
+
+def _tokenize(e, leaf_token_of, leaves: set, col_token_of=None):
     """Structural token of an ALREADY-canonical expression node, or None
     when the node is uncacheable (ad-hoc leaves key by object identity,
     which a cross-request cache must not trust)."""
@@ -82,29 +96,48 @@ def _tokenize(e, leaf_token_of, leaves: set):
         return tok
     if isinstance(e, expr_mod.AdHoc):
         return None
+    if isinstance(e, expr_mod.ValuePred):
+        ct = _col_token(e.col, col_token_of, leaves)
+        if ct is None:
+            return None
+        return ("vpred", *ct, e.op, int(e.lo), int(e.hi))
+    if isinstance(e, expr_mod.Agg):
+        ct = _col_token(e.col, col_token_of, leaves)
+        if ct is None:
+            return None
+        if e.found is None:
+            ftok = ("all",)
+        else:
+            ftok = _tokenize(e.found, leaf_token_of, leaves,
+                             col_token_of)
+            if ftok is None:
+                return None
+        return ("agg", e.kind, int(e.k), *ct, ftok)
     if e.op == "empty":
         return ("empty",)
     kids = []
     for c in e.children:
-        t = _tokenize(c, leaf_token_of, leaves)
+        t = _tokenize(c, leaf_token_of, leaves, col_token_of)
         if t is None:
             return None
         kids.append(t)
     return (e.op, tuple(kids))
 
 
-def node_key(node, leaf_token_of):
+def node_key(node, leaf_token_of, col_token_of=None):
     """``(key, leaves)`` of one canonical expression node; ``(None,
     None)`` when uncacheable.  ``leaf_token_of(index) -> (uid, source,
-    version) | None`` is the engine's resident-set resolver."""
+    version) | None`` is the engine's resident-set resolver;
+    ``col_token_of(name) -> (uid, version) | None`` resolves attached
+    analytics columns (value-predicate / aggregate tokens)."""
     leaves: set = set()
-    tok = _tokenize(node, leaf_token_of, leaves)
+    tok = _tokenize(node, leaf_token_of, leaves, col_token_of)
     if tok is None:
         return None, None
     return tok, frozenset(leaves)
 
 
-def query_key(q, leaf_token_of):
+def query_key(q, leaf_token_of, col_token_of=None):
     """``(key, leaves, form)`` of one ``BatchQuery`` / ``ExprQuery``.
 
     Flat queries normalize through the SAME canonicalization as
@@ -140,7 +173,7 @@ def query_key(q, leaf_token_of):
         # the planner owns rejection (unbounded complement, empty and_):
         # an uncacheable key must not change WHERE the error raises
         return None, None, q.form
-    key, leaves = node_key(e, leaf_token_of)
+    key, leaves = node_key(e, leaf_token_of, col_token_of)
     return key, leaves, q.form
 
 
@@ -148,15 +181,17 @@ def query_key(q, leaf_token_of):
 
 class _Entry:
     __slots__ = ("cardinality", "keys", "words", "cards", "bitmap",
-                 "leaves", "nbytes")
+                 "leaves", "nbytes", "value")
 
-    def __init__(self, cardinality, keys, words, cards, bitmap, leaves):
+    def __init__(self, cardinality, keys, words, cards, bitmap, leaves,
+                 value=None):
         self.cardinality = int(cardinality)
         self.keys = keys          # u16[K] root keys (None: card-only)
         self.words = words        # u32[K, 2048] device rows (None: card-only)
         self.cards = cards        # i32[K] per-key cards (None: card-only)
         self.bitmap = bitmap      # host materialization (None: card-only)
         self.leaves = leaves      # frozenset of (uid, source)
+        self.value = value        # aggregate payload (sum_ totals)
         nbytes = ENTRY_OVERHEAD_BYTES
         if words is not None:
             nbytes += int(words.size) * 4 + int(keys.size) * 2 \
@@ -207,7 +242,8 @@ class ResultCache:
         obs_metrics.counter("rb_result_cache_hits").inc()
         return BatchResult(
             cardinality=e.cardinality,
-            bitmap=e.bitmap.clone() if form == "bitmap" else None)
+            bitmap=e.bitmap.clone() if form == "bitmap" else None,
+            value=e.value)
 
     def would_hit(self, key, form: str = "cardinality") -> bool:
         """Count-free peek — the serving loop's execute-time predictor
@@ -270,7 +306,8 @@ class ResultCache:
                 words = jax.numpy.zeros((0, 2048), jax.numpy.uint32)
                 cards = np.zeros(0, np.int32)
         entry = _Entry(result.cardinality, keys, words, cards, bitmap,
-                       leaves or frozenset())
+                       leaves or frozenset(),
+                       value=getattr(result, "value", None))
         if entry.nbytes > self.max_bytes:
             return
         old = self._data.pop(key, None)

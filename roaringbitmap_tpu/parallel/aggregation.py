@@ -774,6 +774,12 @@ class DeviceBitmapSet:
             self.version = 0
             self.structure_version = 0
             self.source_versions = np.zeros(self.n, np.int64)
+        # attached analytics columns survive an in-place repack like the
+        # uid/version lineage (roaringbitmap_tpu.analytics,
+        # docs/ANALYTICS.md) — they index the same row-id universe, not
+        # the packed rows a repack re-lays
+        if not hasattr(self, "columns"):
+            self.columns = {}
         self.row_versions = np.zeros(self._n_rows, np.int64)
         self._delta_programs = {}
         self._delta_journal = []
@@ -1060,6 +1066,21 @@ class DeviceBitmapSet:
         model the obs ledger registers and predict_resident_bytes is
         parity-pinned against)."""
         return int(sum(insights.resident_set_bytes(self).values()))
+
+    # ----------------------------------------------------------- analytics
+
+    def attach_column(self, column) -> None:
+        """Attach a value column (``analytics.BsiColumn`` /
+        ``RangeColumn``) to this tenant: expression queries may then
+        carry value predicates (``expr.range_`` / ``expr.cmp``) and
+        aggregate roots (``expr.sum_`` / ``expr.top_k``) over it, fused
+        into the same launch as the set algebra (docs/ANALYTICS.md).
+        Re-attaching a name replaces the column (engine plan keys carry
+        per-column versions, so stale plans retire themselves)."""
+        self.columns[column.name] = column
+
+    def detach_column(self, name: str) -> None:
+        self.columns.pop(name, None)
 
     # ------------------------------------------------------------ mutation
 

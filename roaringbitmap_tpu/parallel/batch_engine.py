@@ -153,6 +153,9 @@ class BatchQuery:
 class BatchResult:
     cardinality: int
     bitmap: RoaringBitmap | None = None
+    #: aggregate payload (the analytics lane): sum_ roots carry the
+    #: value total here (cardinality = found count); None otherwise
+    value: int | None = None
 
 
 class _DeviceOperandCache:
@@ -313,7 +316,8 @@ def snap_plan_groups(lat, groups, sections, has_bitmap: bool, counter,
                       if sec.kind == "fused"), default=0)
     point = lat.snap(ops=[op for op, _ in groups], q=q_need,
                      rows=rows_need, keys=keys_need, heads=has_bitmap,
-                     expr=expr_depth, placement=placement, pool=pool)
+                     expr=expr_depth, placement=placement, pool=pool,
+                     bsi=expr_mod.value_depth_of(sections))
     if point is None:
         return None, None
     for op in point.ops:
@@ -497,15 +501,46 @@ class BatchEngine:
             return None
         return (ds.uid, int(i), int(ds.source_versions[i]))
 
+    def _column(self, name: str):
+        """Resolve an attached analytics column by name (the expression
+        compiler's column resolver; docs/ANALYTICS.md)."""
+        col = getattr(self._ds, "columns", {}).get(name)
+        if col is None:
+            raise KeyError(
+                f"no column {name!r} attached to this resident set "
+                f"(DeviceBitmapSet.attach_column)")
+        return col
+
+    def _col_token(self, name: str):
+        """Result-cache column token — (column uid, version); None when
+        unattached (the planner still raises its own typed error)."""
+        col = getattr(self._ds, "columns", {}).get(name)
+        if col is None:
+            return None
+        return (col.uid, col.version)
+
+    def _columns_token(self) -> tuple:
+        """Plan-cache component covering the attached columns: a column
+        delta (new device planes, new predicate semantics) must retire
+        every plan that could reference it, exactly like the set's own
+        version — and a structural repack (shape change) additionally
+        retires the compiled step shapes."""
+        cols = getattr(self._ds, "columns", None)
+        if not cols:
+            return ()
+        return tuple((n, c.uid, c.version, c.structure_version)
+                     for n, c in sorted(cols.items()))
+
     def _cache_key_of(self, q):
         """Result-cache key of one query, memoized per (query, set
-        version): queries are frozen/hashable and leaf versions only
-        move on deltas, so a replayed trace's key computation is a dict
-        hit, not a canonicalization walk."""
-        memo_key = (q, self._ds.version)
+        version, column versions): queries are frozen/hashable and leaf
+        versions only move on deltas, so a replayed trace's key
+        computation is a dict hit, not a canonicalization walk."""
+        memo_key = (q, self._ds.version, self._columns_token())
         got = self._qkeys.get(memo_key)
         if got is None:
-            got = mut_cache.query_key(q, self._leaf_token)
+            got = mut_cache.query_key(q, self._leaf_token,
+                                      self._col_token)
             self._qkeys.put(memo_key, got)
         return got
 
@@ -573,7 +608,8 @@ class BatchEngine:
         # a cached-subtree injection whose leaf versions moved on).  The
         # lattice token retires plans across activations/warmup pins —
         # a snapped and an exact plan of the same queries must not alias
-        key = (tuple(queries), self._ds.version, rt_lattice.plan_token())
+        key = (tuple(queries), self._ds.version, self._columns_token(),
+               rt_lattice.plan_token())
         cached = self._plans.get(key)
         if cached is not None:
             return cached
@@ -588,7 +624,8 @@ class BatchEngine:
                 # reduce.  BatchEngine dispatches never donate, so the
                 # cache's device rows are safe to hand the program
                 # directly (the pooled engines copy — see multiset).
-                k, _leaves = mut_cache.node_key(node, self._leaf_token)
+                k, _leaves = mut_cache.node_key(node, self._leaf_token,
+                                                self._col_token)
                 if k is None:
                     return None
                 got = rc.peek_rows(k)
@@ -624,7 +661,8 @@ class BatchEngine:
                 if isinstance(q, expr_mod.ExprQuery):
                     sections.append(expr_mod.compile_query(
                         q, qid, add_item, self._plan_leaf,
-                        cache_probe=cache_probe))
+                        cache_probe=cache_probe,
+                        col_resolve=self._column))
                 else:
                     add_item(q, qid)
             pad_to, point = snap_plan_groups(
@@ -647,8 +685,12 @@ class BatchEngine:
             # the one-kernel program assembles from the buckets' and
             # sections' HOST arrays, so it must build before the
             # upload-and-drop discipline below frees them
+            # analytics sections stay on the multi-op rungs: the
+            # one-kernel assembler has no scan opcodes yet (stretch),
+            # so megakernel resolves down silently (docs/ANALYTICS.md)
             mega = None
-            if expr_mod.fused_of(sections):
+            if expr_mod.fused_of(sections) \
+                    and not expr_mod.has_value_steps(sections):
                 mega = megakernel.build_full(buckets, sections)
             # single-set plans dispatch sync from the cache (no remap,
             # no donation), so the device arrays upload here and every
@@ -745,7 +787,7 @@ class BatchEngine:
             if eng == "megakernel":
                 mega = plan.mega
 
-                def run(src_in, arrays):
+                def run(src_in, arrays, cols):
                     # the one-kernel hot path: gather + every segmented
                     # reduce + combine passes + outputs in ONE pallas
                     # grid kernel; VMEM accumulators carry the reduce
@@ -753,7 +795,7 @@ class BatchEngine:
                     words = self._words_from_src(src_in, kind, eng)
                     return megakernel.eval_full(mega, words, arrays[0])
             else:
-                def run(src_in, arrays):
+                def run(src_in, arrays, cols):
                     words = self._words_from_src(src_in, kind, eng)
                     barrays = arrays[:len(b_sigs)]
                     outs, heads_by_bi = [], [None] * len(b_sigs)
@@ -770,12 +812,14 @@ class BatchEngine:
                     if not fused:
                         return outs
                     expr_outs = expr_mod.eval_sections(
-                        fused, arrays[len(b_sigs):], words, heads_by_bi)
+                        fused, arrays[len(b_sigs):], words, heads_by_bi,
+                        cols_list=cols)
                     return outs, expr_outs
 
             t0 = time.perf_counter()
             compiled = jax.jit(run).lower(
-                src, self._launch_arrays(plan, eng)).compile()
+                src, self._launch_arrays(plan, eng),
+                self._launch_cols(plan)).compile()
             compile_s = time.perf_counter() - t0
             obs_cost.observe_compile("batch_engine", "miss", compile_s)
             # post-warmup, a sealed lattice expects steady state to
@@ -812,6 +856,12 @@ class BatchEngine:
         arrays = [b.device_arrays() for b in plan]
         arrays.extend(s.device_arrays() for s in plan.fused)
         return arrays
+
+    def _launch_cols(self, plan) -> list:
+        """Per-section analytics column operands — a SEPARATE program
+        argument (never donated: a donated cols pytree would destroy
+        the resident slice planes with the launch)."""
+        return expr_mod.launch_cols(plan.fused)
 
     def _bucket_engine(self, plan, engine: str) -> str:
         eng = _engine(engine)
@@ -994,9 +1044,12 @@ class BatchEngine:
             t_launch = time.perf_counter()
             with obs_slo.phase("dispatch"):
                 outs = (compiled if jit else run)(
-                    src, self._launch_arrays(plan, eng))
+                    src, self._launch_arrays(plan, eng),
+                    self._launch_cols(plan))
             if plan.exprs:
                 expr_mod.record_fused_dispatch("batch_engine", plan.exprs)
+                expr_mod.record_analytics_dispatch("batch_engine",
+                                                   plan.exprs, sp)
             if eng == "megakernel":
                 # the one-kernel event (docs/OBSERVABILITY.md;
                 # tools/check_trace.py pins the schema)
@@ -1111,7 +1164,8 @@ class BatchEngine:
         algebra — the rung every fused engine path is pinned against."""
         srcs = self._host_sources()
         if isinstance(q, expr_mod.ExprQuery):
-            return expr_mod.evaluate_host(q.expr, srcs)
+            return expr_mod.evaluate_host(
+                q.expr, srcs, columns=getattr(self._ds, "columns", None))
         if not q.operands:
             return srcs[0].__class__() if srcs else RoaringBitmap()
         if q.op == "andnot":
@@ -1129,16 +1183,27 @@ class BatchEngine:
             acc = fn(acc, srcs[i])
         return acc
 
+    def _sequential_result(self, q) -> BatchResult:
+        """One query through the host reference rung as a BatchResult —
+        aggregate roots route through the host BSI/RangeBitmap oracle
+        (``expr.evaluate_host_agg``); everything else through the
+        bitmap evaluator."""
+        if isinstance(q, expr_mod.ExprQuery) \
+                and expr_mod.is_agg(q.expr):
+            card, value, bm = expr_mod.evaluate_host_agg(
+                q.expr, self._host_sources(),
+                columns=getattr(self._ds, "columns", None))
+            return BatchResult(
+                cardinality=card,
+                bitmap=bm if q.form == "bitmap" else None, value=value)
+        rb = self._sequential_one(q)
+        return BatchResult(cardinality=rb.cardinality,
+                           bitmap=rb if q.form == "bitmap" else None)
+
     def _execute_sequential(self, queries) -> list[BatchResult]:
         """The terminal fallback rung: per-query host container algebra —
         the bit-exact CPU reference every engine is pinned against."""
-        out = []
-        for q in queries:
-            rb = self._sequential_one(q)
-            out.append(BatchResult(
-                cardinality=rb.cardinality,
-                bitmap=rb if q.form == "bitmap" else None))
-        return out
+        return [self._sequential_result(q) for q in queries]
 
     def _shadow_check(self, queries, results, policy) -> None:
         """Re-run a sampled fraction on the sequential reference; raise
@@ -1148,15 +1213,18 @@ class BatchEngine:
         idx = guard.shadow_sample(len(queries), policy.shadow_rate,
                                   policy.shadow_seed, "batch_engine")
         for i in idx:
-            ref = self._sequential_one(queries[i])
+            ref = self._sequential_result(queries[i])
             got = results[i]
-            bad = got.cardinality != ref.cardinality
+            bad = (got.cardinality != ref.cardinality
+                   or got.value != ref.value)
             if not bad and queries[i].form == "bitmap":
-                bad = got.bitmap != ref
+                bad = got.bitmap != ref.bitmap
             if bad:
                 detail = (f"cardinality {got.cardinality} != "
                           f"{ref.cardinality}"
                           if got.cardinality != ref.cardinality else
+                          f"value {got.value} != {ref.value}"
+                          if got.value != ref.value else
                           f"equal cardinality {ref.cardinality} but "
                           f"differing members")
                 raise errors.ShadowMismatch(
@@ -1219,6 +1287,7 @@ class BatchEngine:
         policy = policy or guard.GuardPolicy.from_env()
         budget = guard.resolve_hbm_budget(policy)
         plan_hit = (tuple(queries), self._ds.version,
+                    self._columns_token(),
                     rt_lattice.plan_token()) in self._plans
         plan = self.plan(queries)
         # explain reports what execute() WOULD do, so it mirrors its
@@ -1383,6 +1452,23 @@ class BatchEngine:
                 self._ds.warmup_delta(point.delta)
                 compiled += 1
                 continue
+            if point.bsi:
+                # analytics shape-class: one representative predicate /
+                # aggregate batch per attached column at this padded
+                # depth — the scan programs close over (tag x depth x
+                # keys), so warmed traffic replaying new predicate
+                # VALUES compiles nothing
+                batches = analytics_rung_queries(
+                    getattr(self._ds, "columns", {}), point.bsi, self.n)
+                with lat.pin(point):
+                    for batch in batches:
+                        plan = self.plan(batch)
+                        for sec in plan.exprs:
+                            lat.note_expr(sec.signature)
+                        self._program(plan,
+                                      self._bucket_engine(plan, engine))
+                compiled += 1
+                continue
             if point.expr:
                 batch = expr_mod.rung_expressions(point.expr, self.n)
             else:
@@ -1542,6 +1628,64 @@ class BatchEngine:
 
     def hbm_bytes(self) -> int:
         return self._ds.hbm_bytes()
+
+
+def analytics_rung_queries(columns: dict, depth: int,
+                           n_residents: int) -> list:
+    """Representative single-query warmup batches for one lattice
+    ``bsi`` shape-class: per attached column whose padded depth the
+    rung covers, one batch per predicate class (cmp / range / fused
+    filter) plus the aggregate roots — predicate values are chosen
+    mid-domain so min/max pruning cannot collapse the scan away (a
+    pruned plan would warm the wrong program shape)."""
+    out = []
+    for name, col in sorted(columns.items()):
+        if col.depth_pad > depth or not col.keys.size:
+            continue
+        mn, mx = col.min_value, col.max_value
+        if mx > mn:
+            mid = mn + (mx - mn) // 2
+            out.append([expr_mod.ExprQuery(
+                expr_mod.cmp(name, "le", mid))])
+            out.append([expr_mod.ExprQuery(
+                expr_mod.range_(name, mn + 1, mx))])
+            if n_residents:
+                # the canonical OLAP class: fused (set-algebra AND
+                # value-scan) filters plus aggregate roots over them —
+                # each its own compiled program shape.  A ref leaf
+                # lowers as a "leaf" gather step while a set reduce
+                # (or_(a, b)) lowers as a "reduce" step, so BOTH
+                # found-set spellings are warmed, for the plain filter
+                # and for the aggregates alike
+                founds = [expr_mod.and_(
+                    expr_mod.ref(0), expr_mod.range_(name, mn + 1, mx))]
+                if n_residents >= 2:
+                    founds.append(expr_mod.and_(
+                        expr_mod.or_(0, 1),
+                        expr_mod.range_(name, mn + 1, mx)))
+                for fused_found in founds:
+                    out.append([expr_mod.ExprQuery(fused_found)])
+                    out.append([expr_mod.ExprQuery(
+                        expr_mod.sum_(name, found=fused_found))])
+                    out.append([expr_mod.ExprQuery(
+                        expr_mod.top_k(name, 1, found=fused_found),
+                        form="bitmap")])
+        # the min/max-pruned "all" fast path (predicate covers the whole
+        # stored domain, ge 0 on both column kinds) is its own leaner
+        # program shape — warm it too
+        out.append([expr_mod.ExprQuery(expr_mod.cmp(name, "ge", 0))])
+        if n_residents:
+            out.append([expr_mod.ExprQuery(expr_mod.and_(
+                expr_mod.ref(0), expr_mod.cmp(name, "ge", 0)))])
+            out.append([expr_mod.ExprQuery(
+                expr_mod.sum_(name, found=expr_mod.ref(0)))])
+            out.append([expr_mod.ExprQuery(
+                expr_mod.top_k(name, 1, found=expr_mod.ref(0)),
+                form="bitmap")])
+        out.append([expr_mod.ExprQuery(expr_mod.sum_(name))])
+        out.append([expr_mod.ExprQuery(expr_mod.top_k(name, 1),
+                                       form="bitmap")])
+    return out
 
 
 def execute_batch(ds: DeviceBitmapSet, queries, engine: str = "auto"

@@ -181,6 +181,71 @@ class Node(Expr):
 EMPTY = Node("empty", ())
 
 
+#: predicate ops a value leaf accepts (analytics lane, docs/ANALYTICS.md)
+VALUE_OPS = ("eq", "neq", "lt", "le", "gt", "ge", "range")
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuePred(Expr):
+    """Leaf: a value-domain predicate over an attached column — the
+    rows whose column value satisfies ``op`` against ``lo`` (and ``hi``
+    for ``range``).  Evaluates over the column's existence plane and
+    lowers to ONE slice-plane scan step inside the same compiled
+    program (analytics.plane), so it composes with or/and/xor/andnot
+    like any bitmap leaf: ``count((A | B) & range_("price", lo, hi))``
+    is one launch."""
+
+    col: str
+    op: str
+    lo: int
+    hi: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Agg(Expr):
+    """Aggregate ROOT over a column: ``sum`` (total + member count of
+    the found set's stored values) or ``topk`` (the rows holding the k
+    largest values).  ``found`` is any bitmap-valued DAG node (None =
+    the column's whole stored domain); aggregates cannot nest inside
+    an expression — canonicalization raises."""
+
+    kind: str
+    col: str
+    k: int
+    found: object = None
+
+
+def range_(col, lo: int, hi: int) -> ValuePred:
+    """Rows with ``lo <= value(col) <= hi`` — the BETWEEN predicate."""
+    return ValuePred(str(col), "range", int(lo), int(hi))
+
+
+def cmp(col, op: str, value: int) -> ValuePred:
+    """Rows with ``value(col) <op> value``; op in eq/neq/lt/le/gt/ge."""
+    op = str(op).lower()
+    if op not in ("eq", "neq", "lt", "le", "gt", "ge"):
+        raise ValueError(f"unsupported value predicate op {op!r} "
+                         f"(range predicates spell range_(col, lo, hi))")
+    return ValuePred(str(col), op, int(value))
+
+
+def sum_(col, found=None) -> Agg:
+    """Aggregate root: (sum of column values over the found set,
+    member count).  ``found`` is any bitmap-valued expression."""
+    return Agg("sum", str(col),
+               0, None if found is None else _as_expr(found))
+
+
+def top_k(col, k: int, found=None) -> Agg:
+    """Aggregate root: the rows holding the k largest column values
+    within the found set (k clamped to the found set's stored rows;
+    ties trimmed by dropping the smallest row ids, the Kaser rule)."""
+    if int(k) < 0:
+        raise ValueError(f"top_k needs k >= 0, got {k}")
+    return Agg("topk", str(col),
+               int(k), None if found is None else _as_expr(found))
+
+
 def _as_expr(x) -> Expr:
     if isinstance(x, Expr):
         return x
@@ -239,6 +304,11 @@ class ExprQuery:
             object.__setattr__(self, "expr", _as_expr(self.expr))
         if self.form not in ("cardinality", "bitmap"):
             raise ValueError(f"unsupported result form {self.form!r}")
+        if isinstance(self.expr, Agg) and self.expr.kind == "sum" \
+                and self.form == "bitmap":
+            raise ValueError(
+                "sum_ roots have no bitmap form (the result is a "
+                "scalar total + count)")
 
 
 # --------------------------------------------------- canonicalize + CSE
@@ -255,6 +325,8 @@ def _skey(e: Expr):
         return (0, e.index)
     if isinstance(e, AdHoc):
         return (1, id(e.bm))
+    if isinstance(e, ValuePred):
+        return (3, e.col, e.op, e.lo, e.hi)
     k = e._skey_c
     if k is None:
         k = e._skey_c = (2, e.op, tuple(_skey(c) for c in e.children))
@@ -265,13 +337,32 @@ def canonicalize(e) -> Expr:
     """Canonical DAG form: flattened associative chains, deduped/sorted
     commutative operands, pairwise-cancelled xor, ``not`` absorbed into
     ``andnot`` (or rejected as unbounded), structural sharing for CSE.
+    Aggregate roots (``sum_`` / ``top_k``) canonicalize their found
+    sub-DAG and stay at the root — anywhere else they raise.
     Raises ValueError on an unbounded complement or an empty ``and``."""
-    out = _canon(_as_expr(e), {}, {})
+    e = _as_expr(e)
+    if isinstance(e, Agg):
+        f = e.found
+        if f is None:
+            return e
+        f_c = _canon(_as_expr(f), {}, {})
+        if isinstance(f_c, Node) and f_c.op == "not":
+            raise ValueError(
+                "unbounded complement: an aggregate's found set is a "
+                "bare not_ (complements are bounded only inside and_)")
+        return Agg(e.kind, e.col, e.k, f_c)
+    out = _canon(e, {}, {})
     if isinstance(out, Node) and out.op == "not":
         raise ValueError(
             "unbounded complement: a bare not_ root spans the whole "
             "2^32 universe (complements are bounded only inside and_)")
     return out
+
+
+def is_agg(e) -> bool:
+    """True when ``e`` is an aggregate-rooted expression (pre- or
+    post-canonicalization — Agg only ever lives at the root)."""
+    return isinstance(e, Agg)
 
 
 def _canon(e: Expr, memo: dict, intern: dict) -> Expr:
@@ -288,8 +379,13 @@ def _canon(e: Expr, memo: dict, intern: dict) -> Expr:
 
 
 def _canon_uncached(e: Expr, memo: dict, intern: dict) -> Expr:
-    if isinstance(e, (Ref, AdHoc)):
+    if isinstance(e, (Ref, AdHoc, ValuePred)):
         return e
+    if isinstance(e, Agg):
+        raise ValueError(
+            "aggregate roots (sum_/top_k) cannot nest inside an "
+            "expression — they consume a bitmap-valued found set and "
+            "produce a scalar/top-k result, not a combinable bitmap")
     if e.op == "empty":
         return EMPTY
     if e.op == "not":
@@ -459,13 +555,29 @@ def _dag_nodes(e: Expr) -> list:
 
 # ------------------------------------------------- host reference rung
 
-def evaluate_host(e, sources) -> object:
+def _host_column(columns, name: str):
+    """Resolve a column by name for the host evaluator / oracle rung."""
+    col = (columns or {}).get(name)
+    if col is None:
+        raise KeyError(
+            f"no column {name!r} attached to the resident set "
+            f"(DeviceBitmapSet.attach_column)")
+    return col
+
+
+def evaluate_host(e, sources, columns=None) -> object:
     """Bit-exact host-side evaluation of an expression over ``sources``
     (a list of host RoaringBitmaps) — the sequential reference rung every
-    fused engine path is pinned against, and the guard ladder's floor."""
+    fused engine path is pinned against, and the guard ladder's floor.
+    ``columns`` maps column names to attached analytics columns (the
+    host BSI/RangeBitmap oracles backing value-predicate leaves)."""
     from ..core.bitmap import RoaringBitmap
 
     e = canonicalize(e)
+    if isinstance(e, Agg):
+        raise ValueError(
+            "aggregate roots evaluate through evaluate_host_agg (the "
+            "result is (cardinality, value, bitmap), not a bitmap)")
     memo: dict = {}
 
     def ev(n):
@@ -480,6 +592,9 @@ def evaluate_host(e, sources) -> object:
             v = sources[n.index]
         elif isinstance(n, AdHoc):
             v = n.bm
+        elif isinstance(n, ValuePred):
+            v = _host_column(columns, n.col).host_filter(n.op, n.lo,
+                                                         n.hi)
         elif n.op == "empty":
             v = RoaringBitmap()
         elif n.op == "andnot":
@@ -503,6 +618,25 @@ def evaluate_host(e, sources) -> object:
         # a bare-leaf root must not alias the caller's resident source
         return out.clone()
     return out
+
+
+def evaluate_host_agg(e, sources, columns=None):
+    """Host-oracle evaluation of an aggregate-rooted expression ->
+    ``(cardinality, value, bitmap | None)``: ``sum`` returns (found
+    count, value total, None) via the host BSI's weighted contraction;
+    ``topk`` returns (k_eff, None, rows bitmap) via the Kaser scan over
+    the found set's stored rows (k clamped, smallest-id tie trim)."""
+    e = canonicalize(e)
+    if not isinstance(e, Agg):
+        raise ValueError("evaluate_host_agg needs an aggregate root")
+    col = _host_column(columns, e.col)
+    found = (None if e.found is None
+             else evaluate_host(e.found, sources, columns))
+    if e.kind == "sum":
+        total, count = col.host_sum(found)
+        return int(count), int(total), None
+    bm = col.host_top_k(e.k, found)
+    return bm.cardinality, None, bm
 
 
 # ----------------------------------------------------- compiled section
@@ -544,6 +678,12 @@ class ExprSection:
     #: (mutation.result_cache) — each pruned a reduce/combine lowering
     #: into a pre-computed operand (the "adhoc" step shape)
     n_cached: int = 0
+    #: analytics columns this section's vscan/vagg steps read, in step
+    #: slot order — resolved Column objects; their (slices, ebm) device
+    #: twins ride the program's separate NON-donated cols operand
+    cols: list = dataclasses.field(default_factory=list)
+    #: (kind, k) of an aggregate-rooted section (sum_/top_k), else None
+    agg: tuple | None = None
 
     @property
     def signature(self):
@@ -580,7 +720,8 @@ def _is_reduce(n: Expr) -> bool:
 
 
 def compile_query(q: ExprQuery, qid: int, plan_reduce,
-                  plan_leaf, cache_probe=None) -> ExprSection:
+                  plan_leaf, cache_probe=None,
+                  col_resolve=None) -> ExprSection:
     """Compile one :class:`ExprQuery` against an engine's planner.
 
     ``plan_reduce(batch_query, owner)`` registers a pseudo flat query
@@ -594,37 +735,50 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
     materialized cached result for a canonical interior node (the
     mutation result cache) — the node then lowers as a pre-computed
     operand (the "adhoc" step shape) and its reduce/combine lowering is
-    pruned from the program entirely.
+    pruned from the program entirely.  ``col_resolve(name)`` resolves an
+    attached analytics column (docs/ANALYTICS.md): value-predicate
+    leaves lower to in-program slice-plane scan steps over it, and
+    aggregate roots (``sum_`` / ``top_k``) append one ``vagg`` step over
+    their found sub-DAG.
     """
     from .batch_engine import BatchQuery
 
     # ONE canonicalization per compile: stats/host-op walks take the
-    # already-canonical (interned) dag
+    # already-canonical (interned) dag.  Aggregate roots split into the
+    # agg head and the found-set core the normal machinery lowers.
     e = canonicalize(q.expr)
-    stats = _dag_stats_canonical(e)
+    agg = e if isinstance(e, Agg) else None
+    core = e.found if agg is not None else e
+    stats = (_dag_stats_canonical(core) if core is not None
+             else {"nodes": 0, "tree_nodes": 0, "cse_saved": 0,
+                   "depth": 0})
     with obs_trace.span("expr.compile", qid=qid, form=q.form,
                         nodes=stats["nodes"],
                         depth=stats["depth"],
                         cse_saved=stats["cse_saved"]) as sp:
         sec = ExprSection(qid=qid, form=q.form, kind="fused",
-                          n_nodes=max(1, stats["nodes"]),
+                          n_nodes=max(1, stats["nodes"]
+                                      + (1 if agg is not None else 0)),
                           depth=stats["depth"],
                           cse_saved=stats["cse_saved"],
-                          host_ops=_host_op_count_canonical(e))
-        if isinstance(e, Node) and e.op == "empty":
+                          host_ops=(_host_op_count_canonical(core)
+                                    if core is not None else 0))
+        if agg is not None:
+            sec.agg = (agg.kind, agg.k)
+        if agg is None and isinstance(e, Node) and e.op == "empty":
             sec.kind = "empty"
             sp.tag(kind=sec.kind)
             return sec
-        if isinstance(e, AdHoc):
+        if agg is None and isinstance(e, AdHoc):
             sec.kind, sec.adhoc_bm = "adhoc", e.bm
             sp.tag(kind=sec.kind)
             return sec
-        if isinstance(e, Ref):
+        if agg is None and isinstance(e, Ref):
             plan_reduce(BatchQuery("or", (e.index,), form=q.form), qid)
             sec.kind, sec.n_reduce = "flat", 1
             sp.tag(kind=sec.kind)
             return sec
-        if _is_reduce(e):
+        if agg is None and _is_reduce(e):
             # flat root — but prune an empty key space first (disjoint
             # AND, all-empty operands): the empty short circuit applies
             # one level down too, and skips the device entirely
@@ -673,6 +827,67 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
             keyof[si] = keys
             return si
 
+        def resolve_col(name: str):
+            if col_resolve is None:
+                raise ValueError(
+                    f"value predicate over column {name!r} but this "
+                    f"engine path has no column resolver (attach "
+                    f"columns via DeviceBitmapSet.attach_column)")
+            return col_resolve(name)
+
+        def col_slot(col) -> int:
+            for i, c in enumerate(sec.cols):
+                if c is col:
+                    return i
+            sec.cols.append(col)
+            return len(sec.cols) - 1
+
+        def emit_scan(col, scan) -> int | None:
+            """One value-predicate step: the column's plan-time lowering
+            (min/max pruning shared with the host comparator) becomes
+            either nothing ("empty"), the existence plane ("all"), or a
+            slice-plane scan whose predicate BITS ride as operands —
+            the compiled program is shared across predicate values."""
+            if scan[0] == "empty":
+                return None
+            si = len(steps)
+            ci = col_slot(col)
+            if scan[0] == "all":
+                steps.append(("vscan", ci, "col:all", col.depth_pad,
+                              int(col.keys.size)))
+            else:
+                _, tag, bits, bits2 = scan
+                steps.append(("vscan", ci, tag, col.depth_pad,
+                              int(col.keys.size)))
+                host[f"b{si}"] = np.asarray(bits, np.int32)
+                host[f"b2{si}"] = np.asarray(bits2, np.int32)
+            keyof[si] = col.keys
+            return si
+
+        def emit_agg(col, found_si: int) -> int:
+            """The aggregate head over the found step: align the found
+            set onto the column's key space (plan-time searchsorted,
+            the combine-alignment discipline) and append ONE vagg step
+            — sum's weighted-popcount contraction or topk's Kaser scan
+            (k rides as a traced operand so one program serves all k)."""
+            si = len(steps)
+            ci = col_slot(col)
+            fk, ck = keyof[found_si], col.keys
+            aligned = (fk.size == ck.size
+                       and bool(np.array_equal(fk, ck)))
+            if not aligned:
+                idx = np.searchsorted(fk, ck).clip(
+                    0, max(0, fk.size - 1)).astype(np.int32)
+                host[f"i{si}"] = idx
+                host[f"o{si}"] = (fk[idx] == ck) if fk.size else \
+                    np.zeros(ck.size, bool)
+            if agg.kind == "topk":
+                host[f"k{si}"] = np.asarray(agg.k, np.int32)
+            steps.append(("vagg", agg.kind, found_si, aligned, ci,
+                          col.depth_pad, int(ck.size)))
+            keyof[si] = ck
+            return si
+
         def emit(n) -> int | None:
             if n in memo:
                 return memo[n]
@@ -708,6 +923,9 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
             return si
 
         def _emit(n) -> int | None:
+            if isinstance(n, ValuePred):
+                col = resolve_col(n.col)
+                return emit_scan(col, col.scan_plan(n.op, n.lo, n.hi))
             if isinstance(n, Ref):
                 rows, keys = plan_leaf(n.index)
                 if keys.size == 0:
@@ -812,7 +1030,22 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
             keyof[si] = node_keys
             return si
 
-        root = emit(e)
+        if agg is not None:
+            agg_col = resolve_col(agg.col)
+            if core is None:
+                # found=None: the column's whole stored domain — the
+                # existence plane as the found step
+                found_si = emit_scan(agg_col, ("all",)
+                                     if agg_col.keys.size else ("empty",))
+            else:
+                found_si = emit(core)
+            if found_si is None:
+                sec.kind = "empty"
+                sp.tag(kind=sec.kind, agg=agg.kind)
+                return sec
+            root = emit_agg(agg_col, found_si)
+        else:
+            root = emit(e)
         if root is None:
             sec.kind = "empty"
             sp.tag(kind=sec.kind)
@@ -820,10 +1053,16 @@ def compile_query(q: ExprQuery, qid: int, plan_reduce,
         sec.steps, sec.root = steps, root
         sec.root_keys = keyof[root]
         sec.host = host
+        n_value = sum(1 for st in steps
+                      if st[0] in ("vscan", "vagg"))
         sp.tag(kind=sec.kind, reduce_nodes=sec.n_reduce,
                combine_nodes=sec.n_combine, steps=len(steps),
                root_keys=int(sec.root_keys.size),
                cached_nodes=sec.n_cached, depth=sec.depth)
+        if n_value:
+            sp.tag(value_steps=n_value,
+                   bsi_depth=value_depth_of([sec]),
+                   agg=(agg.kind if agg is not None else None))
         return sec
 
 
@@ -832,6 +1071,38 @@ def fused_of(sections) -> list:
     every plan's ``fused`` property delegates to (one definition of the
     contract across the three engines)."""
     return [s for s in sections if s.kind == "fused"]
+
+
+def has_value_steps(sections) -> bool:
+    """True when any fused section carries analytics steps (vscan /
+    vagg) — the megakernel gate: the one-kernel assembler has no scan
+    opcodes yet, so such plans resolve down to the multi-op rungs
+    silently (docs/ANALYTICS.md)."""
+    return any(st[0] in ("vscan", "vagg")
+               for s in sections if s.kind == "fused" for st in s.steps)
+
+
+def value_depth_of(sections) -> int:
+    """Max padded slice depth across the sections' analytics steps —
+    the ``bsi`` dimension of the lattice snap (0 = no analytics)."""
+    depth = 0
+    for s in sections:
+        if s.kind != "fused":
+            continue
+        for st in s.steps:
+            if st[0] == "vscan":
+                depth = max(depth, int(st[3]))
+            elif st[0] == "vagg":
+                depth = max(depth, int(st[5]))
+    return depth
+
+
+def launch_cols(fused_sections) -> list:
+    """Per-section column device operands — the engines' separate
+    NON-donated program argument (a donated cols operand would destroy
+    the resident planes with the launch)."""
+    return [[c.device_operands() for c in s.cols]
+            for s in fused_sections]
 
 
 def signature_of(sections) -> tuple:
@@ -892,12 +1163,19 @@ def traced_bucket_heads(buckets, op_groups, group_outs,
     return out
 
 
-def eval_section(sec: ExprSection, arrs: dict, words, bucket_heads):
+def eval_section(sec: ExprSection, arrs: dict, words, bucket_heads,
+                 cols=()):
     """Traced fused evaluation of one section: walk the compiled steps
     bottom-up, keeping every intermediate a traced value (registers /
     HBM scratch — never read back).  Returns ``(heads_or_None, cards)``
     with heads ``u32[K_root, W]`` only for bitmap-form roots (the
-    cardinality short circuit: the popcount is the only root output)."""
+    cardinality short circuit: the popcount is the only root output).
+    ``cols`` holds the section's column ``(slices, ebm)`` operands in
+    slot order; an aggregate root returns its own output pair — sum:
+    ``(i32[S, K] per-(slice, key) cards, i32[K_found] found cards)``,
+    topk: ``(u32[K, W] result words, i32[K] cards)``."""
+    from ..analytics import plane as _plane
+
     vals: list = [None] * len(sec.steps)
     for si, st in enumerate(sec.steps):
         kind = st[0]
@@ -908,6 +1186,30 @@ def eval_section(sec: ExprSection, arrs: dict, words, bucket_heads):
         elif kind == "reduce":
             _, bi, slot, kq = st
             v = bucket_heads[bi][slot, :kq]
+        elif kind == "vscan":
+            _, ci, tag, _depth, _kc = st
+            slices, ebm = cols[ci]
+            v = _plane.scan_words(tag, slices, ebm,
+                                  arrs.get(f"b{si}"),
+                                  arrs.get(f"b2{si}"))
+        elif kind == "vagg":
+            _, akind, fi, aligned, ci, _depth, _kc = st
+            slices, ebm = cols[ci]
+            f = vals[fi]
+            if akind == "sum":
+                found_cards = dense.popcount(f)
+            fc = f
+            if not aligned:
+                fc = f[arrs[f"i{si}"]] if f.shape[0] else jnp.zeros(
+                    (st[6], WORDS32), jnp.uint32)
+                fc = jnp.where(arrs[f"o{si}"][:, None], fc,
+                               jnp.uint32(0))
+            if akind == "sum":
+                v = (_plane.sum_cards(slices, fc), found_cards)
+            else:
+                res = _plane.topk_words(slices, fc & ebm,
+                                        arrs[f"k{si}"])
+                v = (res, dense.popcount(res))
         else:
             _, op, children, _k = st
             parts = []
@@ -930,13 +1232,19 @@ def eval_section(sec: ExprSection, arrs: dict, words, bucket_heads):
                     v = fn(v, p)
         vals[si] = v
     rootv = vals[sec.root]
+    if sec.agg is not None:
+        # aggregate roots ARE their output pair (assembled host-side)
+        return rootv
     cards = dense.popcount(rootv)
     return (rootv if sec.form == "bitmap" else None), cards
 
 
-def eval_sections(sections, arrays_list, words, bucket_heads) -> list:
-    return [eval_section(sec, arrs, words, bucket_heads)
-            for sec, arrs in zip(sections, arrays_list)]
+def eval_sections(sections, arrays_list, words, bucket_heads,
+                  cols_list=None) -> list:
+    if cols_list is None:
+        cols_list = [()] * len(sections)
+    return [eval_section(sec, arrs, words, bucket_heads, cols=cols)
+            for sec, arrs, cols in zip(sections, arrays_list, cols_list)]
 
 
 # ---------------------------------------------------------- accounting
@@ -958,24 +1266,79 @@ def record_fused_dispatch(site: str, sections) -> None:
                             site=site).inc(saved)
 
 
+def record_analytics_dispatch(site: str, sections, span) -> None:
+    """Analytics accounting at a device-dispatch site: count the fused
+    vscan/vagg steps (``rb_analytics_scans_total`` /
+    ``rb_analytics_aggs_total``) and attach the ``analytics.scan``
+    event ``tools/check_trace.py`` validates (docs/ANALYTICS.md)."""
+    scans = aggs = 0
+    for s in sections:
+        if s is None or s.kind != "fused":
+            continue
+        for st in s.steps:
+            if st[0] == "vscan":
+                scans += 1
+            elif st[0] == "vagg":
+                aggs += 1
+    if not scans and not aggs:
+        return
+    obs_metrics.counter("rb_analytics_scans_total", site=site).inc(scans)
+    if aggs:
+        obs_metrics.counter("rb_analytics_aggs_total",
+                            site=site).inc(aggs)
+    span.event("analytics.scan", site=site, scans=scans, aggs=aggs,
+               bsi_depth=value_depth_of(sections))
+
+
 def assemble_section_result(sec: ExprSection, out, form: str):
     """Host readback of one section's device outputs -> (cardinality,
-    bitmap|None).  ``out`` is the (heads, cards) pair for fused
-    sections, ignored for empty/adhoc ones."""
+    bitmap|None, value|None).  ``out`` is the (heads, cards) pair for
+    fused sections — or the aggregate output pair for agg roots —
+    ignored for empty/adhoc ones."""
     from ..core.bitmap import RoaringBitmap
 
+    if sec.agg is not None:
+        return _assemble_agg(sec, out, form)
     if sec.kind == "empty":
-        return 0, (RoaringBitmap() if form == "bitmap" else None)
+        return 0, (RoaringBitmap() if form == "bitmap" else None), None
     if sec.kind == "adhoc":
         bm = sec.adhoc_bm
-        return bm.cardinality, (bm.clone() if form == "bitmap" else None)
+        return (bm.cardinality,
+                bm.clone() if form == "bitmap" else None, None)
     heads, cards = out
     cards = np.asarray(cards)
     bm = None
     if form == "bitmap":
         bm = packing.unpack_result(sec.root_keys, np.asarray(heads),
                                    cards)
-    return int(cards.sum()), bm
+    return int(cards.sum()), bm, None
+
+
+def _assemble_agg(sec: ExprSection, out, form: str):
+    """Aggregate readback: sum weights the per-slice popcounts in host
+    Python ints (exact past 32 bits); topk unpacks the result rows and
+    applies the smallest-id tie trim the host Kaser rule specifies."""
+    from ..core.bitmap import RoaringBitmap
+
+    akind, k = sec.agg
+    if sec.kind == "empty":
+        if akind == "sum":
+            return 0, None, 0
+        return 0, (RoaringBitmap() if form == "bitmap" else None), None
+    if akind == "sum":
+        slice_cards, found_cards = out
+        slice_cards = np.asarray(slice_cards)
+        total = sum((1 << i) * int(slice_cards[i].sum())
+                    for i in range(slice_cards.shape[0]))
+        return int(np.asarray(found_cards).sum()), None, total
+    words, cards = out
+    cards = np.asarray(cards)
+    from ..bsi.slice_index import trim_smallest
+
+    bm = trim_smallest(
+        packing.unpack_result(sec.root_keys, np.asarray(words), cards),
+        k)
+    return bm.cardinality, (bm if form == "bitmap" else None), None
 
 
 def assemble_section_results(sections, expr_outs, results,
@@ -994,8 +1357,10 @@ def assemble_section_results(sections, expr_outs, results,
         if sec.kind == "fused":
             out = expr_outs[fi]
             fi += 1
-        card, bm = assemble_section_result(sec, out, form_of(sec.qid))
-        results[sec.qid] = BatchResult(cardinality=card, bitmap=bm)
+        card, bm, value = assemble_section_result(sec, out,
+                                                  form_of(sec.qid))
+        results[sec.qid] = BatchResult(cardinality=card, bitmap=bm,
+                                       value=value)
     return results
 
 
@@ -1016,6 +1381,11 @@ def execute_node_at_a_time(engine, queries) -> list:
             out.append(engine.execute([q])[0])
             continue
         e = canonicalize(q.expr)
+        if isinstance(e, Agg):
+            from ..analytics import two_phase_execute
+
+            out.extend(two_phase_execute(engine, [q]))
+            continue
         memo: dict = {}
 
         def ev(n):
@@ -1026,6 +1396,8 @@ def execute_node_at_a_time(engine, queries) -> list:
                 v = engine._host_sources()[n.index]
             elif isinstance(n, AdHoc):
                 v = n.bm
+            elif isinstance(n, ValuePred):
+                v = engine._column(n.col).host_filter(n.op, n.lo, n.hi)
             elif n.op == "empty":
                 from ..core.bitmap import RoaringBitmap
 

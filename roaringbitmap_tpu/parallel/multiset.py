@@ -549,7 +549,8 @@ class MultiSetBatchEngine:
         rc = self.result_cache
 
         def probe(node):
-            k, _leaves = mut_cache.node_key(node, e._leaf_token)
+            k, _leaves = mut_cache.node_key(node, e._leaf_token,
+                                            e._col_token)
             if k is None:
                 return None
             got = rc.peek_rows(k)
@@ -578,6 +579,7 @@ class MultiSetBatchEngine:
         key = (tuple(pooled),
                tuple((self._engines[s]._ds.uid,
                       self._engines[s]._ds.version) for s in sids),
+               tuple(self._engines[s]._columns_token() for s in sids),
                rt_lattice.plan_token())
         cached = self._plans.get(key)
         if cached is not None:
@@ -622,7 +624,9 @@ class MultiSetBatchEngine:
                         q, qid,
                         lambda pq, own, sid=sid: add_item(sid, pq, own),
                         lambda i, sid=sid: plan_leaf(sid, i),
-                        cache_probe=self._cache_probe_for(sid)))
+                        cache_probe=self._cache_probe_for(sid),
+                        col_resolve=(lambda name, sid=sid:
+                                     self._engines[sid]._column(name))))
                 else:
                     add_item(sid, q, qid)
             # the pooled-row dimension must be judged WITH the shape
@@ -748,8 +752,11 @@ class MultiSetBatchEngine:
             # gathers (pooled row space), after finalize resolved the
             # reduce steps' bucket slots; the pool keeps every host
             # array alive for the donate path, so nothing drops here
+            # analytics sections resolve the megakernel rung down (no
+            # scan opcodes yet — docs/ANALYTICS.md)
             mega = None
-            if expr_mod.fused_of(sections):
+            if expr_mod.fused_of(sections) \
+                    and not expr_mod.has_value_steps(sections):
                 mega = megakernel.build_full(buckets, sections)
             occupancy = (len(pooled)
                          / max(1, sum(b.q for b in buckets)))
@@ -916,7 +923,7 @@ class MultiSetBatchEngine:
             if eng == "megakernel":
                 mega = plan.mega
 
-                def run(src_list, sel_list, arrays):
+                def run(src_list, sel_list, arrays, cols):
                     # one-kernel hot path over the pooled image: every
                     # bucket's reduce + the fused combines + outputs in
                     # one pallas grid kernel (ops.megakernel); the
@@ -927,7 +934,7 @@ class MultiSetBatchEngine:
             elif eng == "xla-vmap":
                 # unmerged per-bucket cross-check path: proves the op
                 # merge and the query-axis flattening equivalent
-                def run(src_list, sel_list, arrays):
+                def run(src_list, sel_list, arrays, cols):
                     words = pooled_words(src_list, sel_list)
                     outs, heads_by_bi = [], [None] * len(b_sigs)
                     for bi, (s, a) in enumerate(zip(b_sigs,
@@ -940,9 +947,10 @@ class MultiSetBatchEngine:
                     if not fused:
                         return outs
                     return outs, expr_mod.eval_sections(
-                        fused, arrays[len(b_sigs):], words, heads_by_bi)
+                        fused, arrays[len(b_sigs):], words, heads_by_bi,
+                        cols_list=cols)
             else:
-                def run(src_list, sel_list, arrays):
+                def run(src_list, sel_list, arrays, cols):
                     words = pooled_words(src_list, sel_list)
                     outs, group_heads = [], []
                     for gi, (s, a) in enumerate(zip(g_sigs,
@@ -958,7 +966,8 @@ class MultiSetBatchEngine:
                         plan.buckets, plan.op_groups, group_heads,
                         live_ok=(eng != "pallas"))
                     return outs, expr_mod.eval_sections(
-                        fused, arrays[len(g_sigs):], words, bucket_heads)
+                        fused, arrays[len(g_sigs):], words, bucket_heads,
+                        cols_list=cols)
 
             jit_kw = {"donate_argnums": (2,)} if donate else {}
             # donate-variant lowering traces against avals only: caching
@@ -972,7 +981,7 @@ class MultiSetBatchEngine:
             compiled = jax.jit(run, **jit_kw).lower(
                 [s for s, _ in srcs],
                 [plan.row_sel_dev(s) for s in plan.sids],
-                operands).compile()
+                operands, expr_mod.launch_cols(plan.fused)).compile()
             compile_s = time.perf_counter() - t0
             obs_cost.observe_compile(SITE, "miss", compile_s)
             rt_lattice.note_compile(SITE, eng, plan.point, compile_s)
@@ -1320,7 +1329,8 @@ class MultiSetBatchEngine:
                             pipelined=not sync) as sp:
             t_launch = time.perf_counter()
             with obs_slo.phase("dispatch"):
-                outs = (compiled if jit else run)(srcs, sels, barrays)
+                outs = (compiled if jit else run)(
+                    srcs, sels, barrays, expr_mod.launch_cols(plan.fused))
             # counted HERE, not per pipeline-window slot: an OOM-split
             # slot dispatches 2+ real launches, a sequential landing
             # dispatches none — the counter must track what actually
@@ -1329,6 +1339,7 @@ class MultiSetBatchEngine:
                                 site=SITE).inc()
             if plan.exprs:
                 expr_mod.record_fused_dispatch(SITE, plan.exprs)
+                expr_mod.record_analytics_dispatch(SITE, plan.exprs, sp)
             if eng == "megakernel":
                 sp.event("expr.megakernel", **plan.mega.stats_event())
             if sync:
@@ -1474,31 +1485,28 @@ class MultiSetBatchEngine:
     def _sequential(self, pooled) -> list:
         """Terminal fallback: each query on its own set's host container
         algebra — the bit-exact reference every pooled rung is pinned
-        against."""
-        out = []
-        for sid, q in pooled:
-            rb = self._engines[sid]._sequential_one(q)
-            out.append(BatchResult(
-                cardinality=rb.cardinality,
-                bitmap=rb if q.form == "bitmap" else None))
-        return out
+        against (aggregate roots through the host BSI/RangeBitmap
+        oracle, like BatchEngine's floor)."""
+        return [self._engines[sid]._sequential_result(q)
+                for sid, q in pooled]
 
     def _shadow_check(self, pooled, results, policy) -> None:
         idx = guard.shadow_sample(len(pooled), policy.shadow_rate,
                                   policy.shadow_seed, SITE)
         for i in idx:
             sid, q = pooled[i]
-            ref = self._engines[sid]._sequential_one(q)
+            ref = self._engines[sid]._sequential_result(q)
             got = results[i]
-            bad = got.cardinality != ref.cardinality
+            bad = (got.cardinality != ref.cardinality
+                   or got.value != ref.value)
             if not bad and q.form == "bitmap":
-                bad = got.bitmap != ref
+                bad = got.bitmap != ref.bitmap
             if bad:
                 raise errors.ShadowMismatch(
                     f"multiset query {i} ({query_desc(q)} on set "
                     f"{sid}) diverged from the sequential reference: got "
-                    f"cardinality {got.cardinality}, want "
-                    f"{ref.cardinality}")
+                    f"cardinality {got.cardinality}/value {got.value}, "
+                    f"want {ref.cardinality}/{ref.value}")
 
     # --------------------------------------------------------- conveniences
 
@@ -1525,6 +1533,27 @@ class MultiSetBatchEngine:
             if point.delta:
                 for e in self._engines:
                     e._ds.warmup_delta(point.delta)
+                compiled += 1
+                continue
+            if point.bsi:
+                # analytics shape-classes warm per tenant through the
+                # adopted engines (the S=1 route the loop above took);
+                # pooled analytics pools additionally warm here for
+                # tenant 0's columns
+                from .batch_engine import analytics_rung_queries
+
+                batches = analytics_rung_queries(
+                    getattr(self._engines[0]._ds, "columns", {}),
+                    point.bsi, self._engines[0].n)
+                with lat.pin(point):
+                    for batch in batches:
+                        pooled, _ = self._flatten(
+                            [BatchGroup(0, batch)])
+                        plan = self._plan_pool(pooled)
+                        for sec in plan.exprs:
+                            lat.note_expr(sec.signature)
+                        self._program(plan,
+                                      self._pool_engine(plan, engine))
                 compiled += 1
                 continue
             if point.expr:

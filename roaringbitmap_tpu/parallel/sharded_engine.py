@@ -482,6 +482,7 @@ class ShardedBatchEngine:
         key = (tuple(pooled),
                tuple((self._engines[s]._ds.uid,
                       self._engines[s]._ds.version) for s in sids),
+               tuple(self._engines[s]._columns_token() for s in sids),
                rt_lattice.plan_token())
         cached = self._plans.get(key)
         if cached is not None:
@@ -524,7 +525,9 @@ class ShardedBatchEngine:
                         q, qid,
                         lambda pq, own, sid=sid: add_item(sid, pq, own),
                         lambda i, sid=sid: plan_leaf(sid, i),
-                        cache_probe=self._single._cache_probe_for(sid)))
+                        cache_probe=self._single._cache_probe_for(sid),
+                        col_resolve=(lambda name, sid=sid:
+                                     self._engines[sid]._column(name))))
                 else:
                     add_item(sid, q, qid)
             pad_to, point = snap_plan_groups(
@@ -569,7 +572,7 @@ class ShardedBatchEngine:
             # keeps the multi-op eval_sections path (mega=None)
             mega = None
             fused = expr_mod.fused_of(sections)
-            if fused:
+            if fused and not expr_mod.has_value_steps(sections):
                 mega = megakernel.build_combines(
                     buckets, op_groups, sections,
                     expr_mod.expr_bucket_ids(fused))
@@ -636,6 +639,33 @@ class ShardedBatchEngine:
         return plan._arrays + (
             mega_upload(False) if plan.mega is not None
             else [expr_upload(s, False) for s in plan.fused])
+
+    def _launch_cols(self, plan: _ShardedPlan) -> list:
+        """Analytics column operands, REPLICATED like everything on the
+        post-butterfly side (scan steps run there); uploads cache per
+        (column uid, version) so replayed predicate values never
+        re-place the planes — but ANY column delta does (a value-only
+        patch rewrites plane contents at stable shapes, so caching on
+        structure_version alone would serve stale planes)."""
+        if not expr_mod.has_value_steps(plan.exprs):
+            return [[] for _ in plan.fused]
+        repl = NamedSharding(self._mesh, self._specs.replicated())
+        cache = getattr(self, "_col_arrays", None)
+        if cache is None:
+            cache = self._col_arrays = {}
+
+        def put(col):
+            key = (col.uid, col.version)
+            got = cache.get(key)
+            if got is None:
+                if len(cache) > 64:
+                    cache.clear()      # retired column versions
+                got = cache[key] = (
+                    podmesh.global_put(col.slices_np, repl),
+                    podmesh.global_put(col.ebm_np, repl))
+            return got
+
+        return [[put(c) for c in s.cols] for s in plan.fused]
 
     def _operand_avals(self, plan: _ShardedPlan) -> list:
         """Sharding-carrying avals matching ``_operands(fresh=True)`` —
@@ -778,7 +808,7 @@ class ShardedBatchEngine:
                 obs_trace.span("sharded.program_build", mesh=self._mesh_label,
                                groups=len(g_sigs), donate=donate,
                                exprs=len(fused)) as sp:
-            def run(pool_words, arrays):
+            def run(pool_words, arrays, cols):
                 outs, group_heads = [], []
                 for gi, (s, n, a) in enumerate(zip(g_sigs, n_pads,
                                                    arrays[:len(g_sigs)])):
@@ -815,14 +845,16 @@ class ShardedBatchEngine:
                     plan.buckets, plan.op_groups, group_heads,
                     live_ok=False)
                 return outs, expr_mod.eval_sections(
-                    fused, arrays[len(g_sigs):], pool_words, bucket_heads)
+                    fused, arrays[len(g_sigs):], pool_words, bucket_heads,
+                    cols_list=cols)
 
             jit_kw = {"donate_argnums": (1,)} if donate else {}
             operands = (self._operand_avals(plan) if donate
                         else self._operands(plan))
             t0 = time.perf_counter()
             compiled = jax.jit(run, **jit_kw).lower(
-                self.pool_words, operands).compile()
+                self.pool_words, operands,
+                self._launch_cols(plan)).compile()
             compile_s = time.perf_counter() - t0
             obs_cost.observe_compile(SITE, "miss", compile_s)
             rt_lattice.note_compile(SITE, guard.MESH, plan.point,
@@ -982,11 +1014,13 @@ class ShardedBatchEngine:
             t_launch = time.perf_counter()
             with obs_slo.phase("dispatch"):
                 outs = (compiled if jit else run)(self.pool_words,
-                                                  operands)
+                                                  operands,
+                                                  self._launch_cols(plan))
             obs_metrics.counter("rb_sharded_launches_total", site=SITE,
                                 mesh=self._mesh_label).inc()
             if plan.exprs:
                 expr_mod.record_fused_dispatch(SITE, plan.exprs)
+                expr_mod.record_analytics_dispatch(SITE, plan.exprs, sp)
             if plan.mega is not None:
                 sp.event("expr.megakernel", **plan.mega.stats_event())
             with obs_slo.phase("sync"):
@@ -1086,17 +1120,18 @@ class ShardedBatchEngine:
                                   policy.shadow_seed, SITE)
         for i in idx:
             sid, q = pooled[i]
-            ref = self._engines[sid]._sequential_one(q)
+            ref = self._engines[sid]._sequential_result(q)
             got = results[i]
-            bad = got.cardinality != ref.cardinality
+            bad = (got.cardinality != ref.cardinality
+                   or got.value != ref.value)
             if not bad and q.form == "bitmap":
-                bad = got.bitmap != ref
+                bad = got.bitmap != ref.bitmap
             if bad:
                 raise errors.ShadowMismatch(
                     f"sharded query {i} ({query_desc(q)} on set "
                     f"{sid}) diverged from the sequential reference: got "
-                    f"cardinality {got.cardinality}, want "
-                    f"{ref.cardinality}")
+                    f"cardinality {got.cardinality}/value {got.value}, "
+                    f"want {ref.cardinality}/{ref.value}")
 
     # --------------------------------------------------------- conveniences
 
@@ -1115,6 +1150,23 @@ class ShardedBatchEngine:
             if point.delta:
                 for e in self._engines:
                     e._ds.warmup_delta(point.delta)
+                compiled += 1
+                continue
+            if point.bsi:
+                from .batch_engine import analytics_rung_queries
+
+                batches = analytics_rung_queries(
+                    getattr(self._engines[0]._ds, "columns", {}),
+                    point.bsi, self._engines[0].n)
+                with lat.pin(point):
+                    for batch in batches:
+                        pooled, _ = self._single._flatten(
+                            [BatchGroup(0, batch)])
+                        plan = self._plan(tuple(pooled))
+                        for sec in plan.exprs:
+                            lat.note_expr(sec.signature)
+                        self._program(plan,
+                                      donate=_donation_supported())
                 compiled += 1
                 continue
             if point.expr:

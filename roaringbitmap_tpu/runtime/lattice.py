@@ -96,6 +96,9 @@ class ProgramSignature:
     engine: str = "auto"
     placement: str = "auto"
     delta: int = 0
+    #: analytics shape-class: the padded slice depth the plan's value
+    #: scans cover (0 = no analytics steps) — docs/ANALYTICS.md
+    bsi: int = 0
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -120,6 +123,10 @@ class Lattice:
     engines: tuple = ("auto",)
     placements: tuple = ("auto",)
     delta: tuple = ()
+    #: analytics slice-depth rungs (pow2-padded column depths x the
+    #: predicate classes their scan tags enumerate); empty = analytics
+    #: traffic is out of vocabulary (its compiles are escapes)
+    bsi: tuple = ()
     sealed: bool = dataclasses.field(default=False, compare=False)
     escapes: int = dataclasses.field(default=0, compare=False)
     _pin: object = dataclasses.field(default=None, compare=False,
@@ -131,7 +138,8 @@ class Lattice:
                                         compare=False, repr=False)
 
     def __post_init__(self):
-        for name in ("q", "rows", "keys", "pool", "expr", "delta"):
+        for name in ("q", "rows", "keys", "pool", "expr", "delta",
+                     "bsi"):
             setattr(self, name, tuple(sorted(
                 {int(v) for v in getattr(self, name)})))
         self.op_sets = tuple(sorted(
@@ -172,8 +180,8 @@ class Lattice:
         return best
 
     def snap(self, *, ops, q: int, rows: int, keys: int, heads: bool,
-             expr: int = 0, pool: int = 0, placement: str = "auto"
-             ) -> ProgramSignature | None:
+             expr: int = 0, pool: int = 0, placement: str = "auto",
+             bsi: int = 0) -> ProgramSignature | None:
         """The covering lattice point of a concrete plan shape, or None
         when any dimension is beyond the vocabulary (the plan then keeps
         its exact pow2 shapes and its first compile is an escape).
@@ -191,6 +199,11 @@ class Lattice:
         expr_s = 0
         if expr:
             expr_s = _cover(expr, tuple(d for d in self.expr if d))
+        bsi_s = 0
+        if bsi:
+            bsi_s = _cover(bsi, self.bsi)
+            if bsi_s is None:
+                return None     # analytics depth beyond the vocabulary
         heads_s = bool(heads)
         if p is not None and p.heads and not heads_s:
             heads_s = True
@@ -207,7 +220,7 @@ class Lattice:
             return None
         return ProgramSignature(ops=ops_s, q=q_s, rows=r_s, keys=k_s,
                                 heads=heads_s, expr=expr_s, pool=pool_s,
-                                placement=placement)
+                                placement=placement, bsi=bsi_s)
 
     def contains(self, point: ProgramSignature | None) -> bool:
         """Vocabulary membership of a point (per-dimension; ``engine``
@@ -217,6 +230,8 @@ class Lattice:
             return False
         if point.delta:
             return point.delta in self.delta
+        if point.bsi and point.bsi not in self.bsi:
+            return False
         return (tuple(sorted(point.ops)) in self.op_sets
                 and point.q in self.q and point.rows in self.rows
                 and point.keys in self.keys
@@ -263,6 +278,11 @@ class Lattice:
         for d in self.expr:
             if d:
                 pts.append(ProgramSignature(expr=d))
+        for d in self.bsi:
+            # one analytics shape-class per padded slice depth: the
+            # engines warm representative predicate/aggregate programs
+            # over every attached column the rung covers
+            pts.append(ProgramSignature(bsi=d))
         for d in self.delta:
             pts.append(ProgramSignature(ops=(), delta=d))
         return pts
@@ -274,7 +294,7 @@ class Lattice:
                 * len(self.keys) * len(self.heads)
                 * (len(self.pool) if pooled else 1))
         return (flat + sum(1 for d in self.expr if d)
-                + len(self.delta))
+                + len(self.bsi) + len(self.delta))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -313,6 +333,8 @@ class Lattice:
             "engines=" + ",".join(self.engines),
             "placements=" + ",".join(self.placements),
         ]
+        if self.bsi:
+            dims.append("bsi=" + num(self.bsi))
         if self.delta:
             dims.append("delta=" + num(self.delta))
         return ";".join(dims)
@@ -342,6 +364,8 @@ class Lattice:
                 kw[name] = _pow2_ladder(v)
         if isinstance(kw.get("delta"), int):
             kw["delta"] = (kw["delta"],)
+        if isinstance(kw.get("bsi"), int):
+            kw["bsi"] = (kw["bsi"],)
         if isinstance(kw.get("expr"), int):
             kw["expr"] = (0, kw["expr"]) if kw["expr"] else (0,)
         return cls(**kw)
@@ -356,7 +380,7 @@ def parse_profile(s: str) -> dict:
             continue
         key, _, val = part.partition("=")
         key, val = key.strip(), val.strip()
-        if key in ("q", "rows", "keys", "pool", "expr", "delta"):
+        if key in ("q", "rows", "keys", "pool", "expr", "delta", "bsi"):
             # bare "q=64" = the full pow2 ladder up to 64; a comma makes
             # the list explicit ("q=8,64" — or "q=64," for one sparse
             # rung), which is how profiles keep the vocabulary small

@@ -156,9 +156,10 @@ def test_s1_pool_routes_through_single_set_path(tenant_bitmaps):
     assert len(eng._plans) == 0 and len(eng._programs) == 0
     # and the single-set engine's own caches served the call
     be = eng._engines[1]
-    # plan keys carry the set's mutation version (docs/MUTATION.md)
+    # plan keys carry the set's mutation version (docs/MUTATION.md),
+    # the attached-column token (docs/ANALYTICS.md; () while bare),
     # plus the lattice token (docs/LATTICE.md; None while inactive)
-    assert (tuple(queries), be._ds.version,
+    assert (tuple(queries), be._ds.version, be._columns_token(),
             rt_lattice.plan_token()) in be._plans
     want = be.execute(queries, engine="xla")
     assert [r.cardinality for r in got[0]] == \

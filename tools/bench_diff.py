@@ -88,6 +88,15 @@ import sys
 #: aggregate over the 1-process control, via ``cluster2_vs``) — both
 #: HIGHER; ``route_us`` and ``host_drop_recovery_ms`` ride the generic
 #: ``_us`` / ``_ms`` LOWER fragments.
+#: The analytics OLAP lane (bench.py olap_phase, ISSUE 15,
+#: docs/ANALYTICS.md) adds ``olap.q{Q}.fused_qps`` (via ``qps``) and
+#: ``fused_vs_twophase_x`` (the fused filter-then-aggregate headline,
+#: via ``fused_vs``) — HIGHER; ``olap.warmed.warmed_compiles`` /
+#: ``escapes`` ride the ``compiles`` / ``escapes`` LOWER fragments and
+#: ``replay_p50_ms`` the ``_ms`` rule.  ``twophase_qps`` is the
+#: two-dispatch CONTROL arm (NEUTRAL via ``twophase``, checked before
+#: the generic ``qps`` fragment): the baseline getting faster or
+#: slower measures the disease, not the cure.
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
           "fused_vs", "mega_vs", "vs_repack", "vs_recompute", "attain",
@@ -112,7 +121,7 @@ LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
 #: with higher survivor attainment can be the better trade); the
 #: ``x4`` cells' serving direction signal is ``slo_attainment``.
 NEUTRAL = ("host_overlapped", "phase_ms", "noshed", "shed_rate",
-           "compiles_cold")
+           "compiles_cold", "twophase")
 
 
 def salvage_tail_json(tail: str) -> dict | None:

@@ -71,6 +71,15 @@ forward mis-routed arrivals, degrade a forced host drop through the
 serve every routed result bit-exactly vs the sequential reference —
 pinning the ``pod.*`` bench lanes' correctness before their trend is
 gated.
+
+``--smoke-olap`` (ISSUE 15, docs/ANALYTICS.md) prepends the analytics
+OLAP smoke: fused filter-then-aggregate queries (``sum_`` / ``top_k``
+roots, value-predicate filters) over attached BSI and RangeBitmap
+columns must match the host oracle bit-exactly on every engine rung,
+through a forced fault demotion to the sequential oracle floor, and
+vs the two-phase baseline, with typed-only failures — pinning the
+``olap.q{Q}.*`` / ``fused_vs_twophase_x`` bench lanes' correctness
+before their trend is gated.
 """
 
 from __future__ import annotations
@@ -487,6 +496,101 @@ def lattice_smoke() -> int:
     return 0 if ok else 1
 
 
+def olap_smoke() -> int:
+    """Analytics OLAP smoke (ISSUE 15, docs/ANALYTICS.md): fused
+    filter-then-aggregate queries — ``sum_`` / ``top_k`` roots and
+    value-predicate filters over attached BSI and RangeBitmap columns —
+    bit-exact vs the host oracle (``expr.evaluate_host_agg``) on every
+    engine rung, through a forced fault demotion to the sequential
+    oracle floor, and vs the two-phase baseline; failures must be
+    TYPED (unattached column -> KeyError, sum_ bitmap form ->
+    ValueError), never silent.  Returns 0 when every contract holds,
+    1 otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.analytics import (BsiColumn, RangeColumn,
+                                             two_phase_execute)
+    from roaringbitmap_tpu.parallel import BatchEngine, expr
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+    from roaringbitmap_tpu.runtime import faults
+
+    faults.reset_clock()
+    rng = np.random.default_rng(0x01A5)
+    uni = 1 << 15
+    bms = [RoaringBitmap.from_values(np.unique(
+        rng.integers(0, uni, 1200)).astype(np.uint32))
+        for _ in range(4)]
+    ds = DeviceBitmapSet(bms, layout="dense")
+    ids = np.unique(rng.integers(0, uni, 4000)).astype(np.uint32)
+    price = BsiColumn("price", ids,
+                      rng.integers(0, 5000, ids.size).astype(np.int64))
+    lat = RangeColumn("lat",
+                      rng.integers(0, 1 << 34, 2000).astype(np.int64))
+    ds.attach_column(price)
+    ds.attach_column(lat)
+    eng = BatchEngine(ds, result_cache=None)
+    cols = {"price": price, "lat": lat}
+
+    queries = [
+        expr.ExprQuery(expr.and_(expr.or_(0, 1),
+                                 expr.range_("price", 100, 3000)),
+                       form="bitmap"),
+        expr.ExprQuery(expr.andnot(expr.cmp("lat", "ge", 1 << 32),
+                                   expr.ref(2)), form="bitmap"),
+        expr.ExprQuery(expr.sum_(
+            "price", found=expr.and_(expr.or_(0, 1),
+                                     expr.range_("price", 50, 4000)))),
+        expr.ExprQuery(expr.top_k("price", 7, found=expr.or_(0, 2)),
+                       form="bitmap"),
+    ]
+
+    def oracle(q):
+        if expr.is_agg(q.expr):
+            card, value, bm = expr.evaluate_host_agg(q.expr, bms, cols)
+            return card, value, bm
+        bm = expr.evaluate_host(q.expr, bms, cols)
+        return bm.cardinality, None, bm
+
+    def exact(rows) -> bool:
+        for q, r in zip(queries, rows):
+            card, value, bm = oracle(q)
+            if (r.cardinality, r.value) != (card, value):
+                return False
+            if q.form == "bitmap" and bm is not None \
+                    and r.bitmap != bm:
+                return False
+        return True
+
+    checks: dict = {}
+    for rung in ("xla", "xla-vmap", "pallas"):
+        checks[f"bit_exact_{rung}"] = exact(
+            eng.execute(queries, engine=rung, fallback=False))
+    with faults.inject("lowering@batch_engine=1.0:5"):
+        checks["bit_exact_demoted_to_oracle_floor"] = exact(
+            eng.execute(queries))
+    aggs = [q for q in queries if expr.is_agg(q.expr)]
+    tp = two_phase_execute(eng, aggs)
+    fused = eng.execute(aggs)
+    checks["two_phase_agrees"] = all(
+        (a.cardinality, a.value) == (b.cardinality, b.value)
+        and a.bitmap == b.bitmap for a, b in zip(fused, tp))
+    try:
+        eng.execute([expr.ExprQuery(expr.cmp("nope", "le", 1))])
+        checks["unattached_column_typed"] = False
+    except KeyError:
+        checks["unattached_column_typed"] = True
+    try:
+        expr.ExprQuery(expr.sum_("price"), form="bitmap")
+        checks["sum_bitmap_form_typed"] = False
+    except ValueError:
+        checks["sum_bitmap_form_typed"] = True
+    ok = all(checks.values())
+    print(json.dumps({"smoke_olap": checks, "ok": ok}))
+    return 0 if ok else 1
+
+
 def mutation_smoke() -> int:
     """Mutation-subsystem smoke (ISSUE 12, docs/MUTATION.md): (a) a
     random in-place delta is bit-exact vs the host oracle across
@@ -692,6 +796,12 @@ def main() -> int:
                          "diverse-tenant replay compiles zero programs, "
                          "zero escapes, bit-exact vs unwarmed control; "
                          "exit 1 on violation)")
+    ap.add_argument("--smoke-olap", action="store_true",
+                    help="first run the analytics OLAP smoke (fused "
+                         "filter-then-aggregate bit-exact vs the host "
+                         "BSI/RangeBitmap oracle across engine rungs "
+                         "incl. fault demotion, typed-only failures; "
+                         "exit 1 on violation)")
     args = ap.parse_args()
 
     if args.smoke_sharded:
@@ -716,6 +826,10 @@ def main() -> int:
             return rc
     if args.smoke_lattice:
         rc = lattice_smoke()
+        if rc:
+            return rc
+    if args.smoke_olap:
+        rc = olap_smoke()
         if rc:
             return rc
 

@@ -176,6 +176,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _mutation_semantics([s for _, s in spans])
         errors += _lattice_semantics([s for _, s in spans])
         errors += _pod_semantics([s for _, s in spans])
+        errors += _analytics_semantics([s for _, s in spans])
     return errors
 
 
@@ -257,6 +258,79 @@ def _workload_semantics(spans: list[dict],
     errors += _mutation_semantics(spans, require=budget_semantics)
     errors += _lattice_semantics(spans, require=budget_semantics)
     errors += _pod_semantics(spans, require=budget_semantics)
+    errors += _analytics_semantics(spans, require=budget_semantics)
+    return errors
+
+
+def _analytics_semantics(spans: list[dict],
+                         require: bool = False) -> list[str]:
+    """The device-native analytics lane's span/event vocabulary
+    (roaringbitmap_tpu.analytics, docs/ANALYTICS.md).  Arbitrary dumps
+    validate the ``analytics.column`` span, the dispatch-site
+    ``analytics.scan`` event, and the ``analytics.delta`` event SCHEMAS
+    wherever they appear; ``require`` (the --workload run, which drives
+    one fused filter-then-aggregate OLAP query plus a column delta)
+    additionally demands an attached-column span, at least one scan
+    event carrying an aggregate, and the delta's exact-invalidation
+    record."""
+    errors: list[str] = []
+    col_spans = [s for s in spans
+                 if s.get("name") == "analytics.column"]
+    for s in col_spans:
+        tags = s.get("tags") or {}
+        if tags.get("kind") not in ("bsi_column", "range_column"):
+            errors.append(f"analytics.column span with unknown kind: "
+                          f"{tags!r}")
+        if not tags.get("col"):
+            errors.append(f"analytics.column span without a col tag: "
+                          f"{tags!r}")
+        for field in ("uid", "depth", "depth_pad", "keys", "hbm_bytes"):
+            if not isinstance(tags.get(field), int) or tags[field] < 0:
+                errors.append(f"analytics.column span without a numeric "
+                              f"{field} tag: {tags!r}")
+        if isinstance(tags.get("depth_pad"), int) \
+                and isinstance(tags.get("depth"), int) \
+                and tags["depth_pad"] < max(1, tags["depth"]):
+            errors.append(f"analytics.column depth_pad below depth "
+                          f"(pow2 padding broken): {tags!r}")
+    scans = [ev for s in spans for ev in s.get("events", [])
+             if ev.get("name") == "analytics.scan"]
+    for ev in scans:
+        if not ev.get("site"):
+            errors.append(f"analytics.scan event without a site: {ev!r}")
+        for field in ("scans", "aggs", "bsi_depth"):
+            if not isinstance(ev.get(field), int) or ev[field] < 0:
+                errors.append(f"analytics.scan event without a numeric "
+                              f"{field}: {ev!r}")
+        if (ev.get("scans") or 0) + (ev.get("aggs") or 0) < 1:
+            errors.append(f"analytics.scan event recording no analytics "
+                          f"steps: {ev!r}")
+    deltas = [ev for s in spans for ev in s.get("events", [])
+              if ev.get("name") == "analytics.delta"]
+    for ev in deltas:
+        if not ev.get("col") or ev.get("kind") not in ("bsi_column",
+                                                       "range_column"):
+            errors.append(f"analytics.delta event without col/kind: "
+                          f"{ev!r}")
+        for field in ("uid", "version", "structure_version",
+                      "cache_dropped", "hbm_bytes"):
+            if not isinstance(ev.get(field), int) or ev[field] < 0:
+                errors.append(f"analytics.delta event without a numeric "
+                              f"{field}: {ev!r}")
+        if isinstance(ev.get("version"), int) and ev["version"] < 1:
+            errors.append(f"analytics.delta event with a pre-bump "
+                          f"version: {ev!r}")
+    if require:
+        if not col_spans:
+            errors.append("no analytics.column span — the workload's "
+                          "column attach was not traced")
+        if not any((ev.get("aggs") or 0) >= 1 for ev in scans):
+            errors.append("no analytics.scan event with aggs >= 1 — "
+                          "the workload's fused filter-then-aggregate "
+                          "query did not record")
+        if not deltas:
+            errors.append("no analytics.delta event — the workload's "
+                          "column delta did not record")
     return errors
 
 
@@ -901,6 +975,37 @@ def run_workload(path: str) -> None:
             | mut_eng._ds.host_bitmaps()[2]
         assert got == want.cardinality, \
             "post-delta batch diverged from the host oracle"
+
+        # analytics lane (ISSUE 15, docs/ANALYTICS.md): attach a value
+        # column (analytics.column span), drive ONE fused
+        # filter-then-aggregate OLAP query (the dispatch span's
+        # analytics.scan event must carry the vagg step), then a column
+        # delta (analytics.delta event; exact result-cache
+        # invalidation) and a bit-exact re-execute vs the host oracle
+        from roaringbitmap_tpu.analytics import BsiColumn
+
+        col_rng = np.random.default_rng(0xA11)
+        col_ids = np.unique(col_rng.integers(0, 1 << 16, 3000)
+                            ).astype(np.uint32)
+        col = BsiColumn("price", col_ids,
+                        col_rng.integers(0, 5000, col_ids.size)
+                        .astype(np.int64))
+        mut_eng._ds.attach_column(col)
+        olap_q = expr.ExprQuery(expr.sum_(
+            "price", found=expr.and_(expr.or_(0, 1),
+                                     expr.range_("price", 100, 4000))))
+        olap_got = mut_eng.execute([olap_q])[0]
+        card, value, _ = expr.evaluate_host_agg(
+            olap_q.expr, mut_eng._ds.host_bitmaps(), {"price": col})
+        assert (olap_got.cardinality, olap_got.value) == (card, value), \
+            "fused OLAP query diverged from the host BSI oracle"
+        col.apply_delta(set_values={int(col_ids[0]): 4999})
+        olap_again = mut_eng.execute([olap_q])[0]
+        card, value, _ = expr.evaluate_host_agg(
+            olap_q.expr, mut_eng._ds.host_bitmaps(), {"price": col})
+        assert (olap_again.cardinality, olap_again.value) \
+            == (card, value), \
+            "post-column-delta OLAP query diverged from the host oracle"
 
         # serving lane (ISSUE 10): an OVERLOADED continuous-batching
         # burst over the same tenants — a tiny per-tenant queue cap

@@ -368,10 +368,29 @@ def predict_expr_dispatch_bytes(expr_sigs, engine: str) -> dict:
             # one-kernel lowering: leaf rows stream through the kernel's
             # BlockSpec gather and combine intermediates are VMEM slots
             # — only the root's popcount partials (and its rows, for
-            # bitmap form) reach HBM
-            outputs += root_k * MEGA_CARD_ROW_BYTES
-            if bitmap_form:
-                outputs += root_k * ROW_BYTES
+            # bitmap form) reach HBM.  Analytics steps additionally
+            # stream their column's slice planes + ebm through the
+            # bank-2 column gather (one row per VSCAN/VAGG touch), and
+            # an aggregate root emits its compact output (per-slice
+            # card partials for sum, K rows + cards for topk).
+            agg_root = False
+            for step in steps:
+                skind = step[0]
+                if skind not in ("vscan", "vagg"):
+                    continue
+                depth = _value_step_depth(step)
+                k = _expr_step_rows(step)[2]
+                scan += (depth + 1) * k * ROW_BYTES
+                if skind == "vagg":
+                    agg_root = True
+                    if step[1] == "sum":
+                        outputs += depth * k * 4
+                    else:
+                        outputs += k * ROW_BYTES + k * 4
+            if not agg_root:
+                outputs += root_k * MEGA_CARD_ROW_BYTES
+                if bitmap_form:
+                    outputs += root_k * ROW_BYTES
             continue
         for step in steps:
             skind, _op, k, copies = _expr_step_rows(step)

@@ -43,20 +43,40 @@ HBM):
   per key — the cardinality-only short circuit costs 16x less output
   than a row, and nothing else leaves the chip.
 
-Two banks feed row ops: bank 0 is the resident (or pooled) row image,
+Three banks feed row ops: bank 0 is the resident (or pooled) row image,
 bank 1 ships ad-hoc leaf rows (and, in combine-only mode, the
-pre-gathered leaf rows).  ``mode="combine"`` (:func:`build_combines`) is
-the mesh composition: the sharded engine keeps its shard-local reduce +
-ppermute butterfly and hands the REPLICATED post-butterfly head tensors
-to the megakernel as bank 0, so the interior combine passes fuse into
-one kernel on every device.
+pre-gathered leaf rows), bank 2 is the **column operand bank** — the
+attached analytics columns' slice planes and existence rows, flattened
+in section/column-slot order.  ``mode="combine"``
+(:func:`build_combines`) is the mesh composition: the sharded engine
+keeps its shard-local reduce + ppermute butterfly and hands the
+REPLICATED post-butterfly head tensors to the megakernel as bank 0, so
+the interior combine passes fuse into one kernel on every device.
+
+Megakernel v2 (analytics opcodes — ROADMAP item 2).  Fused
+filter-then-aggregate expressions no longer demote: a ``vscan`` step
+lowers to the O'Neil comparator as instruction-stream micro-ops
+(:data:`VSCAN_HI` / :data:`VSCAN_LO` fuse one state update each, so
+every slice costs exactly TWO steps per bound regardless of the
+predicate's bit value — predicate VALUES select opcodes, never step
+counts, so one compiled program serves every predicate at a given
+shape, the property the sealed lattice's "steady state compiles
+nothing" contract needs); a ``vagg`` step lowers sum to
+:data:`VAGG_CARD` masked-popcount partials (one step per (slice, key))
+and top-k to the branch-free Kaser scan (:data:`ACC_POP` popcount
+accumulation + :data:`TAKE` broadcasting the per-slice take decision
+against the ``imm`` operand).  Both mirror ``bsi.device`` word for
+word, so the one-kernel rung stays bit-exact against the host oracle.
 
 Budget math (docs/EXPRESSIONS.md "Megakernel lowering"): the scratch
 holds ``n_slots`` 8 KiB rows in VMEM (:data:`MAX_SLOTS` bounds it) and
 the instruction stream prefetches into SMEM (:data:`MAX_STEPS`); a plan
 past either bound reports ``fits() == False`` and the engines demote to
-the multi-op pallas rung — the existing pallas -> xla ladder is the
-safety net below that.
+the multi-op pallas rung — counted on
+``rb_mega_capacity_demotions_total{reason}`` plus a
+``mega.capacity_demotion`` trace event (:func:`note_capacity_demotion`;
+capacity demotions are never silent) — the existing pallas -> xla
+ladder is the safety net below that.
 """
 
 from __future__ import annotations
@@ -95,24 +115,42 @@ MAX_STEPS = 1 << 14
 # prefetched orow/crow arrays select.  NOP-like steps are absorbed by
 # the dead slot / dead rows, so padding the stream to a pow2 costs
 # nothing but grid steps.
+#
+# The v2 analytics opcodes keep the same one-read-one-write discipline:
+# VSCAN_HI/VSCAN_LO fuse one O'Neil comparator state update each
+# (``lt |= eq & ~w`` / ``gt |= eq & w`` — bsi.device.oneil_scan's two
+# conditional accumulations), VAGG_CARD routes ``popcount(srcv & row)``
+# to a card row (the sum_ per-(slice, key) partial — a dead-slot write
+# on the accumulator side), ACC_POP accumulates per-word popcounts into
+# a counter slot and TAKE broadcasts the Kaser take decision
+# (``sum(counter) < imm``) as an all-ones/zero mask slot.
 
 (NOP, LOAD_ROW, OR_ROW, AND_ROW, XOR_ROW, ANDNOT_ROW_REV, ZERO,
  COPY_SLOT, OR_SLOT, AND_SLOT, XOR_SLOT, ANDNOT_SLOT, ANDNOT_ROW,
- OUT, CARD) = range(15)
+ OUT, CARD, VSCAN_HI, VSCAN_LO, VAGG_CARD, ACC_POP, TAKE) = range(20)
+
+#: opcodes whose accumulator write is the dead slot (their payload
+#: leaves through the out/card rows instead)
+_DEAD_DST = (OUT, CARD, VAGG_CARD)
 
 _OP_ROW = {"or": OR_ROW, "and": AND_ROW, "xor": XOR_ROW}
 _OP_SLOT = {"or": OR_SLOT, "and": AND_SLOT, "xor": XOR_SLOT}
 
 
 def _kernel(opc_ref, dst_ref, src_ref, row_ref, bank_ref, orow_ref,
-            crow_ref, wa_ref, wb_ref, out_ref, card_ref, acc_ref):
+            crow_ref, imm_ref, wa_ref, wb_ref, wc_ref, out_ref,
+            card_ref, acc_ref):
     i = pl.program_id(0)
     opc = opc_ref[i]
     dst = dst_ref[i]
     src = src_ref[i]
-    row = jnp.where(bank_ref[i] == 1, wb_ref[0], wa_ref[0])
+    row = jax.lax.select_n(bank_ref[i], wa_ref[0], wb_ref[0], wc_ref[0])
     cur = acc_ref[dst]
     srcv = acc_ref[src]
+    pop = jax.lax.population_count(srcv)
+    take = jnp.where(
+        jnp.sum(srcv.astype(jnp.int32)) < imm_ref[i],
+        jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
     acc_ref[dst] = jax.lax.select_n(
         opc,
         cur,                    # NOP
@@ -130,12 +168,19 @@ def _kernel(opc_ref, dst_ref, src_ref, row_ref, bank_ref, orow_ref,
         cur & ~row,             # ANDNOT_ROW
         cur,                    # OUT (dead-slot write)
         cur,                    # CARD (dead-slot write)
+        cur | (srcv & ~row),    # VSCAN_HI (lt |= eq & ~w)
+        cur | (srcv & row),     # VSCAN_LO (gt |= eq & w)
+        cur,                    # VAGG_CARD (dead-slot write)
+        cur + pop,              # ACC_POP (per-word popcount partials)
+        jnp.zeros_like(cur) | take,     # TAKE (broadcast take mask)
     )
     # unconditional output writes: non-OUT/CARD steps land on the dead
-    # out/card row their index maps select, real steps carry acc[src]
+    # out/card row their index maps select, real steps carry acc[src];
+    # VAGG_CARD's card payload is the masked partial popcount(srcv & w)
+    cval = jnp.where(opc == VAGG_CARD, srcv & row, srcv)
     out_ref[0] = srcv
     card_ref[0] = jnp.sum(
-        jax.lax.population_count(srcv).astype(jnp.int32), axis=0)
+        jax.lax.population_count(cval).astype(jnp.int32), axis=0)
 
 
 def _use_interpret() -> bool:
@@ -144,14 +189,14 @@ def _use_interpret() -> bool:
 
 class _Emitter:
     """Instruction-stream builder: one append per micro-op, pow2-padded
-    into the seven prefetch arrays at finish()."""
+    into the eight prefetch arrays at finish()."""
 
     def __init__(self):
-        self.ops: list = []     # (opc, dst, src, row, bank, orow, crow)
+        self.ops: list = []  # (opc, dst, src, row, bank, orow, crow, imm)
 
     def emit(self, opc, dst=0, src=0, row=0, bank=0, orow=None,
-             crow=None):
-        self.ops.append((opc, dst, src, row, bank, orow, crow))
+             crow=None, imm=0):
+        self.ops.append((opc, dst, src, row, bank, orow, crow, imm))
 
     def finish(self, n_slots: int, out_pad: int, card_pad: int) -> dict:
         n = max(1, len(self.ops))
@@ -164,14 +209,16 @@ class _Emitter:
             "bank": np.zeros(n_pad, np.int32),
             "orow": np.full(n_pad, out_pad, np.int32),
             "crow": np.full(n_pad, card_pad, np.int32),
+            "imm": np.zeros(n_pad, np.int32),
         }
-        for i, (opc, dst, src, row, bank, orow, crow) in enumerate(
+        for i, (opc, dst, src, row, bank, orow, crow, imm) in enumerate(
                 self.ops):
             host["opc"][i] = opc
-            host["dst"][i] = dst if opc not in (OUT, CARD) else n_slots
+            host["dst"][i] = dst if opc not in _DEAD_DST else n_slots
             host["src"][i] = src
             host["row"][i] = row
             host["bank"][i] = bank
+            host["imm"][i] = imm
             if orow is not None:
                 host["orow"][i] = orow
             if crow is not None:
@@ -199,7 +246,12 @@ class MegaPlan:
     arrays: dict | None = None
     #: per bucket: (card_base, out_base | None, n_real, k_pad)
     bucket_out: tuple = ()
-    #: per fused section: (card_base, out_base | None, k_root)
+    #: per fused section: (card_base, out_base | None, k_root,
+    #: agg_layout) — agg_layout is None for standard roots,
+    #: ("sum", S, K, K_found) for weighted-popcount contractions (the
+    #: card rows carry the i32[S, K] partials then the K_found found
+    #: cards), ("topk",) for Kaser-scan roots (standard heads+cards
+    #: rows, heads always materialized)
     expr_out: tuple = ()
     #: combine mode: heads-bank row base per op group (-1 = group
     #: produces no heads and is never referenced)
@@ -208,12 +260,17 @@ class MegaPlan:
     #: program-shape signature)
     extra_rows: int = 1
     leaf_rows: int = 0
+    #: static bank-2 row count (column slice planes + existence rows)
+    col_rows: int = 0
+    #: analytics IR-step counts (observability: expr.megakernel event)
+    n_vscan: int = 0
+    n_vagg: int = 0
 
     @property
     def signature(self) -> tuple:
         return (self.mode, self.steps_pad, self.slots_pad, self.out_pad,
                 self.card_pad, self.extra_rows, self.leaf_rows,
-                self.bucket_out, self.expr_out)
+                self.col_rows, self.bucket_out, self.expr_out)
 
     def fits(self) -> bool:
         return (self.slots_pad + 1 <= MAX_SLOTS
@@ -231,7 +288,10 @@ class MegaPlan:
                 "vmem_bytes": int(self.vmem_bytes),
                 "out_rows": int(self.out_pad),
                 "card_rows": int(self.card_pad),
-                "sections": len(self.expr_out)}
+                "sections": len(self.expr_out),
+                "vscan_steps": int(self.n_vscan),
+                "vagg_steps": int(self.n_vagg),
+                "col_rows": int(self.col_rows)}
 
     def device_arrays(self, fresh: bool = False) -> dict:
         if fresh:
@@ -243,6 +303,33 @@ class MegaPlan:
         if self.arrays is None:
             self.arrays = {k: jnp.asarray(v) for k, v in self.host.items()}
         return self.arrays
+
+
+def capacity_reason(mega: MegaPlan) -> str | None:
+    """Which budget a non-fitting plan blew: "slots" (VMEM accumulator)
+    or "steps" (SMEM instruction stream); None when the plan fits."""
+    if mega.slots_pad + 1 > MAX_SLOTS:
+        return "slots"
+    if mega.steps_pad > MAX_STEPS:
+        return "steps"
+    return None
+
+
+def note_capacity_demotion(site: str, mega: MegaPlan) -> None:
+    """Count + trace a capacity demotion (a plan that assembled but
+    resolves below the megakernel rung because ``fits()`` failed) —
+    ``rb_mega_capacity_demotions_total{reason}`` plus a tagged
+    ``mega.capacity_demotion`` span event, so the silent fall-through
+    the PR 11 ladder allowed is always visible."""
+    from ..obs import metrics as obs_metrics
+    from ..obs import trace as obs_trace
+    reason = capacity_reason(mega) or "unknown"
+    obs_metrics.counter("rb_mega_capacity_demotions_total",
+                        site=site, reason=reason).inc()
+    obs_trace.current().event(
+        "mega.capacity_demotion", site=site, reason=reason,
+        steps=int(mega.steps_pad), slots=int(mega.slots_pad),
+        vmem_bytes=int(mega.vmem_bytes))
 
 
 # ------------------------------------------------------------- assembler
@@ -297,12 +384,26 @@ class _SectionCtx:
     """Per-section assembly state: maps compiled steps to (slot | row)
     sources for each of the node's keys."""
 
-    def __init__(self, sec, slot_of_reduce, extra_base, leaf_row):
+    def __init__(self, sec, slot_of_reduce, extra_base, leaf_row,
+                 col_base=None):
         self.sec = sec
         self.slot_of_reduce = slot_of_reduce
         self.extra_base = extra_base
         self.leaf_row = leaf_row
         self.combine_base: dict = {}
+        #: col slot -> (bank-2 row base, depth_pad, K) for this section
+        self.col_base: dict = col_base or {}
+        #: vscan step -> per-key source list (result slots, or bank-2
+        #: existence rows for the "col:all" short circuit)
+        self.vscan_src: dict = {}
+
+    def ebm_row(self, ci_col: int, j: int) -> int:
+        base, s, k = self.col_base[ci_col]
+        return base + s * k + j
+
+    def slice_row(self, ci_col: int, s_i: int, j: int) -> int:
+        base, _s, k = self.col_base[ci_col]
+        return base + s_i * k + j
 
     def source(self, ci: int, j: int):
         """("slot", s) | ("row", bank, r) for step ``ci``'s key ``j``."""
@@ -316,6 +417,8 @@ class _SectionCtx:
         if kind == "reduce":
             _, bi, slot, _kq = st
             return self.slot_of_reduce(bi, slot, j)
+        if kind == "vscan":
+            return self.vscan_src[ci][j]
         return ("slot", self.combine_base[ci] + j)
 
 
@@ -381,6 +484,206 @@ def _emit_op(em: _Emitter, dst: int, srcp, slot_op: int,
         em.emit(row_op, dst=dst, row=srcp[2], bank=srcp[1])
 
 
+def _col_layout(sections) -> tuple:
+    """Bank-2 row layout: per (section, col slot), the column's padded
+    slice planes (``S * K`` rows, slice-major) followed by its ``K``
+    existence rows — matching the trace-time ``_col_bank`` concat order
+    exactly.  Returns ({(sid, ci): (base, S, K)}, total_rows)."""
+    shapes: dict = {}
+    for sid, sec in enumerate(sections):
+        for st in sec.steps:
+            if st[0] == "vscan":
+                shapes[(sid, st[1])] = (int(st[3]), int(st[4]))
+            elif st[0] == "vagg":
+                shapes[(sid, st[4])] = (int(st[5]), int(st[6]))
+    bases, off = {}, 0
+    for key in sorted(shapes):
+        s, k = shapes[key]
+        bases[key] = (off, s, k)
+        off += s * k + k
+    return bases, off
+
+
+def _emit_vscan(em: _Emitter, ctx: _SectionCtx, si: int,
+                n_slots: int) -> int:
+    """One value-predicate step as instruction-stream micro-ops: the
+    descending O'Neil pass of bsi.device (oneil_scan / oneil_scan2),
+    one (VSCAN_HI|VSCAN_LO, AND_ROW|ANDNOT_ROW) pair per (slice, key)
+    per bound — the predicate's BITS select which opcode lands in each
+    pair but never how many steps there are, so every predicate value
+    at a given (tag, depth, K) shape shares one compiled program.
+    Padded zero planes carry zero bits, so their pairs reduce to exact
+    no-ops, matching the traced scan's pow2-closure property."""
+    sec = ctx.sec
+    _, ci, tag, depth, kq = sec.steps[si]
+    kind, _, op = tag.partition(":")
+    if op == "all":
+        ctx.vscan_src[si] = [("row", 2, ctx.ebm_row(ci, j))
+                             for j in range(kq)]
+        return n_slots
+    bits = np.asarray(sec.host[f"b{si}"])
+    bits2 = np.asarray(sec.host[f"b2{si}"])
+    scan2 = op in ("RANGE", "between")
+    srcs: list = []
+    for j in range(kq):
+        erow = ctx.ebm_row(ci, j)
+        if scan2:
+            g1, e1, l2, e2 = range(n_slots, n_slots + 4)
+            n_slots += 4
+            em.emit(ZERO, dst=g1)
+            em.emit(LOAD_ROW, dst=e1, row=erow, bank=2)
+            em.emit(ZERO, dst=l2)
+            em.emit(LOAD_ROW, dst=e2, row=erow, bank=2)
+            for t in range(depth):
+                w = ctx.slice_row(ci, depth - 1 - t, j)
+                if int(bits[t]):
+                    em.emit(NOP)
+                    em.emit(AND_ROW, dst=e1, row=w, bank=2)
+                else:
+                    em.emit(VSCAN_LO, dst=g1, src=e1, row=w, bank=2)
+                    em.emit(ANDNOT_ROW, dst=e1, row=w, bank=2)
+                if int(bits2[t]):
+                    em.emit(VSCAN_HI, dst=l2, src=e2, row=w, bank=2)
+                    em.emit(AND_ROW, dst=e2, row=w, bank=2)
+                else:
+                    em.emit(NOP)
+                    em.emit(ANDNOT_ROW, dst=e2, row=w, bank=2)
+            # (gt1 | eq1) & (lt2 | eq2) — the found mask is the
+            # existence plane every scan state already lives inside
+            em.emit(OR_SLOT, dst=g1, src=e1)
+            em.emit(OR_SLOT, dst=l2, src=e2)
+            em.emit(AND_SLOT, dst=g1, src=l2)
+            srcs.append(("slot", g1))
+            continue
+        gt, lt, eq = range(n_slots, n_slots + 3)
+        n_slots += 3
+        em.emit(ZERO, dst=gt)
+        em.emit(ZERO, dst=lt)
+        em.emit(LOAD_ROW, dst=eq, row=erow, bank=2)
+        for t in range(depth):
+            w = ctx.slice_row(ci, depth - 1 - t, j)
+            if int(bits[t]):
+                em.emit(VSCAN_HI, dst=lt, src=eq, row=w, bank=2)
+                em.emit(AND_ROW, dst=eq, row=w, bank=2)
+            else:
+                em.emit(VSCAN_LO, dst=gt, src=eq, row=w, bank=2)
+                em.emit(ANDNOT_ROW, dst=eq, row=w, bank=2)
+        if op in ("EQ", "eq"):
+            res = eq
+        elif op in ("NEQ", "neq"):
+            # ebm & ~eq — gt's slot is free to carry the complement
+            em.emit(LOAD_ROW, dst=gt, row=erow, bank=2)
+            em.emit(ANDNOT_SLOT, dst=gt, src=eq)
+            res = gt
+        elif op == "GT":
+            res = gt
+        elif op == "LT":
+            res = lt
+        elif op in ("LE", "lte"):
+            em.emit(OR_SLOT, dst=lt, src=eq)
+            res = lt
+        elif op in ("GE", "gte"):
+            em.emit(OR_SLOT, dst=gt, src=eq)
+            res = gt
+        else:
+            raise ValueError(f"unknown scan tag {tag!r}")
+        srcs.append(("slot", res))
+    ctx.vscan_src[si] = srcs
+    return n_slots
+
+
+def _emit_vagg(em: _Emitter, ctx: _SectionCtx, si: int, n_slots: int,
+               n_card: int, n_out: int) -> tuple:
+    """One aggregate root as instruction-stream micro-ops.  ``sum``:
+    align the found step onto the column keys (plan-time searchsorted
+    masks, the combine discipline), then one VAGG_CARD per (slice, key)
+    routes ``popcount(found & slice)`` partials to the card rows, plus
+    the found step's own K_found cards (both halves of the traced
+    eval_section sum pair — the 2^i weighting stays host-side).
+    ``top_k``: the branch-free Kaser scan — per slice, candidate rows
+    ``x = g | (e & w)``, an ACC_POP counter contraction, one TAKE
+    broadcasting ``sum < k`` (k rides the imm operand: one program per
+    shape, any k), and masked g/e updates ``g |= x & F``,
+    ``e &= w ^ F``.  Returns (n_slots, n_card, n_out, expr_out entry)."""
+    sec = ctx.sec
+    _, akind, fi, aligned, ci, _depth, kq = sec.steps[si]
+    host = sec.host
+    base, s_depth, K = ctx.col_base[ci]
+    k_found = int(sec.steps[fi][-1])
+    idx = host.get(f"i{si}")
+    okm = host.get(f"o{si}")
+    # key-aligned found slots (ok-masked; NOT existence-masked — sum's
+    # traced twin intersects with the slice planes only)
+    fc = list(range(n_slots, n_slots + kq))
+    n_slots += kq
+    for k in range(kq):
+        ok, jj = (True, k) if aligned else (bool(okm[k]), int(idx[k]))
+        if ok:
+            _emit_set(em, fc[k], ctx.source(fi, jj))
+        else:
+            em.emit(ZERO, dst=fc[k])
+    if akind == "sum":
+        cb = n_card
+        for s_i in range(s_depth):
+            for k in range(kq):
+                em.emit(VAGG_CARD, src=fc[k],
+                        row=ctx.slice_row(ci, s_i, k), bank=2,
+                        crow=cb + s_i * kq + k)
+        # the found set's own cards ride the same card block, computed
+        # from the PRE-alignment value (eval_section's found_cards)
+        tmp = n_slots
+        n_slots += 1
+        for j in range(k_found):
+            srcp = ctx.source(fi, j)
+            if srcp[0] == "slot":
+                em.emit(CARD, src=srcp[1], crow=cb + s_depth * kq + j)
+            else:
+                _emit_set(em, tmp, srcp)
+                em.emit(CARD, src=tmp, crow=cb + s_depth * kq + j)
+        n_card += s_depth * kq + k_found
+        return (n_slots, n_card, n_out,
+                (cb, None, kq, ("sum", s_depth, kq, k_found)))
+    # top_k: e starts as found ∩ existence, g empty
+    kk = int(host[f"k{si}"])
+    e = fc
+    for k in range(kq):
+        em.emit(AND_ROW, dst=e[k], row=ctx.ebm_row(ci, k), bank=2)
+    g = list(range(n_slots, n_slots + kq))
+    x = list(range(n_slots + kq, n_slots + 2 * kq))
+    counter, flag, t2 = range(n_slots + 2 * kq, n_slots + 2 * kq + 3)
+    n_slots += 2 * kq + 3
+    for k in range(kq):
+        em.emit(ZERO, dst=g[k])
+    for s_i in range(s_depth - 1, -1, -1):      # descending slice pass
+        for k in range(kq):
+            w = ctx.slice_row(ci, s_i, k)
+            em.emit(COPY_SLOT, dst=x[k], src=e[k])
+            em.emit(AND_ROW, dst=x[k], row=w, bank=2)
+            em.emit(OR_SLOT, dst=x[k], src=g[k])
+        em.emit(ZERO, dst=counter)
+        for k in range(kq):
+            em.emit(ACC_POP, dst=counter, src=x[k])
+        em.emit(TAKE, dst=flag, src=counter, imm=kk)
+        for k in range(kq):
+            # g' = where(take, x, g) == g | (x & F)  (g ⊆ x)
+            em.emit(AND_SLOT, dst=x[k], src=flag)
+            em.emit(OR_SLOT, dst=g[k], src=x[k])
+        for k in range(kq):
+            # e' = where(take, e & ~w, e & w) == e & (w ^ F)
+            em.emit(COPY_SLOT, dst=t2, src=flag)
+            em.emit(XOR_ROW, dst=t2, row=ctx.slice_row(ci, s_i, k),
+                    bank=2)
+            em.emit(AND_SLOT, dst=e[k], src=t2)
+    cb, ob = n_card, n_out
+    for k in range(kq):
+        em.emit(OR_SLOT, dst=g[k], src=e[k])
+        em.emit(CARD, src=g[k], crow=cb + k)
+        em.emit(OUT, src=g[k], orow=ob + k)
+    n_card += kq
+    n_out += kq
+    return n_slots, n_card, n_out, (cb, ob, kq, ("topk",))
+
+
 def _pack_extra(sections) -> tuple:
     """Bank-1 rows: every ad-hoc leaf's container rows, concatenated;
     per-(section-id, step) base offsets for the assembler."""
@@ -426,6 +729,8 @@ def _assemble(mode: str, buckets, sections, slot_of_reduce, leaf_row,
                                              bucket_out):
             _emit_bucket(em, b, base, cb, ob)
 
+    col_bases, col_rows = _col_layout(sections)
+    n_vscan = n_vagg = 0
     ctxs: list = []
     for sid, sec in enumerate(sections):
         ctx = _SectionCtx(
@@ -434,7 +739,9 @@ def _assemble(mode: str, buckets, sections, slot_of_reduce, leaf_row,
             extra_base={ci: extra_bases.get((sid, ci), 0)
                         for ci, st in enumerate(sec.steps)
                         if st[0] == "adhoc"},
-            leaf_row=leaf_row)
+            leaf_row=leaf_row,
+            col_base={ci: v for (s, ci), v in col_bases.items()
+                      if s == sid})
         for si, st in enumerate(sec.steps):
             if st[0] == "combine":
                 ctx.combine_base[si] = n_slots
@@ -442,12 +749,22 @@ def _assemble(mode: str, buckets, sections, slot_of_reduce, leaf_row,
         ctxs.append(ctx)
     for ctx in ctxs:
         for si, st in enumerate(ctx.sec.steps):
-            if st[0] == "combine":
+            if st[0] == "vscan":
+                n_vscan += 1
+                n_slots = _emit_vscan(em, ctx, si, n_slots)
+            elif st[0] == "combine":
                 _emit_combine(em, ctx, si)
 
     expr_out: list = []
     for ctx in ctxs:
         sec = ctx.sec
+        root_st = sec.steps[sec.root]
+        if root_st[0] == "vagg":
+            n_vagg += 1
+            n_slots, n_card, n_out, entry = _emit_vagg(
+                em, ctx, sec.root, n_slots, n_card, n_out)
+            expr_out.append(entry)
+            continue
         k_root = int(sec.root_keys.size)
         root_srcs = [ctx.source(sec.root, j) for j in range(k_root)]
         if any(s[0] == "row" for s in root_srcs):
@@ -463,7 +780,7 @@ def _assemble(mode: str, buckets, sections, slot_of_reduce, leaf_row,
         else:
             root_slots = [s[1] for s in root_srcs]
         ob = n_out if sec.form == "bitmap" else None
-        expr_out.append((n_card, ob, k_root))
+        expr_out.append((n_card, ob, k_root, None))
         for j in range(k_root):
             em.emit(CARD, src=root_slots[j], crow=n_card + j)
             if ob is not None:
@@ -498,7 +815,8 @@ def _assemble(mode: str, buckets, sections, slot_of_reduce, leaf_row,
         n_slots=n_slots, slots_pad=slots_pad,
         out_pad=out_pad, card_pad=card_pad, host=host,
         bucket_out=tuple(bucket_out), expr_out=tuple(expr_out),
-        extra_rows=int(extra.shape[0]))
+        extra_rows=int(extra.shape[0]), col_rows=int(col_rows),
+        n_vscan=n_vscan, n_vagg=n_vagg)
 
 
 def build_full(buckets, sections) -> MegaPlan:
@@ -590,29 +908,32 @@ def build_combines(buckets, op_groups, sections, expr_bis) -> MegaPlan:
 
 # --------------------------------------------------------- traced eval
 
-def _raw_call(mega: MegaPlan, bank_a, bank_b, arrs):
+def _raw_call(mega: MegaPlan, bank_a, bank_b, bank_c, arrs):
     """The pallas_call: one sequential grid pass over the instruction
     stream.  Returns the raw padded (out, cards) buffers."""
     steps = int(arrs["opc"].shape[0])
     out_pad = max(1, mega.out_pad)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=7,
+        num_scalar_prefetch=8,
         grid=(steps,),
         in_specs=[
             pl.BlockSpec((1, _SUB, _LANE),
-                         lambda i, opc, dst, src, row, bank, orow, crow:
-                         (jnp.where(bank[i] == 0, row[i], 0), 0, 0)),
+                         lambda i, opc, dst, src, row, bank, orow, crow,
+                         imm: (jnp.where(bank[i] == 0, row[i], 0), 0, 0)),
             pl.BlockSpec((1, _SUB, _LANE),
-                         lambda i, opc, dst, src, row, bank, orow, crow:
-                         (jnp.where(bank[i] == 1, row[i], 0), 0, 0)),
+                         lambda i, opc, dst, src, row, bank, orow, crow,
+                         imm: (jnp.where(bank[i] == 1, row[i], 0), 0, 0)),
+            pl.BlockSpec((1, _SUB, _LANE),
+                         lambda i, opc, dst, src, row, bank, orow, crow,
+                         imm: (jnp.where(bank[i] == 2, row[i], 0), 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, _SUB, _LANE),
-                         lambda i, opc, dst, src, row, bank, orow, crow:
-                         (orow[i], 0, 0)),
+                         lambda i, opc, dst, src, row, bank, orow, crow,
+                         imm: (orow[i], 0, 0)),
             pl.BlockSpec((1, _LANE),
-                         lambda i, opc, dst, src, row, bank, orow, crow:
-                         (crow[i], 0)),
+                         lambda i, opc, dst, src, row, bank, orow, crow,
+                         imm: (crow[i], 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((mega.slots_pad + 1, _SUB, _LANE), jnp.uint32)],
@@ -626,21 +947,40 @@ def _raw_call(mega: MegaPlan, bank_a, bank_b, arrs):
         ],
         interpret=_use_interpret(),
     )(arrs["opc"], arrs["dst"], arrs["src"], arrs["row"], arrs["bank"],
-      arrs["orow"], arrs["crow"],
-      bank_a.reshape(-1, _SUB, _LANE), bank_b.reshape(-1, _SUB, _LANE))
+      arrs["orow"], arrs["crow"], arrs["imm"],
+      bank_a.reshape(-1, _SUB, _LANE), bank_b.reshape(-1, _SUB, _LANE),
+      bank_c.reshape(-1, _SUB, _LANE))
     return out, cards
 
 
-def _call(mega: MegaPlan, bank_a, bank_b, arrs, wrap=None):
+def _col_bank(mega: MegaPlan, cols_list):
+    """Trace-time bank-2 build: every fused section's column operands —
+    slice planes reshaped slice-major, existence rows after — in the
+    exact (section, col slot) order :func:`_col_layout` laid bases out
+    in.  Stays an operand (never a baked constant): the planes are the
+    RESIDENT column arrays, shared across dispatches and versions."""
+    parts = []
+    for seccols in (cols_list or []):
+        for slices, ebm in seccols:
+            parts.append(slices.reshape(-1, WORDS32))
+            parts.append(ebm.reshape(-1, WORDS32))
+    if not parts:
+        return jnp.zeros((1, WORDS32), jnp.uint32)
+    bank = (parts[0] if len(parts) == 1
+            else jnp.concatenate(parts, axis=0))
+    return bank
+
+
+def _call(mega: MegaPlan, bank_a, bank_b, bank_c, arrs, wrap=None):
     """One megakernel dispatch -> (out_rows u32[out_pad, 2048] | None,
     card_rows i32[card_pad, 128]).  ``wrap`` (the mesh composition)
     wraps the raw call — e.g. a fully-replicated ``shard_map`` so every
     device runs the whole kernel on its replica instead of letting the
     SPMD partitioner slice the grid."""
-    fn = lambda a, b, r: _raw_call(mega, a, b, r)
+    fn = lambda a, b, c, r: _raw_call(mega, a, b, c, r)
     if wrap is not None:
         fn = wrap(fn)
-    out, cards = fn(bank_a, bank_b, arrs)
+    out, cards = fn(bank_a, bank_b, bank_c, arrs)
     out_rows = (out[:mega.out_pad].reshape(mega.out_pad, WORDS32)
                 if mega.out_pad else None)
     return out_rows, cards[:mega.card_pad]
@@ -649,7 +989,9 @@ def _call(mega: MegaPlan, bank_a, bank_b, arrs, wrap=None):
 def _slice_outputs(mega: MegaPlan, out_rows, card_rows):
     """HBM outputs -> (per-bucket outs, per-section expr outs), the
     engines' run-fn contract: buckets get (heads|None, cards[n, k_pad]),
-    fused sections get (heads|None, cards[K])."""
+    fused sections get (heads|None, cards[K]); aggregate sections get
+    their eval_section-shaped pair — sum: (i32[S, K] slice cards,
+    i32[K_found] found cards), topk: (u32[K, W] words, i32[K] cards)."""
     cards = jnp.sum(card_rows, axis=1)
     outs = []
     for cb, ob, n, k_pad in mega.bucket_out:
@@ -658,31 +1000,42 @@ def _slice_outputs(mega: MegaPlan, out_rows, card_rows):
              if ob is not None else None)
         outs.append((h, c))
     expr_outs = []
-    for cb, ob, k_root in mega.expr_out:
+    for cb, ob, k_root, agg in mega.expr_out:
+        if agg is not None and agg[0] == "sum":
+            _, s_depth, kq, k_found = agg
+            slice_cards = cards[cb:cb + s_depth * kq].reshape(
+                s_depth, kq)
+            found_cards = cards[cb + s_depth * kq:
+                                cb + s_depth * kq + k_found]
+            expr_outs.append((slice_cards, found_cards))
+            continue
         c = cards[cb:cb + k_root]
         h = out_rows[ob:ob + k_root] if ob is not None else None
         expr_outs.append((h, c))
     return outs, expr_outs
 
 
-def eval_full(mega: MegaPlan, words, arrs):
+def eval_full(mega: MegaPlan, words, arrs, cols=None):
     """Traced FULL-mode evaluation: ``words`` is the resident (or
-    pooled) row image the stream's bank-0 rows index; returns the
-    ``(bucket_outs, expr_outs)`` pair the engines' fused run fns
-    return."""
-    out_rows, card_rows = _call(mega, words, arrs["extra"], arrs)
+    pooled) row image the stream's bank-0 rows index, ``cols`` the
+    per-fused-section column operands (``expr.launch_cols`` — bank 2);
+    returns the ``(bucket_outs, expr_outs)`` pair the engines' fused
+    run fns return."""
+    out_rows, card_rows = _call(mega, words, arrs["extra"],
+                                _col_bank(mega, cols), arrs)
     return _slice_outputs(mega, out_rows, card_rows)
 
 
 def eval_combines(mega: MegaPlan, group_heads, pool_words, arrs,
-                  wrap=None):
+                  wrap=None, cols=None):
     """Traced COMBINE-mode evaluation (the sharded engine's replicated
     post-butterfly side): bank 0 = the producing groups' flat head
-    tensors, bank 1 = pre-gathered leaf rows + ad-hoc rows.  The leaf
-    gather runs OUTSIDE the kernel (it may cross shards on a
-    rows-sharded pool; ``wrap``'s replicated in_specs then hand every
-    device the full banks).  Returns the per-section expr outs only
-    (bucket outputs stay with the group bodies)."""
+    tensors, bank 1 = pre-gathered leaf rows + ad-hoc rows, bank 2 =
+    the replicated column operands.  The leaf gather runs OUTSIDE the
+    kernel (it may cross shards on a rows-sharded pool; ``wrap``'s
+    replicated in_specs then hand every device the full banks).
+    Returns the per-section expr outs only (bucket outputs stay with
+    the group bodies)."""
     bank_a = [h for h, _ in group_heads if h is not None]
     bank_a = (jnp.concatenate(bank_a, axis=0) if bank_a
               else jnp.zeros((1, WORDS32), jnp.uint32))
@@ -694,7 +1047,8 @@ def eval_combines(mega: MegaPlan, group_heads, pool_words, arrs,
     bank_b = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
                                                               axis=0)
     kernel_arrs = {k: v for k, v in arrs.items() if k != "leafidx"}
-    out_rows, card_rows = _call(mega, bank_a, bank_b, kernel_arrs,
+    out_rows, card_rows = _call(mega, bank_a, bank_b,
+                                _col_bank(mega, cols), kernel_arrs,
                                 wrap=wrap)
     _outs, expr_outs = _slice_outputs(mega, out_rows, card_rows)
     return expr_outs

@@ -684,13 +684,11 @@ class BatchEngine:
             expr_mod.finalize_sections(sections, buckets)
             # the one-kernel program assembles from the buckets' and
             # sections' HOST arrays, so it must build before the
-            # upload-and-drop discipline below frees them
-            # analytics sections stay on the multi-op rungs: the
-            # one-kernel assembler has no scan opcodes yet (stretch),
-            # so megakernel resolves down silently (docs/ANALYTICS.md)
+            # upload-and-drop discipline below frees them; analytics
+            # sections ride the same stream via the vscan/vagg opcodes
+            # (Megakernel v2 — docs/EXPRESSIONS.md)
             mega = None
-            if expr_mod.fused_of(sections) \
-                    and not expr_mod.has_value_steps(sections):
+            if expr_mod.fused_of(sections):
                 mega = megakernel.build_full(buckets, sections)
             # single-set plans dispatch sync from the cache (no remap,
             # no donation), so the device arrays upload here and every
@@ -793,7 +791,8 @@ class BatchEngine:
                     # grid kernel; VMEM accumulators carry the reduce
                     # heads straight into the combines (ops.megakernel)
                     words = self._words_from_src(src_in, kind, eng)
-                    return megakernel.eval_full(mega, words, arrays[0])
+                    return megakernel.eval_full(mega, words, arrays[0],
+                                                cols=cols)
             else:
                 def run(src_in, arrays, cols):
                     words = self._words_from_src(src_in, kind, eng)
@@ -869,7 +868,11 @@ class BatchEngine:
                 plan.mega is not None and plan.mega.fits()):
             # no fused sections, or past the VMEM/SMEM instruction
             # budget: the one-kernel rung resolves down to the multi-op
-            # pallas rung (whose own bounds apply below)
+            # pallas rung (whose own bounds apply below) — capacity
+            # demotions are counted, never silent
+            if plan.mega is not None:
+                megakernel.note_capacity_demotion("batch_engine",
+                                                  plan.mega)
             eng = "pallas"
         ds = self._ds
         if (eng in ("pallas", "megakernel")
@@ -1465,8 +1468,18 @@ class BatchEngine:
                         plan = self.plan(batch)
                         for sec in plan.exprs:
                             lat.note_expr(sec.signature)
-                        self._program(plan,
-                                      self._bucket_engine(plan, engine))
+                        eng = self._bucket_engine(plan, engine)
+                        self._program(plan, eng)
+                        # Megakernel v2: fused analytics now assemble
+                        # into the one-kernel rung, so the sealed
+                        # vocabulary must carry that program too — else
+                        # the first resident-queue pool at this depth
+                        # is a counted escape
+                        mega_eng = self._bucket_engine(plan,
+                                                       "megakernel")
+                        if mega_eng == "megakernel" \
+                                and eng != "megakernel":
+                            self._program(plan, mega_eng)
                 compiled += 1
                 continue
             if point.expr:

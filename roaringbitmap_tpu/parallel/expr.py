@@ -1075,9 +1075,11 @@ def fused_of(sections) -> list:
 
 def has_value_steps(sections) -> bool:
     """True when any fused section carries analytics steps (vscan /
-    vagg) — the megakernel gate: the one-kernel assembler has no scan
-    opcodes yet, so such plans resolve down to the multi-op rungs
-    silently (docs/ANALYTICS.md)."""
+    vagg).  Since Megakernel v2 these assemble into the one-kernel
+    rung like every other step (VSCAN/VAGG opcodes over the column
+    operand bank — ops.megakernel), so this is no longer a demotion
+    gate: it only decides whether column operands must ship with the
+    launch (docs/EXPRESSIONS.md "Megakernel v2")."""
     return any(st[0] in ("vscan", "vagg")
                for s in sections if s.kind == "fused" for st in s.steps)
 

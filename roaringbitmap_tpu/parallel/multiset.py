@@ -751,12 +751,11 @@ class MultiSetBatchEngine:
             # the one-kernel program assembles from the REMAPPED host
             # gathers (pooled row space), after finalize resolved the
             # reduce steps' bucket slots; the pool keeps every host
-            # array alive for the donate path, so nothing drops here
-            # analytics sections resolve the megakernel rung down (no
-            # scan opcodes yet — docs/ANALYTICS.md)
+            # array alive for the donate path, so nothing drops here;
+            # analytics sections ride the vscan/vagg opcodes
+            # (Megakernel v2 — docs/EXPRESSIONS.md)
             mega = None
-            if expr_mod.fused_of(sections) \
-                    and not expr_mod.has_value_steps(sections):
+            if expr_mod.fused_of(sections):
                 mega = megakernel.build_full(buckets, sections)
             occupancy = (len(pooled)
                          / max(1, sum(b.q for b in buckets)))
@@ -790,6 +789,8 @@ class MultiSetBatchEngine:
         eng = _engine(engine)
         if eng == "megakernel" and not (
                 plan.mega is not None and plan.mega.fits()):
+            if plan.mega is not None:
+                megakernel.note_capacity_demotion(SITE, plan.mega)
             eng = "pallas"
         if eng in ("pallas", "megakernel"):
             for sid in plan.sids:
@@ -930,7 +931,8 @@ class MultiSetBatchEngine:
                     # bucket gathers were offset-remapped into the
                     # pooled row space at plan time
                     words = pooled_words(src_list, sel_list)
-                    return megakernel.eval_full(mega, words, arrays[0])
+                    return megakernel.eval_full(mega, words, arrays[0],
+                                                cols=cols)
             elif eng == "xla-vmap":
                 # unmerged per-bucket cross-check path: proves the op
                 # merge and the query-axis flattening equivalent
@@ -1552,8 +1554,15 @@ class MultiSetBatchEngine:
                         plan = self._plan_pool(pooled)
                         for sec in plan.exprs:
                             lat.note_expr(sec.signature)
-                        self._program(plan,
-                                      self._pool_engine(plan, engine))
+                        eng = self._pool_engine(plan, engine)
+                        self._program(plan, eng)
+                        # Megakernel v2: warm the one-kernel analytics
+                        # rung too — the resident queue serves sealed
+                        # points from this cache and must never compile
+                        mega_eng = self._pool_engine(plan, "megakernel")
+                        if mega_eng == "megakernel" \
+                                and eng != "megakernel":
+                            self._program(plan, mega_eng)
                 compiled += 1
                 continue
             if point.expr:

@@ -572,11 +572,12 @@ class ShardedBatchEngine:
             # keeps the multi-op eval_sections path (mega=None)
             mega = None
             fused = expr_mod.fused_of(sections)
-            if fused and not expr_mod.has_value_steps(sections):
+            if fused:
                 mega = megakernel.build_combines(
                     buckets, op_groups, sections,
                     expr_mod.expr_bucket_ids(fused))
                 if not mega.fits():
+                    megakernel.note_capacity_demotion("sharding", mega)
                     mega = None
             padding = (plan_padding(buckets, groups)
                        if point is not None else (0, 0.0))
@@ -832,12 +833,12 @@ class ShardedBatchEngine:
                     def wrap(fn):
                         return shard_map(
                             fn, mesh=self._mesh,
-                            in_specs=(repl, repl, repl),
+                            in_specs=(repl, repl, repl, repl),
                             out_specs=(repl, repl), check_vma=False)
 
                     return outs, megakernel.eval_combines(
                         plan.mega, group_heads, pool_words,
-                        arrays[len(g_sigs)], wrap=wrap)
+                        arrays[len(g_sigs)], wrap=wrap, cols=cols)
                 # fused combine passes run on the replicated side, after
                 # every group's butterfly combine — the padded flat head
                 # layout (no live fast path on the mesh)

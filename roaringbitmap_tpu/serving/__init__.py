@@ -29,7 +29,10 @@ from .frontdoor import PodFrontDoor
 from .loop import (AdmissionRejected, PumpDriver, RequestShed,
                    ServingLoop, ServingPolicy, ServingRequest,
                    TenantPolicy, Ticket)
+from .resident import (DescriptorRing, ResidentEscape, ResidentQueue,
+                       RingBackpressure)
 
 __all__ = ["ServingLoop", "ServingPolicy", "ServingRequest",
            "TenantPolicy", "Ticket", "AdmissionRejected", "RequestShed",
-           "PodFrontDoor", "PumpDriver"]
+           "PodFrontDoor", "PumpDriver", "ResidentQueue",
+           "DescriptorRing", "ResidentEscape", "RingBackpressure"]

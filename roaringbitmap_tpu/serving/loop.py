@@ -79,6 +79,7 @@ ENV_DEADLINE_MS = "ROARING_TPU_SERVING_DEADLINE_MS"
 ENV_SHED = "ROARING_TPU_SERVING_SHED"
 ENV_HEADROOM = "ROARING_TPU_SERVING_HEADROOM"
 ENV_MAX_QUEUE = "ROARING_TPU_SERVING_MAX_QUEUE"
+ENV_RESIDENT = "ROARING_TPU_SERVING_RESIDENT"
 
 #: ladder depth (level 3 is the last rung: fair-share caps)
 MAX_LEVEL = 3
@@ -169,6 +170,12 @@ class ServingPolicy:
     tenants: dict = dataclasses.field(default_factory=dict)
     guard: guard.GuardPolicy | None = None
     engine: str = "auto"
+    #: serve vocabulary pools through the persistent device-resident
+    #: descriptor ring instead of per-pool host dispatch (Megakernel
+    #: v2, docs/SERVING.md "Resident pump"); requires a sealed-lattice
+    #: warmup — without one every pool is a typed ``inactive`` demotion
+    resident: bool = False
+    resident_capacity: int = 64    # descriptor-ring slots (power of 2)
 
     @classmethod
     def from_env(cls, **overrides) -> "ServingPolicy":
@@ -183,6 +190,9 @@ class ServingPolicy:
             env["hbm_headroom"] = float(os.environ[ENV_HEADROOM])
         if ENV_MAX_QUEUE in os.environ:
             env["max_queue"] = max(1, int(os.environ[ENV_MAX_QUEUE]))
+        if ENV_RESIDENT in os.environ:
+            env["resident"] = os.environ[ENV_RESIDENT] \
+                not in ("0", "false", "")
         env.update(overrides)
         return cls(**env)
 
@@ -221,6 +231,30 @@ def replay_stream(target, arrivals) -> list:
         target.pump()
     target.drain()
     return tickets
+
+
+def _expr_shape(e):
+    """Value-free structural key of an expression DAG: predicate and
+    aggregate literals (cmp/range bounds, never the topk k — k sizes
+    the output) are dropped, everything shape-bearing is kept."""
+    if isinstance(e, expr_mod.ValuePred):
+        return ("vp", e.col, e.op)
+    if isinstance(e, expr_mod.Agg):
+        return ("agg", e.kind, e.col, e.k,
+                None if e.found is None else _expr_shape(e.found))
+    if isinstance(e, expr_mod.Node):
+        return ("n", e.op, tuple(_expr_shape(c) for c in e.children))
+    return e                        # Ref / AdHoc: already value-free
+
+
+def _query_shape(q):
+    """Admission-cache key for one request's query: a ``BatchQuery`` is
+    already value-free; an ``ExprQuery`` keys by its DAG's shape so
+    fresh predicate literals (operands under the sealed lattice) reuse
+    the cached footprint."""
+    if isinstance(q, expr_mod.ExprQuery):
+        return ("expr", q.form, _expr_shape(q.expr))
+    return q
 
 
 @dataclasses.dataclass
@@ -317,6 +351,15 @@ class ServingLoop:
         self._hot = self._calm = 0
         self._sheds_since_pump = 0
         self._completed_sheds: list = []
+        #: the Megakernel v2 descriptor ring (docs/SERVING.md "Resident
+        #: pump"); inactive until a sealed-lattice warmup seals its
+        #: vocabulary — every pool until then is a typed demotion
+        self._resident = None
+        if self.policy.resident:
+            from . import resident as resident_mod
+            self._resident = resident_mod.ResidentQueue(
+                engine, capacity=self.policy.resident_capacity)
+            self._resident.seal_vocab()
         self.stats = {"admitted": 0, "rejected": 0, "served": 0,
                       "shed": 0, "failed": 0, "pools": 0, "degraded": 0}
 
@@ -404,8 +447,13 @@ class ServingLoop:
     def _request_bytes(self, request: ServingRequest) -> int:
         """Per-request footprint estimate (the admission increment): the
         single-query predicted dispatch bytes of that request against
-        its own set — plan-cached, so repeated shapes are dict hits."""
-        key = (request.set_id, request.query)
+        its own set — cached by the query's value-free SHAPE, so the
+        prepared-statement replay pattern (same structure, fresh
+        predicate literals every arrival) is a dict hit instead of a
+        per-submit plan resolve.  Predicate/aggregate literals are
+        operands under the sealed lattice: they move bytes' contents,
+        never the predicted footprint."""
+        key = (request.set_id, _query_shape(request.query))
         b = self._req_bytes.get(key)
         if b is None:
             be = self._engine._engines[request.set_id]
@@ -728,10 +776,18 @@ class ServingLoop:
                    deadline_s=round(deadline_s, 6))
             miss0 = self._compile_misses()
             t0 = faults.clock()
+            rows = None
+            if self._resident is not None:
+                rows = self._try_resident(groups, sp)
             try:
-                rows = self._engine.execute(groups,
-                                            engine=self.policy.engine,
-                                            policy=pol)
+                if rows is None:
+                    # the per-pool host dispatch — the path ring-served
+                    # steady state never takes (pinned by
+                    # rb_serving_dispatches_total staying flat)
+                    obs_metrics.counter("rb_serving_dispatches_total",
+                                        site=SITE).inc()
+                    rows = self._engine.execute(
+                        groups, engine=self.policy.engine, policy=pol)
             except Exception as exc:
                 fault = errors.classify(exc)
                 if fault is None:
@@ -777,6 +833,26 @@ class ServingLoop:
             self._pending_bytes -= t.pending_bytes
             self.stats["served"] += 1
         return order
+
+    def _try_resident(self, groups, sp):
+        """One attempt at the resident lane; None means a TYPED
+        demotion happened (counted + traced) and the ordinary one-shot
+        dispatch must serve the pool — the drain half of the ring
+        protocol's escape ladder (docs/EXPRESSIONS.md "Demotion
+        rules")."""
+        from . import resident as resident_mod
+        try:
+            rows = self._resident.serve(groups)
+        except resident_mod.ResidentEscape as exc:
+            obs_metrics.counter("rb_serving_resident_demotions_total",
+                                site=SITE, reason=exc.reason).inc()
+            sp.event("mega.resident", site=SITE, outcome="demoted",
+                     reason=exc.reason)
+            _log.warning("%s: resident demotion (%s); pool falls back "
+                         "to one-shot dispatch", SITE, exc.reason)
+            return None
+        sp.tag(resident=True)
+        return rows
 
     @staticmethod
     def _compile_misses() -> int:
@@ -874,6 +950,11 @@ class ServingLoop:
         self._s_per_q = None
         self._chronic_run = 0
         self._lattice_warmed = rt_lattice.sealed_active()
+        if self._resident is not None:
+            # a sealed vocabulary is the resident ring's descriptor
+            # enum — seal (or re-seal after a profile change) here so
+            # the first post-warmup pool can ride the ring
+            self._resident.seal_vocab()
         return rep
 
     def start_pump(self, interval_s: float | None = None) -> "PumpDriver":
@@ -913,6 +994,10 @@ class ServingLoop:
         rc = getattr(self._engine, "result_cache", None)
         if rc is not None:
             out["result_cache"] = rc.stats()
+        if self._resident is not None:
+            out["resident"] = {"active": self._resident.active,
+                               "stats": dict(self._resident.stats),
+                               "ring": self._resident.ring.state_event()}
         lat = rt_lattice.active()
         if lat is not None:
             out["lattice"] = {"sealed": lat.sealed,

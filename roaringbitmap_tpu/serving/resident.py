@@ -1,0 +1,374 @@
+"""Persistent device-resident pool queue — Megakernel v2's serving lane.
+
+Steady-state serving pays a per-pool host dispatch: the pump plans the
+pool, resolves a program, and launches it — host round trip included —
+even when the sealed lattice guarantees the program is already compiled
+and the operands are already resident.  This module removes that round
+trip for vocabulary traffic:
+
+- :class:`DescriptorRing` is the pinned work ring the pump writes into:
+  fixed-capacity slots of ``(sig_id, seq, payload)`` descriptors plus a
+  completion-stamp array the consumer writes back.  ``sig_id`` is a
+  CLOSED enum over the sealed lattice's :class:`ProgramSignature` points
+  (mixed-radix index over the vocabulary dimensions) — a pool whose
+  snapped point is outside the vocabulary cannot even be described, so
+  it demotes before it touches the ring.
+- :class:`ResidentQueue` owns the ring plus the consumer.  On a real
+  TPU the consumer is a persistent grid kernel spinning on the ring in
+  HBM (capture rides BENCH_r06); the CPU proxy runs an **interpreted
+  twin**: the same descriptor protocol, the same sealed-cache program
+  lookup, the same completion stamps — executed inline, bit-exact with
+  the one-shot megakernel and the host oracle.  Either way the serving
+  pump only writes descriptors and polls stamps: the per-pool host
+  dispatch path (``engine.execute`` -> plan -> launch) is never taken
+  for ring-served pools, which is what ``rb_serving_dispatches_total``
+  staying flat pins.
+- Every exit from the lane is TYPED: :class:`ResidentEscape` with
+  ``reason`` in :data:`ESCAPE_REASONS` drops the pool back to the
+  one-shot megakernel dispatch (and from there down the ordinary guard
+  ladder).  A wedged ring, an out-of-vocabulary pool, a backend that
+  cannot host the resident consumer — each is a counted, traced
+  demotion (``rb_serving_resident_demotions_total{reason}``), never a
+  silent fallback.
+
+docs/SERVING.md "Resident pump" is the operator reference;
+docs/EXPRESSIONS.md "Megakernel v2" documents the descriptor format and
+ring protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..parallel import expr as expr_mod
+from ..runtime import errors, faults
+from ..runtime import lattice as rt_lattice
+
+#: the guard/trace/metric site of the resident lane
+SITE = "resident"
+
+#: every way a pool can leave the resident lane (the demotion reasons
+#: ``rb_serving_resident_demotions_total`` / ``mega.resident`` carry):
+#: ``vocabulary`` — the pool's snapped point is outside the sealed
+#: lattice (or the plan cannot take the megakernel rung); ``wedged`` —
+#: the ring is wedged or its backpressure tripped; ``backend`` — the
+#: engine cannot host a resident consumer; ``inactive`` — no sealed
+#: vocabulary yet (warmup has not run seal_vocab)
+ESCAPE_REASONS = ("vocabulary", "wedged", "backend", "inactive")
+
+
+class RingBackpressure(errors.RoaringRuntimeError):
+    """Typed ring admission refusal: the descriptor was NOT written.
+    ``reason`` is ``"full"`` (capacity descriptors in flight) or
+    ``"wedged"`` (the consumer stopped stamping)."""
+
+    def __init__(self, msg: str, reason: str, **context):
+        super().__init__(msg)
+        self.reason = reason
+        self.context = dict(context)
+
+
+class ResidentEscape(errors.RoaringRuntimeError):
+    """Typed demotion out of the resident lane — the pool must be
+    served by the ordinary one-shot dispatch path instead.  ``reason``
+    is one of :data:`ESCAPE_REASONS`."""
+
+    def __init__(self, reason: str, msg: str | None = None, **context):
+        if reason not in ESCAPE_REASONS:
+            raise ValueError(f"unknown resident escape reason {reason!r}")
+        super().__init__(msg or f"resident escape: {reason}")
+        self.reason = reason
+        self.context = dict(context)
+
+
+@dataclasses.dataclass(frozen=True)
+class Descriptor:
+    """One ring slot's content as the consumer sees it."""
+
+    slot: int
+    seq: int          # 1-based global push sequence number
+    sig_id: int       # closed-enum lattice point id
+    payload: object   # host-side pool handle (plan key + pooled tuple)
+
+
+class DescriptorRing:
+    """Fixed-capacity single-producer/single-consumer work ring.
+
+    The device twin of this structure is a pinned HBM buffer a
+    persistent kernel spins on; here it is numpy arrays with the exact
+    same protocol so the CPU proxy exercises every transition the
+    device path has:
+
+    - ``push`` writes a descriptor at ``head % capacity`` and advances
+      ``head`` — typed :class:`RingBackpressure` when the ring is full
+      (``head - tail == capacity``) or wedged, never an overwrite;
+    - ``pop`` hands the consumer the descriptor at ``tail % capacity``
+      and advances ``tail``;
+    - ``complete`` stamps a finished descriptor; stamps are FIFO — a
+      completion arriving out of push order is a protocol violation and
+      wedges the ring (the device kernel stamps in grid order, so an
+      out-of-order stamp means memory corruption, not scheduling);
+    - ``poll`` answers "has sequence number ``seq`` completed"; the
+      pump spins on it instead of blocking on a device future;
+    - ``drain_barrier`` waits (on the fault clock) until everything
+      pushed has stamped — the serving drain path.
+    """
+
+    def __init__(self, capacity: int = 64):
+        capacity = int(capacity)
+        if capacity < 2 or capacity & (capacity - 1):
+            raise ValueError(
+                f"ring capacity must be a power of two >= 2: {capacity}")
+        self.capacity = capacity
+        self.sig_id = np.full(capacity, -1, np.int32)
+        self.seq = np.zeros(capacity, np.int64)
+        self.stamp = np.zeros(capacity, np.int64)   # completion stamps
+        self._payload: list = [None] * capacity
+        self.head = 0        # total pushes (producer cursor)
+        self.tail = 0        # total pops (consumer cursor)
+        self.completed = 0   # highest FIFO-contiguous stamped seq
+        self.wedged = False
+
+    # ------------------------------------------------------------- producer
+
+    def depth(self) -> int:
+        """Descriptors pushed but not yet popped."""
+        return self.head - self.tail
+
+    def in_flight(self) -> int:
+        """Descriptors pushed but not yet stamped complete."""
+        return self.head - self.completed
+
+    def push(self, sig_id: int, payload: object) -> tuple:
+        """Write one descriptor; returns ``(slot, seq)``."""
+        if self.wedged:
+            raise RingBackpressure("descriptor ring is wedged",
+                                   reason="wedged", head=self.head,
+                                   completed=self.completed)
+        if self.in_flight() >= self.capacity:
+            raise RingBackpressure(
+                f"descriptor ring full: {self.capacity} in flight",
+                reason="full", capacity=self.capacity,
+                head=self.head, completed=self.completed)
+        slot = self.head % self.capacity
+        seq = self.head + 1
+        self.sig_id[slot] = int(sig_id)
+        self.seq[slot] = seq
+        self.stamp[slot] = 0
+        self._payload[slot] = payload
+        self.head = seq
+        return slot, seq
+
+    # ------------------------------------------------------------- consumer
+
+    def pop(self) -> Descriptor:
+        if self.tail >= self.head:
+            raise IndexError("pop on an empty descriptor ring")
+        slot = self.tail % self.capacity
+        d = Descriptor(slot=slot, seq=int(self.seq[slot]),
+                       sig_id=int(self.sig_id[slot]),
+                       payload=self._payload[slot])
+        self._payload[slot] = None
+        self.tail += 1
+        return d
+
+    def complete(self, slot: int, seq: int) -> None:
+        """Stamp descriptor ``seq`` complete at ``slot``.  FIFO order
+        enforced: stamping anything but ``completed + 1`` wedges."""
+        if seq != self.completed + 1 or int(self.seq[slot]) != seq:
+            self.wedged = True
+            raise RingBackpressure(
+                f"out-of-order completion stamp: seq {seq} at slot "
+                f"{slot}, expected {self.completed + 1}",
+                reason="wedged", seq=seq, slot=slot,
+                completed=self.completed)
+        self.stamp[slot] = seq
+        self.completed = seq
+
+    def poll(self, seq: int) -> bool:
+        return self.completed >= int(seq)
+
+    def wedge(self) -> None:
+        """Mark the ring wedged (fault injection / incident path): every
+        later push is typed backpressure until ``reset``."""
+        self.wedged = True
+
+    def reset(self) -> None:
+        """Drop all state — the recovery path after a wedge (the device
+        twin re-initializes the pinned buffer)."""
+        self.sig_id[:] = -1
+        self.seq[:] = 0
+        self.stamp[:] = 0
+        self._payload = [None] * self.capacity
+        self.head = self.tail = self.completed = 0
+        self.wedged = False
+
+    def drain_barrier(self, timeout_s: float = 5.0) -> None:
+        """Block (fault clock) until every pushed descriptor stamped.
+        A wedged ring cannot drain — typed backpressure, not a hang."""
+        t0 = faults.clock()
+        while self.completed < self.head:
+            if self.wedged:
+                raise RingBackpressure("drain barrier on a wedged ring",
+                                       reason="wedged",
+                                       completed=self.completed,
+                                       head=self.head)
+            if faults.clock() - t0 > timeout_s:
+                self.wedged = True
+                raise RingBackpressure(
+                    f"drain barrier timed out after {timeout_s}s",
+                    reason="wedged", completed=self.completed,
+                    head=self.head)
+            faults.advance_clock(1e-4)
+
+    def state_event(self) -> dict:
+        """The ``mega.queue`` trace-event fields."""
+        return {"capacity": self.capacity, "depth": self.depth(),
+                "in_flight": self.in_flight(), "head": self.head,
+                "tail": self.tail, "completed": self.completed,
+                "wedged": self.wedged}
+
+
+def signature_id(lat, point) -> int | None:
+    """The closed-enum descriptor id of a snapped lattice point: a
+    mixed-radix index over the sealed vocabulary's dimension tuples.
+    None when the point is outside the vocabulary (such a pool cannot
+    be described to the resident consumer — demotion by construction,
+    docs/EXPRESSIONS.md "Descriptor format")."""
+    if point is None or point.delta or not lat.contains(point):
+        return None
+    dims = ((tuple(sorted(point.ops)), lat.op_sets),
+            (point.q, lat.q), (point.rows, lat.rows),
+            (point.keys, lat.keys), (bool(point.heads), lat.heads),
+            (point.expr, lat.expr),
+            (point.pool, (0,) + tuple(lat.pool)),
+            (point.bsi, (0,) + tuple(lat.bsi)))
+    sig = 0
+    for val, rungs in dims:
+        rungs = tuple(rungs)
+        if val not in rungs:
+            return None
+        sig = sig * len(rungs) + rungs.index(val)
+    return sig
+
+
+class ResidentQueue:
+    """The resident lane over one pooled engine: seal the vocabulary,
+    then ``serve(groups)`` pushes descriptors and polls stamps instead
+    of dispatching.  Built for ``MultiSetBatchEngine``-shaped engines
+    (the plan/program/readback internals the consumer mirrors); any
+    other engine is a typed ``backend`` escape."""
+
+    #: engine internals the interpreted consumer requires — resolved by
+    #: duck type so the sharded engine (different plan/program split)
+    #: demotes typed instead of failing deep inside
+    _ENGINE_ATTRS = ("_flatten", "_plan_pool", "_pool_engine",
+                     "_program", "_launch_operands", "_readback",
+                     "_regroup")
+
+    def __init__(self, engine, capacity: int = 64):
+        self._engine = engine
+        self.ring = DescriptorRing(capacity)
+        self._lat = None
+        self.stats = {"served": 0, "demoted": 0, "pushed": 0}
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def active(self) -> bool:
+        return self._lat is not None
+
+    def seal_vocab(self) -> bool:
+        """Adopt the process's SEALED lattice as the descriptor
+        vocabulary.  Returns False (queue stays inactive — every serve
+        is an ``inactive`` escape) when no sealed lattice governs: the
+        resident lane only exists inside a closed vocabulary, because
+        the consumer may never compile."""
+        lat = rt_lattice.active()
+        if lat is None or not lat.sealed:
+            self._lat = None
+            return False
+        self._lat = lat
+        return True
+
+    def drain(self, timeout_s: float = 5.0) -> None:
+        if self.ring.head:
+            self.ring.drain_barrier(timeout_s)
+
+    # -------------------------------------------------------------- serving
+
+    def serve(self, groups) -> list:
+        """Serve one pool through the ring; returns per-group result
+        lists exactly like ``engine.execute``.  Typed
+        :class:`ResidentEscape` on ANY exit from the lane."""
+        if self._lat is None:
+            raise ResidentEscape("inactive")
+        eng = self._engine
+        for attr in self._ENGINE_ATTRS:
+            if not hasattr(eng, attr):
+                raise ResidentEscape(
+                    "backend", engine=type(eng).__name__)
+        pooled, lengths = eng._flatten(groups)
+        if not pooled:
+            return [[] for _ in groups]
+        pooled = tuple(pooled)
+        plan = eng._plan_pool(pooled)
+        rung = eng._pool_engine(plan, "megakernel")
+        if rung != "megakernel":
+            # the pool cannot assemble in one kernel (capacity demotion
+            # or no fused sections) — out of the resident lane's
+            # vocabulary even if the lattice covers its shapes
+            raise ResidentEscape("vocabulary", rung=rung)
+        sig_id = signature_id(self._lat, plan.point)
+        if sig_id is None:
+            raise ResidentEscape("vocabulary",
+                                 point=None if plan.point is None
+                                 else plan.point.as_dict())
+        try:
+            slot, seq = self.ring.push(sig_id, (plan.signature,
+                                                len(pooled)))
+        except RingBackpressure as exc:
+            self.stats["demoted"] += 1
+            raise ResidentEscape("wedged", str(exc),
+                                 **exc.context) from exc
+        self.stats["pushed"] += 1
+        faults.maybe_delay(SITE)
+        flat = self._consume(plan, pooled, slot, seq)
+        if not self.ring.poll(seq):
+            raise ResidentEscape("wedged", "completion stamp missing",
+                                 seq=seq)
+        self.stats["served"] += 1
+        obs_metrics.counter("rb_serving_resident_pools_total",
+                            site=SITE).inc()
+        cur = obs_trace.current()
+        cur.event("expr.megakernel", **plan.mega.stats_event())
+        cur.event("mega.resident", site=SITE, outcome="served",
+                  sig_id=int(sig_id), seq=int(seq), slot=int(slot),
+                  pool=len(pooled))
+        cur.event("mega.queue", site=SITE, **self.ring.state_event())
+        return eng._regroup(flat, lengths)
+
+    def _consume(self, plan, pooled, slot: int, seq: int) -> list:
+        """The interpreted consumer twin: pop the descriptor, run the
+        SEALED-CACHE compiled megakernel program, stamp completion.
+        On device this loop lives in the persistent kernel; the
+        protocol (pop -> execute -> FIFO stamp) is identical."""
+        import jax
+
+        eng = self._engine
+        d = self.ring.pop()
+        assert d.slot == slot and d.seq == seq
+        _run, compiled, _pred, _meas, _cost = eng._program(
+            plan, "megakernel")
+        srcs = [eng._engines[s]._resident_src()[0] for s in plan.sids]
+        sels = [plan.row_sel_dev(s) for s in plan.sids]
+        arrays = eng._launch_operands(plan, "megakernel")
+        outs = compiled(srcs, sels, arrays,
+                        expr_mod.launch_cols(plan.fused))
+        outs = jax.block_until_ready(outs)
+        self.ring.complete(d.slot, d.seq)
+        return eng._readback(plan, outs, pooled, "megakernel", False)

@@ -616,13 +616,141 @@ def test_analytics_scan_event_and_explain(tmp_path):
     assert rep["predicted"]["peak_bytes"] > 0
 
 
-def test_megakernel_rung_resolves_down_silently():
-    """Analytics plans have no one-kernel lowering yet: an explicit
-    megakernel request resolves down and still answers bit-exactly."""
+def test_megakernel_rung_runs_analytics_in_kernel():
+    """Megakernel v2: the one-kernel assembler emits VSCAN steps, so an
+    explicit megakernel request STAYS on the top rung for analytics
+    plans (pre-v2 it silently resolved down) and answers bit-exactly."""
     bms, ds, col = build(121, 122)
     eng = BatchEngine(ds, result_cache=None)
     q = expr.ExprQuery(expr.and_(expr.or_(0, 1),
                                  expr.range_("price", 10, 4000)))
-    got = eng.execute([q], engine="megakernel")[0]
+    plan = eng.plan([q])
+    assert plan.mega is not None and plan.mega.n_vscan >= 1
+    assert eng._bucket_engine(plan, "megakernel") == "megakernel"
+    got = eng.execute([q], engine="megakernel", fallback=False)[0]
     ref = expr.evaluate_host(q.expr, bms, {"price": col})
     assert got.cardinality == ref.cardinality
+
+
+# --------------------------------------- megakernel v2 parity matrix
+
+def _mega_queries():
+    """Every analytics root family through one fused pool: predicate
+    filters in both forms, sum, and top-k."""
+    return [
+        expr.ExprQuery(expr.and_(expr.or_(0, 1),
+                                 expr.cmp("price", "le", 2500)),
+                       form="bitmap"),
+        expr.ExprQuery(expr.andnot(expr.range_("price", 100, 5000),
+                                   expr.ref(2))),
+        expr.ExprQuery(expr.sum_(
+            "price", found=expr.and_(expr.or_(0, 1),
+                                     expr.range_("price", 50, 4000)))),
+        expr.ExprQuery(expr.top_k("price", 7, found=expr.or_(0, 1, 2)),
+                       form="bitmap"),
+    ]
+
+
+def _assert_mega_exact(got, qs, bms, col, tag=""):
+    for i, (g, q) in enumerate(zip(got, qs)):
+        if expr.is_agg(q.expr):
+            card, value, bm = expr.evaluate_host_agg(q.expr, bms,
+                                                     {"price": col})
+            assert (g.cardinality, g.value) == (card, value), (tag, i)
+            if q.form == "bitmap":
+                assert g.bitmap == bm, (tag, i)
+        else:
+            ref = expr.evaluate_host(q.expr, bms, {"price": col})
+            assert g.cardinality == ref.cardinality, (tag, i)
+            if q.form == "bitmap":
+                assert g.bitmap == ref, (tag, i)
+
+
+@pytest.mark.parametrize("layout", ["dense", "compact", "counts"])
+def test_megakernel_analytics_parity_batch(layout):
+    """Filter-then-aggregate in the ONE-kernel rung (explicit
+    engine="megakernel", no fallback), every root family x layout,
+    bit-exact vs the host oracle."""
+    bms, ds, col = build(61, 62, layout=layout)
+    eng = BatchEngine(ds, result_cache=None)
+    qs = _mega_queries()
+    plan = eng.plan(qs)
+    assert plan.mega is not None and plan.mega.fits()
+    assert plan.mega.n_vscan >= 1 and plan.mega.n_vagg >= 1
+    assert eng._bucket_engine(plan, "megakernel") == "megakernel"
+    got = eng.execute(qs, engine="megakernel", fallback=False)
+    _assert_mega_exact(got, qs, bms, col, layout)
+
+
+def _mega_events(trace_path):
+    import json
+
+    events = []
+    with open(trace_path) as f:
+        for line in f:
+            events += [ev for ev in json.loads(line).get("events", [])
+                       if ev.get("name") == "expr.megakernel"]
+    return events
+
+
+def test_megakernel_analytics_parity_multiset(tmp_path):
+    a, b, qa, qb = _mk_two_tenants()
+    ms = MultiSetBatchEngine([a[1], b[1]])
+    pool = [BatchGroup(0, [qa, qb]), BatchGroup(1, [qa, qb])]
+    trace = tmp_path / "t.jsonl"
+    obs.enable(str(trace))
+    out = ms.execute(pool, engine="megakernel", fallback=False)
+    obs.disable()
+    _assert_pooled_exact(out, (a, b), qa, qb)
+    evs = _mega_events(trace)
+    assert any(ev.get("vscan_steps", 0) >= 1
+               and ev.get("vagg_steps", 0) >= 1 for ev in evs), \
+        "pooled dispatch did not run analytics opcodes in-kernel"
+
+
+def test_megakernel_analytics_parity_sharded(tmp_path):
+    from roaringbitmap_tpu.parallel.sharded_engine import \
+        ShardedBatchEngine
+
+    a, b, qa, qb = _mk_two_tenants()
+    sh = ShardedBatchEngine([a[1], b[1]])
+    pool = [BatchGroup(0, [qa, qb]), BatchGroup(1, [qa, qb])]
+    trace = tmp_path / "t.jsonl"
+    obs.enable(str(trace))
+    out = sh.execute(pool, engine="megakernel", fallback=False)
+    obs.disable()
+    _assert_pooled_exact(out, (a, b), qa, qb)
+    evs = _mega_events(trace)
+    assert any(ev.get("vscan_steps", 0) >= 1
+               and ev.get("vagg_steps", 0) >= 1 for ev in evs), \
+        "mesh dispatch did not run analytics opcodes in-kernel"
+
+
+@pytest.mark.parametrize("fault_spec,", [
+    "lowering@megakernel=1.0:0x16",              # land on pallas
+    "lowering@megakernel=1.0,lowering@pallas=1.0:0x17",   # land on xla
+])
+def test_megakernel_analytics_fault_demotion_bit_exact(fault_spec):
+    """A lowering fault in the v2 kernel walks the unchanged ladder
+    and every landing answers the analytics pool bit-exactly."""
+    bms, ds, col = build(71, 72)
+    eng = BatchEngine(ds, result_cache=None)
+    qs = _mega_queries()
+    with faults.inject(fault_spec):
+        got = eng.execute(qs, engine="megakernel")
+    _assert_mega_exact(got, qs, bms, col, fault_spec)
+
+
+def test_megakernel_two_phase_agreement():
+    """The one-kernel lane agrees with the two-dispatch + readback
+    baseline the OLAP bench measures against."""
+    bms, ds, col = build(81, 82)
+    eng = BatchEngine(ds, result_cache=None)
+    qs = [q for q in _mega_queries() if expr.is_agg(q.expr)]
+    assert len(qs) == 2                  # sum + top-k
+    fused = eng.execute(qs, engine="megakernel", fallback=False)
+    tp = two_phase_execute(eng, qs)
+    for i, (f, t) in enumerate(zip(fused, tp)):
+        assert (f.cardinality, f.value) == (t.cardinality, t.value), i
+        if qs[i].form == "bitmap":
+            assert f.bitmap == t.bitmap, i

@@ -1,0 +1,333 @@
+"""Persistent device-resident pool queue acceptance (ISSUE 16).
+
+Pins:
+- :class:`DescriptorRing` protocol properties: slot wraparound past
+  capacity, full-ring and wedged-ring admission as TYPED
+  ``RingBackpressure`` (never an overwrite, never silent), FIFO
+  completion-stamp enforcement (an out-of-order stamp wedges), and the
+  drain barrier (fault clock — a wedged or stalled ring is typed
+  backpressure, not a hang);
+- ``signature_id``: a closed mixed-radix enum over the SEALED lattice's
+  dimension tuples — injective over the vocabulary, None outside it;
+- every :class:`ResidentQueue` escape is typed with its reason
+  (``inactive`` / ``backend`` / ``vocabulary`` / ``wedged``) and the
+  serving loop demotes such pools to the one-shot dispatch path,
+  bit-exact, with ``rb_serving_resident_demotions_total`` moved;
+- the steady-state pin: >= 64 fused-analytics pools replayed through a
+  resident serving loop move ``rb_serving_dispatches_total`` ZERO
+  times (every pool ring-served), bit-exact vs the host BSI oracle.
+"""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.analytics import BsiColumn
+from roaringbitmap_tpu.obs import metrics as obs_metrics
+from roaringbitmap_tpu.parallel import MultiSetBatchEngine, expr
+from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+from roaringbitmap_tpu.parallel.multiset import BatchGroup
+from roaringbitmap_tpu.runtime import faults, guard
+from roaringbitmap_tpu.runtime import lattice as rt_lattice
+from roaringbitmap_tpu.serving import (DescriptorRing, ResidentEscape,
+                                       ResidentQueue, RingBackpressure,
+                                       ServingLoop, ServingPolicy,
+                                       ServingRequest)
+from roaringbitmap_tpu.serving.loop import replay_stream
+from roaringbitmap_tpu.serving.resident import signature_id
+
+NOSLEEP = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    faults.reset_clock()
+    rt_lattice.deactivate()
+    yield
+    obs.disable()
+    obs.reset()
+    faults.reset_clock()
+    rt_lattice.deactivate()
+
+
+# --------------------------------------------------- ring protocol
+
+
+def test_ring_wraparound_reuses_slots():
+    ring = DescriptorRing(4)
+    for i in range(11):                  # nearly 3 laps of capacity 4
+        slot, seq = ring.push(i, payload=i)
+        assert slot == i % 4 and seq == i + 1
+        d = ring.pop()
+        assert (d.slot, d.seq, d.sig_id, d.payload) == (slot, seq, i, i)
+        ring.complete(slot, seq)
+        assert ring.poll(seq)
+    assert ring.depth() == 0 and ring.in_flight() == 0
+    assert not ring.wedged
+
+
+def test_ring_capacity_rejects_typed():
+    ring = DescriptorRing(4)
+    for i in range(4):
+        ring.push(i, payload=None)
+    with pytest.raises(RingBackpressure) as exc:
+        ring.push(9, payload=None)
+    assert exc.value.reason == "full"
+    assert not ring.wedged               # full is transient, not fatal
+    # completing frees a slot again
+    d = ring.pop()
+    ring.complete(d.slot, d.seq)
+    ring.push(9, payload=None)
+
+
+def test_ring_wedged_rejects_typed():
+    ring = DescriptorRing(4)
+    ring.wedge()
+    with pytest.raises(RingBackpressure) as exc:
+        ring.push(0, payload=None)
+    assert exc.value.reason == "wedged"
+    ring.reset()
+    ring.push(0, payload=None)           # recovery path
+
+
+def test_ring_out_of_order_stamp_wedges():
+    ring = DescriptorRing(4)
+    ring.push(0, payload=None)
+    ring.push(1, payload=None)
+    d1 = ring.pop()
+    d2 = ring.pop()
+    with pytest.raises(RingBackpressure) as exc:
+        ring.complete(d2.slot, d2.seq)   # seq 2 before seq 1: protocol
+    assert exc.value.reason == "wedged"
+    assert ring.wedged                   # corruption, not scheduling
+    with pytest.raises(RingBackpressure):
+        ring.push(2, payload=None)
+    # d1 exists only to show the FIFO expectation; the wedge is sticky
+    assert d1.seq == 1 and ring.completed == 0
+
+
+def test_ring_drain_barrier_completes_and_times_out():
+    ring = DescriptorRing(4)
+    ring.drain_barrier()                 # nothing pushed: immediate
+    ring.push(0, payload=None)
+    d = ring.pop()
+    ring.complete(d.slot, d.seq)
+    ring.drain_barrier()                 # everything stamped: immediate
+    ring.push(1, payload=None)           # in flight, never stamped
+    with pytest.raises(RingBackpressure) as exc:
+        ring.drain_barrier(timeout_s=0.01)
+    assert exc.value.reason == "wedged" and ring.wedged
+
+
+def test_ring_capacity_must_be_pow2():
+    with pytest.raises(ValueError):
+        DescriptorRing(6)
+    with pytest.raises(ValueError):
+        DescriptorRing(1)
+
+
+# --------------------------------------------------- resident serving
+
+PROFILE = "q=4,;rows=16,;keys=4,;ops=or,and;heads=both;pool=16,;expr=2;"
+
+
+def _mk_tenant(seed: int, uni: int, vmax: int):
+    rng = np.random.default_rng(seed)
+    bms = [RoaringBitmap.from_values(np.unique(
+        rng.integers(0, uni, 500)).astype(np.uint32)) for _ in range(4)]
+    ds = DeviceBitmapSet(bms, layout="dense")
+    ids = np.unique(rng.integers(0, uni, 1200)).astype(np.uint32)
+    col = BsiColumn("price", ids,
+                    rng.integers(0, vmax, ids.size).astype(np.int64))
+    ds.attach_column(col)
+    return bms, ds, col
+
+
+@pytest.fixture(scope="module")
+def tenants():
+    return [_mk_tenant(0x161, 1 << 12, 400),
+            _mk_tenant(0x162, 1 << 11, 120)]
+
+
+@pytest.fixture(scope="module")
+def warmed(tenants):
+    """ONE warmed engine + sealed lattice for the whole module — the
+    vocabulary compile is the expensive part, and the compiled programs
+    live in the engine's LRUs, so tests re-activate the SAME lattice
+    (``from_profile`` passes a Lattice through) instead of re-warming.
+    The autouse ``_clean`` deactivates between tests; each test that
+    needs the warm state starts with ``rt_lattice.activate(lat)``."""
+    depth = max(c.depth_pad for _, _, c in tenants)
+    eng = MultiSetBatchEngine([ds for _, ds, _ in tenants])
+    eng.warmup(profile=PROFILE + f"bsi={depth},")
+    lat = rt_lattice.active()
+    assert lat is not None and lat.sealed
+    yield eng, lat
+    rt_lattice.deactivate()
+
+
+def _queries(i: int):
+    if i % 2:
+        return expr.ExprQuery(expr.sum_(
+            "price", found=expr.and_(expr.or_(0, 1),
+                                     expr.cmp("price", "ge", 5 + i))))
+    return expr.ExprQuery(expr.and_(expr.or_(0, 1),
+                                    expr.cmp("price", "le", 60 + i)))
+
+
+def _check_ticket(t, tenants):
+    assert t.status == "done", (t.status, t.error)
+    bms, _, col = tenants[t.request.set_id]
+    q = t.request.query
+    if expr.is_agg(q.expr):
+        card, value, _ = expr.evaluate_host_agg(q.expr, bms,
+                                                {"price": col})
+        assert (t.result.cardinality, t.result.value) == (card, value)
+    else:
+        ref = expr.evaluate_host(q.expr, bms, {"price": col})
+        assert t.result.cardinality == ref.cardinality
+
+
+def test_signature_id_closed_enum(warmed):
+    _eng, lat = warmed
+    # dispatch shapes: the flat cross product (expr/bsi/delta
+    # MARKER points are shape-classes, not pool shapes — their
+    # default q=1 is outside the rungs and they get no id)
+    flat = [p for p in lat.enumerate_points(pooled=True)
+            if p.q in lat.q and not p.delta]
+    assert flat
+    seen = {}
+    for point in flat:
+        sig = signature_id(lat, point)
+        assert sig is not None and sig >= 0, point
+        assert sig not in seen, (point, seen[sig])  # injective
+        seen[sig] = point
+    for point in lat.enumerate_points(pooled=True):
+        if point.q not in lat.q or point.delta:
+            assert signature_id(lat, point) is None, point
+
+
+def test_resident_serves_64_pools_zero_dispatch(tenants, warmed):
+    """The acceptance pin: >= 64 pools ring-served end-to-end with the
+    per-pool host dispatch counter FLAT, bit-exact vs the host BSI
+    oracle."""
+    eng, lat = warmed
+    rt_lattice.activate(lat)
+    loop = ServingLoop(eng, ServingPolicy(
+        resident=True, pool_target=2, engine="megakernel",
+        default_deadline_ms=600_000.0, guard=NOSLEEP))
+    arrivals = [(i * 1e-4, ServingRequest(i % 2, _queries(i),
+                                          tenant=f"t{i % 2}"))
+                for i in range(128)]
+    d0 = obs_metrics.counter("rb_serving_dispatches_total",
+                             site="serving").value
+    tickets = replay_stream(loop, arrivals)
+    d1 = obs_metrics.counter("rb_serving_dispatches_total",
+                             site="serving").value
+    assert d1 == d0, "a ring-served pool paid a host dispatch"
+    assert loop._resident.stats["served"] >= 64
+    assert loop._resident.stats["demoted"] == 0
+    for t in tickets:
+        _check_ticket(t, tenants)
+
+
+def test_wedged_ring_demotes_typed_and_bit_exact(tenants, warmed):
+    eng, lat = warmed
+    rt_lattice.activate(lat)
+    loop = ServingLoop(eng, ServingPolicy(
+        resident=True, pool_target=2, engine="megakernel",
+        default_deadline_ms=600_000.0, guard=NOSLEEP))
+    loop._resident.ring.wedge()
+    dem0 = obs_metrics.counter("rb_serving_resident_demotions_total",
+                               site="serving",
+                               reason="wedged").value
+    d0 = obs_metrics.counter("rb_serving_dispatches_total",
+                             site="serving").value
+    tickets = [loop.submit(ServingRequest(0, _queries(i),
+                                          tenant="t0"))
+               for i in range(2)]
+    loop.drain()
+    assert obs_metrics.counter("rb_serving_resident_demotions_total",
+                               site="serving",
+                               reason="wedged").value == dem0 + 1
+    assert obs_metrics.counter("rb_serving_dispatches_total",
+                               site="serving").value > d0
+    for t in tickets:
+        _check_ticket(t, tenants)
+
+
+def test_inactive_vocab_escape(tenants):
+    # NO warmup: no sealed lattice, so the queue must refuse activation
+    # and serve() must escape typed
+    eng = MultiSetBatchEngine([ds for _, ds, _ in tenants])
+    rq = ResidentQueue(eng)
+    assert not rq.seal_vocab() and not rq.active
+    with pytest.raises(ResidentEscape) as exc:
+        rq.serve([BatchGroup(0, [_queries(0)])])
+    assert exc.value.reason == "inactive"
+
+
+def test_backend_escape_is_typed(warmed):
+    _eng, lat = warmed
+    rt_lattice.activate(lat)
+
+    class NotAnEngine:
+        pass
+
+    rq = ResidentQueue(NotAnEngine())
+    assert rq.seal_vocab()           # the lattice governs...
+    with pytest.raises(ResidentEscape) as exc:
+        rq.serve([BatchGroup(0, [_queries(0)])])
+    assert exc.value.reason == "backend"  # ...the backend cannot
+
+
+def test_vocabulary_escape_flat_only_pool(warmed):
+    # a pool with NO fused section assembles no one-kernel program —
+    # the resident lane refuses it even though the lattice covers the
+    # shape (the megakernel is the expression assembler)
+    eng, lat = warmed
+    rt_lattice.activate(lat)
+    rq = ResidentQueue(eng)
+    assert rq.seal_vocab()
+    with pytest.raises(ResidentEscape) as exc:
+        rq.serve([BatchGroup(0, [BatchQuery("or", (0, 1, 2))])])
+    assert exc.value.reason == "vocabulary"
+
+
+def test_vocabulary_escape_unwarmed_shape(warmed):
+    # a fused pool whose snapped point is OUTSIDE the sealed vocabulary
+    # (expression depth 3 vs the warmed expr=2 rung) cannot even be
+    # described to the consumer
+    eng, lat = warmed
+    rt_lattice.activate(lat)
+    rq = ResidentQueue(eng)
+    assert rq.seal_vocab()
+    deep = expr.ExprQuery(expr.and_(
+        expr.or_(expr.and_(0, 1), expr.and_(1, 2)),
+        expr.cmp("price", "le", 50)))
+    with pytest.raises(ResidentEscape) as exc:
+        rq.serve([BatchGroup(0, [deep])])
+    assert exc.value.reason == "vocabulary"
+
+
+def test_wedged_push_escape_counts_demotion(warmed):
+    eng, lat = warmed
+    rt_lattice.activate(lat)
+    rq = ResidentQueue(eng)
+    assert rq.seal_vocab()
+    rq.ring.wedge()
+    with pytest.raises(ResidentEscape) as exc:
+        rq.serve([BatchGroup(0, [_queries(0), _queries(2)])])
+    assert exc.value.reason == "wedged"
+    assert rq.stats["demoted"] == 1 and rq.stats["served"] == 0
+
+
+def test_resident_queue_env_opt_in(tenants, monkeypatch):
+    monkeypatch.setenv("ROARING_TPU_SERVING_RESIDENT", "1")
+    assert ServingPolicy.from_env().resident
+    monkeypatch.setenv("ROARING_TPU_SERVING_RESIDENT", "0")
+    assert not ServingPolicy.from_env().resident

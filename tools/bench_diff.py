@@ -97,9 +97,17 @@ import sys
 #: two-dispatch CONTROL arm (NEUTRAL via ``twophase``, checked before
 #: the generic ``qps`` fragment): the baseline getting faster or
 #: slower measures the disease, not the cure.
+#: The Megakernel v2 lanes (bench.py olap_phase mega sub-cell +
+#: resident_phase, ISSUE 16) add ``mega_olap_x`` (fused analytics on
+#: the one-kernel rung vs the multi-op auto rung, via ``mega_olap``)
+#: and ``resident_vs_dispatch_x`` (ring-served steady-state serving
+#: over the per-pool host-dispatch arm, via ``resident_vs``) — both
+#: HIGHER; the resident arm's ``host_dispatches`` count rides nothing
+#: (it is a 0/1 pin asserted in-phase, not a trend lane).
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
-          "fused_vs", "mega_vs", "vs_repack", "vs_recompute", "attain",
+          "fused_vs", "mega_olap", "mega_vs", "resident_vs",
+          "vs_repack", "vs_recompute", "attain",
           "pod_vs", "cluster2_vs")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
          "shard_balance", "warm_restart", "escapes", "padding",

@@ -80,6 +80,16 @@ through a forced fault demotion to the sequential oracle floor, and
 vs the two-phase baseline, with typed-only failures — pinning the
 ``olap.q{Q}.*`` / ``fused_vs_twophase_x`` bench lanes' correctness
 before their trend is gated.
+
+``--smoke-resident`` (ISSUE 16, docs/SERVING.md "Resident pump")
+prepends the persistent resident-queue smoke: pools served through the
+descriptor ring must match BOTH the one-shot megakernel dispatch and
+the host oracle bit-exactly on flat boolean, expression-DAG, and
+filter-then-aggregate roots; a wedged ring must escape with the typed
+``ResidentEscape`` and demote the pool to the one-shot host-dispatch
+path (still bit-exact, never silent) — pinning the
+``resident.resident_vs_dispatch_x`` bench lane's correctness before
+its trend is gated.
 """
 
 from __future__ import annotations
@@ -744,6 +754,163 @@ def pod_smoke() -> int:
     return 0 if ok else 1
 
 
+def resident_smoke() -> int:
+    """Persistent resident-queue smoke (ISSUE 16, docs/SERVING.md
+    "Resident pump"): fused pools served through the descriptor ring
+    must be bit-exact vs BOTH the one-shot megakernel dispatch and the
+    host oracle on flat boolean, expression-DAG, and
+    filter-then-aggregate roots; a WEDGED ring must escape typed
+    (``ResidentEscape(reason="wedged")``, never silent) and the
+    serving loop must demote that pool to the one-shot host-dispatch
+    path, still bit-exact.  Returns 0 when every contract holds, 1
+    otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.analytics import BsiColumn
+    from roaringbitmap_tpu.obs import metrics as obs_metrics
+    from roaringbitmap_tpu.parallel import expr
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+    from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+    from roaringbitmap_tpu.parallel.multiset import (BatchGroup,
+                                                     MultiSetBatchEngine)
+    from roaringbitmap_tpu.runtime import guard
+    from roaringbitmap_tpu.runtime import lattice as rt_lattice
+    from roaringbitmap_tpu.serving import (ResidentEscape, ResidentQueue,
+                                           ServingLoop, ServingPolicy,
+                                           ServingRequest)
+
+    def tenant(seed: int, uni: int, vmax: int):
+        r = np.random.default_rng(seed)
+        bms = [RoaringBitmap.from_values(np.unique(
+            r.integers(0, uni, 600)).astype(np.uint32))
+            for _ in range(4)]
+        ds = DeviceBitmapSet(bms, layout="dense")
+        ids = np.unique(r.integers(0, uni, 1500)).astype(np.uint32)
+        col = BsiColumn("price", ids,
+                        r.integers(0, vmax, ids.size).astype(np.int64))
+        ds.attach_column(col)
+        return bms, ds, col
+
+    tenants = [tenant(0x161, 1 << 12, 400), tenant(0x162, 1 << 11, 120)]
+    depth = max(c.depth_pad for _, _, c in tenants)
+    eng = MultiSetBatchEngine([ds for _, ds, _ in tenants])
+    checks: dict = {}
+    try:
+        eng.warmup(profile=f"q=4,;rows=16,;keys=4,;"
+                           f"ops=or,and,xor,andnot;heads=both;pool=16,;"
+                           f"expr=2;bsi={depth},")
+        rq = ResidentQueue(eng)
+        checks["vocab_sealed"] = rq.seal_vocab() and rq.active
+
+        # flat queries ride the ring inside a FUSED pool: a pool with
+        # no fused section assembles no one-kernel program at all (the
+        # megakernel is the expression assembler), so the flat case
+        # anchors one depth-2 expression and pools flat BatchQuerys
+        # around it — the one kernel executes both
+        pools = {
+            "flat": [BatchGroup(0, [BatchQuery("or", (0, 1, 2)),
+                                    BatchQuery("and", (1, 2))]),
+                     BatchGroup(1, [BatchQuery("xor", (0, 3)),
+                                    expr.ExprQuery(expr.andnot(
+                                        expr.or_(0, 1), expr.ref(2)))])],
+            "expression": [
+                BatchGroup(0, [expr.ExprQuery(
+                    expr.andnot(expr.or_(0, 1), expr.ref(2)))]),
+                BatchGroup(1, [expr.ExprQuery(
+                    expr.and_(expr.or_(0, 1),
+                              expr.cmp("price", "le", 90)),
+                    form="bitmap")])],
+            "filter_then_aggregate": [
+                BatchGroup(0, [expr.ExprQuery(expr.sum_(
+                    "price", found=expr.and_(
+                        expr.or_(0, 1),
+                        expr.cmp("price", "ge", 50))))]),
+                BatchGroup(1, [expr.ExprQuery(
+                    expr.top_k("price", 5, found=expr.or_(0, 2)),
+                    form="bitmap")])],
+        }
+
+        import functools
+        import operator
+        _FLAT_OPS = {"or": operator.or_, "and": operator.and_,
+                     "xor": operator.xor, "andnot": lambda a, b: a - b}
+
+        def exact(groups, rows) -> bool:
+            for g, rs in zip(groups, rows):
+                bms_x, _, col_x = tenants[g.set_id]
+                cols = {"price": col_x}
+                for q, r in zip(g.queries, rs):
+                    if isinstance(q, BatchQuery):
+                        want = functools.reduce(
+                            _FLAT_OPS[q.op],
+                            [bms_x[i] for i in q.operands])
+                        if r.cardinality != want.cardinality:
+                            return False
+                        continue
+                    if expr.is_agg(q.expr):
+                        card, value, bm = expr.evaluate_host_agg(
+                            q.expr, bms_x, cols)
+                    else:
+                        bm = expr.evaluate_host(q.expr, bms_x, cols)
+                        card, value = bm.cardinality, None
+                    if (r.cardinality, r.value) != (card, value):
+                        return False
+                    if q.form == "bitmap" and bm is not None \
+                            and r.bitmap != bm:
+                        return False
+            return True
+
+        for name, groups in pools.items():
+            ring_rows = rq.serve(groups)
+            one_shot = eng.execute(groups, engine="megakernel",
+                                   fallback=False)
+            checks[f"ring_bit_exact_{name}"] = exact(groups, ring_rows)
+            checks[f"one_shot_agrees_{name}"] = all(
+                (a.cardinality, a.value, a.bitmap)
+                == (b.cardinality, b.value, b.bitmap)
+                for ga, gb in zip(ring_rows, one_shot)
+                for a, b in zip(ga, gb))
+        checks["ring_served_all"] = rq.stats["served"] == len(pools)
+
+        # wedged ring: the direct lane must raise the TYPED escape ...
+        rq.ring.wedge()
+        try:
+            rq.serve(pools["flat"])
+            checks["wedged_escape_typed"] = False
+        except ResidentEscape as exc:
+            checks["wedged_escape_typed"] = exc.reason == "wedged"
+        # ... and the serving loop must demote that pool to the
+        # one-shot host-dispatch path (counter moves), still bit-exact
+        loop = ServingLoop(eng, ServingPolicy(
+            resident=True, pool_target=2, engine="megakernel",
+            default_deadline_ms=600_000.0,
+            guard=guard.GuardPolicy(backoff_base=0.0,
+                                    sleep=lambda s: None)))
+        loop._resident.ring.wedge()
+        d0 = obs_metrics.counter("rb_serving_dispatches_total",
+                                 site="serving").value
+        wq = expr.ExprQuery(expr.and_(expr.or_(0, 1),
+                                      expr.cmp("price", "le", 200)))
+        wt = [loop.submit(ServingRequest(0, wq, tenant="w"))
+              for _ in range(2)]
+        loop.drain()
+        d1 = obs_metrics.counter("rb_serving_dispatches_total",
+                                 site="serving").value
+        ref = expr.evaluate_host(wq.expr, tenants[0][0],
+                                 {"price": tenants[0][2]})
+        checks["wedged_demotes_to_dispatch"] = d1 > d0
+        checks["demoted_bit_exact"] = all(
+            t.status == "done"
+            and t.result.cardinality == ref.cardinality for t in wt)
+    finally:
+        rt_lattice.deactivate()
+    ok = all(checks.values())
+    print(json.dumps({"smoke_resident": checks, "ok": ok}))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -802,6 +969,13 @@ def main() -> int:
                          "BSI/RangeBitmap oracle across engine rungs "
                          "incl. fault demotion, typed-only failures; "
                          "exit 1 on violation)")
+    ap.add_argument("--smoke-resident", action="store_true",
+                    help="first run the resident-queue smoke (ring-"
+                         "served pools bit-exact vs one-shot megakernel "
+                         "AND the host oracle on flat/expression/"
+                         "aggregate roots, typed wedged-ring escape + "
+                         "demotion to host dispatch; exit 1 on "
+                         "violation)")
     args = ap.parse_args()
 
     if args.smoke_sharded:
@@ -830,6 +1004,10 @@ def main() -> int:
             return rc
     if args.smoke_olap:
         rc = olap_smoke()
+        if rc:
+            return rc
+    if args.smoke_resident:
+        rc = resident_smoke()
         if rc:
             return rc
 

@@ -80,6 +80,18 @@ and a wide aggregation — then (2) validates every emitted line:
   vocabulary and then forces ONE deliberate out-of-lattice query,
   asserting it executes bit-exactly, emits a traced escape, AND moves
   ``rb_lattice_escapes_total`` — an escape is never silent.
+- resident-queue semantics (ISSUE 16, docs/SERVING.md "Resident
+  pump"): the ``mega.resident`` (served: numeric descriptor
+  coordinates; demoted: a typed escape reason), ``mega.queue``
+  (descriptor-ring counters, ``head >= tail >= completed``,
+  ``depth <= capacity``) and ``mega.capacity_demotion`` (blown budget
+  + plan stats) event schemas are validated on arbitrary dumps, as are
+  the Megakernel v2 ``vscan_steps`` / ``vagg_steps`` / ``col_rows``
+  counters on ``expr.megakernel`` events; the --workload run replays
+  fused filter-then-aggregate pools through the persistent ring
+  (zero per-pool host dispatches, bit-exact vs the host BSI oracle)
+  and then WEDGES the ring for one pool, requiring at least one
+  served and one demoted ``mega.resident`` event.
 
 Validation-only mode (``python tools/check_trace.py <path>``) checks an
 existing dump, e.g. one captured from a serving process.
@@ -177,6 +189,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _lattice_semantics([s for _, s in spans])
         errors += _pod_semantics([s for _, s in spans])
         errors += _analytics_semantics([s for _, s in spans])
+        errors += _resident_semantics([s for _, s in spans])
     return errors
 
 
@@ -259,6 +272,7 @@ def _workload_semantics(spans: list[dict],
     errors += _lattice_semantics(spans, require=budget_semantics)
     errors += _pod_semantics(spans, require=budget_semantics)
     errors += _analytics_semantics(spans, require=budget_semantics)
+    errors += _resident_semantics(spans, require=budget_semantics)
     return errors
 
 
@@ -592,12 +606,27 @@ def _expr_semantics(spans: list[dict], require: bool = False) -> list[str]:
         if not (isinstance(ev.get("steps"), int) and ev["steps"] > 0):
             errors.append(f"expr.megakernel event with no instructions: "
                           f"{ev!r}")
+        # Megakernel v2 analytics counters (VSCAN/VAGG opcodes + the
+        # column-operand bank) — optional on pre-v2 dumps, validated
+        # wherever present
+        for field in ("vscan_steps", "vagg_steps", "col_rows"):
+            if field in ev and (not isinstance(ev[field], int)
+                                or ev[field] < 0):
+                errors.append(f"expr.megakernel event with non-numeric "
+                              f"{field}: {ev!r}")
     compiles = [s for s in spans if s.get("name") == "expr.compile"]
     for s in compiles:
         tags = s.get("tags") or {}
+        # a value-only analytics DAG (e.g. a bare column predicate or a
+        # whole-domain aggregate, as lattice warmup synthesizes) has no
+        # boolean nodes — nodes may be 0 iff value_steps carries the
+        # work instead
         if not isinstance(tags.get("nodes"), int) or tags["nodes"] < 1:
-            errors.append(f"expr.compile span without a positive nodes "
-                          f"tag: {tags!r}")
+            if not (tags.get("nodes") == 0
+                    and isinstance(tags.get("value_steps"), int)
+                    and tags["value_steps"] >= 1):
+                errors.append(f"expr.compile span without a positive "
+                              f"nodes tag: {tags!r}")
         if not isinstance(tags.get("depth"), int) or tags["depth"] < 0:
             errors.append(f"expr.compile span without a numeric depth "
                           f"tag: {tags!r}")
@@ -621,6 +650,95 @@ def _expr_semantics(spans: list[dict], require: bool = False) -> list[str]:
         if not megas:
             errors.append("no expr.megakernel event — the one-kernel "
                           "workload case did not record")
+        elif not any(isinstance(ev.get("vagg_steps"), int)
+                     and ev["vagg_steps"] >= 1
+                     and isinstance(ev.get("vscan_steps"), int)
+                     and ev["vscan_steps"] >= 1 for ev in megas):
+            errors.append(
+                "no expr.megakernel event with vscan_steps >= 1 and "
+                "vagg_steps >= 1 — the fused filter-then-aggregate "
+                "workload case did not run in the one-kernel rung")
+    return errors
+
+
+_RESIDENT_REASONS = ("vocabulary", "wedged", "backend", "inactive")
+_CAPACITY_REASONS = ("slots", "steps", "unknown")
+
+
+def _resident_semantics(spans: list[dict],
+                        require: bool = False) -> list[str]:
+    """The persistent device-resident pool queue's event vocabulary
+    (serving.resident, docs/SERVING.md "Resident pump"): every
+    ``mega.resident`` event records one pool's outcome (``served`` with
+    its descriptor coordinates, or ``demoted`` with a typed escape
+    reason), every ``mega.queue`` event snapshots the descriptor ring's
+    counters, and every ``mega.capacity_demotion`` event names the blown
+    budget.  Arbitrary dumps validate the schemas wherever they appear;
+    ``require`` (the --workload run, which replays fused-analytics pools
+    through the ring AND forces one wedged-ring escape) demands at least
+    one served pool, one demoted pool, and one ring snapshot."""
+    errors: list[str] = []
+    residents = [ev for s in spans for ev in s.get("events", [])
+                 if ev.get("name") == "mega.resident"]
+    for ev in residents:
+        if not isinstance(ev.get("site"), str):
+            errors.append(f"mega.resident event without a site: {ev!r}")
+        outcome = ev.get("outcome")
+        if outcome == "served":
+            for field in ("sig_id", "seq", "slot", "pool"):
+                if not isinstance(ev.get(field), int) or ev[field] < 0:
+                    errors.append(f"served mega.resident event without "
+                                  f"a numeric {field}: {ev!r}")
+        elif outcome == "demoted":
+            if ev.get("reason") not in _RESIDENT_REASONS:
+                errors.append(f"demoted mega.resident event with an "
+                              f"untyped reason: {ev!r}")
+        else:
+            errors.append(f"mega.resident event with bad outcome: {ev!r}")
+    queues = [ev for s in spans for ev in s.get("events", [])
+              if ev.get("name") == "mega.queue"]
+    for ev in queues:
+        for field in ("capacity", "depth", "in_flight", "head", "tail",
+                      "completed"):
+            if not isinstance(ev.get(field), int) or ev[field] < 0:
+                errors.append(f"mega.queue event without a numeric "
+                              f"{field}: {ev!r}")
+        if not isinstance(ev.get("wedged"), bool):
+            errors.append(f"mega.queue event without a boolean wedged "
+                          f"flag: {ev!r}")
+        if isinstance(ev.get("capacity"), int) \
+                and isinstance(ev.get("depth"), int) \
+                and ev["depth"] > ev["capacity"]:
+            errors.append(f"mega.queue event with depth > capacity "
+                          f"(ring overflow): {ev!r}")
+        if all(isinstance(ev.get(f), int)
+               for f in ("head", "tail", "completed")) \
+                and not ev["head"] >= ev["tail"] >= ev["completed"]:
+            errors.append(f"mega.queue event violates the counter order "
+                          f"head >= tail >= completed: {ev!r}")
+    caps = [ev for s in spans for ev in s.get("events", [])
+            if ev.get("name") == "mega.capacity_demotion"]
+    for ev in caps:
+        if not isinstance(ev.get("site"), str):
+            errors.append(f"mega.capacity_demotion event without a "
+                          f"site: {ev!r}")
+        if ev.get("reason") not in _CAPACITY_REASONS:
+            errors.append(f"mega.capacity_demotion event with an "
+                          f"untyped reason: {ev!r}")
+        for field in ("steps", "slots", "vmem_bytes"):
+            if not isinstance(ev.get(field), int) or ev[field] < 0:
+                errors.append(f"mega.capacity_demotion event without a "
+                              f"numeric {field}: {ev!r}")
+    if require:
+        if not any(ev.get("outcome") == "served" for ev in residents):
+            errors.append("no served mega.resident event — the resident "
+                          "ring served no pool")
+        if not any(ev.get("outcome") == "demoted" for ev in residents):
+            errors.append("no demoted mega.resident event — the forced "
+                          "wedged-ring escape did not record")
+        if not queues:
+            errors.append("no mega.queue event — the descriptor ring "
+                          "was never snapshotted")
     return errors
 
 
@@ -1085,6 +1203,107 @@ def run_workload(path: str) -> None:
             assert lattice_escape_metric() > e0, \
                 "out-of-lattice compile was not metered on " \
                 "rb_lattice_escapes_total"
+        finally:
+            rt_lattice.deactivate()
+
+        # resident lane (ISSUE 16, docs/SERVING.md "Resident pump"):
+        # fused filter-then-aggregate pools replayed through the
+        # persistent descriptor ring — every pool must be ring-served
+        # with ZERO per-pool host dispatches (the counter pin below),
+        # bit-exact vs the host BSI oracle; then the ring is WEDGED for
+        # one pool, whose typed escape demotes it to the one-shot path
+        # (still bit-exact) and records the demoted mega.resident event
+        # the semantics checks above require
+        from roaringbitmap_tpu.analytics import BsiColumn as ResBsi
+        from roaringbitmap_tpu.parallel.aggregation import \
+            DeviceBitmapSet
+
+        def res_tenant(seed: int, uni: int, vmax: int):
+            bms = datasets.synthetic_bitmaps(4, seed=seed, universe=uni,
+                                             density=0.004)
+            ds = DeviceBitmapSet(bms)
+            rng = np.random.default_rng(seed + 1)
+            ids = np.unique(rng.integers(0, uni, 2000)
+                            ).astype(np.uint32)
+            col = ResBsi("price", ids,
+                         rng.integers(0, vmax, ids.size)
+                         .astype(np.int64))
+            ds.attach_column(col)
+            return bms, ds, col
+
+        res_tenants = [res_tenant(0x71, 1 << 12, 400),
+                       res_tenant(0x81, 1 << 11, 120)]
+        res_depth = max(c.depth_pad for _, _, c in res_tenants)
+        res_eng = MultiSetBatchEngine([ds for _, ds, _ in res_tenants])
+        res_loop = ServingLoop(res_eng, ServingPolicy(
+            resident=True, pool_target=2, engine="megakernel",
+            default_deadline_ms=600_000.0,
+            guard=rt_guard.GuardPolicy(backoff_base=0.0,
+                                       sleep=lambda s: None)))
+        try:
+            res_loop.warmup(
+                profile=f"q=4,;rows=16,;keys=4,;ops=or,and;heads=both;"
+                        f"pool=16,;expr=2;bsi={res_depth},")
+            d0 = obs_metrics.counter("rb_serving_dispatches_total",
+                                     site="serving").value
+            res_tickets = []
+            for i in range(8):
+                if i % 2:
+                    q = expr.ExprQuery(expr.sum_(
+                        "price", found=expr.and_(
+                            expr.or_(0, 1),
+                            expr.cmp("price", "ge", 10 + i))))
+                else:
+                    q = expr.ExprQuery(expr.and_(
+                        expr.or_(0, 1),
+                        expr.cmp("price", "le", 100 + i)))
+                res_tickets.append(res_loop.submit(ServingRequest(
+                    i % 2, q, tenant=f"r{i % 2}")))
+            res_loop.drain()
+            d_served = obs_metrics.counter(
+                "rb_serving_dispatches_total", site="serving").value
+            assert d_served == d0, \
+                "ring-served pools still paid per-pool host dispatches"
+            assert res_loop._resident.stats["served"] >= 4, \
+                res_loop._resident.stats
+            for t in res_tickets:
+                assert t.status == "done", (t.status, t.error)
+                bms_x, _, col_x = res_tenants[t.request.set_id]
+                q = t.request.query
+                if isinstance(q.expr, expr.Agg):
+                    card, value, _ = expr.evaluate_host_agg(
+                        q.expr, bms_x, {"price": col_x})
+                    assert (t.result.cardinality, t.result.value) \
+                        == (card, value), \
+                        "ring-served aggregate diverged from the host " \
+                        "BSI oracle"
+                else:
+                    ref = expr.evaluate_host(q.expr, bms_x,
+                                             {"price": col_x})
+                    assert t.result.cardinality == ref.cardinality, \
+                        "ring-served filter diverged from the host " \
+                        "oracle"
+            # forced escape: wedge the ring, serve one more pool — the
+            # typed ResidentEscape demotes it to the one-shot dispatch
+            # path (counter moves), still bit-exact
+            res_loop._resident.ring.wedge()
+            doomed_q = expr.ExprQuery(expr.and_(
+                expr.or_(0, 1), expr.cmp("price", "le", 300)))
+            wt = [res_loop.submit(ServingRequest(0, doomed_q,
+                                                 tenant="r0"))
+                  for _ in range(2)]
+            res_loop.drain()
+            d_after = obs_metrics.counter(
+                "rb_serving_dispatches_total", site="serving").value
+            assert d_after > d_served, \
+                "the wedged-ring pool did not demote to host dispatch"
+            for t in wt:
+                assert t.status == "done", (t.status, t.error)
+                ref = expr.evaluate_host(
+                    doomed_q.expr, res_tenants[0][0],
+                    {"price": res_tenants[0][2]})
+                assert t.result.cardinality == ref.cardinality, \
+                    "the demoted pool diverged from the host oracle"
         finally:
             rt_lattice.deactivate()
 

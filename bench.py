@@ -1612,6 +1612,148 @@ def pod_worker_main(pid: int, port: str, nproc: int) -> None:
         "routes": [str(fd.owner_host(i)) for i in range(s)]}))
 
 
+def durability_phase() -> dict:
+    """Durable-tenant lane (ISSUE 17, docs/DURABILITY.md): the write-
+    ahead journal's overhead on the delta path (NEUTRAL — durability is
+    bought, not free; the lane pins the price), crash-recovery wall vs
+    tenant count (snapshot load + journal-tail replay), and a LIVE
+    migration under traffic (blip wall + the zero-failed-request pin).
+    Runs in an 8-device dry-run subprocess like the pod lane."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--durability-cell"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            timeout=1200, env=_dryrun_env(8),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        return {"error":
+                f"durability cell failed: {type(e).__name__}: {e}"}
+
+
+def durability_cell_main() -> None:
+    """Subprocess body for durability_phase (8 CPU devices)."""
+    import shutil
+    import tempfile
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.mutation.durability import (DurableTenant,
+                                                       FlushPolicy,
+                                                       recover_tenant)
+    from roaringbitmap_tpu.parallel import (BatchQuery, DeviceBitmapSet,
+                                            podmesh)
+    from roaringbitmap_tpu.runtime import guard
+    from roaringbitmap_tpu.serving import (PodFrontDoor, ServingPolicy,
+                                           ServingRequest,
+                                           migrate_tenant)
+
+    rng = np.random.default_rng(0xD07A)
+    root = tempfile.mkdtemp(prefix="rb_durability_bench_")
+    policy = FlushPolicy(mode="batch", every_n=8)
+
+    def mk_ds():
+        return DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+            rng.integers(0, 1 << 16, 1200).astype(np.uint32)))
+            for _ in range(4)], layout="dense")
+
+    def deltas(n, seed):
+        r = np.random.default_rng(seed)
+        return [({int(s): np.unique(r.integers(0, 1 << 16, 24)).tolist()
+                  for s in r.integers(0, 4, 2)},
+                 {0: r.integers(0, 1 << 16, 4).tolist()})
+                for _ in range(n)]
+
+    out: dict = {}
+    try:
+        # (a) journal overhead: same delta stream, plain vs journaled
+        n = 48
+        stream = deltas(n, 11)
+        plain = mk_ds()
+        plain.apply_delta(adds={0: [1]})                      # warm
+        t0 = time.perf_counter()
+        for a, rm in stream:
+            plain.apply_delta(adds=a, removes=rm)
+        plain_s = time.perf_counter() - t0
+        tenant = DurableTenant(mk_ds(), root=root, tenant="overhead",
+                               policy=policy, snapshot_every=None)
+        tenant.apply_delta(adds={0: [1]})                     # warm
+        t0 = time.perf_counter()
+        for a, rm in stream:
+            tenant.apply_delta(adds=a, removes=rm)
+        durable_s = time.perf_counter() - t0
+        tenant.close()
+        out["journal"] = {
+            "deltas": n, "flush": policy.mode,
+            "plain_ms": round(plain_s * 1e3, 2),
+            "durable_ms": round(durable_s * 1e3, 2),
+            # NEUTRAL: the WAL's price, pinned not gated
+            "journal_overhead_x": round(
+                durable_s / max(plain_s, 1e-9), 3)}
+        # (b) recovery wall vs tenant count
+        rec = {}
+        for count in (1, 4):
+            names = []
+            for i in range(count):
+                t = DurableTenant(mk_ds(), root=root,
+                                  tenant=f"rec{count}-{i}",
+                                  policy=policy, snapshot_every=6)
+                for a, rm in deltas(10, 100 + i):
+                    t.apply_delta(adds=a, removes=rm)
+                t.close()
+                names.append(f"rec{count}-{i}")
+            t0 = time.perf_counter()
+            reports = [recover_tenant(root=root, tenant=nm,
+                                      policy=policy)[1]
+                       for nm in names]
+            rec[f"tenants{count}"] = {
+                "recovery_ms": round(
+                    (time.perf_counter() - t0) * 1e3, 1),
+                "replayed": sum(r["replayed"] for r in reports)}
+        out["recovery"] = rec
+        # (c) live migration under traffic: requests before/during/after
+        # the flip, zero non-expired failures, blip wall
+        sets = [mk_ds() for _ in range(3)]
+        pod = podmesh.PodMesh.simulate(2)
+        fd = PodFrontDoor(sets, pod=pod, policy=ServingPolicy(
+            pool_target=8, default_deadline_ms=600_000.0,
+            max_queue=4096,
+            guard=guard.GuardPolicy(backoff_base=0.0,
+                                    sleep=lambda _s: None)))
+        sid = next(s for s in range(3)
+                   if fd.plan.regime(s) != "sharded")
+        target = next(h for h in fd.pod.alive()
+                      if h != fd.owner_host(sid))
+        shapes = [("or", (0, 1, 2)), ("and", (1, 2, 3)),
+                  ("xor", (0, 2))]
+        served = []
+
+        def traffic(k, seed):
+            r = np.random.default_rng(seed)
+            for i in range(k):
+                served.append(fd.submit(ServingRequest(
+                    sid, BatchQuery(*shapes[int(r.integers(3))]),
+                    tenant=f"t{sid}")))
+            fd.drain()
+
+        traffic(24, 1)                                        # warm
+        rep = migrate_tenant(fd, sid, target,
+                             during=lambda _fd: traffic(24, 2))
+        traffic(24, 3)
+        bad = [t for t in served if t.status == "failed"
+               or (t.status == "shed"
+                   and getattr(t, "shed_reason", None) != "expired")]
+        out["migration"] = {
+            "requests": len(served), "failed_or_shed": len(bad),
+            "migration_blip_ms": rep["blip_ms"],
+            "stream_bytes": rep["bytes"],
+            "catch_up_records": rep["catch_up_records"]}
+        assert not bad, "migration lane left failed/shed requests"
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(out))
+
+
 #: hard byte cap on the final stdout summary line.  The driver captures a
 #: BOUNDED tail of stdout (ADVICE r5: the r05 summary still came back
 #: "parsed": null with the JSON head truncated), so the line must fit a
@@ -1626,7 +1768,8 @@ SUMMARY_MAX_BYTES = 2048
 #: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
 #: they are trend inputs for the sentry, not driver-gate fields, and the
 #: full doc always keeps them
-SUMMARY_DROP_ORDER = ("phase_ms", "cost", "resident", "olap", "pod",
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "durability", "resident",
+                      "olap", "pod",
                       "lattice",
                       "mutation", "serving", "sharded", "expression",
                       "marginal_us_spread", "multiset", "batched_qps",
@@ -1817,6 +1960,21 @@ def build_summary(out: dict, full_path: str) -> dict:
         if "cluster2_vs_single_x" in c2:
             po_lane["cluster2_vs_single_x"] = c2["cluster2_vs_single_x"]
         s["pod"] = po_lane
+    # durability lane, compact: journal overhead (NEUTRAL — a pinned
+    # price, not a gate), recovery wall per tenant-count cell, and the
+    # live-migration blip + zero-failure pin (bench.py
+    # durability_phase, docs/DURABILITY.md)
+    du = out.get("durability") or {}
+    if du.get("journal"):
+        du_lane = {"journal_overhead_x":
+                   du["journal"].get("journal_overhead_x")}
+        for key, row in (du.get("recovery") or {}).items():
+            du_lane[f"recovery_ms_{key}"] = row.get("recovery_ms")
+        mig = du.get("migration") or {}
+        if "migration_blip_ms" in mig:
+            du_lane["migration_blip_ms"] = mig["migration_blip_ms"]
+            du_lane["migration_failed"] = mig.get("failed_or_shed")
+        s["durability"] = du_lane
     return s
 
 
@@ -1932,6 +2090,9 @@ def main() -> None:
     ap.add_argument("--pod-cell", action="store_true",
                     help="internal: run the simulated-pod cells in a "
                          "CPU dry-run subprocess and exit")
+    ap.add_argument("--durability-cell", action="store_true",
+                    help="internal: run the durable-tenant cells in a "
+                         "CPU dry-run subprocess and exit")
     ap.add_argument("--pod-worker", nargs=3, metavar=("PID", "PORT", "N"),
                     help="internal: one pod-cluster worker (process id, "
                          "coordinator port, process count) and exit")
@@ -1952,6 +2113,9 @@ def main() -> None:
         return
     if args.pod_cell:
         pod_cell_main()
+        return
+    if args.durability_cell:
+        durability_cell_main()
         return
 
     # stdout hygiene: everything during the run (library prints, warnings
@@ -1996,6 +2160,7 @@ def main() -> None:
     olap = olap_phase()
     resident = resident_phase()
     pod = pod_phase()
+    durability = durability_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
     # exactly once.  A single steady-state marginal at VMEM-resident
@@ -2056,6 +2221,7 @@ def main() -> None:
     out["olap"] = olap
     out["resident"] = resident
     out["pod"] = pod
+    out["durability"] = durability
 
     # full document to disk; stdout gets ONLY the compact summary as its
     # final line (the driver's bounded tail capture must parse it)

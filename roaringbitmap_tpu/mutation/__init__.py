@@ -28,12 +28,22 @@ with two coupled halves:
   ``mode="repack_queued"``, deferred commit) instead of stalling the
   serving pump; the pre-delta image serves bit-exactly until the commit
   lands and the engines re-sync.
+- :mod:`.durability` — **durable tenants**: per-tenant write-ahead
+  delta journal (append-before-apply, length+CRC framed, typed flush
+  policy) plus crash-consistent portable-format snapshots
+  (format/spec.py files + a lineage manifest), so crash recovery =
+  load snapshot + replay journal tail, bit-exact vs the never-crashed
+  oracle — the seam serving/migration.py streams for live tenant
+  migration.  See docs/DURABILITY.md.
 
 See docs/MUTATION.md for the operator-facing contract (delta API,
-versioning rules, invalidation semantics, repack escalation).
+versioning rules, invalidation semantics, repack escalation) and
+docs/DURABILITY.md for the durable write path.
 """
 
 from .delta import apply_delta, drift_report, host_bitmaps, repack_in_place
+from .durability import (DeltaJournal, DurableTenant, FlushPolicy,
+                         load_snapshot, recover_tenant, scan_journal)
 from .maintenance import MaintenanceWorker
 from .result_cache import (ENV_RESULT_CACHE, ResultCache, from_env,
                            node_key, notify_version_bump, query_key,
@@ -41,6 +51,8 @@ from .result_cache import (ENV_RESULT_CACHE, ResultCache, from_env,
 
 __all__ = [
     "apply_delta", "drift_report", "host_bitmaps", "repack_in_place",
+    "DeltaJournal", "DurableTenant", "FlushPolicy", "load_snapshot",
+    "recover_tenant", "scan_journal",
     "MaintenanceWorker",
     "ENV_RESULT_CACHE", "ResultCache", "from_env", "node_key",
     "notify_version_bump", "query_key", "serve_and_fill",

@@ -341,7 +341,8 @@ def drift_report(ds, drift_limit: int | None = None) -> dict:
 
 
 def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
-                drift_limit: int | None = None, worker=None) -> dict:
+                drift_limit: int | None = None, worker=None,
+                journal=None) -> dict:
     """Mutate a resident ``DeviceBitmapSet`` at segment granularity.
 
     ``adds`` / ``removes`` map source index -> u32 values (a value in
@@ -358,6 +359,14 @@ def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
     job re-reads the then-current host sources, so interleaved value
     patches are never lost; ``worker.drain()`` is the barrier).  In-
     place patches never queue — they are the fast path already.
+
+    ``journal`` (a ``mutation.durability.DeltaJournal``) arms the
+    write-ahead contract: the normalized delta is appended (and synced
+    per the journal's flush policy) BEFORE any resident state mutates,
+    with the ``crash`` fault points firing around the append — the seam
+    docs/DURABILITY.md's recovery invariants hang off.  Deltas that
+    normalize to nothing never journal (replaying a no-op is wasted
+    recovery work, not a correctness issue).
     """
     if repack not in ("auto", "never", "always"):
         raise ValueError(f"unknown repack policy {repack!r}")
@@ -368,6 +377,11 @@ def apply_delta(ds, adds=None, removes=None, repack: str = "auto",
     n_rem = sum(int(v.size) for v in removes.values())
     with obs_trace.span("mutation.delta", site=SITE, uid=ds.uid,
                         values_added=n_add, values_removed=n_rem) as sp:
+        if journal is not None and (adds or removes):
+            # append-before-apply: once wal_delta returns, the record
+            # is as durable as the flush policy promises and a crash
+            # anywhere below recovers it by replay
+            sp.tag(journal_seq=journal.wal_delta(adds, removes))
         if not adds and not removes:
             sp.tag(mode="noop", version=ds.version)
             return {"mode": "noop", "version": ds.version,

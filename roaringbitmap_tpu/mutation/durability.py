@@ -1,0 +1,860 @@
+"""Durable tenants: write-ahead delta journal + crash-consistent snapshots.
+
+Everything mutable built since the delta layer (mutation.delta) lives
+purely in process memory: a host crash loses every delta ever applied.
+This module is the production write path — the reference library's
+portable serialization stratum (format/spec.py cookies) promoted from an
+ingest format to the durable disk shape:
+
+**Write-ahead journal** (``DeltaJournal``).  Append-before-apply: every
+``apply_delta`` (set deltas AND analytics-column deltas) first appends a
+length+CRC framed record to a per-tenant journal file, then mutates the
+resident image.  Records reuse the ``apply_delta`` adds/removes
+vocabulary verbatim, so replay IS apply_delta — the same code path, the
+same bit-exactness contract.  fsync scheduling is a typed
+:class:`FlushPolicy` (``always`` / ``batch`` / ``never``).
+
+**Snapshots**.  Periodic portable-format snapshots: one
+``format/spec.py``-compatible file per tenant source (any Roaring
+implementation can read them) plus ``MANIFEST.json`` carrying the
+version lineage (version / structure_version / source_versions), layout,
+per-file CRCs, and the analytics column payloads (BSI existence+slice
+planes as portable bitmaps, RangeColumn values as little-endian i64).
+The manifest records the journal sequence number the snapshot captures;
+the snapshot directory flips in via an atomically-replaced ``CURRENT``
+pointer, so a crash mid-snapshot leaves the previous snapshot live.
+
+**Recovery** (``recover_tenant``).  Load the CURRENT snapshot, replay
+the journal records past the manifest's sequence number: bit-exact vs a
+never-crashed host oracle by construction.  A torn TAIL (the last record
+truncated mid-frame or failing its CRC — the shape a crash mid-append
+leaves) is truncated, counted (``rb_journal_torn_tails_total``) and
+traced, then recovery proceeds: the record never committed.  Corruption
+anywhere BEFORE the tail — or a corrupt snapshot — dies typed
+(:class:`~..runtime.errors.CorruptInput`), never as a raw struct/numpy
+error, and never silently.
+
+Crash points.  The ``crash`` fault kind (runtime.faults.maybe_crash,
+``ROARING_TPU_FAULTS="crash[@scope][=rate]:seed"``) fires at the three
+seams every WAL must survive: ``pre_append`` (record lost — neither
+journal nor memory has it), ``pre_apply`` (record durable, memory
+doesn't have it — replay must apply it; the ``@torn`` scope tears the
+just-written record mid-frame instead, so replay must NOT apply it), and
+``post_apply`` (record durable and applied — replay is idempotent by
+sequence filtering).  ``InjectedCrash`` is typed and must never be
+caught between the crash point and ``recover_tenant``.
+
+Env knobs: ``ROARING_TPU_JOURNAL_DIR`` (default durable root for
+tenants created without an explicit one), ``ROARING_TPU_SNAPSHOT_EVERY``
+(auto-snapshot after N applies; 0/unset = only explicit snapshots).
+
+See docs/DURABILITY.md for the on-disk format and the recovery
+invariants; serving/migration.py streams these same snapshot + journal
+bytes between pod hosts for live tenant migration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..runtime import errors, faults
+from . import delta as mut_delta
+
+#: the trace/metric/fault site of everything durable
+SITE = "durability"
+
+ENV_JOURNAL_DIR = "ROARING_TPU_JOURNAL_DIR"
+ENV_SNAPSHOT_EVERY = "ROARING_TPU_SNAPSHOT_EVERY"
+
+#: journal file header — version-stamped so a format change is a typed
+#: error, not a misparse
+JOURNAL_MAGIC = b"RBWAL001"
+#: per-record frame: u32 payload length, u32 crc32(payload), payload
+_FRAME = struct.Struct("<II")
+#: absurd-length guard: a frame claiming more than this is corruption,
+#: not a real record (largest realistic delta record is ~MBs of JSON)
+MAX_RECORD_BYTES = 1 << 28
+
+JOURNAL_FILE = "journal.wal"
+CURRENT_FILE = "CURRENT"
+MANIFEST_FILE = "MANIFEST.json"
+SNAPSHOT_FORMAT = "roaring-tpu-snapshot-v1"
+
+
+# ------------------------------------------------------------ flush policy
+
+@dataclasses.dataclass(frozen=True)
+class FlushPolicy:
+    """When journal appends reach the platter.
+
+    ``always``  fsync every append (the durability ceiling: a clean
+                crash after ``apply_delta`` returns can never lose it);
+    ``batch``   fsync every ``every_n`` appends (amortized; up to
+                ``every_n - 1`` CLEAN-crash records at risk — torn-tail
+                handling is unaffected);
+    ``never``   OS-buffered writes only (bench baseline / tests).
+    """
+
+    mode: str = "always"
+    every_n: int = 8
+
+    def __post_init__(self):
+        if self.mode not in ("always", "batch", "never"):
+            raise ValueError(
+                f"unknown flush mode {self.mode!r} (one of "
+                f"'always', 'batch', 'never')")
+        if self.mode == "batch" and int(self.every_n) < 1:
+            raise ValueError(
+                f"batch flush needs every_n >= 1, got {self.every_n}")
+
+
+# ---------------------------------------------------------------- journal
+
+def _jsonable_delta(spec: dict) -> dict:
+    return {str(k): np.asarray(v).tolist() for k, v in spec.items()}
+
+
+def _delta_from_json(spec: dict) -> dict:
+    return {int(k): np.asarray(v, np.uint32) for k, v in spec.items()}
+
+
+class DeltaJournal:
+    """Append-only, length+CRC framed, per-tenant write-ahead journal.
+
+    One record per logical mutation, JSON payload tagged by ``kind``:
+    ``delta`` (set adds/removes in the apply_delta vocabulary), ``bsi``
+    (BsiColumn set/remove pairs), ``range`` (RangeColumn updates).
+    ``seq`` is the journal's monotone per-record sequence number — the
+    coordinate snapshots and replay filter on.
+    """
+
+    def __init__(self, path: str, policy: FlushPolicy | None = None,
+                 start_seq: int = 0):
+        self.path = str(path)
+        self.policy = policy or FlushPolicy()
+        self.seq = int(start_seq)
+        self._since_fsync = 0
+        self._last_frame: tuple | None = None   # (start_offset, payload_len)
+        fresh = (not os.path.exists(self.path)
+                 or os.path.getsize(self.path) == 0)
+        self._f = open(self.path, "ab")
+        if fresh:
+            self._f.write(JOURNAL_MAGIC)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    # -- framing ----------------------------------------------------
+    def append(self, record: dict) -> int:
+        """Frame + write one record (policy decides when it syncs);
+        returns its sequence number."""
+        self.seq += 1
+        record = dict(record, seq=self.seq)
+        payload = json.dumps(record, separators=(",", ":")).encode()
+        if len(payload) > MAX_RECORD_BYTES:
+            raise ValueError(
+                f"journal record of {len(payload)} bytes exceeds the "
+                f"{MAX_RECORD_BYTES}-byte frame ceiling")
+        start = self._f.tell()
+        self._f.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+        self._last_frame = (start, len(payload))
+        self._since_fsync += 1
+        if self.policy.mode == "always":
+            self.flush(fsync=True)
+        elif (self.policy.mode == "batch"
+              and self._since_fsync >= self.policy.every_n):
+            self.flush(fsync=True)
+        else:
+            self._f.flush()
+        obs_metrics.counter("rb_journal_appends_total").inc()
+        obs_metrics.counter("rb_journal_bytes_total").inc(
+            _FRAME.size + len(payload))
+        return self.seq
+
+    def flush(self, fsync: bool = True) -> None:
+        self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
+            self._since_fsync = 0
+            obs_metrics.counter("rb_journal_fsyncs_total").inc()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def tear_tail(self) -> None:
+        """Simulate a crash mid-``write``: truncate the LAST record
+        mid-frame (header intact, payload cut), the exact torn-write
+        shape ``scan_journal`` must classify as a recoverable tail."""
+        if self._last_frame is None:
+            return
+        start, payload_len = self._last_frame
+        self._f.flush()
+        self._f.truncate(start + _FRAME.size + max(1, payload_len // 2))
+        self._last_frame = None
+
+    # -- WAL hooks (called from mutation.delta / DurableTenant) -----
+    def _crash(self, point: str) -> None:
+        # only pre_apply has a frame write in flight: torn rules match
+        # there alone (tearing at any other point would un-commit an
+        # already-applied durable record)
+        mode = faults.maybe_crash(SITE, point,
+                                  tearable=point == "pre_apply")
+        if mode is None:
+            return
+        if mode == "torn":
+            self.tear_tail()
+        self.close()
+        raise errors.InjectedCrash(
+            f"injected crash at {SITE}/{point} (mode={mode}, "
+            f"seq={self.seq})")
+
+    def wal_delta(self, adds: dict, removes: dict) -> int:
+        """Append-before-apply for a set delta: crash point before the
+        append (record lost), the append, crash point between append
+        and apply (record durable — or torn)."""
+        self._crash("pre_append")
+        seq = self.append({"kind": "delta",
+                           "adds": _jsonable_delta(adds),
+                           "removes": _jsonable_delta(removes)})
+        self._crash("pre_apply")
+        return seq
+
+    def wal_column(self, record: dict) -> int:
+        self._crash("pre_append")
+        seq = self.append(record)
+        self._crash("pre_apply")
+        return seq
+
+    # -- compaction -------------------------------------------------
+    def compact(self, keep_after_seq: int) -> int:
+        """Drop records with seq <= ``keep_after_seq`` (they are inside
+        a durable snapshot): rewrite to a temp file, fsync, atomic
+        replace, reopen.  Returns records kept."""
+        self.close()
+        records, _torn, _end = scan_journal(self.path)
+        keep = [r for r in records if int(r["seq"]) > int(keep_after_seq)]
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(JOURNAL_MAGIC)
+            for r in keep:
+                payload = json.dumps(r, separators=(",", ":")).encode()
+                f.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
+                f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self._last_frame = None
+        self._since_fsync = 0
+        return len(keep)
+
+
+def scan_journal(path: str) -> tuple[list[dict], bool, int]:
+    """Parse a journal file -> ``(records, torn, valid_end)``.
+
+    A frame that runs past EOF or whose LAST-position payload fails its
+    CRC is a torn tail: ``torn=True`` and ``valid_end`` is the byte
+    offset recovery truncates to (the record never committed — WAL
+    contract).  A CRC failure with MORE bytes following, a bad magic
+    header, or an absurd frame length is NOT a torn write — it raises
+    :class:`CorruptInput` (typed, never a struct error)."""
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except FileNotFoundError:
+        return [], False, 0
+    if not buf:
+        return [], False, 0
+    if buf[:len(JOURNAL_MAGIC)] != JOURNAL_MAGIC:
+        raise errors.CorruptInput(
+            f"journal {path}: bad magic {buf[:8]!r} (want "
+            f"{JOURNAL_MAGIC!r})")
+    records: list[dict] = []
+    pos, n = len(JOURNAL_MAGIC), len(buf)
+    while pos < n:
+        start = pos
+        if n - pos < _FRAME.size:
+            return records, True, start        # torn inside the header
+        length, crc = _FRAME.unpack_from(buf, pos)
+        if length > MAX_RECORD_BYTES:
+            raise errors.CorruptInput(
+                f"journal {path}: frame at byte {start} claims "
+                f"{length} bytes (> {MAX_RECORD_BYTES}) — corrupt "
+                f"header, not a torn tail")
+        pos += _FRAME.size
+        payload = buf[pos:pos + length]
+        if len(payload) < length:
+            return records, True, start        # torn inside the payload
+        if zlib.crc32(payload) != crc:
+            if pos + length >= n:
+                return records, True, start    # tail record, bad CRC
+            raise errors.CorruptInput(
+                f"journal {path}: record at byte {start} fails CRC "
+                f"with {n - pos - length} bytes following — "
+                f"mid-journal corruption, unrecoverable")
+        try:
+            rec = json.loads(payload)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            # the CRC passed, so these bytes are what was written — a
+            # writer bug or deliberate tamper, never a torn write
+            raise errors.CorruptInput(
+                f"journal {path}: record at byte {start} passes CRC "
+                f"but is not valid JSON ({e})") from None
+        if not isinstance(rec, dict) or "seq" not in rec \
+                or "kind" not in rec:
+            raise errors.CorruptInput(
+                f"journal {path}: record at byte {start} lacks "
+                f"seq/kind: {rec!r}")
+        records.append(rec)
+        pos += length
+    return records, False, n
+
+
+# --------------------------------------------------------------- snapshots
+
+def _capture_columns(ds) -> dict:
+    """Portable per-column payloads captured synchronously (the async
+    snapshot job must not race later column deltas)."""
+    out: dict = {}
+    for name, col in getattr(ds, "columns", {}).items():
+        kind = getattr(col, "kind", None)
+        if kind == "bsi_column":
+            out[name] = {
+                "kind": "bsi", "min_value": int(col.host.min_value),
+                "max_value": int(col.host.max_value),
+                "version": int(col.version),
+                "structure_version": int(col.structure_version),
+                "ebm": col.host.ebm.serialize(),
+                "slices": [s.serialize() for s in col.host.slices],
+            }
+        elif kind == "range_column":
+            out[name] = {
+                "kind": "range", "version": int(col.version),
+                "structure_version": int(col.structure_version),
+                "values": np.asarray(col.values, "<i8").tobytes(),
+            }
+        else:
+            raise ValueError(
+                f"column {name!r} has unsnapshotable kind {kind!r}")
+    return out
+
+
+def capture_state(ds, seq: int = 0, tenant: str = "t0") -> dict:
+    """Everything a snapshot writes, serialized to bytes in memory —
+    spec-portable source files + manifest fields — so the file writes
+    can run on a maintenance worker without racing further deltas.
+    serving.migration streams exactly this payload between pod hosts
+    (the snapshot half of snapshot + journal tail)."""
+    sources = [bm.serialize() for bm in mut_delta.host_bitmaps(ds)]
+    return {
+        "tenant": str(tenant), "seq": int(seq),
+        "layout": ds.layout, "version": int(ds.version),
+        "structure_version": int(ds.structure_version),
+        "source_versions": np.asarray(ds.source_versions).tolist(),
+        "sources": sources,
+        "columns": _capture_columns(ds),
+    }
+
+
+def state_bytes(state: dict) -> int:
+    """Wire size of one captured state: the portable source + column
+    payload bytes a migration actually streams."""
+    total = sum(len(b) for b in state["sources"])
+    for col in state["columns"].values():
+        if col["kind"] == "bsi":
+            total += len(col["ebm"]) + sum(len(s) for s in col["slices"])
+        else:
+            total += len(col["values"])
+    return total
+
+
+def restore_state(state: dict):
+    """In-memory twin of :func:`load_snapshot`: a :func:`capture_state`
+    payload -> a fresh ``DeviceBitmapSet`` (+ attached columns)
+    carrying the captured version lineage.  Corrupt portable bytes die
+    typed through ``RoaringBitmap.deserialize`` (== CorruptInput)."""
+    from ..analytics.column import BsiColumn, RangeColumn
+    from ..bsi.slice_index import RoaringBitmapSliceIndex
+    from ..core.bitmap import RoaringBitmap
+    from ..parallel.aggregation import DeviceBitmapSet
+
+    bitmaps = [RoaringBitmap.deserialize(b) for b in state["sources"]]
+    ds = DeviceBitmapSet(bitmaps, layout=state["layout"])
+    ds.version = int(state["version"])
+    ds.structure_version = int(state["structure_version"])
+    ds.source_versions = np.asarray(state["source_versions"], np.int64)
+    ds.row_versions[:] = ds.version
+    ds._host_cache = None
+    for name, cm in state["columns"].items():
+        if cm["kind"] == "bsi":
+            idx = RoaringBitmapSliceIndex()
+            idx.ebm = RoaringBitmap.deserialize(cm["ebm"])
+            idx.slices = [RoaringBitmap.deserialize(b)
+                          for b in cm["slices"]]
+            idx.min_value = int(cm["min_value"])
+            idx.max_value = int(cm["max_value"])
+            col = BsiColumn.from_bsi(name, idx)
+        else:
+            blob = cm["values"]
+            if len(blob) % 8:
+                raise errors.CorruptInput(
+                    f"column {name} values payload is {len(blob)} "
+                    f"bytes — not a whole i64 vector")
+            col = RangeColumn(name, np.frombuffer(blob, "<i8"))
+        col.version = int(cm.get("version", 0))
+        col.structure_version = int(cm.get("structure_version", 0))
+        ds.attach_column(col)
+    return ds
+
+
+def _write_snapshot_dir(tenant_dir: str, state: dict) -> dict:
+    """Write one snapshot directory + flip CURRENT atomically.  Layout::
+
+        <tenant>/snap-<seq>/src-<i>.rb       portable spec bytes
+        <tenant>/snap-<seq>/col-<name>-*     column payloads
+        <tenant>/snap-<seq>/MANIFEST.json    lineage + per-file CRCs
+        <tenant>/CURRENT                     -> "snap-<seq>"
+
+    The manifest is written LAST inside the dir; CURRENT is replaced
+    atomically after everything fsyncs — a crash at any byte leaves the
+    previous snapshot live and loadable."""
+    name = f"snap-{state['seq']}"
+    snap_dir = os.path.join(tenant_dir, name)
+    tmp_dir = snap_dir + ".tmp"
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    os.makedirs(tmp_dir)
+    total = 0
+
+    def put(fname: str, blob: bytes) -> dict:
+        nonlocal total
+        with open(os.path.join(tmp_dir, fname), "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        total += len(blob)
+        return {"file": fname, "bytes": len(blob),
+                "crc32": zlib.crc32(blob)}
+
+    manifest = {
+        "format": SNAPSHOT_FORMAT, "tenant": state["tenant"],
+        "seq": state["seq"], "layout": state["layout"],
+        "version": state["version"],
+        "structure_version": state["structure_version"],
+        "source_versions": state["source_versions"],
+        "sources": [put(f"src-{i}.rb", blob)
+                    for i, blob in enumerate(state["sources"])],
+        "columns": {},
+    }
+    for cname, col in state["columns"].items():
+        if col["kind"] == "bsi":
+            manifest["columns"][cname] = {
+                "kind": "bsi", "min_value": col["min_value"],
+                "max_value": col["max_value"],
+                "version": col["version"],
+                "structure_version": col["structure_version"],
+                "ebm": put(f"col-{cname}-ebm.rb", col["ebm"]),
+                "slices": [put(f"col-{cname}-s{k}.rb", blob)
+                           for k, blob in enumerate(col["slices"])],
+            }
+        else:
+            manifest["columns"][cname] = {
+                "kind": "range", "version": col["version"],
+                "structure_version": col["structure_version"],
+                "values": put(f"col-{cname}.i64", col["values"]),
+            }
+    with open(os.path.join(tmp_dir, MANIFEST_FILE), "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    shutil.rmtree(snap_dir, ignore_errors=True)
+    os.replace(tmp_dir, snap_dir)
+    # flip CURRENT via write-temp + atomic replace
+    cur_tmp = os.path.join(tenant_dir, CURRENT_FILE + ".tmp")
+    with open(cur_tmp, "w") as f:
+        f.write(name)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(cur_tmp, os.path.join(tenant_dir, CURRENT_FILE))
+    # dead snapshots GC AFTER the flip (never the one CURRENT names)
+    for entry in os.listdir(tenant_dir):
+        if entry.startswith("snap-") and entry != name:
+            shutil.rmtree(os.path.join(tenant_dir, entry),
+                          ignore_errors=True)
+    manifest["_bytes"] = total
+    return manifest
+
+
+def _read_blob(snap_dir: str, ref, what: str) -> bytes:
+    """One manifest-referenced file, CRC-checked — every failure typed."""
+    if not isinstance(ref, dict) or "file" not in ref:
+        raise errors.CorruptInput(
+            f"snapshot manifest: malformed file reference for {what}: "
+            f"{ref!r}")
+    path = os.path.join(snap_dir, str(ref["file"]))
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise errors.CorruptInput(
+            f"snapshot {what} unreadable: {e}") from None
+    if len(blob) != int(ref.get("bytes", -1)) \
+            or zlib.crc32(blob) != int(ref.get("crc32", -1)):
+        raise errors.CorruptInput(
+            f"snapshot {what} ({ref['file']}) fails its manifest "
+            f"CRC/length check — corrupt snapshot")
+    return blob
+
+
+def load_snapshot(tenant_dir: str):
+    """CURRENT snapshot -> ``(bitmaps, columns, manifest)``.
+
+    ``bitmaps`` are host RoaringBitmaps deserialized from the portable
+    per-source files; ``columns`` maps name -> rebuilt analytics column.
+    Every corruption shape — missing/garbled CURRENT or manifest, CRC
+    mismatch, spec-invalid bitmap bytes, short column payloads — raises
+    :class:`CorruptInput`; no raw struct/json/numpy error escapes."""
+    from ..analytics.column import BsiColumn, RangeColumn
+    from ..bsi.slice_index import RoaringBitmapSliceIndex
+    from ..core.bitmap import RoaringBitmap
+
+    cur_path = os.path.join(tenant_dir, CURRENT_FILE)
+    try:
+        with open(cur_path) as f:
+            name = f.read().strip()
+    except OSError as e:
+        raise errors.CorruptInput(
+            f"no CURRENT snapshot pointer under {tenant_dir}: "
+            f"{e}") from None
+    if not name or os.sep in name or name.startswith("."):
+        raise errors.CorruptInput(
+            f"CURRENT pointer is garbled: {name!r}")
+    snap_dir = os.path.join(tenant_dir, name)
+    try:
+        with open(os.path.join(snap_dir, MANIFEST_FILE)) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        raise errors.CorruptInput(
+            f"snapshot manifest unreadable: {e}") from None
+    except json.JSONDecodeError as e:
+        raise errors.CorruptInput(
+            f"snapshot manifest is not valid JSON: {e}") from None
+    if not isinstance(manifest, dict) \
+            or manifest.get("format") != SNAPSHOT_FORMAT:
+        raise errors.CorruptInput(
+            f"snapshot manifest format mismatch: "
+            f"{manifest.get('format') if isinstance(manifest, dict) else manifest!r} "
+            f"(want {SNAPSHOT_FORMAT})")
+    for field, typ in (("seq", int), ("version", int),
+                       ("structure_version", int), ("layout", str),
+                       ("sources", list), ("source_versions", list),
+                       ("columns", dict)):
+        if not isinstance(manifest.get(field), typ):
+            raise errors.CorruptInput(
+                f"snapshot manifest field {field!r} missing or "
+                f"mistyped: {manifest.get(field)!r}")
+    bitmaps = [RoaringBitmap.deserialize(
+                   _read_blob(snap_dir, ref, f"source {i}"))
+               for i, ref in enumerate(manifest["sources"])]
+    columns: dict = {}
+    for cname, cm in manifest["columns"].items():
+        kind = cm.get("kind") if isinstance(cm, dict) else None
+        if kind == "bsi":
+            idx = RoaringBitmapSliceIndex()
+            idx.ebm = RoaringBitmap.deserialize(
+                _read_blob(snap_dir, cm.get("ebm"),
+                           f"column {cname} ebm"))
+            idx.slices = [
+                RoaringBitmap.deserialize(
+                    _read_blob(snap_dir, ref, f"column {cname} "
+                               f"slice {k}"))
+                for k, ref in enumerate(cm.get("slices") or [])]
+            idx.min_value = int(cm.get("min_value", 0))
+            idx.max_value = int(cm.get("max_value", 0))
+            col = BsiColumn.from_bsi(cname, idx)
+        elif kind == "range":
+            blob = _read_blob(snap_dir, cm.get("values"),
+                              f"column {cname} values")
+            if len(blob) % 8:
+                raise errors.CorruptInput(
+                    f"column {cname} values payload is {len(blob)} "
+                    f"bytes — not a whole i64 vector")
+            col = RangeColumn(cname, np.frombuffer(blob, "<i8"))
+        else:
+            raise errors.CorruptInput(
+                f"snapshot column {cname!r} has unknown kind "
+                f"{kind!r}")
+        col.version = int(cm.get("version", 0))
+        col.structure_version = int(cm.get("structure_version", 0))
+        columns[cname] = col
+    return bitmaps, columns, manifest
+
+
+# ---------------------------------------------------------- durable tenant
+
+def _snapshot_every_default() -> int:
+    raw = os.environ.get(ENV_SNAPSHOT_EVERY, "")
+    if not raw:
+        return 0
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_SNAPSHOT_EVERY} must be an integer, got "
+            f"{raw!r}") from None
+    return max(0, n)
+
+
+class DurableTenant:
+    """One mutable ``DeviceBitmapSet`` bound to its durable state.
+
+    Construction writes the base snapshot synchronously (recovery is
+    snapshot + journal tail — without a base snapshot the initial build
+    would be unrecoverable) and opens the journal.  Every mutation goes
+    through :meth:`apply_delta` / :meth:`apply_column_delta`:
+    append-before-apply, crash points armed, auto-snapshot after
+    ``snapshot_every`` applies (``ROARING_TPU_SNAPSHOT_EVERY``).
+    """
+
+    def __init__(self, ds, root: str | None = None, tenant: str = "t0",
+                 policy: FlushPolicy | None = None,
+                 snapshot_every: int | None = None,
+                 worker=None, _recovered_seq: int | None = None):
+        root = root or os.environ.get(ENV_JOURNAL_DIR)
+        if not root:
+            raise ValueError(
+                f"DurableTenant needs a durable root: pass root= or "
+                f"set {ENV_JOURNAL_DIR}")
+        self.ds = ds
+        self.tenant = str(tenant)
+        self.dir = os.path.join(str(root), self.tenant)
+        self.policy = policy or FlushPolicy()
+        self.snapshot_every = (snapshot_every
+                               if snapshot_every is not None
+                               else _snapshot_every_default())
+        self._worker = worker
+        self._lock = threading.Lock()
+        self._applies_since_snapshot = 0
+        os.makedirs(self.dir, exist_ok=True)
+        if _recovered_seq is None:
+            if os.path.exists(os.path.join(self.dir, CURRENT_FILE)):
+                raise ValueError(
+                    f"tenant dir {self.dir} already holds durable "
+                    f"state — use recover_tenant() to attach to it")
+            self.journal = DeltaJournal(
+                os.path.join(self.dir, JOURNAL_FILE), self.policy)
+            self.snapshot()
+        else:
+            self.journal = DeltaJournal(
+                os.path.join(self.dir, JOURNAL_FILE), self.policy,
+                start_seq=_recovered_seq)
+
+    # -- mutations --------------------------------------------------
+    def apply_delta(self, adds=None, removes=None, repack: str = "auto",
+                    drift_limit: int | None = None, worker=None) -> dict:
+        """``mutation.delta.apply_delta`` with the WAL armed: the
+        normalized record is durable (per the flush policy) before the
+        resident image mutates."""
+        with self._lock:
+            report = mut_delta.apply_delta(
+                self.ds, adds, removes, repack=repack,
+                drift_limit=drift_limit,
+                worker=worker if worker is not None else self._worker,
+                journal=self.journal)
+            self.journal._crash("post_apply")
+            self._applies_since_snapshot += 1
+        self.maybe_snapshot()
+        return report
+
+    def apply_column_delta(self, name: str, set_values=None,
+                           removes=(), updates=None) -> dict:
+        """Journaled analytics-column mutation: BSI columns take
+        ``set_values``/``removes``, Range columns take ``updates``."""
+        col = self.ds.columns.get(name)
+        if col is None:
+            raise KeyError(f"no column {name!r} attached to tenant "
+                           f"{self.tenant}")
+        with self._lock:
+            if col.kind == "bsi_column":
+                if isinstance(set_values, dict):
+                    pairs = sorted((int(k), int(v))
+                                   for k, v in set_values.items())
+                elif set_values:
+                    ids, vals = set_values
+                    pairs = list(zip(np.asarray(ids).tolist(),
+                                     np.asarray(vals).tolist()))
+                else:
+                    pairs = []
+                self.journal.wal_column({
+                    "kind": "bsi", "col": name, "set": pairs,
+                    "removes": np.asarray(list(removes)).tolist()})
+                report = col.apply_delta(
+                    set_values=dict(pairs) or None,
+                    removes=list(removes))
+            elif col.kind == "range_column":
+                updates = {int(k): int(v)
+                           for k, v in (updates or {}).items()}
+                self.journal.wal_column({
+                    "kind": "range", "col": name, "updates":
+                    {str(k): v for k, v in updates.items()}})
+                report = col.apply_delta(updates)
+            else:
+                raise ValueError(
+                    f"column {name!r} kind {col.kind!r} is not "
+                    f"journalable")
+            self.journal._crash("post_apply")
+            self._applies_since_snapshot += 1
+        self.maybe_snapshot()
+        return report
+
+    # -- snapshots --------------------------------------------------
+    def maybe_snapshot(self) -> dict | None:
+        if (self.snapshot_every
+                and self._applies_since_snapshot >= self.snapshot_every):
+            return self.snapshot(worker=self._worker)
+        return None
+
+    def snapshot(self, worker=None) -> dict:
+        """Capture now (synchronously — later deltas never leak in),
+        write now or on ``worker`` (kind="snapshot").  After the
+        snapshot is durable the journal compacts to the records past
+        it."""
+        with self._lock:
+            state = capture_state(self.ds, self.journal.seq,
+                                  self.tenant)
+        if worker is None:
+            return self._write_snapshot(state)
+        worker.submit(lambda: self._write_snapshot(state),
+                      kind="snapshot",
+                      desc=f"tenant={self.tenant} seq={state['seq']}")
+        return {"queued": True, "seq": state["seq"]}
+
+    def _write_snapshot(self, state: dict) -> dict:
+        t0 = time.perf_counter()
+        with obs_trace.span("durability.snapshot", site=SITE,
+                            tenant=self.tenant, seq=state["seq"],
+                            sources=len(state["sources"]),
+                            columns=len(state["columns"])) as sp:
+            manifest = _write_snapshot_dir(self.dir, state)
+            with self._lock:
+                kept = self.journal.compact(state["seq"])
+                self._applies_since_snapshot = 0
+            wall = time.perf_counter() - t0
+            sp.tag(bytes=manifest["_bytes"], journal_kept=kept)
+            obs_metrics.counter("rb_snapshot_total").inc()
+            obs_metrics.counter("rb_snapshot_bytes_total").inc(
+                manifest["_bytes"])
+            obs_metrics.histogram("rb_snapshot_seconds").observe(wall)
+        return {"seq": state["seq"], "bytes": manifest["_bytes"],
+                "journal_kept": kept, "wall_ms": round(wall * 1e3, 3)}
+
+    def close(self) -> None:
+        self.journal.close()
+
+
+# ---------------------------------------------------------------- recovery
+
+def replay_record(ds, rec: dict) -> None:
+    """One journal record re-applied through the SAME mutation paths the
+    original apply took — replay is apply, so bit-exactness vs the
+    uncrashed oracle is by construction, not by a parallel decoder."""
+    kind = rec.get("kind")
+    if kind == "delta":
+        mut_delta.apply_delta(ds, _delta_from_json(rec.get("adds") or {}),
+                              _delta_from_json(rec.get("removes") or {}))
+    elif kind == "bsi":
+        col = ds.columns.get(rec.get("col"))
+        if col is None:
+            raise errors.CorruptInput(
+                f"journal bsi record names unknown column "
+                f"{rec.get('col')!r}")
+        pairs = {int(i): int(v) for i, v in (rec.get("set") or [])}
+        col.apply_delta(set_values=pairs or None,
+                        removes=[int(r) for r in rec.get("removes") or []])
+    elif kind == "range":
+        col = ds.columns.get(rec.get("col"))
+        if col is None:
+            raise errors.CorruptInput(
+                f"journal range record names unknown column "
+                f"{rec.get('col')!r}")
+        col.apply_delta({int(k): int(v)
+                         for k, v in (rec.get("updates") or {}).items()})
+    else:
+        raise errors.CorruptInput(
+            f"journal record kind {kind!r} is unknown to this build")
+
+
+def recover_tenant(root: str | None = None, tenant: str = "t0",
+                   policy: FlushPolicy | None = None,
+                   snapshot_every: int | None = None,
+                   worker=None) -> tuple:
+    """Crash recovery: CURRENT snapshot + journal-tail replay ->
+    ``(DurableTenant, report)``.
+
+    A torn tail truncates (counted + traced — the record never
+    committed); any other corruption raises :class:`CorruptInput`.  The
+    recovered set carries the snapshot's version lineage with replayed
+    deltas re-bumping it, exactly as the uncrashed process would have.
+    """
+    from ..parallel.aggregation import DeviceBitmapSet
+
+    root = root or os.environ.get(ENV_JOURNAL_DIR)
+    if not root:
+        raise ValueError(
+            f"recover_tenant needs a durable root: pass root= or set "
+            f"{ENV_JOURNAL_DIR}")
+    tenant_dir = os.path.join(str(root), str(tenant))
+    t0 = time.perf_counter()
+    with obs_trace.span("durability.replay", site=SITE,
+                        tenant=str(tenant)) as sp:
+        bitmaps, columns, manifest = load_snapshot(tenant_dir)
+        snap_seq = int(manifest["seq"])
+        journal_path = os.path.join(tenant_dir, JOURNAL_FILE)
+        records, torn, valid_end = scan_journal(journal_path)
+        if torn:
+            size = os.path.getsize(journal_path)
+            with open(journal_path, "ab") as f:
+                f.truncate(valid_end)
+            obs_metrics.counter("rb_journal_torn_tails_total").inc()
+            sp.event("torn_tail", truncated_bytes=size - valid_end,
+                     valid_end=valid_end)
+        tail = [r for r in records if int(r["seq"]) > snap_seq]
+        ds = DeviceBitmapSet(bitmaps, layout=manifest["layout"])
+        ds.version = int(manifest["version"])
+        ds.structure_version = int(manifest["structure_version"])
+        ds.source_versions = np.asarray(manifest["source_versions"],
+                                        np.int64)
+        if ds.source_versions.size != ds.n:
+            raise errors.CorruptInput(
+                f"snapshot source_versions has {ds.source_versions.size} "
+                f"entries for {ds.n} sources")
+        ds.row_versions[:] = ds.version
+        ds._host_cache = None
+        for col in columns.values():
+            ds.attach_column(col)
+        for rec in tail:
+            replay_record(ds, rec)
+        obs_metrics.counter("rb_journal_replayed_records_total").inc(
+            len(tail))
+        last_seq = max([snap_seq] + [int(r["seq"]) for r in records])
+        sp.tag(snapshot_seq=snap_seq, records=len(tail), torn=bool(torn),
+               version=int(ds.version))
+        dt = DurableTenant(ds, root=root, tenant=tenant, policy=policy,
+                           snapshot_every=snapshot_every, worker=worker,
+                           _recovered_seq=last_seq)
+    wall = time.perf_counter() - t0
+    return dt, {"snapshot_seq": snap_seq, "replayed": len(tail),
+                "torn": bool(torn), "version": int(ds.version),
+                "wall_ms": round(wall * 1e3, 3)}

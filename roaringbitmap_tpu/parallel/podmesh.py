@@ -187,6 +187,28 @@ class PodMesh:
         self._down.discard(int(host_id))
         self._push_gauges()
 
+    def join_host(self, devices=None) -> int:
+        """Elasticity: append one host to the pod and return its id.
+
+        In a simulated pod the new host shares the trailing device
+        group (simulation models topology + routing, not extra silicon
+        — the CPU proxy's devices are interchangeable anyway).  A
+        detected multi-process pod cannot grow in place — jax pins the
+        process set at initialize — so joining there is typed refusal,
+        not a silent no-op."""
+        if any(not h.local for h in self.hosts):
+            raise ValueError(
+                "cannot join_host into a detected multi-process pod: "
+                "the jax process set is fixed at initialize() — "
+                "restart the pod with the new host enrolled")
+        if devices is None:
+            devices = self.hosts[-1].devices
+        new_id = max(h.host_id for h in self.hosts) + 1
+        self.hosts.append(HostInfo(new_id, 0, tuple(devices),
+                                   local=True))
+        self._push_gauges()
+        return new_id
+
     def _push_gauges(self) -> None:
         obs_metrics.gauge("rb_pod_hosts", state="alive").set(
             len(self.alive()))
@@ -380,14 +402,26 @@ def place(sets, pod: PodMesh, budget_per_host: int | None = None,
 
 # --------------------------------------------------------------- routing
 
-def route(plan: PlacementPlan, sid: int, alive, salt: int = 0) -> int | None:
+def route(plan: PlacementPlan, sid: int, alive, salt: int = 0,
+          overrides: dict | None = None) -> int | None:
     """Consistent tenant routing: the rendezvous (highest-random-weight)
     winner among the tenant's ALIVE placement hosts.  Deterministic
     across processes (same plan + alive set => same answer everywhere —
     the property that lets every host route without coordination), and
     consistent under host loss: removing a host only re-routes the
     tenants that host was serving.  ``None`` when no placement host is
-    alive (the front door's single-host demotion case)."""
+    alive (the front door's single-host demotion case).
+
+    ``overrides`` (sid -> host_id) is the live-migration flip map
+    (serving.migration): an alive override wins over the rendezvous
+    draw, so flipping one tenant's route is one dict write — no plan
+    rebuild on the admission path — and a dead override falls back to
+    rendezvous (the migration target dying mid-window degrades through
+    the normal ladder, never strands the tenant)."""
+    if overrides:
+        ov = overrides.get(sid)
+        if ov is not None and ov in set(alive):
+            return ov
     alive = set(alive)
     candidates = [h for h in plan.hosts_of(sid) if h in alive]
     if not candidates:

@@ -382,12 +382,25 @@ class ShardedBatchEngine:
                 break
             if ds.version == self._placed_versions[i]:
                 continue
-            if (ds._journal_dropped_version > self._placed_versions[i]
-                    or jax.process_count() > 1):
-                # journal lag — or a detected multi-process pod, where
-                # the in-place patch program cannot take host-local
-                # operands: re-place wholesale (each host feeds its
-                # addressable shard again)
+            if ds._journal_dropped_version > self._placed_versions[i]:
+                # journal lag: the bounded delta journal dropped entries
+                # this pool still needed — the silent-overflow cause is
+                # now counted + traced so capacity tuning can see it
+                # (ROARING_TPU_DELTA_JOURNAL vs mutation rate)
+                obs_metrics.counter(
+                    "rb_sharded_journal_overflows_total",
+                    site=SITE).inc()
+                obs_trace.current().event(
+                    "sharded.journal_overflow", site=SITE, tenant=i,
+                    placed_version=int(self._placed_versions[i]),
+                    dropped_through=int(ds._journal_dropped_version),
+                    version=int(ds.version))
+                stale = True
+                break
+            if jax.process_count() > 1:
+                # a detected multi-process pod: the in-place patch
+                # program cannot take host-local operands — re-place
+                # wholesale (each host feeds its addressable shard)
                 stale = True
                 break
         if stale:

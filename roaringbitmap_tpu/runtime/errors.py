@@ -87,6 +87,26 @@ class ShadowMismatch(RoaringRuntimeError):
     (a retry that happens to pass would hide a miscompiling engine)."""
 
 
+class InjectedCrash(RoaringRuntimeError):
+    """A ``crash`` fault rule fired (runtime.faults): the process is
+    simulating its own death between a journal append and the in-memory
+    apply.  Deliberately NOT retryable/demotable — nothing above the
+    durability layer may catch-and-continue past a crash point; the only
+    legal continuation is a fresh recovery (durability.recover_tenant),
+    which is exactly what the crash-recovery property tests drive."""
+
+
+class TornJournalTail(CorruptInput):
+    """The LAST record of a write-ahead journal is incomplete or fails its
+    CRC: the torn-write shape every append-before-apply journal must
+    expect after a crash mid-append.  A torn TAIL is recoverable by
+    contract (truncate the tail, the record never committed — see
+    docs/DURABILITY.md); corruption anywhere BEFORE the tail is not and
+    stays plain :class:`CorruptInput`.  Subclasses CorruptInput so
+    callers that only care about "durable state is damaged" catch one
+    type."""
+
+
 #: message fragments -> taxonomy, checked in order (first hit wins).  OOM
 #: before transient: XLA RESOURCE_EXHAUSTED statuses often also carry
 #: "while running replica" noise that the transient patterns would catch.

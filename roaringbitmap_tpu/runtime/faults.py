@@ -15,7 +15,11 @@ kind   one of ``transient`` (retryable device hiccup), ``oom`` (device
        timeout), ``silent`` (result corrupted WITHOUT an exception — only
        the shadow cross-check can catch it), ``slow`` (injected latency
        before a dispatch: no exception, the **fault clock** below jumps
-       forward by SLOW_LATENCY_S — deadlines expire, nothing sleeps).
+       forward by SLOW_LATENCY_S — deadlines expire, nothing sleeps),
+       ``crash`` (simulated process death at the durability layer's
+       journal/apply seams only — ``maybe_crash`` below; the special
+       scope ``@torn`` additionally tears the journal's last record
+       mid-frame before dying, the classic torn-write shape).
 scope  optional dispatch-site name ("batch_engine", "aggregation",
        "sharding", "multihost") or engine rung ("pallas", "xla",
        "xla-vmap", "sharded", "coordinator"); omitted = everywhere.
@@ -62,9 +66,11 @@ from . import errors
 ENV_VAR = "ROARING_TPU_FAULTS"
 
 KINDS = ("transient", "oom", "lowering", "corrupt", "coordinator", "silent",
-         "slow")
+         "slow", "crash")
 #: kinds that raise at the boundary (silent corrupts results in place,
-#: slow advances the fault clock — neither raises)
+#: slow advances the fault clock, crash only fires at the durability
+#: layer's journal/apply seams via maybe_crash — none of the three raise
+#: from the generic engine-boundary hook)
 RAISING_KINDS = KINDS[:5]
 
 #: virtual latency one firing ``slow`` rule injects, seconds — sized so a
@@ -236,6 +242,45 @@ def maybe_fail(site: str, engine: str | None = None) -> None:
     kind = plan.pick(site, engine)
     if kind is not None:
         raise_fault(kind, site, engine)
+
+
+def maybe_crash(site: str, point: str | None = None,
+                tearable: bool = False) -> str | None:
+    """The durability-seam hook: when a ``crash`` rule fires for
+    (site, point), return the crash mode — ``"clean"`` (the journal
+    record hit the disk whole before the process died) or ``"torn"``
+    (the process died mid-``write``, leaving the last record truncated
+    mid-frame).  None when no rule fires.
+
+    The caller (mutation.durability) acts on the verdict: tear the
+    journal tail for ``"torn"``, then raise ``errors.InjectedCrash`` for
+    either mode.  The harness cannot kill the process for real in-test,
+    so the contract is that NOTHING between the crash point and the
+    recovery entry point may catch InjectedCrash.
+
+    Grammar: ``crash[@scope][=rate]`` where scope is a site/point name
+    (``durability``, ``pre_apply``, ``post_apply``, ...) or the special
+    scope ``torn``, which switches the mode to a torn write and
+    therefore only matches calls with ``tearable=True`` — the one point
+    where a frame write is actually in flight (a "torn" crash anywhere
+    else would have to tear an ALREADY-COMMITTED record, violating the
+    WAL contract the tests pin).  Scheduling is Philox-deterministic
+    like every other kind — a fixed seed + call order reproduces the
+    exact crash."""
+    plan = active()
+    if plan is None:
+        return None
+    for i, r in enumerate(plan.rules):
+        if r.kind != "crash":
+            continue
+        mode = "torn" if r.scope == "torn" else "clean"
+        if mode == "torn" and not tearable:
+            continue
+        if r.scope not in (None, "torn", site, point):
+            continue
+        if plan._draw(i, f"{site}/{point}") < r.rate:
+            return mode
+    return None
 
 
 def should_corrupt(site: str, engine: str | None = None) -> bool:

@@ -17,7 +17,13 @@ reference):
   cannot meet their deadline instead of letting them poison the pool;
 - **graceful degradation** under sustained overload walks a ladder
   (shrink pool target -> shed optional fields -> per-tenant fair-share
-  caps) and recovers symmetrically.
+  caps) and recovers symmetrically;
+- **live migration / elasticity** (``serving.migration``) moves a
+  tenant between pod hosts while it serves — snapshot stream +
+  dual-write catch-up + one-dict-write route flip — and grows/drains
+  hosts (:func:`host_join` / :func:`host_leave`) or rebuilds a LOST
+  host's tenants from their durable journal+snapshot state
+  (:func:`restore_host_tenants`, docs/DURABILITY.md).
 
 Everything reports through the existing vocabulary: ``serving.admit`` /
 ``serving.assemble`` / ``serving.dispatch`` / ``serving.shed`` spans,
@@ -29,10 +35,16 @@ from .frontdoor import PodFrontDoor
 from .loop import (AdmissionRejected, PumpDriver, RequestShed,
                    ServingLoop, ServingPolicy, ServingRequest,
                    TenantPolicy, Ticket)
+from .migration import (MigrationError, MigrationSession,
+                        begin_migration, host_join, host_leave,
+                        migrate_tenant, restore_host_tenants)
 from .resident import (DescriptorRing, ResidentEscape, ResidentQueue,
                        RingBackpressure)
 
 __all__ = ["ServingLoop", "ServingPolicy", "ServingRequest",
            "TenantPolicy", "Ticket", "AdmissionRejected", "RequestShed",
            "PodFrontDoor", "PumpDriver", "ResidentQueue",
-           "DescriptorRing", "ResidentEscape", "RingBackpressure"]
+           "DescriptorRing", "ResidentEscape", "RingBackpressure",
+           "MigrationSession", "MigrationError", "begin_migration",
+           "migrate_tenant", "host_join", "host_leave",
+           "restore_host_tenants"]

@@ -114,6 +114,13 @@ class PodFrontDoor:
         self._cap_sid: dict = {}
         self._single_loop: ServingLoop | None = None
         self._route_counts: dict = {}   # sid -> admitted (rate stats)
+        #: live-migration flip map (podmesh.route overrides): sid ->
+        #: host_id, written under _lock by serving.migration at the
+        #: route-flip instant
+        self._route_overrides: dict = {}
+        #: sid -> active MigrationSession (the dual-write window:
+        #: apply_delta forwards every delta there as well)
+        self._dual_writes: dict = {}
         self._rate_t0 = faults.clock()
         self.stats = {"routed": 0, "forwarded": 0, "reroutes": 0,
                       "host_drops": 0, "single_demotions": 0}
@@ -139,32 +146,42 @@ class PodFrontDoor:
             self._cap_loop = ServingLoop(eng, self.policy)
             self._cap_sid = {sid: i for i, sid in enumerate(cap_sids)}
         for h in (hi.host_id for hi in self.pod.hosts if hi.local):
-            sids = [s for s in range(self.plan.n_tenants)
-                    if self.plan.regime(s) != "sharded"
-                    and h in self.plan.hosts_of(s)]
-            if not sids:
-                continue
-            local_sets = []
-            for s in sids:
-                ds = self._sets[s]
-                if self.plan.hosts_of(s)[0] == h:
-                    local_sets.append(ds)     # the authoritative copy
-                else:
-                    # replica: a full per-host copy rebuilt from the
-                    # authoritative host tier (a real pod re-ingests
-                    # from storage; the ledger counts it either way)
-                    local_sets.append(DeviceBitmapSet(
-                        ds.host_bitmaps(), layout=ds.layout))
-            if self._host_engine == "sharded":
-                eng = ShardedBatchEngine(
-                    local_sets, mesh=self.pod.host_mesh(h),
-                    placement="auto", result_cache=self._result_cache)
+            self._build_host(h)
+
+    def _build_host(self, h) -> None:
+        """(Re)build ONE host's loop from the current plan + set table —
+        the scoped half of ``_build`` that live migration uses to touch
+        only the source and target hosts during the route flip (a full
+        pod rebuild inside the flip would turn the blip into a wall)."""
+        self._loops.pop(h, None)
+        for key in [k for k in self._local_sid if k[0] == h]:
+            del self._local_sid[key]
+        sids = [s for s in range(self.plan.n_tenants)
+                if self.plan.regime(s) != "sharded"
+                and h in self.plan.hosts_of(s)]
+        if not sids:
+            return
+        local_sets = []
+        for s in sids:
+            ds = self._sets[s]
+            if self.plan.hosts_of(s)[0] == h:
+                local_sets.append(ds)     # the authoritative copy
             else:
-                eng = MultiSetBatchEngine(
-                    local_sets, result_cache=self._result_cache)
-            self._loops[h] = ServingLoop(eng, self.policy)
-            self._local_sid.update(
-                {(h, s): i for i, s in enumerate(sids)})
+                # replica: a full per-host copy rebuilt from the
+                # authoritative host tier (a real pod re-ingests
+                # from storage; the ledger counts it either way)
+                local_sets.append(DeviceBitmapSet(
+                    ds.host_bitmaps(), layout=ds.layout))
+        if self._host_engine == "sharded":
+            eng = ShardedBatchEngine(
+                local_sets, mesh=self.pod.host_mesh(h),
+                placement="auto", result_cache=self._result_cache)
+        else:
+            eng = MultiSetBatchEngine(
+                local_sets, result_cache=self._result_cache)
+        self._loops[h] = ServingLoop(eng, self.policy)
+        self._local_sid.update(
+            {(h, s): i for i, s in enumerate(sids)})
 
     # ------------------------------------------------------------- routing
 
@@ -176,7 +193,8 @@ class PodFrontDoor:
         Deterministic across processes."""
         if self.plan.regime(set_id) == "sharded":
             return CAPACITY
-        return podmesh.route(self.plan, set_id, self.pod.alive())
+        return podmesh.route(self.plan, set_id, self.pod.alive(),
+                             overrides=self._route_overrides)
 
     def routes_local(self, set_id: int) -> bool:
         """Whether this process can serve the tenant's routed host — the
@@ -407,7 +425,8 @@ class PodFrontDoor:
         # host-down callers already marked from_h dead, so route() over
         # the alive set cannot hand the ticket back; a rebalance may
         # legitimately re-route to the SAME (alive, rebuilt) host
-        to = podmesh.route(self.plan, sid, self.pod.alive())
+        to = podmesh.route(self.plan, sid, self.pod.alive(),
+                           overrides=self._route_overrides)
         with obs_trace.span("pod.reroute", site=SITE, set_id=sid,
                             from_host=str(from_h),
                             to=(str(to) if to is not None else SINGLE),
@@ -517,6 +536,12 @@ class PodFrontDoor:
                         self._local_sid[(h, sid)]]._ds
                     reports.append(replica.apply_delta(
                         adds, removes, repack=repack, worker=worker))
+            # live-migration dual-write window (serving.migration): the
+            # in-flight copy must see every delta the source sees, or
+            # the route flip would serve stale bits
+            session = self._dual_writes.get(sid)
+            if session is not None:
+                session.on_delta(adds, removes, repack=repack)
             return reports
 
     # ----------------------------------------------- warmup / rebalance

@@ -1,0 +1,500 @@
+"""Durable tenants acceptance (ISSUE 17): write-ahead journal,
+crash-consistent snapshots, live migration (mutation.durability +
+serving.migration, docs/DURABILITY.md).
+
+Pins:
+- journal framing round-trips; torn tails are recoverable-typed
+  (``TornJournalTail`` semantics inside ``scan_journal``), mid-file
+  corruption is hard-typed ``CorruptInput``; compaction drops only
+  records a durable snapshot covers;
+- THE property: a randomized interleaved delta/query stream crashed at
+  every journal/apply boundary (pre_append, pre_apply clean + torn,
+  post_apply) recovers BIT-EXACTLY vs the never-crashed oracle across
+  layouts, including BSI/Range column state — with the WAL's
+  at-most-once-unacked gap re-supplied by client retry exactly when the
+  crash point says the record was lost;
+- snapshots are spec-portable (``format.spec`` deserializes every
+  source file) and the ``utils.fuzz`` mutation corpus makes a corrupt
+  snapshot die typed, never misparse;
+- live migration serves bit-exactly end to end with zero non-expired
+  failures and emits the ``pod.migrate`` span; sharded tenants refuse
+  typed; host join/drain keep serving; a LOST host's tenants rebuild
+  from durable state (``restore_host_tenants``);
+- the PR 12 sharded-pool debt: a bounded delta journal that overflowed
+  re-places the pool AND says so (``rb_sharded_journal_overflows_total``
+  + trace event), never silently.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.analytics.column import BsiColumn, RangeColumn
+from roaringbitmap_tpu.format import spec as fmt_spec
+from roaringbitmap_tpu.mutation import durability
+from roaringbitmap_tpu.mutation import delta as mut_delta
+from roaringbitmap_tpu.mutation.durability import (DeltaJournal,
+                                                   DurableTenant,
+                                                   FlushPolicy,
+                                                   load_snapshot,
+                                                   recover_tenant,
+                                                   scan_journal)
+from roaringbitmap_tpu.obs import metrics as obs_metrics
+from roaringbitmap_tpu.parallel import podmesh
+from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+from roaringbitmap_tpu.runtime import errors, faults, guard
+from roaringbitmap_tpu.serving import (MigrationError, PodFrontDoor,
+                                       ServingPolicy, ServingRequest,
+                                       host_join, host_leave,
+                                       migrate_tenant,
+                                       restore_host_tenants)
+from roaringbitmap_tpu.utils import fuzz
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+NEVER = FlushPolicy(mode="never")      # tests don't need real fsyncs
+
+
+def mk_bitmaps(seed, n=3, uni=1 << 14, card=300):
+    rng = np.random.default_rng(seed)
+    return [RoaringBitmap.from_values(
+        np.unique(rng.integers(0, uni, card)).astype(np.uint32))
+        for _ in range(n)]
+
+
+def _read_trace(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ----------------------------------------------------------- journal core
+
+def test_flush_policy_typed():
+    with pytest.raises(ValueError, match="unknown flush mode"):
+        FlushPolicy(mode="sometimes")
+    with pytest.raises(ValueError, match="every_n"):
+        FlushPolicy(mode="batch", every_n=0)
+
+
+def test_journal_roundtrip_compact_and_metrics(tmp_path):
+    path = str(tmp_path / "j.wal")
+    j = DeltaJournal(path, NEVER)
+    for i in range(5):
+        j.append({"kind": "delta", "adds": {"0": [i]}, "removes": {}})
+    j.close()
+    records, torn, _ = scan_journal(path)
+    assert not torn
+    assert [r["seq"] for r in records] == [1, 2, 3, 4, 5]
+    assert records[2]["adds"] == {"0": [2]}
+    # compact drops only covered records and survives reopen
+    j = DeltaJournal(path, NEVER, start_seq=5)
+    kept = j.compact(3)
+    assert kept == 2
+    j.append({"kind": "delta", "adds": {"0": [99]}, "removes": {}})
+    j.close()
+    records, torn, _ = scan_journal(path)
+    assert [r["seq"] for r in records] == [4, 5, 6]
+    assert not torn
+
+
+def test_torn_tail_recoverable_but_midfile_corruption_hard(tmp_path):
+    path = str(tmp_path / "j.wal")
+    j = DeltaJournal(path, NEVER)
+    for i in range(3):
+        j.append({"kind": "delta", "adds": {"0": [i]}, "removes": {}})
+    j.close()
+    whole = open(path, "rb").read()
+    # torn tail: final record cut mid-frame -> recoverable, prior kept
+    open(path, "wb").write(whole[:-5])
+    records, torn, valid_end = scan_journal(path)
+    assert torn and [r["seq"] for r in records] == [1, 2]
+    assert valid_end < len(whole) - 5
+    # CRC damage with bytes FOLLOWING it is not a tail: hard typed
+    open(path, "wb").write(whole)
+    blob = bytearray(whole)
+    blob[len(durability.JOURNAL_MAGIC) + durability._FRAME.size + 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(errors.CorruptInput):
+        scan_journal(path)
+    # bad magic is typed too
+    open(path, "wb").write(b"NOTAWAL0" + whole[8:])
+    with pytest.raises(errors.CorruptInput):
+        scan_journal(path)
+
+
+def test_fresh_tenant_refuses_existing_state(tmp_path):
+    ds = DeviceBitmapSet(mk_bitmaps(1))
+    t = DurableTenant(ds, root=str(tmp_path), tenant="t0", policy=NEVER)
+    t.close()
+    with pytest.raises(ValueError, match="recover_tenant"):
+        DurableTenant(DeviceBitmapSet(mk_bitmaps(1)),
+                      root=str(tmp_path), tenant="t0", policy=NEVER)
+
+
+# --------------------------------------------- crash-recovery property
+
+def _mk_tenant(seed, layout, root, tenant, snapshot_every=3):
+    ds = DeviceBitmapSet(mk_bitmaps(seed), layout=layout)
+    rng = np.random.default_rng(seed + 1)
+    ids = np.unique(rng.integers(0, 1 << 14, 200)).astype(np.uint32)
+    ds.attach_column(BsiColumn(
+        "price", ids, rng.integers(0, 500, ids.size).astype(np.int64)))
+    ds.attach_column(RangeColumn(
+        "lat", rng.integers(0, 1 << 30, 64).astype(np.int64)))
+    return DurableTenant(ds, root=root, tenant=tenant, policy=NEVER,
+                         snapshot_every=snapshot_every)
+
+
+class _Oracle:
+    """Host-side never-crashed twin: plain RoaringBitmaps + dict/array
+    column models, mutated by the same delta stream."""
+
+    def __init__(self, seed):
+        self.hosts = mk_bitmaps(seed)
+        rng = np.random.default_rng(seed + 1)
+        ids = np.unique(rng.integers(0, 1 << 14, 200)).astype(np.uint32)
+        vals = rng.integers(0, 500, ids.size).astype(np.int64)
+        self.bsi = dict(zip(ids.tolist(), vals.tolist()))
+        self.lat = rng.integers(0, 1 << 30, 64).astype(np.int64)
+
+    def apply(self, step):
+        kind, payload = step
+        if kind == "delta":
+            adds, removes = payload
+            for src, vs in adds.items():
+                a = RoaringBitmap()
+                a.add_many(np.asarray(vs, np.uint32))
+                self.hosts[src] = self.hosts[src] | a
+            for src, vs in removes.items():
+                r = RoaringBitmap()
+                r.add_many(np.asarray(vs, np.uint32))
+                self.hosts[src] = self.hosts[src] - r
+        elif kind == "bsi":
+            set_values, removes = payload
+            self.bsi.update(set_values)
+            for i in removes:
+                self.bsi.pop(i, None)
+        else:
+            self.lat = self.lat.copy()
+            for i, v in payload.items():
+                self.lat[i] = v
+
+    def check(self, ds):
+        assert ds.host_bitmaps() == self.hosts
+        col = ds.columns["price"]
+        assert col.host_sum(None) == (sum(self.bsi.values()),
+                                      len(self.bsi))
+        assert np.array_equal(ds.columns["lat"].values, self.lat)
+
+
+def _stream(seed, steps):
+    rng = np.random.default_rng(seed)
+    out = []
+    for k in range(steps):
+        if k % 4 == 2:
+            ids = rng.integers(0, 1 << 14, 4).tolist()
+            out.append(("bsi", ({int(i): int(rng.integers(1, 500))
+                                 for i in ids[:3]}, [int(ids[3])])))
+        elif k % 4 == 3:
+            out.append(("range", {int(i): int(rng.integers(0, 1 << 30))
+                                  for i in rng.integers(0, 64, 3)}))
+        else:
+            adds = {int(s): np.unique(rng.integers(
+                0, 1 << 14, 20)).tolist() for s in rng.integers(0, 3, 2)}
+            rems = {0: rng.integers(0, 1 << 14, 5).tolist()}
+            out.append(("delta", (adds, rems)))
+    return out
+
+
+def _apply_step(tenant, step):
+    kind, payload = step
+    if kind == "delta":
+        tenant.apply_delta(adds=payload[0], removes=payload[1])
+    elif kind == "bsi":
+        tenant.apply_column_delta("price", set_values=payload[0],
+                                  removes=payload[1])
+    else:
+        tenant.apply_column_delta("lat", updates=payload)
+
+
+@pytest.mark.parametrize("layout", ["dense", "counts"])
+@pytest.mark.parametrize("point", ["pre_append", "pre_apply", "torn",
+                                   "post_apply"])
+def test_crash_recovery_property(tmp_path, layout, point):
+    """Crash at every journal/apply boundary of a randomized interleaved
+    delta/query stream; recovery (+ client retry of the un-acked record
+    exactly when the WAL says it was lost) is bit-exact vs the
+    never-crashed oracle, columns included."""
+    steps = _stream(0xD0 + hash(layout) % 97, 8)
+    committed = point in ("pre_apply", "post_apply")
+    for k in range(len(steps)):
+        root = str(tmp_path / f"{layout}-{point}-{k}")
+        tenant = _mk_tenant(40, layout, root, "t0")
+        oracle = _Oracle(40)
+        for step in steps[:k]:
+            _apply_step(tenant, step)
+            oracle.apply(step)
+        with faults.inject(f"crash@{point}=1.0:1"):
+            with pytest.raises(errors.InjectedCrash):
+                _apply_step(tenant, steps[k])
+        # the crashed process is gone; attach from durable state
+        recovered, report = recover_tenant(root=root, tenant="t0",
+                                           policy=NEVER)
+        assert report["torn"] == (point == "torn")
+        if committed:
+            oracle.apply(steps[k])
+        oracle.check(recovered.ds)   # the crash-boundary state, exact
+        if not committed:
+            _apply_step(recovered, steps[k])     # client retry
+            oracle.apply(steps[k])
+        for step in steps[k + 1:]:
+            _apply_step(recovered, step)
+            oracle.apply(step)
+        oracle.check(recovered.ds)   # the full-stream state, exact
+        recovered.close()
+    # the stream's query half: the final recovered set serves through a
+    # device engine bit-exactly (replay is apply, same engine path)
+    from roaringbitmap_tpu.parallel.batch_engine import BatchEngine
+    got = BatchEngine(recovered.ds, result_cache=None).execute(
+        [BatchQuery("or", (0, 1, 2), form="bitmap")])[0]
+    ref = oracle.hosts[0] | oracle.hosts[1] | oracle.hosts[2]
+    assert got.bitmap == ref and got.cardinality == ref.cardinality
+
+
+def test_recovery_replays_snapshot_plus_tail(tmp_path):
+    """Auto-snapshots mid-stream: recovery loads the LATEST snapshot and
+    replays only the journal tail past it."""
+    root = str(tmp_path)
+    tenant = _mk_tenant(7, "dense", root, "t0", snapshot_every=3)
+    oracle = _Oracle(7)
+    for step in _stream(9, 7):
+        _apply_step(tenant, step)
+        oracle.apply(step)
+    tenant.close()
+    recovered, report = recover_tenant(root=root, tenant="t0",
+                                       policy=NEVER)
+    assert report["snapshot_seq"] >= 3      # a mid-stream snapshot won
+    assert report["replayed"] <= 4          # only the tail replayed
+    oracle.check(recovered.ds)
+    recovered.close()
+
+
+# ------------------------------------------------- snapshot portability
+
+def test_snapshot_is_spec_portable_and_fuzz_typed(tmp_path):
+    """Every snapshot source file deserializes through format.spec (the
+    interchange guarantee), a mutated one dies typed CorruptInput, and a
+    clean snapshot re-ingests bit-exactly across layouts."""
+    rng = np.random.default_rng(3)
+    for layout in ("dense", "counts"):
+        root = str(tmp_path / layout)
+        tenant = _mk_tenant(50, layout, root, "t0", snapshot_every=None)
+        tenant.snapshot()
+        tenant.close()
+        tdir = os.path.join(root, "t0")
+        snap = os.path.join(
+            tdir, open(os.path.join(tdir, durability.CURRENT_FILE))
+            .read().strip())
+        srcs = sorted(f for f in os.listdir(snap)
+                      if f.startswith("src-"))
+        assert srcs, snap
+        for f in srcs:
+            blob = open(os.path.join(snap, f), "rb").read()
+            rb = RoaringBitmap.deserialize(blob)     # spec-portable
+            assert rb.serialize() == blob
+        # clean re-ingest is bit-exact, columns included
+        bitmaps, columns, manifest = load_snapshot(tdir)
+        assert bitmaps == tenant.ds.host_bitmaps()
+        assert manifest["layout"] == tenant.ds.layout
+        assert set(columns) == {"price", "lat"}
+        # fuzz corpus: every mutation kind dies typed, never misparses
+        target = os.path.join(snap, srcs[0])
+        blob = open(target, "rb").read()
+        for kind in fuzz.MUTATION_KINDS:
+            mutated = fuzz.mutate_serialized(rng, blob, kind)
+            if mutated == blob:
+                continue
+            open(target, "wb").write(mutated)
+            with pytest.raises(errors.CorruptInput):
+                load_snapshot(tdir)
+        open(target, "wb").write(blob)
+        # a manifest that lies about the CRC is typed too
+        mpath = os.path.join(snap, durability.MANIFEST_FILE)
+        manifest_raw = json.load(open(mpath))
+        manifest_raw["sources"][0]["crc32"] ^= 1
+        json.dump(manifest_raw, open(mpath, "w"))
+        with pytest.raises(errors.CorruptInput):
+            load_snapshot(tdir)
+
+
+# ------------------------------------------------------- live migration
+
+def _front_door(n_hosts=2, seed=21):
+    pod = podmesh.PodMesh.simulate(n_hosts)
+    sets = [DeviceBitmapSet(mk_bitmaps(seed + i)) for i in range(3)]
+    fd = PodFrontDoor(sets, pod=pod,
+                      policy=ServingPolicy(default_deadline_ms=60_000,
+                                           pool_target=2))
+    return fd
+
+
+def _ask(fd, sid):
+    t = fd.submit(ServingRequest(sid, BatchQuery("or", (0, 1, 2)),
+                                 tenant=f"t{sid}"))
+    done = fd.drain()
+    bad = [x for x in done
+           if x.status == "failed"
+           or (x.status == "shed" and x.shed_reason != "expired")]
+    assert not bad, [(x.status, x.error) for x in bad]
+    assert t.status == "done", (t.status, t.error)
+    return int(t.result.cardinality)
+
+
+def test_live_migration_bit_exact_zero_failures(tmp_path):
+    obs.enable(str(tmp_path / "mig.jsonl"))
+    fd = _front_door()
+    sid = next(s for s in range(3) if fd.plan.regime(s) != "sharded")
+    src = fd.owner_host(sid)
+    target = next(h for h in fd.pod.alive() if h != src)
+    before = _ask(fd, sid)
+
+    def during(fd):
+        # traffic + a delta INSIDE the dual-write window
+        fd.apply_delta(sid, adds={0: [999991, 999992]})
+        assert _ask(fd, sid) == before + 2
+
+    rep = migrate_tenant(fd, sid, target, during=during)
+    assert rep["catch_up_records"] >= 1 and rep["bytes"] > 0
+    assert fd.owner_host(sid) == target
+    assert _ask(fd, sid) == before + 2           # bit-exact after flip
+    fd.apply_delta(sid, adds={0: [999993]})      # writes keep landing
+    assert _ask(fd, sid) == before + 3
+    obs.disable()
+    spans = [s for s in _read_trace(tmp_path / "mig.jsonl")
+             if s["name"] == "pod.migrate"]
+    assert spans, "migration must emit the pod.migrate span"
+    tags = spans[0]["tags"]
+    assert tags["set_id"] == sid and tags["to"] == str(target)
+    assert tags["from_host"] == str(src)
+    assert tags["bytes"] > 0 and tags["blip_ms"] >= 0
+    c = obs_metrics.REGISTRY.counter("rb_migration_total", status="ok")
+    assert c.value >= 1
+
+
+def test_migration_typed_refusals():
+    fd = _front_door(seed=33)
+    sid = next(s for s in range(3) if fd.plan.regime(s) != "sharded")
+    with pytest.raises(MigrationError, match="unknown"):
+        migrate_tenant(fd, sid, 99)
+    fd.pod.mark_down(1)
+    if fd.owner_host(sid) != 0:
+        sid = next(s for s in range(3) if fd.owner_host(s) == 0)
+    with pytest.raises(MigrationError, match="down"):
+        migrate_tenant(fd, sid, 1)
+    fd.pod.mark_up(1)
+    # a second concurrent migration of the same tenant refuses typed
+    from roaringbitmap_tpu.serving import begin_migration
+    s1 = begin_migration(fd, sid, 1)
+    with pytest.raises(MigrationError, match="already migrating"):
+        begin_migration(fd, sid, 1)
+    s1.finish()
+
+
+def test_host_join_and_leave_keep_serving():
+    fd = _front_door(seed=44)
+    sid = next(s for s in range(3) if fd.plan.regime(s) != "sharded")
+    base = _ask(fd, sid)
+    j = host_join(fd)
+    assert j["host"] == 2 and j["changed"] in (True, False)
+    assert _ask(fd, sid) == base
+    # force a tenant onto the new host, then drain it
+    migrate_tenant(fd, sid, j["host"])
+    assert fd.owner_host(sid) == j["host"]
+    assert _ask(fd, sid) == base
+    rep = host_leave(fd, j["host"])
+    assert sid in rep["moved"]
+    assert fd.owner_host(sid) != j["host"]
+    assert _ask(fd, sid) == base
+    # draining the last host refuses typed
+    for h in list(fd.pod.alive())[1:]:
+        fd.pod.mark_down(h)
+    with pytest.raises(MigrationError, match="last alive"):
+        host_leave(fd, fd.pod.alive()[0])
+
+
+def test_restore_host_tenants_from_durable_state(tmp_path):
+    """Host LOSS beyond the reroute rung: a single-copy tenant on the
+    dead host rebuilds from its journal+snapshot, bit-exact."""
+    root = str(tmp_path)
+    fd = _front_door(seed=55)
+    sid = next(s for s in range(3)
+               if fd.plan.regime(s) != "sharded"
+               and len(fd.plan.hosts_of(s)) == 1)
+    lost = fd.owner_host(sid)
+    tenant = DurableTenant(fd._sets[sid], root=root, tenant=f"sid{sid}",
+                           policy=NEVER, snapshot_every=None)
+    tenant.apply_delta(adds={0: [777777, 777778]})
+    expect = _ask(fd, sid)
+    tenant.close()
+    fd.fail_host(lost)
+    rep = restore_host_tenants(fd, lost, root, {sid: f"sid{sid}"})
+    assert rep["restored"] == [sid]
+    assert fd.owner_host(sid) in fd.pod.alive()
+    assert _ask(fd, sid) == expect               # durable bits, exact
+    assert rep["reports"][sid]["replayed"] >= 1  # the journal tail ran
+    # an alive host refuses the loss rung
+    with pytest.raises(MigrationError, match="alive"):
+        restore_host_tenants(fd, fd.pod.alive()[0], root, {})
+
+
+# -------------------------------------- sharded journal overflow (PR 12)
+
+def test_sharded_journal_overflow_counted(monkeypatch):
+    import jax
+    from jax.sharding import Mesh
+
+    from roaringbitmap_tpu.parallel.multiset import (
+        MultiSetBatchEngine, random_multiset_pool)
+    from roaringbitmap_tpu.parallel.sharded_engine import \
+        ShardedBatchEngine
+
+    monkeypatch.setattr(mut_delta, "JOURNAL_DEPTH", 2)
+    tenants = [mk_bitmaps(60 + i, n=4, uni=1 << 16, card=900)
+               for i in range(2)]
+    ms = MultiSetBatchEngine(
+        [DeviceBitmapSet(b, layout="dense") for b in tenants],
+        result_cache=None)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("rows", "data"))
+    sh = ShardedBatchEngine(ms._engines, mesh=mesh, placement="sharded",
+                            result_cache=None)
+    pool = random_multiset_pool([4] * 2, 6, seed=8)
+    sh.execute(pool)
+    c0 = obs_metrics.REGISTRY.counter(
+        "rb_sharded_journal_overflows_total", site="sharded_engine").value
+    ds = ms._engines[0]._ds
+    for i in range(4):      # > JOURNAL_DEPTH in-place patches
+        ds.apply_delta(adds={1: [40000 + i]})
+    assert ds._journal_dropped_version > 0
+    got = [[r.cardinality for r in rows] for rows in sh.execute(pool)]
+    assert obs_metrics.REGISTRY.counter(
+        "rb_sharded_journal_overflows_total",
+        site="sharded_engine").value > c0
+    # ...and the wholesale re-place is still bit-exact
+    refs = [[ms._engines[g.set_id]._sequential_one(q).cardinality
+             for q in g.queries] for g in pool]
+    assert got == refs

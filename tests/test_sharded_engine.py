@@ -23,6 +23,7 @@ Pins:
 """
 
 import dataclasses
+import gc
 import json
 
 import jax
@@ -268,6 +269,11 @@ def test_dispatch_registers_no_new_resident_buffers(engines):
     on the HBM ledger."""
     eng = ShardedBatchEngine(engines[0], mesh=_mesh(2))
     qs = [BatchQuery("or", (0, 1, 2)), BatchQuery("xor", (1, 3))]
+    # the ledger releases entries via weakref.finalize, so dead owners
+    # left behind by earlier test modules must be flushed before the
+    # baseline snapshot or a GC pass inside the execute window shrinks
+    # the ledger out from under the equality pin
+    gc.collect()
     ledger_before = obs_memory.LEDGER.snapshot()
     eng.execute(qs)
     n_programs = len(eng._programs)

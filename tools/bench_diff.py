@@ -104,6 +104,12 @@ import sys
 #: over the per-pool host-dispatch arm, via ``resident_vs``) — both
 #: HIGHER; the resident arm's ``host_dispatches`` count rides nothing
 #: (it is a 0/1 pin asserted in-phase, not a trend lane).
+#: The durability lane (bench.py durability_phase, ISSUE 17,
+#: docs/DURABILITY.md) adds ``journal_overhead_x`` — NEUTRAL (the WAL's
+#: price is pinned, not gated: a flush-policy change legitimately moves
+#: it either way; durability semantics are gated by tests, not trend);
+#: ``recovery_ms_tenants{N}`` and ``migration_blip_ms`` ride the ``_ms``
+#: LOWER fragment, ``migration_failed`` is a 0-pin asserted in-phase.
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
           "fused_vs", "mega_olap", "mega_vs", "resident_vs",
@@ -129,7 +135,7 @@ LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
 #: with higher survivor attainment can be the better trade); the
 #: ``x4`` cells' serving direction signal is ``slo_attainment``.
 NEUTRAL = ("host_overlapped", "phase_ms", "noshed", "shed_rate",
-           "compiles_cold", "twophase")
+           "compiles_cold", "twophase", "journal_overhead")
 
 
 def salvage_tail_json(tail: str) -> dict | None:

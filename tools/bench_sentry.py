@@ -90,6 +90,15 @@ filter-then-aggregate roots; a wedged ring must escape with the typed
 path (still bit-exact, never silent) — pinning the
 ``resident.resident_vs_dispatch_x`` bench lane's correctness before
 its trend is gated.
+
+``--smoke-durability`` (ISSUE 17, docs/DURABILITY.md) prepends the
+durable-tenant smoke: a journaled delta stream crashed CLEAN (record
+durable, not applied) and TORN (last record truncated mid-frame) must
+recover bit-exactly from snapshot + journal-tail replay with typed
+``InjectedCrash`` on the way down, and a live tenant migration under
+traffic must serve bit-exactly with zero failed requests — pinning the
+``durability.*`` bench lanes' correctness (``journal_overhead_x``,
+``recovery_ms_*``, ``migration_blip_ms``) before their trend is gated.
 """
 
 from __future__ import annotations
@@ -911,6 +920,118 @@ def resident_smoke() -> int:
     return 0 if ok else 1
 
 
+def durability_smoke() -> int:
+    """Durable-tenant smoke (ISSUE 17, docs/DURABILITY.md): a journaled
+    delta stream crashed clean AND torn recovers bit-exactly from
+    snapshot + journal tail (typed ``InjectedCrash`` on the way down,
+    nothing silent), and a live migration under traffic serves exactly
+    with zero failed requests.  Returns 0 when every contract holds, 1
+    otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import tempfile
+
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap
+    from roaringbitmap_tpu.mutation.durability import (DurableTenant,
+                                                       FlushPolicy,
+                                                       recover_tenant)
+    from roaringbitmap_tpu.parallel import (BatchQuery, DeviceBitmapSet,
+                                            podmesh)
+    from roaringbitmap_tpu.runtime import errors, faults, guard
+    from roaringbitmap_tpu.serving import (PodFrontDoor, ServingPolicy,
+                                           ServingRequest,
+                                           migrate_tenant)
+
+    rng = np.random.default_rng(0xD07B)
+    checks: dict = {}
+
+    def mk_hosts():
+        return [RoaringBitmap.from_values(np.unique(
+            rng.integers(0, 1 << 15, 500).astype(np.uint32))
+            .astype(np.uint32)) for _ in range(3)]
+
+    with tempfile.TemporaryDirectory(prefix="rb_dur_smoke_") as root:
+        policy = FlushPolicy(mode="never")
+        # clean crash at the durable-not-applied point: recovery replays
+        hosts = mk_hosts()
+        t = DurableTenant(DeviceBitmapSet(hosts), root=root,
+                          tenant="clean", policy=policy,
+                          snapshot_every=None)
+        t.apply_delta(adds={0: [70001]})
+        crashed_typed = False
+        with faults.inject("crash@pre_apply=1.0:3"):
+            try:
+                t.apply_delta(adds={1: [70002, 70003]})
+            except errors.InjectedCrash:
+                crashed_typed = True
+        rec, rep = recover_tenant(root=root, tenant="clean",
+                                  policy=policy)
+        want = list(hosts)
+        want[0] = want[0] | RoaringBitmap.from_values(
+            np.asarray([70001], np.uint32))
+        want[1] = want[1] | RoaringBitmap.from_values(
+            np.asarray([70002, 70003], np.uint32))
+        checks["clean_crash_typed"] = crashed_typed
+        checks["clean_crash_replayed"] = (
+            rep["replayed"] >= 1 and rec.ds.host_bitmaps() == want)
+        rec.close()
+        # torn crash: the tail truncates, the torn record is NOT
+        # replayed, prior records survive
+        hosts = mk_hosts()
+        t = DurableTenant(DeviceBitmapSet(hosts), root=root,
+                          tenant="torn", policy=policy,
+                          snapshot_every=None)
+        t.apply_delta(adds={0: [70001]})
+        crashed_typed = False
+        with faults.inject("crash@torn=1.0:3"):
+            try:
+                t.apply_delta(adds={1: [70002]})
+            except errors.InjectedCrash:
+                crashed_typed = True
+        rec, rep = recover_tenant(root=root, tenant="torn",
+                                  policy=policy)
+        want = list(hosts)
+        want[0] = want[0] | RoaringBitmap.from_values(
+            np.asarray([70001], np.uint32))
+        checks["torn_crash_typed"] = crashed_typed
+        checks["torn_tail_truncated"] = (
+            rep["torn"] and rec.ds.host_bitmaps() == want)
+        rec.close()
+        # live migration under traffic: bit-exact, zero failed requests
+        sets = [DeviceBitmapSet(mk_hosts()) for _ in range(2)]
+        fd = PodFrontDoor(
+            sets, pod=podmesh.PodMesh.simulate(2),
+            plan=podmesh.PlacementPlan(
+                regimes=("local", "local"), hosts=((0,), (1,)),
+                bytes_per_host=(0, 0)),
+            policy=ServingPolicy(
+                pool_target=4, default_deadline_ms=600_000.0,
+                guard=guard.GuardPolicy(backoff_base=0.0,
+                                        sleep=lambda s: None)))
+        tickets = []
+
+        def ask():
+            tickets.append(fd.submit(ServingRequest(
+                0, BatchQuery("or", (0, 1, 2)), tenant="t0")))
+            fd.drain()
+            return int(tickets[-1].result.cardinality)
+
+        base = ask()
+        rep = migrate_tenant(
+            fd, 0, 1,
+            during=lambda _fd: (_fd.apply_delta(0, adds={0: [80001]}),
+                                ask()))
+        checks["migration_flipped"] = fd.owner_host(0) == 1
+        checks["migration_bit_exact"] = ask() == base + 1
+        checks["migration_zero_failed"] = all(
+            t.status == "done" for t in tickets)
+        checks["migration_blip_bounded"] = rep["blip_ms"] < 60_000
+    ok = all(checks.values())
+    print(json.dumps({"smoke_durability": checks, "ok": ok}))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -969,6 +1090,12 @@ def main() -> int:
                          "BSI/RangeBitmap oracle across engine rungs "
                          "incl. fault demotion, typed-only failures; "
                          "exit 1 on violation)")
+    ap.add_argument("--smoke-durability", action="store_true",
+                    help="first run the durable-tenant smoke (clean + "
+                         "torn crash recovery bit-exact from snapshot "
+                         "+ journal tail, typed InjectedCrash, live "
+                         "migration serving exactly with zero failed "
+                         "requests; exit 1 on violation)")
     ap.add_argument("--smoke-resident", action="store_true",
                     help="first run the resident-queue smoke (ring-"
                          "served pools bit-exact vs one-shot megakernel "
@@ -1008,6 +1135,10 @@ def main() -> int:
             return rc
     if args.smoke_resident:
         rc = resident_smoke()
+        if rc:
+            return rc
+    if args.smoke_durability:
+        rc = durability_smoke()
         if rc:
             return rc
 

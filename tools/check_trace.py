@@ -92,6 +92,19 @@ and a wide aggregation — then (2) validates every emitted line:
   (zero per-pool host dispatches, bit-exact vs the host BSI oracle)
   and then WEDGES the ring for one pool, requiring at least one
   served and one demoted ``mega.resident`` event.
+- durability semantics (ISSUE 17, docs/DURABILITY.md): the
+  ``durability.snapshot`` span tags (tenant, monotone ``seq``,
+  ``sources`` / ``columns`` counts, and — once durable — ``bytes`` +
+  ``journal_kept``), the ``durability.replay`` span tags
+  (``snapshot_seq`` / ``records`` / ``torn`` / ``version``) plus the
+  torn recovery's ``torn_tail`` event schema, and the ``pod.migrate``
+  span tags (``set_id`` / ``to`` / ``from_host``, plus ``bytes`` /
+  ``blip_ms`` / ``records`` once the flip completed) are validated on
+  arbitrary dumps; the --workload run crashes a journaled tenant with
+  a TORN tail, recovers it bit-exactly from snapshot + journal-tail
+  replay, and live-migrates a served tenant across a 2-host pod under
+  traffic — all three span kinds (and the torn_tail event) must
+  appear, with zero failed requests.
 
 Validation-only mode (``python tools/check_trace.py <path>``) checks an
 existing dump, e.g. one captured from a serving process.
@@ -190,6 +203,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _pod_semantics([s for _, s in spans])
         errors += _analytics_semantics([s for _, s in spans])
         errors += _resident_semantics([s for _, s in spans])
+        errors += _durability_semantics([s for _, s in spans])
     return errors
 
 
@@ -273,6 +287,7 @@ def _workload_semantics(spans: list[dict],
     errors += _pod_semantics(spans, require=budget_semantics)
     errors += _analytics_semantics(spans, require=budget_semantics)
     errors += _resident_semantics(spans, require=budget_semantics)
+    errors += _durability_semantics(spans, require=budget_semantics)
     return errors
 
 
@@ -413,6 +428,102 @@ def _pod_semantics(spans: list[dict], require: bool = False) -> list[str]:
     return errors
 
 
+def _durability_semantics(spans: list[dict],
+                          require: bool = False) -> list[str]:
+    """Durable-tenant vocabulary (ISSUE 17, mutation.durability +
+    serving.migration, docs/DURABILITY.md).  Arbitrary dumps validate
+    the ``durability.snapshot`` / ``durability.replay`` / ``pod.migrate``
+    span schemas (and the ``torn_tail`` event) wherever they appear;
+    tags written AFTER the risky work (``bytes`` / ``journal_kept`` on
+    snapshots, ``snapshot_seq``..``version`` on replays, the blip
+    stats on migrations) are type-checked only when present — a span
+    that closed on an exception legitimately lacks them.  ``require``
+    (the --workload run, which crashes a journaled tenant with a torn
+    tail, recovers it, and live-migrates a served tenant) additionally
+    demands a completed snapshot, a torn replay with its torn_tail
+    event, and a completed migration flip."""
+    errors: list[str] = []
+    snaps = [s for s in spans if s.get("name") == "durability.snapshot"]
+    for s in snaps:
+        tags = s.get("tags") or {}
+        if not tags.get("tenant"):
+            errors.append(f"durability.snapshot span without a tenant: "
+                          f"{tags!r}")
+        for field in ("seq", "sources", "columns"):
+            if not isinstance(tags.get(field), int) or tags[field] < 0:
+                errors.append(f"durability.snapshot span without a "
+                              f"non-negative {field} tag: {tags!r}")
+        for field in ("bytes", "journal_kept"):
+            if field in tags and (not isinstance(tags[field], int)
+                                  or tags[field] < 0):
+                errors.append(f"durability.snapshot {field} tag not a "
+                              f"non-negative int: {tags!r}")
+    replays = [s for s in spans if s.get("name") == "durability.replay"]
+    for s in replays:
+        tags = s.get("tags") or {}
+        if not tags.get("tenant"):
+            errors.append(f"durability.replay span without a tenant: "
+                          f"{tags!r}")
+        for field in ("snapshot_seq", "records", "version"):
+            if field in tags and (not isinstance(tags[field], int)
+                                  or tags[field] < 0):
+                errors.append(f"durability.replay {field} tag not a "
+                              f"non-negative int: {tags!r}")
+        if "torn" in tags and not isinstance(tags["torn"], bool):
+            errors.append(f"durability.replay torn tag not a bool: "
+                          f"{tags!r}")
+    torn_evs = [ev for s in replays for ev in s.get("events", [])
+                if ev.get("name") == "torn_tail"]
+    for ev in torn_evs:
+        if not isinstance(ev.get("truncated_bytes"), int) \
+                or ev["truncated_bytes"] < 1:
+            errors.append(f"torn_tail event without positive "
+                          f"truncated_bytes: {ev!r}")
+        if not isinstance(ev.get("valid_end"), int) \
+                or ev["valid_end"] < 0:
+            errors.append(f"torn_tail event without a non-negative "
+                          f"valid_end: {ev!r}")
+    migrates = [s for s in spans if s.get("name") == "pod.migrate"]
+    for s in migrates:
+        tags = s.get("tags") or {}
+        if not isinstance(tags.get("set_id"), int) or tags["set_id"] < 0:
+            errors.append(f"pod.migrate span without a set_id: {tags!r}")
+        for field in ("to", "from_host"):
+            if field in tags and not (isinstance(tags[field], str)
+                                      and tags[field]):
+                errors.append(f"pod.migrate {field} tag not a non-empty "
+                              f"string: {tags!r}")
+        for field in ("bytes", "records"):
+            if field in tags and (not isinstance(tags[field], int)
+                                  or tags[field] < 0):
+                errors.append(f"pod.migrate {field} tag not a "
+                              f"non-negative int: {tags!r}")
+        if "blip_ms" in tags and (not isinstance(tags["blip_ms"],
+                                                 (int, float))
+                                  or tags["blip_ms"] < 0):
+            errors.append(f"pod.migrate blip_ms tag not a non-negative "
+                          f"number: {tags!r}")
+    if require:
+        if not any("journal_kept" in (s.get("tags") or {})
+                   for s in snaps):
+            errors.append("no completed durability.snapshot span — the "
+                          "workload's journaled tenant never snapshot")
+        if not any((s.get("tags") or {}).get("torn") is True
+                   for s in replays):
+            errors.append("no torn durability.replay span — the "
+                          "workload's torn-tail crash recovery did not "
+                          "record")
+        if not torn_evs:
+            errors.append("no torn_tail event — the torn recovery's "
+                          "truncation was not traced")
+        if not any(isinstance((s.get("tags") or {}).get("blip_ms"),
+                              (int, float)) for s in migrates):
+            errors.append("no completed pod.migrate span — the "
+                          "workload's live migration flip did not "
+                          "record")
+    return errors
+
+
 def _lattice_semantics(spans: list[dict],
                        require: bool = False) -> list[str]:
     """Closed-lattice vocabulary (ISSUE 13, docs/LATTICE.md): validate
@@ -485,6 +596,14 @@ def _mutation_semantics(spans: list[dict],
     deltas = [s for s in spans if s.get("name") == "mutation.delta"]
     for s in deltas:
         tags = s.get("tags") or {}
+        if tags.get("status") == "error":
+            # a delta killed mid-apply (ISSUE 17's injected crashes)
+            # closes with status=error and never reaches the post-apply
+            # mode/version tagging — that partial span is legitimate
+            if not tags.get("error_class"):
+                errors.append(f"error-status mutation.delta span "
+                              f"without an error_class: {tags!r}")
+            continue
         if tags.get("mode") not in ("patch", "repack", "repack_queued",
                                     "noop"):
             errors.append(f"mutation.delta span with bad mode: {tags!r}")
@@ -1342,6 +1461,90 @@ def run_workload(path: str) -> None:
         assert fd.stats["forwarded"] > 0, "no arrival was forwarded"
         assert fd.stats["reroutes"] > 0, \
             "the forced host drop rerouted nothing"
+
+        # durability lane (ISSUE 17, docs/DURABILITY.md): a journaled
+        # tenant crashed mid-apply with a TORN journal tail, recovered
+        # bit-exactly from snapshot + journal-tail replay (the
+        # durability.snapshot / durability.replay spans + torn_tail
+        # event the semantics checks above pin), then a served tenant
+        # live-migrated across a fresh 2-host pod under traffic — the
+        # pod.migrate flip must record with zero failed requests
+        import shutil
+        import tempfile
+
+        from roaringbitmap_tpu.mutation import durability
+        from roaringbitmap_tpu.runtime import errors as rt_errors
+        from roaringbitmap_tpu.serving import migrate_tenant
+
+        dur_root = tempfile.mkdtemp(prefix="rb_trace_dur_")
+        try:
+            dt = durability.DurableTenant(
+                DeviceBitmapSet(datasets.synthetic_bitmaps(
+                    3, seed=0xD7, universe=1 << 14, density=0.01)),
+                root=dur_root, tenant="wl",
+                policy=durability.FlushPolicy(mode="batch", every_n=2),
+                snapshot_every=3)
+            for i in range(5):
+                dt.apply_delta(adds={i % 3: [1000 + 7 * i]})
+            dur_want = dt.ds.host_bitmaps()
+            crashed = False
+            with faults.inject("crash@torn=1.0:17"):
+                try:
+                    dt.apply_delta(adds={0: [12345]})
+                except rt_errors.InjectedCrash:
+                    crashed = True
+            assert crashed, "crash@torn did not fire"
+            rec, rep = durability.recover_tenant(root=dur_root,
+                                                 tenant="wl")
+            assert rep["torn"], "the torn crash left no torn tail"
+            assert rep["replayed"] >= 1, rep
+            assert rec.ds.host_bitmaps() == dur_want, \
+                "torn recovery diverged from the pre-crash image"
+
+            mig_fd = PodFrontDoor(
+                [DeviceBitmapSet(datasets.synthetic_bitmaps(
+                    3, seed=0xE0 + i, universe=1 << 14, density=0.01))
+                 for i in range(2)],
+                pod=podmesh.PodMesh.simulate(2),
+                policy=ServingPolicy(
+                    pool_target=2, default_deadline_ms=600_000.0,
+                    guard=rt_guard.GuardPolicy(backoff_base=0.0,
+                                               sleep=lambda s: None)))
+
+            def mig_ask(sid: int) -> int:
+                t = mig_fd.submit(ServingRequest(
+                    sid, BatchQuery("or", (0, 1, 2)), tenant=f"m{sid}"))
+                done = mig_fd.drain()
+                bad = [x for x in done
+                       if x.status == "failed"
+                       or (x.status == "shed"
+                           and x.shed_reason != "expired")]
+                assert not bad, [(x.status, x.error) for x in bad]
+                assert t.status == "done", (t.status, t.error)
+                return int(t.result.cardinality)
+
+            mig_sid = next(s for s in range(2)
+                           if mig_fd.plan.regime(s) != "sharded")
+            mig_to = next(h for h in mig_fd.pod.alive()
+                          if h != mig_fd.owner_host(mig_sid))
+            mig_before = mig_ask(mig_sid)
+
+            def mig_during(_fd):
+                # traffic + a delta INSIDE the dual-write window
+                mig_fd.apply_delta(mig_sid,
+                                   adds={0: [999_991, 999_992]})
+                assert mig_ask(mig_sid) == mig_before + 2, \
+                    "serving diverged inside the dual-write window"
+
+            mig_rep = migrate_tenant(mig_fd, mig_sid, mig_to,
+                                     during=mig_during)
+            assert mig_fd.owner_host(mig_sid) == mig_to, \
+                "the migration flip did not move ownership"
+            assert mig_rep["catch_up_records"] >= 1, mig_rep
+            assert mig_ask(mig_sid) == mig_before + 2, \
+                "post-flip serving diverged"
+        finally:
+            shutil.rmtree(dur_root, ignore_errors=True)
     finally:
         obs.disable()
 

@@ -1776,6 +1776,36 @@ SUMMARY_DROP_ORDER = ("phase_ms", "cost", "durability", "resident",
                       "marginal_us_median", "unit", "backend",
                       "north_star")
 
+#: backend-declarative lane schema (ROADMAP item 1 groundwork): each
+#: top-level lane group of the FULL document declares the platforms it
+#: runs on and the engine rungs it exercises, so a diff between
+#: documents captured on different hardware (the BENCH_r06 TPU capture
+#: vs the committed CPU rounds) can SKIP a lane absent on the other
+#: side's platform instead of reporting it removed
+#: (tools/bench_diff.py reads this together with the doc's
+#: ``platform``).  ``"any"`` = every backend bench.py runs on; the
+#: schema ships in benchmarks/bench_full.json only — the byte-capped
+#: stdout summary never carries it.
+LANE_SCHEMA = {
+    "batched_by_dataset": {
+        "platforms": "any",
+        "rungs": ["pallas", "xla", "xla-vmap", "sequential"]},
+    "multiset": {"platforms": "any",
+                 "rungs": ["xla", "megakernel", "sequential"]},
+    "expression": {"platforms": "any", "rungs": ["xla", "megakernel"]},
+    "serving": {"platforms": "any", "rungs": ["auto"]},
+    "sharded": {"platforms": "any", "rungs": ["xla"]},
+    "mutation": {"platforms": "any", "rungs": ["auto"]},
+    "lattice": {"platforms": "any", "rungs": ["auto"]},
+    "olap": {"platforms": "any", "rungs": ["auto", "megakernel"]},
+    "resident": {"platforms": "any", "rungs": ["megakernel"]},
+    "pod": {"platforms": "any", "rungs": ["auto"]},
+    "durability": {"platforms": "any", "rungs": ["auto"]},
+    # xprof kernel attribution needs real device traces
+    "detail.profile_kernel_us": {"platforms": ["tpu"], "rungs": []},
+    "detail.profile_trace_dir": {"platforms": ["tpu"], "rungs": []},
+}
+
 
 def summary_line(out: dict, full_path: str,
                  max_bytes: int = SUMMARY_MAX_BYTES) -> str:
@@ -2222,6 +2252,8 @@ def main() -> None:
     out["resident"] = resident
     out["pod"] = pod
     out["durability"] = durability
+    out["platform"] = jax.default_backend()
+    out["lane_schema"] = LANE_SCHEMA
 
     # full document to disk; stdout gets ONLY the compact summary as its
     # final line (the driver's bounded tail capture must parse it)

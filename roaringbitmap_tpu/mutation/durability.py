@@ -62,10 +62,12 @@ import shutil
 import struct
 import threading
 import time
+import weakref
 import zlib
 
 import numpy as np
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..runtime import errors, faults
@@ -145,6 +147,7 @@ class DeltaJournal:
         self.policy = policy or FlushPolicy()
         self.seq = int(start_seq)
         self._since_fsync = 0
+        self._unflushed_bytes = 0   # framed bytes not yet fsynced (statusz)
         self._last_frame: tuple | None = None   # (start_offset, payload_len)
         fresh = (not os.path.exists(self.path)
                  or os.path.getsize(self.path) == 0)
@@ -170,6 +173,7 @@ class DeltaJournal:
         self._f.write(payload)
         self._last_frame = (start, len(payload))
         self._since_fsync += 1
+        self._unflushed_bytes += _FRAME.size + len(payload)
         if self.policy.mode == "always":
             self.flush(fsync=True)
         elif (self.policy.mode == "batch"
@@ -187,6 +191,7 @@ class DeltaJournal:
         if fsync:
             os.fsync(self._f.fileno())
             self._since_fsync = 0
+            self._unflushed_bytes = 0
             obs_metrics.counter("rb_journal_fsyncs_total").inc()
 
     def close(self) -> None:
@@ -217,6 +222,12 @@ class DeltaJournal:
         if mode == "torn":
             self.tear_tail()
         self.close()
+        # black-box the crash before raising: the flight artifact is the
+        # only observability this "process" leaves behind
+        obs_flight.record("error", site=SITE, error_class="InjectedCrash",
+                          point=point, mode=mode, seq=self.seq)
+        obs_flight.trigger("crash", site=SITE, point=point, mode=mode,
+                           seq=self.seq)
         raise errors.InjectedCrash(
             f"injected crash at {SITE}/{point} (mode={mode}, "
             f"seq={self.seq})")
@@ -259,6 +270,7 @@ class DeltaJournal:
         self._f = open(self.path, "ab")
         self._last_frame = None
         self._since_fsync = 0
+        self._unflushed_bytes = 0
         return len(keep)
 
 
@@ -647,6 +659,7 @@ class DurableTenant:
         self._worker = worker
         self._lock = threading.Lock()
         self._applies_since_snapshot = 0
+        self._snapshot_t = time.time()   # newest durable snapshot (or attach)
         os.makedirs(self.dir, exist_ok=True)
         if _recovered_seq is None:
             if os.path.exists(os.path.join(self.dir, CURRENT_FILE)):
@@ -660,6 +673,7 @@ class DurableTenant:
             self.journal = DeltaJournal(
                 os.path.join(self.dir, JOURNAL_FILE), self.policy,
                 start_seq=_recovered_seq)
+        _TENANTS.add(self)
 
     # -- mutations --------------------------------------------------
     def apply_delta(self, adds=None, removes=None, repack: str = "auto",
@@ -757,11 +771,41 @@ class DurableTenant:
             obs_metrics.counter("rb_snapshot_bytes_total").inc(
                 manifest["_bytes"])
             obs_metrics.histogram("rb_snapshot_seconds").observe(wall)
+        self._snapshot_t = time.time()
         return {"seq": state["seq"], "bytes": manifest["_bytes"],
                 "journal_kept": kept, "wall_ms": round(wall * 1e3, 3)}
 
+    def health(self) -> dict:
+        """Durability lag as one plain dict — the statusz journal
+        section: how much committed state would need journal replay
+        (unflushed bytes, applies since snapshot) and how stale the
+        newest snapshot is."""
+        return {
+            "tenant": self.tenant, "seq": self.journal.seq,
+            "unflushed_bytes": self.journal._unflushed_bytes,
+            "applies_since_snapshot": self._applies_since_snapshot,
+            "snapshot_age_s": round(time.time() - self._snapshot_t, 3),
+        }
+
     def close(self) -> None:
         self.journal.close()
+
+
+#: live DurableTenant instances (weak — closing/discarding a tenant
+#: drops it from the fleet health view without an unregister call)
+_TENANTS: "weakref.WeakSet[DurableTenant]" = weakref.WeakSet()
+
+
+def health() -> list:
+    """Per-tenant durability health for every live DurableTenant in the
+    process, sorted by tenant id (obs.statusz's journal section)."""
+    docs = []
+    for t in list(_TENANTS):
+        try:
+            docs.append(t.health())
+        except Exception:  # pragma: no cover - tenant mid-close
+            continue
+    return sorted(docs, key=lambda d: d["tenant"])
 
 
 # ---------------------------------------------------------------- recovery

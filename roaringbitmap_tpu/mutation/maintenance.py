@@ -42,6 +42,7 @@ import queue as queue_mod
 import threading
 import time
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 
@@ -76,10 +77,14 @@ class MaintenanceWorker:
     # -------------------------------------------------------------- API
 
     def submit(self, job, kind: str = "repack", desc: str = "") -> None:
-        """Enqueue one maintenance job (runs in submission order)."""
+        """Enqueue one maintenance job (runs in submission order).  The
+        submitter's trace context rides the queue item: the worker
+        thread parents the job's span into the operation that enqueued
+        it (a repack triggered by a serving-path delta lands in THAT
+        request's trace, not in an orphan tree)."""
         with self._idle:
             self._pending += 1
-        self._queue.put((job, kind, desc))
+        self._queue.put((job, kind, desc, obs_trace.inject()))
         obs_metrics.counter("rb_maintenance_jobs_total",
                             kind=kind).inc()
         obs_metrics.gauge("rb_maintenance_queue_depth").set(
@@ -137,25 +142,42 @@ class MaintenanceWorker:
                 obs_metrics.gauge("rb_maintenance_queue_depth").set(
                     self.pending())
 
-    def _run_one(self, job, kind: str, desc: str) -> None:
+    def _run_one(self, job, kind: str, desc: str, ctx=None) -> None:
+        # a REAL span parented into the submitter's context (on the
+        # worker thread current() is the no-op, so the old event-only
+        # form silently dropped every job from the trace); the legacy
+        # mutation.maintenance event is kept on the span for scrapers
         t0 = time.perf_counter()
-        try:
-            if self._lock is not None:
-                with self._lock:
+        with obs_trace.span_from(ctx, "mutation.maintenance", site=SITE,
+                                 kind=kind, desc=desc) as sp:
+            try:
+                if self._lock is not None:
+                    with self._lock:
+                        job()
+                else:
                     job()
-            else:
-                job()
-            self.jobs_done += 1
-            obs_trace.current().event(
-                "mutation.maintenance", site=SITE, kind=kind, desc=desc,
-                wall_ms=round((time.perf_counter() - t0) * 1e3, 2),
-                ok=True)
-        except Exception as exc:   # stay alive; stay visible
-            self.jobs_failed += 1
-            self.last_error = exc
-            obs_metrics.counter("rb_maintenance_failures_total",
-                                error_class=type(exc).__name__).inc()
-            obs_trace.current().event(
-                "mutation.maintenance", site=SITE, kind=kind, desc=desc,
-                ok=False, error_class=type(exc).__name__)
-            _log.exception("%s: job %s (%s) failed", SITE, kind, desc)
+                self.jobs_done += 1
+                sp.tag(ok=True)
+                sp.event(
+                    "mutation.maintenance", site=SITE, kind=kind,
+                    desc=desc,
+                    wall_ms=round((time.perf_counter() - t0) * 1e3, 2),
+                    ok=True)
+            except Exception as exc:   # stay alive; stay visible
+                self.jobs_failed += 1
+                self.last_error = exc
+                obs_metrics.counter("rb_maintenance_failures_total",
+                                    error_class=type(exc).__name__).inc()
+                # "kind" is the ring event type; the job kind rides as
+                # job_kind
+                obs_flight.record("error", site=SITE, job_kind=kind,
+                                  desc=desc,
+                                  error_class=type(exc).__name__)
+                sp.tag(ok=False, status="error",
+                       error_class=type(exc).__name__)
+                sp.event(
+                    "mutation.maintenance", site=SITE, kind=kind,
+                    desc=desc, ok=False,
+                    error_class=type(exc).__name__)
+                _log.exception("%s: job %s (%s) failed", SITE, kind,
+                               desc)

@@ -26,28 +26,39 @@ Three pieces (docs/OBSERVABILITY.md is the operator reference):
   ``rb_phase_seconds``) and deadline/SLO accounting
   (``rb_slo_attained_total`` / ``rb_slo_missed_total``; the ``slo``
   span event on a miss), plus the profile-on-miss capture window.
+- ``obs.flight`` — the black-box flight recorder: an always-on bounded
+  ring of recent span closes / typed errors / state transitions,
+  dumped as an atomic JSON artifact on incident triggers (SLO miss,
+  host loss, crash fault, overload escalation) so post-incident state
+  exists even with ``ROARING_TPU_TRACE`` off.
+- ``obs.statusz`` — the fleet health report: per-host sections
+  (serving degrade/backlog, resident-ring occupancy, journal lag,
+  lattice seal, flight triggers) merged with monotone counters into
+  one JSON + markdown doc; ``obs.statusz()`` is the entry point.
 
 ``snapshot()`` is the in-process JSON API: the full registry state plus
 the tracer's enablement, the HBM ledger, and the cost tracker — one dict
 a health endpoint can return verbatim.
 """
 
-from . import cost, export, memory, metrics, slo, trace
+from . import cost, export, flight, memory, metrics, slo, statusz, trace
 from .cost import TRACKER
 from .export import render_prometheus
 from .memory import LEDGER
 from .metrics import (DEFAULT_LATENCY_BUCKETS, REGISTRY, counter, gauge,
                       histogram, snapshot_delta)
 from .slo import SloPolicy
-from .trace import current, disable, enable, enabled, span
+from .statusz import render_markdown
+from .trace import current, disable, enable, enabled, inject, span, span_from
 
 
 def refresh_from_env() -> None:
     """Re-read every obs env knob (``ROARING_TPU_TRACE[_XPROF]``,
-    ``ROARING_TPU_PROFILE_ON_SLO_MISS``) after an in-process environment
-    change."""
+    ``ROARING_TPU_PROFILE_ON_SLO_MISS``, flight-ring sizing) after an
+    in-process environment change."""
     trace.refresh_from_env()
     slo.refresh_from_env()
+    flight.refresh_from_env()
 
 
 def snapshot() -> dict:
@@ -82,9 +93,11 @@ def reset() -> None:
 
 
 __all__ = [
-    "trace", "metrics", "export", "memory", "cost", "slo",
-    "span", "current", "enable", "disable", "enabled", "refresh_from_env",
+    "trace", "metrics", "export", "memory", "cost", "slo", "flight",
+    "span", "span_from", "inject", "current", "enable", "disable",
+    "enabled", "refresh_from_env",
     "counter", "gauge", "histogram", "snapshot_delta", "REGISTRY",
     "LEDGER", "TRACKER", "SloPolicy", "DEFAULT_LATENCY_BUCKETS",
-    "render_prometheus", "snapshot", "reset",
+    "render_prometheus", "snapshot", "reset", "statusz",
+    "render_markdown",
 ]

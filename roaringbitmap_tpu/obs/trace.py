@@ -26,10 +26,30 @@ Design constraints (docs/OBSERVABILITY.md):
   jax pytree and records the wait as ``sync_ms`` — the device-side tail
   of a dispatch that wall time alone cannot attribute.
 
+- **Cross-host stitching.**  A pod-scale request crosses processes
+  (forwarding, reroute after host loss, migration dual-writes,
+  maintenance threads), so parenthood cannot always ride the contextvar.
+  ``inject()`` captures the current span as a plain JSON-able context
+  ``{"trace_id", "span_id"}``; ``span_from(ctx, name, **tags)`` opens a
+  span whose parent is that *remote* context — the local contextvar
+  parent still wins when one is active, so a remote context only takes
+  effect at the root of a local tree.  tools/check_trace.py stitches one
+  trace out of multiple hosts' JSONL dumps by resolving trace_id /
+  parent_id across the merged file set.
+
 Env knobs::
 
     ROARING_TPU_TRACE=/path/to/trace.jsonl   # enable, append spans here
     ROARING_TPU_TRACE_XPROF=1                # bridge spans into xprof
+    ROARING_TPU_TRACE_MAX_BYTES=<n>          # rotate the sink at ~n bytes
+    ROARING_TPU_TRACE_KEEP=<k>               # keep last k rotated files
+
+Rotation: always-on serving loops and soak runs cannot grow an unbounded
+dump, so when the sink crosses ``ROARING_TPU_TRACE_MAX_BYTES`` it is
+rotated shift-style (``trace.jsonl`` -> ``trace.jsonl.1`` -> ... ->
+``trace.jsonl.<k>``, oldest dropped) and counted in
+``rb_trace_rotations_total``.  Unset/0 means unbounded (the default; the
+CI workload relies on a single contiguous file).
 
 Programmatic: ``enable(path)`` / ``disable()`` / ``refresh_from_env()``.
 """
@@ -46,6 +66,10 @@ import time
 
 ENV_TRACE = "ROARING_TPU_TRACE"
 ENV_XPROF = "ROARING_TPU_TRACE_XPROF"
+ENV_TRACE_MAX_BYTES = "ROARING_TPU_TRACE_MAX_BYTES"
+ENV_TRACE_KEEP = "ROARING_TPU_TRACE_KEEP"
+
+DEFAULT_KEEP = 2
 
 _log = logging.getLogger("roaringbitmap_tpu.obs")
 
@@ -55,8 +79,18 @@ _xprof = False
 _file = None
 _write_lock = threading.Lock()
 _ids = itertools.count(1)
+_max_bytes = 0                # 0 = unbounded sink
+_keep = DEFAULT_KEEP
+_bytes = 0                    # bytes written to the current sink file
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "rb_tpu_span", default=None)
+
+# Called with every completed span record (after the JSONL write) — the
+# flight recorder's feed.  Installed by obs.flight at import; must never
+# raise into Span.__exit__.  Only fires while tracing is enabled: the
+# disabled fast path allocates no Span, which is what
+# tools/check_obs_overhead.py pins.
+_on_close = None
 
 
 class _NoopSpan:
@@ -91,7 +125,7 @@ class Span:
     a JSONL record on ``__exit__`` (tags set after exit are lost)."""
 
     __slots__ = ("name", "span_id", "parent_id", "trace_id", "t_start",
-                 "_t0", "tags", "events", "_token", "_ann")
+                 "_t0", "tags", "events", "_token", "_ann", "_remote")
 
     def __init__(self, name: str, tags: dict):
         self.name = name
@@ -99,12 +133,22 @@ class Span:
         self.tags = tags
         self.events: list = []
         self._ann = None
+        self._remote = None
 
     def __enter__(self):
+        # Parent priority: a live local parent wins (nesting stays
+        # truthful inside one host); an injected remote context applies
+        # only at the root of the local tree (the cross-host seam); else
+        # this span roots a fresh trace.
         parent = _current.get()
-        self.parent_id = parent.span_id if parent is not None else None
-        self.trace_id = (parent.trace_id if parent is not None
-                         else self.span_id)
+        if parent is not None:
+            self.parent_id = parent.span_id
+            self.trace_id = parent.trace_id
+        elif self._remote is not None:
+            self.trace_id, self.parent_id = self._remote
+        else:
+            self.parent_id = None
+            self.trace_id = self.span_id
         self._token = _current.set(self)
         if _xprof:
             self._ann = _xprof_annotation(self.name)
@@ -122,13 +166,20 @@ class Span:
         if exc_type is not None:
             self.tags.setdefault("status", "error")
             self.tags.setdefault("error_class", exc_type.__name__)
-        _write({
+        record = {
             "name": self.name, "span_id": self.span_id,
             "parent_id": self.parent_id, "trace_id": self.trace_id,
             "pid": os.getpid(), "t_start": round(self.t_start, 6),
             "dur_ms": round(dur_ms, 4), "tags": self.tags,
             "events": self.events,
-        })
+        }
+        _write(record)
+        hook = _on_close
+        if hook is not None:
+            try:
+                hook(record)
+            except Exception:  # pragma: no cover - ring must not cost a query
+                pass
         return False
 
     def tag(self, **tags) -> "Span":
@@ -173,6 +224,47 @@ def span(name: str, **tags):
     return Span(name, tags)
 
 
+def span_from(ctx, name: str, **tags):
+    """Start a span whose parent is the *remote* context ``ctx`` (an
+    ``inject()`` dict that crossed a host/thread boundary on a ticket,
+    forwarded envelope, KV payload, or job tuple).  A live local parent
+    still wins — the remote context only roots the local tree — so the
+    call is safe at seams that are sometimes nested, sometimes not.
+    ``ctx=None`` (context never minted, e.g. tracing was off at
+    admission) degrades to a plain ``span()``."""
+    if not _enabled:
+        return _NOOP
+    sp = Span(name, tags)
+    sp._remote = extract(ctx)
+    return sp
+
+
+def inject(sp=None):
+    """The current (or given) span as a plain JSON-able trace context —
+    ``{"trace_id", "span_id"}`` — or None outside any active span.  The
+    pair is everything a downstream host needs to parent its spans into
+    this request's trace."""
+    if sp is None:
+        sp = _current.get()
+    if sp is None or getattr(sp, "span_id", None) is None:
+        return None
+    return {"trace_id": sp.trace_id, "span_id": sp.span_id}
+
+
+def extract(ctx):
+    """Validate a wire-shaped trace context back into a
+    ``(trace_id, parent_span_id)`` pair, or None if ``ctx`` is absent or
+    malformed (a garbled KV payload must never corrupt local spans)."""
+    if not isinstance(ctx, dict):
+        return None
+    tid = ctx.get("trace_id")
+    sid = ctx.get("span_id")
+    if (isinstance(tid, str) and tid
+            and isinstance(sid, str) and sid):
+        return (tid, sid)
+    return None
+
+
 def current():
     """The innermost active span, or the shared no-op — lets deep layers
     (guard decisions) annotate their enclosing span without plumbing."""
@@ -181,12 +273,17 @@ def current():
 
 
 def _write(record: dict) -> None:
+    global _bytes
     with _write_lock:
         if not _enabled or _file is None:
             return
         try:
-            _file.write(json.dumps(record, separators=(",", ":"),
-                                   default=str) + "\n")
+            line = json.dumps(record, separators=(",", ":"),
+                              default=str) + "\n"
+            _file.write(line)
+            _bytes += len(line)
+            if _max_bytes > 0 and _bytes >= _max_bytes:
+                _rotate_locked()
         except OSError as exc:
             # a full disk / revoked fd must cost the trace, never the
             # query that just succeeded (Span.__exit__ calls this)
@@ -195,18 +292,66 @@ def _write(record: dict) -> None:
             _disable_locked()
 
 
-def enable(path: str, xprof: bool | None = None) -> None:
+def _rotate_locked() -> None:
+    """Shift-rotate the sink: close, ``p -> p.1 -> ... -> p.<keep>``
+    (oldest overwritten), reopen ``p`` fresh.  Caller holds _write_lock;
+    OSErrors propagate to _write's disable path — a sink we can no
+    longer rotate is a sink we can no longer bound."""
+    global _file, _bytes
+    _file.close()
+    for i in range(_keep, 1, -1):
+        src = f"{_path}.{i - 1}"
+        if os.path.exists(src):
+            os.replace(src, f"{_path}.{i}")
+    if _keep >= 1:
+        os.replace(_path, f"{_path}.1")
+    else:
+        os.remove(_path)
+    _file = open(_path, "a", buffering=1)
+    _bytes = 0
+    from . import metrics as _metrics
+
+    _metrics.counter("rb_trace_rotations_total").inc()
+
+
+def _env_max_bytes() -> int:
+    try:
+        return max(0, int(os.environ.get(ENV_TRACE_MAX_BYTES, "0")))
+    except ValueError:
+        _log.warning("%s is not an integer, rotation disabled",
+                     ENV_TRACE_MAX_BYTES)
+        return 0
+
+
+def _env_keep() -> int:
+    try:
+        return max(0, int(os.environ.get(ENV_TRACE_KEEP,
+                                         str(DEFAULT_KEEP))))
+    except ValueError:
+        return DEFAULT_KEEP
+
+
+def enable(path: str, xprof: bool | None = None,
+           max_bytes: int | None = None, keep: int | None = None) -> None:
     """Start appending completed spans to ``path`` (JSONL).  Opens the
     file eagerly so a bad path fails HERE, at configuration time, with a
-    plain OSError — not out of the first query's span exit."""
-    global _enabled, _path, _file, _xprof
+    plain OSError — not out of the first query's span exit.
+    ``max_bytes``/``keep`` override the env rotation knobs (0 max_bytes
+    = unbounded); omitted, each enable re-reads the env — a previous
+    enable's explicit rotation caps are NOT sticky across sinks."""
+    global _enabled, _path, _file, _xprof, _max_bytes, _keep, _bytes
     disable()
     f = open(path, "a", buffering=1)
+    size = f.tell()
     with _write_lock:
         _path = path
         _file = f
+        _bytes = size
         if xprof is not None:
             _xprof = bool(xprof)
+        _max_bytes = (max(0, int(max_bytes)) if max_bytes is not None
+                      else _env_max_bytes())
+        _keep = max(0, int(keep)) if keep is not None else _env_keep()
         _enabled = True
 
 
@@ -236,10 +381,13 @@ def path() -> str | None:
 
 
 def refresh_from_env() -> None:
-    """Re-read ``ROARING_TPU_TRACE`` / ``ROARING_TPU_TRACE_XPROF``.  Run
-    at import; call again after mutating the environment in-process."""
-    global _xprof
+    """Re-read ``ROARING_TPU_TRACE`` / ``ROARING_TPU_TRACE_XPROF`` /
+    rotation knobs.  Run at import; call again after mutating the
+    environment in-process."""
+    global _xprof, _max_bytes, _keep
     _xprof = os.environ.get(ENV_XPROF, "") not in ("", "0")
+    _max_bytes = _env_max_bytes()
+    _keep = _env_keep()
     p = os.environ.get(ENV_TRACE)
     if p:
         try:

@@ -435,13 +435,23 @@ def assemble_pooled_results(bucket_outputs, pooled, rb_meta: dict,
 
 @dataclasses.dataclass
 class _Inflight:
-    """A dispatched-but-undrained launch of the pipelined dispatcher."""
+    """A dispatched-but-undrained launch of the pipelined dispatcher.
+
+    Carries the launch's cost-model inputs (static cost analysis, word-op
+    estimate, predicted peak bytes) plus the launch span id so drain()
+    can stamp a ``multiset.cost`` event attributed back to the launch
+    that dispatched it — flagged ``async=True`` because the drain wall
+    includes queueing behind earlier in-flight launches."""
 
     plan: _PoolPlan
     outs: list
     queries: tuple
     eng: str
     inject: bool
+    span_id: str | None = None
+    cost: dict | None = None
+    word_ops: float = 0.0
+    predicted_peak: int = 0
 
 
 def _donation_supported() -> bool:
@@ -486,10 +496,12 @@ class MultiSetBatchEngine:
         #: predicted-vs-measured bytes of the most recent pooled dispatch
         #: (the multiset.memory event payload)
         self.last_dispatch_memory: dict | None = None
-        #: cost/roofline accounting of the most recent SYNC pooled
-        #: dispatch (the multiset.cost event payload; pipelined launches
-        #: complete at drain time, so their wall cannot be attributed to
-        #: one launch and they do not stamp this)
+        #: cost/roofline accounting of the most recent pooled dispatch
+        #: (the multiset.cost event payload).  Sync launches stamp it at
+        #: dispatch; pipelined launches stamp it at drain time with
+        #: ``async=True`` + the originating ``launch_span_id`` — the
+        #: drain wall includes pipeline queueing, so async rooflines are
+        #: lower bounds, not launch walls
         self.last_dispatch_cost: dict | None = None
         self._first_query_done = False  # rb_first_query_seconds, once
         #: stats of the most recent pipelined run (the multiset.pipeline
@@ -1216,6 +1228,22 @@ class MultiSetBatchEngine:
                     res, _ = self._launch_guarded(
                         qs, chain, jit, policy, deadline, budget,
                         sync=True)
+                else:
+                    # drain-time cost attribution: the launch completed
+                    # under this drain, so stamp its multiset.cost here,
+                    # flagged async=True and pointing at the launch span
+                    # (the drain wall includes pipeline queueing, so the
+                    # achieved rates are lower bounds)
+                    cost_ev = obs_cost.record_dispatch(
+                        SITE, payload.eng, payload.cost,
+                        time.perf_counter() - t0,
+                        est={"flops": payload.word_ops,
+                             "bytes_accessed": payload.predicted_peak},
+                        q=len(qs), sets=len(payload.plan.sids),
+                        **{"async": True,
+                           "launch_span_id": payload.span_id})
+                    self.last_dispatch_cost = cost_ev
+                    obs_trace.current().event("multiset.cost", **cost_ev)
             drain_ms += (time.perf_counter() - t0) * 1e3
             out.setdefault(tag, []).extend(res)
 
@@ -1359,17 +1387,17 @@ class MultiSetBatchEngine:
                 rt_lattice.record_padding(SITE, int(pb), pf)
             self.last_dispatch_memory = mem
             sp.event("multiset.memory", **mem)
+            word_ops = insights.predict_multiset_dispatch_word_ops(
+                [b.signature for b in plan.buckets],
+                self._plan_sets(plan), eng,
+                pool_rows=plan.n_pool_rows)
+            if plan.exprs:
+                word_ops += insights.predict_expr_word_ops(
+                    plan.expr_signature, eng)
             if sync:
-                # roofline accounting needs a device-complete wall; an
-                # async (pipelined) launch finishes at drain time, where
-                # its share of the window cannot be attributed honestly
-                word_ops = insights.predict_multiset_dispatch_word_ops(
-                    [b.signature for b in plan.buckets],
-                    self._plan_sets(plan), eng,
-                    pool_rows=plan.n_pool_rows)
-                if plan.exprs:
-                    word_ops += insights.predict_expr_word_ops(
-                        plan.expr_signature, eng)
+                # sync launches have a device-complete wall right here;
+                # async (pipelined) launches finish at drain time, where
+                # drain() stamps the same event flagged async=True
                 cost_ev = obs_cost.record_dispatch(
                     SITE, eng, cost, time.perf_counter() - t_launch,
                     est={"flops": word_ops,
@@ -1379,7 +1407,10 @@ class MultiSetBatchEngine:
                 sp.event("multiset.cost", **cost_ev)
         if not sync:
             return _Inflight(plan=plan, outs=outs, queries=pooled,
-                             eng=eng, inject=inject)
+                             eng=eng, inject=inject,
+                             span_id=sp.span_id, cost=cost,
+                             word_ops=float(word_ops),
+                             predicted_peak=int(predicted["peak_bytes"]))
         return self._readback(plan, outs, pooled, eng, inject)
 
     def _launch_operands(self, plan: _PoolPlan, eng: str,

@@ -74,6 +74,7 @@ import zlib
 import numpy as np
 
 from ..insights import analysis as insights
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 
@@ -181,6 +182,8 @@ class PodMesh:
 
     def mark_down(self, host_id: int) -> None:
         self._down.add(int(host_id))
+        obs_flight.record("host_down", site=SITE, host=str(host_id),
+                          alive=len(self.alive()))
         self._push_gauges()
 
     def mark_up(self, host_id: int) -> None:

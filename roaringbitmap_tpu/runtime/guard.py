@@ -39,6 +39,7 @@ from typing import Callable
 import numpy as np
 
 from . import errors, faults
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
@@ -298,6 +299,12 @@ def _log_transition(level: int, site: str, event: str, engine_from: str,
     (span if span is not None else obs_trace.current()).event(
         event, site=site, engine_from=engine_from, engine_to=engine_to,
         error_class=error_class, **fields)
+    if level >= logging.WARNING:
+        # demote/fatal/sequential rungs feed the flight ring too: the
+        # black box must hold the ladder walk even with tracing off
+        obs_flight.record("guard", event=event, site=site,
+                          engine_from=engine_from, engine_to=engine_to,
+                          error_class=error_class)
 
 
 def _observe_latency(site: str, engine: str, seconds: float) -> None:

@@ -58,7 +58,9 @@ import dataclasses
 import logging
 import threading
 
+from ..obs import flight as obs_flight
 from ..obs import metrics as obs_metrics
+from ..obs import statusz as obs_statusz
 from ..obs import trace as obs_trace
 from ..parallel import podmesh
 from ..parallel.aggregation import DeviceBitmapSet
@@ -125,6 +127,10 @@ class PodFrontDoor:
         self.stats = {"routed": 0, "forwarded": 0, "reroutes": 0,
                       "host_drops": 0, "single_demotions": 0}
         self._build()
+        # plain obs.statusz() folds this front door's per-host docs in
+        # (weakly held: a dropped front door silently leaves the report)
+        obs_statusz.register_provider(f"pod_frontdoor_{id(self)}",
+                                      self._statusz_docs)
 
     @staticmethod
     def _as_set(s) -> DeviceBitmapSet:
@@ -206,13 +212,22 @@ class PodFrontDoor:
         return h in self._loops or h is None
 
     def submit(self, request, via_host=None,
-               arrival: float | None = None) -> Ticket:
+               arrival: float | None = None,
+               context: dict | None = None) -> Ticket:
         """Route + admit one request.  ``via_host`` models the arrival
         host (a load balancer that guessed wrong): when it differs from
         the routed host the request is FORWARDED — counted, traced,
-        served identically.  Typed ``AdmissionRejected`` on refusal,
-        including ``reason="remote_host"`` when the routed host is not
-        addressable from this process (a detected pod peer owns it)."""
+        served identically.  ``context`` is the forwarded envelope's
+        trace context (``obs.trace.inject()`` on the arrival host): the
+        local ``pod.route`` span parents into it, so a request that
+        crossed processes still stitches into ONE trace; in a detected
+        pod a missing envelope context is fetched best-effort from the
+        coordination KV channel the vtime gossip rides.  Typed
+        ``AdmissionRejected`` on refusal, including
+        ``reason="remote_host"`` when the routed host is not addressable
+        from this process (a detected pod peer owns it) — the minted
+        context is published on that KV channel before raising, so the
+        owner's admission can adopt it."""
         with self._lock:
             sid = int(request.set_id)
             if not 0 <= sid < len(self._sets):
@@ -222,8 +237,10 @@ class PodFrontDoor:
             h = self.owner_host(sid)
             regime = self.plan.regime(sid)
             forwarded = via_host is not None and via_host != h
-            with obs_trace.span(
-                    "pod.route", site=SITE, set_id=sid,
+            if context is None and forwarded:
+                context = self._trace_kv_get(sid)
+            with obs_trace.span_from(
+                    context, "pod.route", site=SITE, set_id=sid,
                     tenant=request.tenant, host=str(h), regime=regime,
                     forwarded=forwarded) as sp:
                 self.stats["routed"] += 1
@@ -249,6 +266,10 @@ class PodFrontDoor:
                     if loop is None:
                         from .loop import AdmissionRejected
 
+                        # ship this trace's context to the owner before
+                        # refusing: the peer process that admits the
+                        # re-sent request parents into it
+                        self._trace_kv_put(sid, obs_trace.inject(sp))
                         raise AdmissionRejected(
                             f"{SITE}: request for tenant {sid} routes "
                             f"to host {h}, owned by another process",
@@ -392,6 +413,12 @@ class PodFrontDoor:
                 error_class=type(fault).__name__)
             _log.warning("%s: host %s down (%s); rerouting", SITE, h,
                          fault)
+            # black-box the loss: the flight dump is the post-incident
+            # record of what the pod was doing when the host vanished
+            obs_flight.record("host_down", site=SITE, host=str(h),
+                              error_class=type(fault).__name__)
+            obs_flight.trigger("host_lost", site=SITE, host=str(h),
+                               error_class=type(fault).__name__)
         loop = self._loops.get(h)
         stranded = list(failed)
         if loop is not None:
@@ -414,9 +441,11 @@ class PodFrontDoor:
         if getattr(t, "pod_rerouted", False):
             if t.status != "queued":
                 return             # typed failure stands
-            with obs_trace.span("pod.reroute", site=SITE, set_id=sid,
-                                from_host=str(from_h), to=SINGLE,
-                                reason=reason, rung=guard.REROUTE):
+            with obs_trace.span_from(
+                    t.trace_ctx, "pod.reroute", site=SITE, set_id=sid,
+                    from_host=str(from_h), to=SINGLE,
+                    reason=reason, rung=guard.REROUTE) as sp:
+                t.trace_ctx = obs_trace.inject(sp) or t.trace_ctx
                 self.stats["reroutes"] += 1
                 self._single(None, None, ticket=t)
                 t.pod_host = SINGLE
@@ -427,10 +456,18 @@ class PodFrontDoor:
         # legitimately re-route to the SAME (alive, rebuilt) host
         to = podmesh.route(self.plan, sid, self.pod.alive(),
                            overrides=self._route_overrides)
-        with obs_trace.span("pod.reroute", site=SITE, set_id=sid,
-                            from_host=str(from_h),
-                            to=(str(to) if to is not None else SINGLE),
-                            reason=reason, rung=guard.REROUTE):
+        # parent the hop into the ticket's admission context (remote
+        # form — reroute runs from the pump with no contextvar active),
+        # so the replayed leg lands in the SAME trace the original
+        # admission started, whichever host serves it
+        with obs_trace.span_from(
+                t.trace_ctx, "pod.reroute", site=SITE, set_id=sid,
+                from_host=str(from_h),
+                to=(str(to) if to is not None else SINGLE),
+                reason=reason, rung=guard.REROUTE) as sp:
+            # the served leg should nest UNDER this hop: later
+            # serving.request spans parent into the newest context
+            t.trace_ctx = obs_trace.inject(sp) or t.trace_ctx
             self.stats["reroutes"] += 1
             t.status = "queued"
             t.error = None
@@ -513,6 +550,124 @@ class PodFrontDoor:
         except Exception:
             pass
         return board
+
+    def _kv_client(self):
+        """The jax coordination KV client, or None (simulated pod, no
+        distributed runtime, anything broken — gossip channels are
+        best-effort by contract)."""
+        if not any(not h.local for h in self.pod.hosts):
+            return None
+        try:  # pragma: no cover - needs a live multi-process cluster
+            from jax._src import distributed
+
+            return getattr(distributed.global_state, "client", None)
+        except Exception:  # pragma: no cover
+            return None
+
+    def _trace_kv_put(self, sid: int, ctx: dict | None) -> None:
+        """Publish a request's trace context for the owner process (the
+        detected-pod half of the forwarded envelope).  Best-effort."""
+        client = self._kv_client()
+        if client is None or ctx is None:
+            return
+        try:  # pragma: no cover - needs a live multi-process cluster
+            import json
+
+            payload = json.dumps(ctx, sort_keys=True)
+            try:
+                client.key_value_set(f"rb/pod/trace/{sid}", payload,
+                                     allow_overwrite=True)
+            except TypeError:
+                client.key_value_set(f"rb/pod/trace/{sid}", payload)
+        except Exception:
+            pass
+
+    def _trace_kv_get(self, sid: int) -> dict | None:
+        """Fetch a forwarded request's trace context published by the
+        arrival process; None on any failure (the request then roots a
+        fresh trace — degraded stitching, never a failure)."""
+        client = self._kv_client()
+        if client is None:
+            return None
+        try:  # pragma: no cover - needs a live multi-process cluster
+            import json
+
+            val = client.key_value_try_get(f"rb/pod/trace/{sid}") \
+                if hasattr(client, "key_value_try_get") \
+                else client.key_value_get(f"rb/pod/trace/{sid}", 0)
+            return json.loads(val) if val else None
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------- statusz
+
+    def _statusz_docs(self) -> list:
+        """One statusz doc per local serving loop (the per-host
+        sections: degrade level, backlog, resident ring, result cache,
+        lattice) — the obs.statusz() provider contribution."""
+        with self._lock:
+            hosts = [(str(h), lp) for h, lp in sorted(self._loops.items())]
+            if self._cap_loop is not None:
+                hosts.append((CAPACITY, self._cap_loop))
+            if self._single_loop is not None:
+                hosts.append((SINGLE, self._single_loop))
+            return [obs_statusz.local_doc(
+                host=h, sections={"serving": lp.snapshot()})
+                for h, lp in hosts]
+
+    def statusz(self) -> dict:
+        """The fleet statusz: every local host's doc, every detected-pod
+        peer's docs (exchanged over the same coordination KV channel the
+        fair-share vtimes ride), merged with the monotone counter
+        discipline, plus the pod-level placement map and front-door
+        stats.  One JSON doc; ``obs.statusz.render_markdown`` renders
+        it."""
+        docs = self._statusz_docs()
+        docs.extend(self._statusz_kv(docs))
+        with self._lock:
+            return obs_statusz.merge(
+                docs,
+                pod=self.pod.snapshot(),
+                placement=self.plan.table(),
+                regimes=self.plan.regime_counts(),
+                stats=dict(self.stats),
+                vtime_board=dict(self._vtime_board))
+
+    def _statusz_kv(self, docs: list) -> list:
+        """Detected-pod statusz exchange: publish this process's docs,
+        collect the peers'.  Best-effort, like every gossip channel."""
+        client = self._kv_client()
+        if client is None:
+            return []
+        out: list = []
+        try:  # pragma: no cover - needs a live multi-process cluster
+            import json
+
+            me = self.pod.local_host
+            payload = json.dumps(docs, default=str)
+            try:
+                client.key_value_set(f"rb/pod/statusz/{me}", payload,
+                                     allow_overwrite=True)
+            except TypeError:
+                client.key_value_set(f"rb/pod/statusz/{me}", payload)
+            except Exception:
+                pass
+            try:
+                peers = client.key_value_dir_get("rb/pod/statusz/")
+            except Exception:
+                return out
+            for key, val in peers or ():
+                if str(key).rstrip("/").endswith(f"/{me}"):
+                    continue
+                try:
+                    other = json.loads(val)
+                except Exception:
+                    continue
+                if isinstance(other, list):
+                    out.extend(d for d in other if isinstance(d, dict))
+        except Exception:
+            pass
+        return out
 
     # ----------------------------------------------------------- mutation
 

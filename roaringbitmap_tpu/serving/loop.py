@@ -58,6 +58,7 @@ import os
 import threading
 from collections import deque
 
+from ..obs import flight as obs_flight
 from ..obs import memory as obs_memory
 from ..obs import metrics as obs_metrics
 from ..obs import slo as obs_slo
@@ -276,6 +277,11 @@ class Ticket:
     wall_ms: float | None = None
     missed: bool | None = None   # SLO outcome (done tickets)
     pending_bytes: int = 0       # admission-time footprint estimate
+    #: trace context minted at admission ({"trace_id","span_id"}, None
+    #: with tracing off) — every later seam (reroute, migration,
+    #: maintenance, the post-dispatch serving.request span) parents into
+    #: this so one request is ONE trace across hosts
+    trace_ctx: dict | None = None
     _degraded_query: object = None
 
     @property
@@ -419,7 +425,12 @@ class ServingLoop:
             t = Ticket(request=request, seq=self._seq,
                        enqueued_at=arrival,
                        deadline_at=arrival + deadline_ms / 1e3,
-                       pending_bytes=req_bytes)
+                       pending_bytes=req_bytes,
+                       # mint the request's root context INSIDE the
+                       # admit span: when the pod front door routed us
+                       # its pod.route span is the contextvar parent, so
+                       # the whole lifecycle shares its trace id
+                       trace_ctx=obs_trace.inject())
             q.append(t)
             self._vtime.setdefault(
                 request.tenant, max(self._vtime.values(), default=0.0))
@@ -830,6 +841,26 @@ class ServingLoop:
             dl_ms = (t.deadline_at - t.enqueued_at) * 1e3
             t.missed = t.wall_ms > dl_ms
             obs_slo.count_outcome(SITE, t.missed, tenant=t.request.tenant)
+            # per-request outcome span AFTER the pooled span closed: a
+            # pool serves N tickets with N different trace ids, so the
+            # shared serving.dispatch span cannot carry request-scoped
+            # outcomes — each ticket closes its own serving.request
+            # parented into its admission context (remote form; no
+            # contextvar is active out here), stitching the lifecycle
+            # into one trace even when the pool ran on another host
+            with obs_trace.span_from(
+                    t.trace_ctx, "serving.request", site=SITE,
+                    tenant=t.request.tenant, set_id=t.request.set_id,
+                    outcome="done", wall_ms=round(t.wall_ms, 4),
+                    missed=t.missed, degraded=t.degraded,
+                    dispatch_span_id=sp.span_id):
+                pass
+            if t.missed:
+                obs_flight.trigger(
+                    "slo_miss", site=SITE, tenant=t.request.tenant,
+                    set_id=t.request.set_id,
+                    wall_ms=round(t.wall_ms, 3),
+                    deadline_ms=round(dl_ms, 3))
             self._pending_bytes -= t.pending_bytes
             self.stats["served"] += 1
         return order
@@ -880,11 +911,21 @@ class ServingLoop:
         sp.tag(status="failed", error_class=type(fault).__name__)
         obs_metrics.counter("rb_serving_pool_failures_total",
                             error_class=type(fault).__name__).inc()
+        obs_flight.record("error", site=SITE,
+                          error_class=type(fault).__name__,
+                          tickets=len(tickets))
         for t in tickets:
             t.status = "failed"
             t.error = fault
             self._pending_bytes -= t.pending_bytes
             self.stats["failed"] += 1
+            with obs_trace.span_from(
+                    t.trace_ctx, "serving.request", site=SITE,
+                    tenant=t.request.tenant, set_id=t.request.set_id,
+                    outcome="failed",
+                    error_class=type(fault).__name__,
+                    dispatch_span_id=sp.span_id):
+                pass
         _log.error("%s: pool of %d failed: %s", SITE, len(tickets), fault)
         return tickets
 
@@ -924,6 +965,14 @@ class ServingLoop:
         obs_trace.current().event(
             "degrade", site=SITE, level_from=prev, level_to=level,
             pressure=round(pressure, 4))
+        obs_flight.record("degrade", site=SITE, level_from=prev,
+                          level_to=level, pressure=round(pressure, 4))
+        if level > prev:
+            # escalation is an incident (recovery is not): black-box the
+            # ladder move with the ring's recent history attached
+            obs_flight.trigger("overload", site=SITE, level_from=prev,
+                               level_to=level,
+                               pressure=round(pressure, 4))
         _log.warning("%s: degradation level %d -> %d (pressure %.2f)",
                      SITE, prev, level, pressure,
                      extra={"rb_site": SITE, "rb_event": "degrade",

@@ -87,17 +87,29 @@ class MigrationSession:
         self._pending: list = []    # deltas seen before the copy lands
         self._applied = 0
         self.bytes_streamed = 0
+        #: the enclosing pod.migrate span's context, captured at
+        #: begin_migration: dual-writes arrive later from mutation
+        #: callers with no contextvar link to the migration, so each
+        #: one parents into this explicitly
+        self.trace_ctx = obs_trace.inject()
 
     # -- dual-write window ------------------------------------------
     def on_delta(self, adds, removes, repack: str = "auto") -> None:
         """Every source-side delta during the window lands here (under
         the front-door lock): buffered until the target copy exists,
-        applied directly once it does — the dual-write half."""
-        if self.target_ds is None:
-            self._pending.append((adds, removes, repack))
-        else:
-            self.target_ds.apply_delta(adds, removes, repack=repack)
-            self._applied += 1
+        applied directly once it does — the dual-write half.  Each
+        delta closes a ``pod.dual_write`` span parented into the
+        migration's trace (remote form: the mutation caller's stack has
+        no contextvar tie to ``pod.migrate``)."""
+        with obs_trace.span_from(
+                self.trace_ctx, "pod.dual_write", site=SITE,
+                set_id=self.sid, to=str(self.to_host),
+                buffered=self.target_ds is None):
+            if self.target_ds is None:
+                self._pending.append((adds, removes, repack))
+            else:
+                self.target_ds.apply_delta(adds, removes, repack=repack)
+                self._applied += 1
 
     def _drain_pending(self) -> None:
         while self._pending:

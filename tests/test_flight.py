@@ -1,0 +1,417 @@
+"""Observability plane acceptance (flight recorder + statusz + trace
+propagation — docs/OBSERVABILITY.md).
+
+Pins:
+- cross-host trace propagation: ``inject``/``extract`` round-trip, a
+  local contextvar parent always wins over a remote context, and a
+  forwarded-then-rerouted request on a 2-host simulated pod stitches
+  into ONE trace id covering pod.route / serving.admit / pod.reroute /
+  serving.request (the tentpole acceptance assertion);
+- the black-box flight recorder: bounded ring, span-close feed (only
+  while tracing is enabled), schema-valid atomic dumps on trigger,
+  per-reason debounce, dumps fired by a real SLO miss and by a
+  ``crash@torn`` injected fault;
+- trace JSONL rotation under ``ROARING_TPU_TRACE_MAX_BYTES`` with the
+  keep-last-N shift and ``rb_trace_rotations_total``;
+- statusz: the monotone/idempotent counter merge, and a 2-host
+  simulated pod reporting BOTH hosts' state in one merged report via
+  ``obs.statusz()`` / ``fd.statusz()``;
+- the disabled-tracer fast path stays a shared no-op while the flight
+  ring is armed (the tools/check_obs_overhead.py contract).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.obs import flight as obs_flight
+from roaringbitmap_tpu.obs import statusz as obs_statusz
+from roaringbitmap_tpu.obs import trace as obs_trace
+from roaringbitmap_tpu.parallel import BatchQuery, DeviceBitmapSet, podmesh
+from roaringbitmap_tpu.runtime import errors, faults, guard
+from roaringbitmap_tpu.serving import (PodFrontDoor, ServingLoop,
+                                       ServingPolicy, ServingRequest)
+
+NOSLEEP = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)
+EASY_MS = 300_000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    obs.disable()
+    obs.reset()
+    guard.reset_dispatch_stats()
+    faults.reset_clock()
+    obs_flight.configure(dir=str(tmp_path / "flight"))
+    obs_flight.reset()
+    yield
+    obs.disable()
+    obs.reset()
+    obs_flight.configure(dir=None)
+    obs_flight.reset()
+    faults.reset_clock()
+
+
+@pytest.fixture(scope="module")
+def tenant_sets():
+    rng = np.random.default_rng(0xF117)
+    return [DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 15, 600).astype(np.uint32)))
+        for _ in range(4)], layout="dense") for _ in range(3)]
+
+
+def _policy(**kw) -> ServingPolicy:
+    kw.setdefault("guard", NOSLEEP)
+    kw.setdefault("default_deadline_ms", EASY_MS)
+    return ServingPolicy(**kw)
+
+
+def _pod_front_door(tenant_sets) -> PodFrontDoor:
+    return PodFrontDoor(
+        tenant_sets, pod=podmesh.PodMesh.simulate(2),
+        plan=podmesh.PlacementPlan(
+            regimes=("replicated-2", "local", "local"),
+            hosts=((0, 1), (0,), (1,)), bytes_per_host=(0, 0)),
+        policy=_policy(pool_target=4))
+
+
+def _dumps(tmp_path) -> list:
+    fdir = tmp_path / "flight"
+    if not fdir.is_dir():
+        return []
+    return [json.loads((fdir / f).read_text())
+            for f in sorted(os.listdir(fdir)) if f.startswith("flight-")]
+
+
+# ------------------------------------------------------ trace propagation
+
+
+def test_inject_extract_roundtrip(tmp_path):
+    obs.enable(str(tmp_path / "t.jsonl"))
+    with obs.span("outer", site="test") as sp:
+        ctx = obs_trace.inject()
+        assert ctx == {"trace_id": sp.trace_id, "span_id": sp.span_id}
+        assert obs_trace.extract(ctx) == (sp.trace_id, sp.span_id)
+    assert obs_trace.inject() is None          # outside any span
+    assert obs_trace.extract(None) is None
+    assert obs_trace.extract({"trace_id": "x"}) is None   # malformed
+
+
+def test_span_from_parents_into_remote_context(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    obs.enable(path)
+    with obs.span("origin") as sp:
+        ctx = obs_trace.inject()
+    with obs_trace.span_from(ctx, "continued", site="test"):
+        pass
+    with obs.span("local_parent"):
+        # a live contextvar parent WINS over the remote context: the
+        # remote ctx must never re-root spans already inside a tree
+        with obs_trace.span_from(ctx, "nested_local") as inner:
+            assert inner.trace_id != sp.trace_id
+    obs.disable()
+    spans = {s["name"]: s for s in map(json.loads, open(path))}
+    assert spans["continued"]["trace_id"] == sp.trace_id
+    assert spans["continued"]["parent_id"] == sp.span_id
+    assert spans["nested_local"]["parent_id"] \
+        == spans["local_parent"]["span_id"]
+
+
+def test_span_from_none_context_roots(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    obs.enable(path)
+    with obs_trace.span_from(None, "rootish") as sp:
+        assert sp.parent_id is None and sp.trace_id == sp.span_id
+    obs.disable()
+
+
+def test_forwarded_then_rerouted_request_stitches_one_trace(
+        tenant_sets, tmp_path):
+    """The tentpole acceptance pin: one trace id covers admission on
+    the entry host, the forwarding hop, the reroute after host loss,
+    and the final per-request outcome span."""
+    path = str(tmp_path / "t.jsonl")
+    obs.enable(path)
+    fd = _pod_front_door(tenant_sets)
+    tickets = [fd.submit(ServingRequest(
+        i % 3, BatchQuery("or", (0, 1, 2)), tenant=f"t{i % 3}"),
+        via_host=1 - (i % 2)) for i in range(8)]
+    victim = next(h for h in (0, 1)
+                  if any(t.pod_host == h for t in tickets))
+    fd.fail_host(victim)
+    fd.drain()
+    obs.disable()
+    assert all(t.status == "done" for t in tickets)
+    by_trace: dict = {}
+    for s in map(json.loads, open(path)):
+        by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+    need = {"pod.route", "serving.admit", "pod.reroute",
+            "serving.request"}
+    stitched = [tid for tid, names in by_trace.items() if need <= names]
+    assert stitched, {tid: sorted(n & need)
+                      for tid, n in by_trace.items() if n & need}
+
+
+def test_host_loss_under_injected_fault_stitches_and_dumps(
+        tenant_sets, tmp_path):
+    """Same pin driven through the fault machinery (``coordinator@``)
+    instead of an explicit fail_host call: the host loss dumps a
+    flight artifact and the rerouted tickets keep their trace."""
+    path = str(tmp_path / "t.jsonl")
+    obs.enable(path)
+    fd = _pod_front_door(tenant_sets)
+    tickets = [fd.submit(ServingRequest(
+        i % 3, BatchQuery("or", (0, 1, 2)), tenant=f"t{i % 3}"),
+        via_host=1 - (i % 2)) for i in range(8)]
+    victim = next(h for h in (0, 1)
+                  if any(t.pod_host == h for t in tickets))
+    with faults.inject(f"coordinator@host{victim}=1.0:13"):
+        fd.pump()
+        fd.drain()
+    obs.disable()
+    assert fd.stats["reroutes"] > 0
+    assert all(t.status == "done" for t in tickets)
+    assert any(d["trigger"] == "host_lost" for d in _dumps(tmp_path))
+
+
+def test_maintenance_job_parents_into_submitter_trace(tmp_path):
+    from roaringbitmap_tpu.mutation.maintenance import MaintenanceWorker
+
+    path = str(tmp_path / "t.jsonl")
+    obs.enable(path)
+    w = MaintenanceWorker(start=False)
+    with obs.span("mutation.apply_delta", site="test") as sp:
+        w.submit(lambda: None, kind="repack", desc="t")
+    w.drain()
+    obs.disable()
+    spans = {s["name"]: s for s in map(json.loads, open(path))}
+    job = spans["mutation.maintenance"]
+    assert job["trace_id"] == sp.trace_id
+    assert job["parent_id"] == sp.span_id
+    assert job["tags"]["ok"] is True
+
+
+# ---------------------------------------------------------- trace rotation
+
+
+def test_trace_rotation_keeps_last_n(tmp_path):
+    path = str(tmp_path / "rot.jsonl")
+    obs.reset()
+    obs_trace.enable(path, max_bytes=2000, keep=2)
+    for i in range(200):
+        with obs.span("rotate_me", i=i, pad="x" * 40):
+            pass
+    obs.disable()
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    rot = obs.snapshot()["counters"].get("rb_trace_rotations_total", [])
+    assert sum(r["value"] for r in rot) >= 1
+    # every surviving segment is schema-valid JSONL
+    for p in (path, path + ".1"):
+        for line in open(p):
+            rec = json.loads(line)
+            assert rec["name"] == "rotate_me" and "span_id" in rec
+
+
+def test_trace_rotation_env_knobs(tmp_path, monkeypatch):
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("ROARING_TPU_TRACE", path)
+    monkeypatch.setenv("ROARING_TPU_TRACE_MAX_BYTES", "1500")
+    monkeypatch.setenv("ROARING_TPU_TRACE_KEEP", "3")
+    obs.refresh_from_env()
+    assert obs.enabled()
+    for i in range(200):
+        with obs.span("rotate_env", i=i, pad="y" * 40):
+            pass
+    obs.disable()
+    assert os.path.exists(path + ".1")
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_ring_is_bounded():
+    obs_flight.configure(capacity=8)
+    try:
+        for i in range(40):
+            obs_flight.record("error", i=i)
+        snap = obs_flight.snapshot()
+        assert snap["capacity"] == 8 and snap["occupancy"] == 8
+    finally:
+        obs_flight.configure(capacity=obs_flight.DEFAULT_CAPACITY)
+
+
+def test_span_closes_feed_ring_only_while_tracing(tmp_path):
+    with obs.span("invisible", site="test"):
+        pass                       # tracer off: no span summary
+    assert not any(e.get("kind") == "span"
+                   for e in list(obs_flight._ring))
+    obs.enable(str(tmp_path / "t.jsonl"))
+    with obs.span("visible", site="test", error_class="Boom"):
+        pass
+    obs.disable()
+    summaries = [e for e in list(obs_flight._ring)
+                 if e.get("kind") == "span"]
+    assert any(e["name"] == "visible" and e.get("site") == "test"
+               and e.get("error_class") == "Boom" for e in summaries)
+
+
+def test_trigger_dumps_schema_valid_and_atomic(tmp_path):
+    obs_flight.record("error", site="test", error_class="ValueError")
+    p = obs_flight.trigger("unit_test", site="test", detail=7)
+    assert p is not None and os.path.exists(p)
+    assert not any(f.endswith(".tmp")
+                   for f in os.listdir(tmp_path / "flight"))
+    doc = json.loads(open(p).read())
+    assert doc["kind"] == "rb_flight" and doc["version"] >= 1
+    assert doc["trigger"] == "unit_test"
+    assert doc["context"] == {"site": "test", "detail": 7}
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "error" in kinds and "trigger" in kinds
+    assert isinstance(doc["metrics_delta"], dict)
+    # the dump doubles as a check_trace-accepted artifact
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "check_trace", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "check_trace.py"))
+    ct = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ct)
+    assert ct.validate(p) == []
+
+
+def test_trigger_debounce_per_reason(monkeypatch):
+    monkeypatch.setenv("ROARING_TPU_FLIGHT_DEBOUNCE_S", "3600")
+    assert obs_flight.trigger("same_reason") is not None
+    assert obs_flight.trigger("same_reason") is None    # suppressed
+    assert obs_flight.trigger("other_reason") is not None
+    sup = obs.snapshot()["counters"].get("rb_flight_suppressed_total", [])
+    assert any(r["labels"].get("reason") == "same_reason"
+               and r["value"] >= 1 for r in sup)
+
+
+def test_slo_miss_dumps_flight(tenant_sets, tmp_path):
+    """A real missed deadline on the serving loop fires the slo_miss
+    trigger with the tenant/set context."""
+    from roaringbitmap_tpu.parallel import MultiSetBatchEngine
+
+    eng = MultiSetBatchEngine(tenant_sets)
+    loop = ServingLoop(eng, _policy(pool_target=4, shed=False))
+    t = loop.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                   tenant="late", deadline_ms=10.0))
+    faults.advance_clock(0.5)
+    loop.pump(force=True)
+    assert t.status == "done" and t.missed is True
+    dumps = _dumps(tmp_path)
+    miss = [d for d in dumps if d["trigger"] == "slo_miss"]
+    assert miss, [d["trigger"] for d in dumps]
+    assert miss[0]["context"]["tenant"] == "late"
+
+
+def test_crash_torn_dumps_flight(tmp_path):
+    from roaringbitmap_tpu.mutation import durability
+
+    rng = np.random.default_rng(0xC4A5)
+    dt = durability.DurableTenant(
+        DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+            rng.integers(0, 1 << 14, 300).astype(np.uint32)))
+            for _ in range(3)]),
+        root=str(tmp_path / "dur"), tenant="fl",
+        policy=durability.FlushPolicy(mode="never"),
+        snapshot_every=None)
+    dt.apply_delta(adds={0: [4242]})
+    with faults.inject("crash@torn=1.0:3"):
+        with pytest.raises(errors.InjectedCrash):
+            dt.apply_delta(adds={1: [4243]})
+    dumps = [d for d in _dumps(tmp_path) if d["trigger"] == "crash"]
+    assert dumps, "crash@torn left no flight dump"
+    assert dumps[0]["context"]["mode"] == "torn"
+    assert dumps[0]["context"]["point"] in (
+        "pre_append", "pre_apply", "post_apply")
+    # the crash also landed in the ring as a typed error event
+    assert any(e["kind"] == "error" for e in dumps[0]["events"])
+
+
+def test_disabled_tracer_stays_noop_with_ring_armed():
+    obs_flight.record("error", site="test")
+    assert obs.span("probe", q=1) is obs.trace._NOOP
+    assert obs.trace._on_close is not None
+
+
+# ----------------------------------------------------------------- statusz
+
+
+def test_merge_counters_is_monotone_and_idempotent():
+    a = {"rb_x_total": [{"labels": {"site": "a"}, "value": 3}],
+         "rb_y_total": [{"labels": {}, "value": 10}]}
+    b = {"rb_x_total": [{"labels": {"site": "a"}, "value": 5}],
+         "rb_z_total": [{"labels": {}, "value": 1}]}
+    merged = obs_statusz.merge_counters([a, b])
+    assert merged["rb_x_total"][0]["value"] == 5          # max, not sum
+    assert merged["rb_y_total"][0]["value"] == 10
+    assert merged["rb_z_total"][0]["value"] == 1
+    # commutative + idempotent: order and re-delivery change nothing
+    assert obs_statusz.merge_counters([b, a, b]) == merged
+    assert obs_statusz.merge_counters([merged, a, b]) == merged
+
+
+def test_merge_same_host_newest_wins():
+    d1 = {"kind": "rb_statusz", "version": 1, "merged": False,
+          "host": "0", "pid": 1, "t": 1.0, "obs": {"counters": {}},
+          "flight": {}, "sections": {"serving": {"level": 0}}}
+    d2 = dict(d1, t=2.0, sections={"serving": {"level": 2}})
+    m = obs_statusz.merge([d1, d2])
+    assert m["hosts"]["0"]["sections"]["serving"]["level"] == 2
+    # merging the merged doc with its inputs is idempotent
+    m2 = obs_statusz.merge([m, d1, d2])
+    assert m2["hosts"]["0"] == m["hosts"]["0"]
+    assert m2["counters"] == m["counters"]
+
+
+def test_two_host_pod_statusz_reports_both_hosts(tenant_sets):
+    fd = _pod_front_door(tenant_sets)
+    tickets = [fd.submit(ServingRequest(
+        i % 3, BatchQuery("or", (0, 1)), tenant=f"t{i % 3}"))
+        for i in range(4)]
+    fd.drain()
+    assert all(t.status == "done" for t in tickets)
+    sz = fd.statusz()
+    assert sz["kind"] == "rb_statusz" and sz["merged"] is True
+    assert {"0", "1"} <= set(sz["hosts"])
+    for h in ("0", "1"):
+        serving = sz["hosts"][h]["sections"]["serving"]
+        assert "level" in serving and "backlog" in serving
+    assert "placement" in sz and "stats" in sz
+    # the provider registration makes the package-level entry point see
+    # the same hosts without a front-door handle
+    top = obs.statusz()
+    assert {"0", "1"} <= set(top["hosts"])
+    # and the markdown renderer accepts both shapes
+    page = obs.render_markdown(sz)
+    assert "## host 0" in page and "## host 1" in page
+    assert obs.render_markdown(sz["hosts"]["0"]).startswith("#")
+
+
+def test_statusz_carries_journal_and_flight_sections(tmp_path):
+    from roaringbitmap_tpu.mutation import durability
+
+    rng = np.random.default_rng(0x57A7)
+    dt = durability.DurableTenant(
+        DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+            rng.integers(0, 1 << 14, 300).astype(np.uint32)))
+            for _ in range(3)]),
+        root=str(tmp_path / "dur"), tenant="sz",
+        policy=durability.FlushPolicy(mode="never"),
+        snapshot_every=None)
+    dt.apply_delta(adds={0: [77]})
+    obs_flight.trigger("statusz_test")
+    doc = obs_statusz.local_doc(host="h0")
+    tenants = {t["tenant"]: t for t in doc["journal"]}
+    assert "sz" in tenants
+    assert tenants["sz"]["unflushed_bytes"] > 0      # mode="never"
+    assert tenants["sz"]["snapshot_age_s"] >= 0.0
+    assert any(r["reason"] == "statusz_test"
+               for r in doc["flight"]["recent_triggers"])
+    dt.close()

@@ -36,6 +36,16 @@ bench phase that stopped emitting — is visible (tools/bench_sentry.py
 relies on this to notice vanished lanes across a trajectory).  They are
 informational, never gated: salvaged truncated tails legitimately
 recover different lane subsets per round.
+
+Full documents (``benchmarks/bench_full.json``) additionally declare a
+``lane_schema`` — per lane group, the ``platforms`` it runs on and the
+engine ``rungs`` it exercises — plus the capturing ``platform``.  A
+lane one side emitted whose group declares platforms EXCLUDING the
+other side's platform is reported as ``~ skipped lane (platform)``
+instead of added/removed: a TPU-only lane absent from a CPU round is a
+capture difference, not a vanished lane (the BENCH_r06 hardware-capture
+groundwork).  Summary-line documents carry no schema, so committed
+driver captures diff exactly as before.
 """
 
 from __future__ import annotations
@@ -177,8 +187,10 @@ def salvage_tail_json(tail: str) -> dict | None:
     return best
 
 
-def load_lanes(path: str) -> dict:
-    """Path -> {dotted lane: float} via the document-shape ladder."""
+def load_doc(path: str) -> dict:
+    """The recovered document itself via the document-shape ladder —
+    the ``lane_schema`` / ``platform`` declarations (full documents
+    only) live here alongside the numeric lanes."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict) and "tail" in doc and "cmd" in doc:
@@ -189,9 +201,39 @@ def load_lanes(path: str) -> dict:
             raise SystemExit(
                 f"bench_diff: {path}: driver capture has no parseable "
                 f"summary (parsed is null and the tail salvage failed)")
+    return doc
+
+
+def load_lanes(path: str) -> dict:
+    """Path -> {dotted lane: float} via the document-shape ladder."""
     lanes: dict = {}
-    _flatten(doc, "", lanes)
+    _flatten(load_doc(path), "", lanes)
     return lanes
+
+
+def doc_platform(doc: dict) -> str | None:
+    """The platform a document's lanes ran on: the full document's
+    ``platform`` declaration, else the summary/detail ``backend``."""
+    return (doc.get("platform") or doc.get("backend")
+            or (doc.get("detail") or {}).get("backend"))
+
+
+def platform_skipped(lane: str, schema, platform) -> bool:
+    """True when ``lane`` belongs to a schema group whose declared
+    ``platforms`` EXCLUDE ``platform`` — the lane is legitimately absent
+    from the other document (captured on that platform), so the diff
+    skips it instead of reporting it added/removed.  Lanes with no
+    declaration (or ``"any"``) never skip."""
+    if not isinstance(schema, dict) or not platform:
+        return False
+    for group, decl in schema.items():
+        if lane != group and not lane.startswith(group + ".") \
+                and not lane.startswith(group + "["):
+            continue
+        plats = (decl or {}).get("platforms")
+        if isinstance(plats, list) and platform not in plats:
+            return True
+    return False
 
 
 def _flatten(node, prefix: str, out: dict) -> None:
@@ -296,7 +338,10 @@ def main() -> int:
                          "this substring (e.g. 'qps')")
     args = ap.parse_args()
 
-    old, new = load_lanes(args.old), load_lanes(args.new)
+    old_doc, new_doc = load_doc(args.old), load_doc(args.new)
+    old, new = {}, {}
+    _flatten(old_doc, "", old)
+    _flatten(new_doc, "", new)
     rows, regressions = diff_lanes(old, new, args.threshold)
     if args.lanes:
         rows = [r for r in rows if args.lanes in r[0]]
@@ -311,13 +356,31 @@ def main() -> int:
         print(f"{arrow[sgn]} {lane}: {o:g} -> {n:g} "
               f"({d:+.1%}){flag}")
     added, removed = lane_changes(old, new)
+    # a lane only one side emitted is SKIPPED (not added/removed) when
+    # its own document's lane_schema declares platforms excluding the
+    # other document's platform: a TPU-only lane absent from a CPU
+    # round is a capture difference, not a vanished lane
+    skipped = [(lane, "new") for lane in added
+               if platform_skipped(lane, new_doc.get("lane_schema"),
+                                   doc_platform(old_doc))] \
+        + [(lane, "old") for lane in removed
+           if platform_skipped(lane, old_doc.get("lane_schema"),
+                               doc_platform(new_doc))]
+    skip_names = {lane for lane, _ in skipped}
+    added = [lane for lane in added if lane not in skip_names]
+    removed = [lane for lane in removed if lane not in skip_names]
+    for lane, side in skipped:
+        other = doc_platform(old_doc if side == "new" else new_doc)
+        print(f"~ skipped lane (platform): {lane} — declared absent "
+              f"on {other!r}")
     for lane in removed:
         print(f"! removed lane: {lane} (was {old[lane]:g})")
     for lane in added:
         print(f"+ added lane: {lane} ({new[lane]:g})")
     print(f"bench_diff: {shared} shared lanes, {len(regressions)} "
           f"regression(s) past {args.threshold:.0%}, "
-          f"{len(added)} added, {len(removed)} removed "
+          f"{len(added)} added, {len(removed)} removed, "
+          f"{len(skipped)} platform-skipped "
           f"({args.old} -> {args.new})")
     return 1 if (args.fail and regressions) else 0
 

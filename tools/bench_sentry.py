@@ -99,6 +99,15 @@ recover bit-exactly from snapshot + journal-tail replay with typed
 traffic must serve bit-exactly with zero failed requests — pinning the
 ``durability.*`` bench lanes' correctness (``journal_overhead_x``,
 ``recovery_ms_*``, ``migration_blip_ms``) before their trend is gated.
+
+``--smoke-obs`` (docs/OBSERVABILITY.md) prepends the observability-plane
+smoke: a forwarded-then-rerouted request on a 2-host simulated pod must
+stitch into ONE trace id (``pod.route`` → ``serving.admit`` →
+``pod.reroute`` → ``serving.request``), the forced host loss must leave
+a schema-valid flight-recorder dump, and the merged ``fd.statusz()``
+must report both hosts with an idempotent monotone counter merge —
+nothing about the trace/flight/statusz plane may go silent before the
+bench trends it rides on are gated.
 """
 
 from __future__ import annotations
@@ -1032,6 +1041,89 @@ def durability_smoke() -> int:
     return 0 if ok else 1
 
 
+def obs_smoke() -> int:
+    """Observability-plane smoke (docs/OBSERVABILITY.md): cross-host
+    trace stitching, the black-box flight recorder, and the merged
+    fleet statusz — see the module docstring.  Returns 0 when every
+    contract holds, 1 otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import tempfile
+
+    import numpy as np
+
+    from roaringbitmap_tpu import RoaringBitmap, obs
+    from roaringbitmap_tpu.obs import flight as obs_flight
+    from roaringbitmap_tpu.obs import statusz as obs_statusz
+    from roaringbitmap_tpu.parallel import (BatchQuery, DeviceBitmapSet,
+                                            podmesh)
+    from roaringbitmap_tpu.runtime import guard
+    from roaringbitmap_tpu.serving import (PodFrontDoor, ServingPolicy,
+                                           ServingRequest)
+
+    rng = np.random.default_rng(0x0B5)
+    checks: dict = {}
+    with tempfile.TemporaryDirectory(prefix="rb_obs_smoke_") as root:
+        trace_path = os.path.join(root, "trace.jsonl")
+        obs_flight.configure(dir=os.path.join(root, "flight"))
+        obs_flight.reset()
+        obs.enable(trace_path)
+        try:
+            sets = [DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+                rng.integers(0, 1 << 15, 600).astype(np.uint32)))
+                for _ in range(4)], layout="dense") for _ in range(3)]
+            fd = PodFrontDoor(
+                sets, pod=podmesh.PodMesh.simulate(2),
+                plan=podmesh.PlacementPlan(
+                    regimes=("replicated-2", "local", "local"),
+                    hosts=((0, 1), (0,), (1,)), bytes_per_host=(0, 0)),
+                policy=ServingPolicy(
+                    pool_target=4, default_deadline_ms=600_000.0,
+                    guard=guard.GuardPolicy(backoff_base=0.0,
+                                            sleep=lambda s: None)))
+            tickets = [fd.submit(ServingRequest(
+                i % 3, BatchQuery("or", (0, 1, 2)), tenant=f"t{i % 3}"),
+                via_host=1 - (i % 2)) for i in range(8)]
+            victim = next(h for h in (0, 1)
+                          if any(t.pod_host == h for t in tickets))
+            fd.fail_host(victim)
+            fd.drain()
+            checks["all_served"] = all(t.status == "done"
+                                       for t in tickets)
+            sz = fd.statusz()
+        finally:
+            obs.disable()
+        # one trace id must stitch the forwarded + rerouted lifecycle
+        spans = [json.loads(ln) for ln in open(trace_path)]
+        by_trace: dict = {}
+        for s in spans:
+            by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+        need = {"pod.route", "serving.admit", "pod.reroute",
+                "serving.request"}
+        checks["stitched_trace"] = any(need <= names
+                                       for names in by_trace.values())
+        # the host loss must have dumped a schema-shaped flight artifact
+        dumps = []
+        fdir = os.path.join(root, "flight")
+        if os.path.isdir(fdir):
+            dumps = [json.load(open(os.path.join(fdir, f)))
+                     for f in sorted(os.listdir(fdir))
+                     if f.startswith("flight-")]
+        checks["flight_dumped"] = any(
+            d.get("kind") == "rb_flight" and d.get("trigger")
+            and isinstance(d.get("events"), list) and d["events"]
+            and isinstance(d.get("metrics_delta"), dict)
+            for d in dumps)
+        # merged statusz reports both hosts; re-merging is idempotent
+        checks["statusz_hosts"] = (
+            sz.get("merged") is True
+            and {"0", "1"} <= set(sz.get("hosts") or {}))
+        checks["statusz_idempotent"] = (
+            obs_statusz.merge([sz])["counters"] == sz["counters"])
+    ok = all(checks.values())
+    print(json.dumps({"smoke_obs": checks, "ok": ok}))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -1103,6 +1195,12 @@ def main() -> int:
                          "aggregate roots, typed wedged-ring escape + "
                          "demotion to host dispatch; exit 1 on "
                          "violation)")
+    ap.add_argument("--smoke-obs", action="store_true",
+                    help="first run the observability-plane smoke (one "
+                         "stitched cross-host trace id for a forwarded+"
+                         "rerouted request, a schema-valid flight dump "
+                         "on host loss, merged 2-host statusz; exit 1 "
+                         "on violation)")
     args = ap.parse_args()
 
     if args.smoke_sharded:
@@ -1139,6 +1237,10 @@ def main() -> int:
             return rc
     if args.smoke_durability:
         rc = durability_smoke()
+        if rc:
+            return rc
+    if args.smoke_obs:
+        rc = obs_smoke()
         if rc:
             return rc
 

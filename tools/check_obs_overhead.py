@@ -51,6 +51,7 @@ def main() -> int:
     os.environ.pop("ROARING_TPU_SLO_MS", None)
 
     from roaringbitmap_tpu import obs
+    from roaringbitmap_tpu.obs import flight as obs_flight
     from roaringbitmap_tpu.obs import slo as obs_slo
     from roaringbitmap_tpu.parallel.batch_engine import (BatchEngine,
                                                          random_query_pool)
@@ -58,6 +59,16 @@ def main() -> int:
 
     obs.refresh_from_env()
     assert not obs.enabled()
+    # the flight recorder is ALWAYS on (its span feed hooks trace close,
+    # its ring accepts record() calls regardless of the tracer) — the
+    # 2% bound below is measured with it armed, which is the production
+    # configuration: a disabled tracer must stay free even while the
+    # black box runs
+    assert obs.trace._on_close is not None, \
+        "flight recorder span feed is not installed"
+    obs_flight.record("probe", site="check_obs_overhead")
+    assert obs_flight.snapshot()["occupancy"] >= 1, \
+        "flight ring did not record — the always-on black box is off"
     assert obs.span("probe", q=1) is obs.trace._NOOP, \
         "disabled span() must return the shared no-op"
     assert obs_slo.phase("dispatch") is obs_slo._NOOP, \

@@ -106,8 +106,22 @@ and a wide aggregation — then (2) validates every emitted line:
   traffic — all three span kinds (and the torn_tail event) must
   appear, with zero failed requests.
 
-Validation-only mode (``python tools/check_trace.py <path>``) checks an
-existing dump, e.g. one captured from a serving process.
+- observability-plane semantics (this PR, docs/OBSERVABILITY.md): the
+  ``serving.request`` / ``pod.dual_write`` / ``mutation.maintenance``
+  span schemas are validated on arbitrary dumps; flight-recorder dumps
+  (``"kind": "rb_flight"``) and statusz documents
+  (``"kind": "rb_statusz"``) — whether passed as extra paths or
+  interleaved in a combined dump — validate against their own schemas;
+  the --workload run additionally demands ONE trace id stitching the
+  full forwarded+rerouted request lifecycle (``pod.route`` →
+  ``serving.admit`` → ``pod.reroute`` → ``serving.request``), a
+  schema-valid flight dump from the forced host loss, and a merged
+  ``fd.statusz()`` reporting both simulated hosts.
+
+Validation-only mode (``python tools/check_trace.py <path> [path ...]``)
+checks existing dumps, e.g. captured from serving processes: several
+paths validate as ONE pooled span set, so per-host dumps of a forwarded
+trace stitch and cross-file parent/trace refs resolve.
 
 Exit code 0 = valid; 1 = violations (printed one per line).
 """
@@ -129,54 +143,182 @@ REQUIRED = {
     "parent_id": (str, type(None)), "trace_id": str,
 }
 
+#: non-span observability artifacts this tool also validates: flight-
+#: recorder dumps and statusz documents are single-line JSON docs
+#: self-describing via "kind", so they can be passed as extra paths or
+#: appear interleaved in a combined dump
+DOC_KINDS = ("rb_flight", "rb_statusz")
 
-def validate(path: str, workload_semantics: bool = False,
-             strict_refs: bool | None = None,
-             budget_semantics: bool = False) -> list[str]:
-    """``strict_refs`` controls whether a parent_id/trace_id that resolves
-    to no span in the file is a violation.  Defaults to
-    ``workload_semantics``: the CI workload produces a COMPLETE dump, but
-    a dump captured from a crashed or still-serving process legitimately
-    lacks the enclosing spans that never closed (spans flush on close,
-    parents after children) — those dumps must validate."""
-    if strict_refs is None:
-        strict_refs = workload_semantics
+
+def _flight_doc_errors(doc: dict, where: str) -> list[str]:
+    """Schema of one flight-recorder dump (obs.flight, ``rb_flight``)."""
     errors: list[str] = []
-    spans: list[dict] = []
+    if not isinstance(doc.get("version"), int) or doc["version"] < 1:
+        errors.append(f"{where}: flight doc without a positive integer "
+                      f"version: {doc.get('version')!r}")
+    if not doc.get("trigger") or not isinstance(doc["trigger"], str):
+        errors.append(f"{where}: flight doc without a trigger reason")
+    if not isinstance(doc.get("pid"), int):
+        errors.append(f"{where}: flight doc without an integer pid")
+    if not isinstance(doc.get("t"), (int, float)):
+        errors.append(f"{where}: flight doc without a numeric t")
+    if not isinstance(doc.get("context"), dict):
+        errors.append(f"{where}: flight doc without a context object")
+    events = doc.get("events")
+    if not isinstance(events, list):
+        errors.append(f"{where}: flight doc without an events list")
+    else:
+        for j, ev in enumerate(events):
+            if not isinstance(ev, dict) or not ev.get("kind") \
+                    or not isinstance(ev.get("t"), (int, float)):
+                errors.append(f"{where}: flight event {j} malformed "
+                              f"(needs kind + numeric t): {ev!r}")
+    if not isinstance(doc.get("metrics_delta"), dict):
+        errors.append(f"{where}: flight doc without a metrics_delta "
+                      f"object")
+    return errors
+
+
+def _statusz_counters_errors(counters, where: str) -> list[str]:
+    errors: list[str] = []
+    if not isinstance(counters, dict):
+        return [f"{where}: counters is not an object: {counters!r}"]
+    for name, entries in counters.items():
+        if not isinstance(entries, list):
+            errors.append(f"{where}: counter {name!r} entries not a "
+                          f"list")
+            continue
+        for e in entries:
+            if not isinstance(e, dict) \
+                    or not isinstance(e.get("labels"), dict) \
+                    or not isinstance(e.get("value"), (int, float)):
+                errors.append(f"{where}: counter {name!r} entry "
+                              f"malformed (needs labels + numeric "
+                              f"value): {e!r}")
+    return errors
+
+
+def _statusz_doc_errors(doc: dict, where: str) -> list[str]:
+    """Schema of a statusz document (obs.statusz, ``rb_statusz``) —
+    either shape: one host's local doc or the pod-level merged doc."""
+    errors: list[str] = []
+    if not isinstance(doc.get("version"), int) or doc["version"] < 1:
+        errors.append(f"{where}: statusz doc without a positive integer "
+                      f"version: {doc.get('version')!r}")
+    if not isinstance(doc.get("t"), (int, float)):
+        errors.append(f"{where}: statusz doc without a numeric t")
+    if doc.get("merged"):
+        hosts = doc.get("hosts")
+        if not isinstance(hosts, dict) or not hosts:
+            errors.append(f"{where}: merged statusz doc without a "
+                          f"non-empty hosts map")
+        else:
+            for h, sub in hosts.items():
+                if not isinstance(sub, dict):
+                    errors.append(f"{where}: host {h!r} entry not an "
+                                  f"object")
+                    continue
+                errors += _statusz_doc_errors(sub, f"{where}[host {h}]")
+        errors += _statusz_counters_errors(doc.get("counters"), where)
+    else:
+        if not doc.get("host") or not isinstance(doc["host"], str):
+            errors.append(f"{where}: local statusz doc without a host")
+        if not isinstance(doc.get("pid"), int):
+            errors.append(f"{where}: local statusz doc without an "
+                          f"integer pid")
+        if not isinstance(doc.get("obs"), dict):
+            errors.append(f"{where}: local statusz doc without the obs "
+                          f"registry snapshot")
+        if not isinstance(doc.get("flight"), dict):
+            errors.append(f"{where}: local statusz doc without the "
+                          f"flight recorder section")
+        for opt, types in (("journal", list), ("lattice", dict),
+                           ("sections", dict)):
+            if opt in doc and not isinstance(doc[opt], types):
+                errors.append(f"{where}: statusz section {opt!r} has "
+                              f"type {type(doc[opt]).__name__}")
+    return errors
+
+
+def validate_doc(doc: dict, where: str) -> list[str]:
+    """Dispatch a self-describing observability doc to its schema."""
+    kind = doc.get("kind")
+    if kind == "rb_flight":
+        return _flight_doc_errors(doc, where)
+    if kind == "rb_statusz":
+        return _statusz_doc_errors(doc, where)
+    return [f"{where}: unknown doc kind {kind!r}"]
+
+
+def _parse_file(path: str):
+    """Parse one JSONL artifact into span records + self-describing
+    docs (flight / statusz lines validate their own schema in place).
+    Returns ``(errors, spans)`` where spans are ``(where, rec)``."""
+    errors: list[str] = []
+    spans: list = []
     try:
         with open(path) as f:
             raw = f.readlines()
     except OSError as e:
-        return [f"cannot read {path}: {e}"]
+        return [f"cannot read {path}: {e}"], spans
     if not raw:
-        return [f"{path} is empty — no spans were emitted"]
+        return [f"{path} is empty — no spans were emitted"], spans
     for i, line in enumerate(raw, 1):
+        where = f"{path}:{i}"
         try:
             rec = json.loads(line)
         except json.JSONDecodeError as e:
-            errors.append(f"line {i}: not valid JSON ({e})")
+            errors.append(f"{where}: not valid JSON ({e})")
             continue
         if not isinstance(rec, dict):
-            errors.append(f"line {i}: not a JSON object")
+            errors.append(f"{where}: not a JSON object")
+            continue
+        if rec.get("kind") in DOC_KINDS:
+            errors += validate_doc(rec, where)
             continue
         for field, types in REQUIRED.items():
             if field not in rec:
-                errors.append(f"line {i}: missing field {field!r}")
+                errors.append(f"{where}: missing field {field!r}")
             elif not isinstance(rec[field], types):
                 errors.append(
-                    f"line {i}: field {field!r} has type "
+                    f"{where}: field {field!r} has type "
                     f"{type(rec[field]).__name__}, want {types}")
         if not rec.get("name"):
-            errors.append(f"line {i}: empty span name")
+            errors.append(f"{where}: empty span name")
         if isinstance(rec.get("dur_ms"), (int, float)) and rec["dur_ms"] < 0:
-            errors.append(f"line {i}: negative dur_ms {rec['dur_ms']}")
+            errors.append(f"{where}: negative dur_ms {rec['dur_ms']}")
         for j, ev in enumerate(rec.get("events") or []):
             if not isinstance(ev, dict) or not ev.get("name") \
                     or not isinstance(ev.get("t_offset_ms"), (int, float)):
                 errors.append(
-                    f"line {i}: event {j} malformed (needs name + "
+                    f"{where}: event {j} malformed (needs name + "
                     f"t_offset_ms): {ev!r}")
-        spans.append((i, rec))
+        spans.append((where, rec))
+    return errors, spans
+
+
+def validate(paths, workload_semantics: bool = False,
+             strict_refs: bool | None = None,
+             budget_semantics: bool = False) -> list[str]:
+    """``paths`` is one dump path or a list of them: multiple hosts'
+    dumps (plus flight/statusz artifacts) validate as ONE pooled span
+    set, so a trace forwarded across processes stitches — parent/trace
+    refs resolve against the union, and the propagation semantics see
+    the whole pod.  ``strict_refs`` controls whether a
+    parent_id/trace_id that resolves to no span in the union is a
+    violation.  Defaults to ``workload_semantics``: the CI workload
+    produces a COMPLETE dump, but a dump captured from a crashed or
+    still-serving process legitimately lacks the enclosing spans that
+    never closed (spans flush on close, parents after children) — those
+    dumps must validate."""
+    if strict_refs is None:
+        strict_refs = workload_semantics
+    errors: list[str] = []
+    spans: list = []
+    for path in ([paths] if isinstance(paths, str) else list(paths)):
+        errs, recs = _parse_file(path)
+        errors += errs
+        spans += recs
     if strict_refs:
         ids = {s.get("span_id") for _, s in spans}
         for i, s in spans:
@@ -184,7 +326,7 @@ def validate(path: str, workload_semantics: bool = False,
                 v = s.get(ref)
                 if v is not None and v not in ids:
                     errors.append(
-                        f"line {i}: {ref} {v!r} not present in the dump")
+                        f"{i}: {ref} {v!r} not present in the dump")
     if workload_semantics:
         errors += _workload_semantics([s for _, s in spans],
                                       budget_semantics)
@@ -204,6 +346,7 @@ def validate(path: str, workload_semantics: bool = False,
         errors += _analytics_semantics([s for _, s in spans])
         errors += _resident_semantics([s for _, s in spans])
         errors += _durability_semantics([s for _, s in spans])
+        errors += _propagation_semantics([s for _, s in spans])
     return errors
 
 
@@ -288,6 +431,7 @@ def _workload_semantics(spans: list[dict],
     errors += _analytics_semantics(spans, require=budget_semantics)
     errors += _resident_semantics(spans, require=budget_semantics)
     errors += _durability_semantics(spans, require=budget_semantics)
+    errors += _propagation_semantics(spans, require=budget_semantics)
     return errors
 
 
@@ -425,6 +569,76 @@ def _pod_semantics(spans: list[dict], require: bool = False) -> list[str]:
         if not reroutes:
             errors.append("no pod.reroute span — the workload's forced "
                           "host drop did not record")
+    return errors
+
+
+#: the request-lifecycle span names one stitched cross-host trace must
+#: contain: admission on the entry host, the routing hop, the reroute
+#: after a host loss, and the per-request outcome span on the host that
+#: finally served it (obs.trace inject/extract, docs/OBSERVABILITY.md
+#: "Cross-host trace propagation")
+STITCHED_NAMES = ("pod.route", "serving.admit", "pod.reroute",
+                  "serving.request")
+
+
+def _propagation_semantics(spans: list[dict],
+                           require: bool = False) -> list[str]:
+    """Cross-host trace propagation (this PR's tentpole).  Arbitrary
+    dumps validate the request-scoped span schemas wherever they
+    appear — ``serving.request`` (the per-ticket outcome span), the
+    migration ``pod.dual_write``, and the worker-thread
+    ``mutation.maintenance`` span; ``require`` (the --workload run,
+    which forwards an arrival and then drops its host) additionally
+    demands ONE trace id whose spans cover the full forwarded+rerouted
+    lifecycle — the stitched-trace acceptance assertion."""
+    errors: list[str] = []
+    for s in spans:
+        if s.get("name") != "serving.request":
+            continue
+        tags = s.get("tags") or {}
+        if not tags.get("outcome"):
+            errors.append(f"serving.request span without an outcome: "
+                          f"{tags!r}")
+        if "wall_ms" in tags \
+                and not isinstance(tags["wall_ms"], (int, float)):
+            errors.append(f"serving.request wall_ms not numeric: "
+                          f"{tags!r}")
+    for s in spans:
+        if s.get("name") != "pod.dual_write":
+            continue
+        tags = s.get("tags") or {}
+        if not isinstance(tags.get("set_id"), int):
+            errors.append(f"pod.dual_write span without a set_id: "
+                          f"{tags!r}")
+        if "to" not in tags:
+            errors.append(f"pod.dual_write span without a destination: "
+                          f"{tags!r}")
+    for s in spans:
+        if s.get("name") != "mutation.maintenance":
+            continue
+        tags = s.get("tags") or {}
+        if not tags.get("kind"):
+            errors.append(f"mutation.maintenance span without a job "
+                          f"kind: {tags!r}")
+        if not isinstance(tags.get("ok"), bool):
+            errors.append(f"mutation.maintenance span without an ok "
+                          f"verdict: {tags!r}")
+    if require:
+        by_trace: dict = {}
+        for s in spans:
+            tid = s.get("trace_id")
+            if tid:
+                by_trace.setdefault(tid, set()).add(s.get("name"))
+        stitched = [tid for tid, names in by_trace.items()
+                    if set(STITCHED_NAMES) <= names]
+        if not stitched:
+            best = max(by_trace.values(),
+                       key=lambda n: len(set(STITCHED_NAMES) & n),
+                       default=set())
+            errors.append(
+                "no single trace id stitches the forwarded+rerouted "
+                f"request lifecycle {STITCHED_NAMES} — closest trace "
+                f"held {sorted(set(STITCHED_NAMES) & best)}")
     return errors
 
 
@@ -1431,9 +1645,16 @@ def run_workload(path: str) -> None:
         # then a forced host drop whose tickets walk the reroute rung;
         # the pod.place / pod.route / pod.reroute schemas + presence are
         # what the semantics checks above pin, bit-exact throughout
+        import shutil
+        import tempfile
+
+        from roaringbitmap_tpu.obs import flight as obs_flight
         from roaringbitmap_tpu.parallel import podmesh
         from roaringbitmap_tpu.serving import PodFrontDoor
 
+        flight_dir = tempfile.mkdtemp(prefix="rb_trace_flight_")
+        obs_flight.configure(dir=flight_dir)
+        obs_flight.reset()
         pod_plan = podmesh.PlacementPlan(
             regimes=("replicated-2", "local", "local"),
             hosts=((0, 1), (0,), (1,)), bytes_per_host=(0, 0))
@@ -1461,6 +1682,25 @@ def run_workload(path: str) -> None:
         assert fd.stats["forwarded"] > 0, "no arrival was forwarded"
         assert fd.stats["reroutes"] > 0, \
             "the forced host drop rerouted nothing"
+        # flight recorder (this PR): the host loss must have dumped a
+        # schema-valid black-box artifact, and the merged fleet statusz
+        # must report BOTH simulated hosts' state
+        flight_dumps = sorted(
+            os.path.join(flight_dir, f) for f in os.listdir(flight_dir)
+            if f.startswith("flight-") and f.endswith(".json"))
+        assert flight_dumps, \
+            "the forced host drop left no flight-recorder dump"
+        for fp in flight_dumps:
+            with open(fp) as fh:
+                doc = json.load(fh)
+            doc_errs = validate_doc(doc, fp)
+            assert not doc_errs, doc_errs
+        sz = fd.statusz()
+        sz_errs = _statusz_doc_errors(sz, "fd.statusz()")
+        assert not sz_errs, sz_errs
+        assert {"0", "1"} <= set(sz.get("hosts") or {}), \
+            f"fd.statusz() did not report both hosts: " \
+            f"{sorted(sz.get('hosts') or {})}"
 
         # durability lane (ISSUE 17, docs/DURABILITY.md): a journaled
         # tenant crashed mid-apply with a TORN journal tail, recovered
@@ -1545,6 +1785,7 @@ def run_workload(path: str) -> None:
                 "post-flip serving diverged"
         finally:
             shutil.rmtree(dur_root, ignore_errors=True)
+            shutil.rmtree(flight_dir, ignore_errors=True)
     finally:
         obs.disable()
 
@@ -1554,20 +1795,23 @@ def main() -> int:
     workload = "--workload" in args
     if workload:
         args.remove("--workload")
-    if len(args) != 1:
+    if not args or (workload and len(args) != 1):
         print(__doc__)
         return 2
-    path = args[0]
     if workload:
-        run_workload(path)
-    errors = validate(path, workload_semantics=workload,
+        run_workload(args[0])
+    # several paths (per-host dumps + flight/statusz artifacts) validate
+    # as one pooled span set: refs and the stitched-trace semantics
+    # resolve against the union
+    errors = validate(args if len(args) > 1 else args[0],
+                      workload_semantics=workload,
                       budget_semantics=workload)
     if errors:
         for e in errors:
             print(f"check_trace: {e}", file=sys.stderr)
         return 1
-    n = sum(1 for _ in open(path))
-    print(f"check_trace: {path} OK ({n} spans)")
+    n = sum(sum(1 for _ in open(p)) for p in args)
+    print(f"check_trace: {', '.join(args)} OK ({n} lines)")
     return 0
 
 

@@ -1690,6 +1690,42 @@ def durability_cell_main() -> None:
             # NEUTRAL: the WAL's price, pinned not gated
             "journal_overhead_x": round(
                 durable_s / max(plain_s, 1e-9), 3)}
+        # (a') group commit: one fsync covers N tenants' pending
+        # appends (FlushPolicy(mode="group"), docs/WIRE.md cross-ref)
+        # — fsyncs per applied delta must come in below 1.0 and the
+        # WAL's wall price below the solo batch arm above
+        from roaringbitmap_tpu import obs as _obs
+        from roaringbitmap_tpu.mutation.durability import \
+            GroupCommitScheduler
+
+        def _ctr(name):
+            return sum(r["value"] for r in
+                       _obs.snapshot()["counters"].get(name, []))
+
+        sched = GroupCommitScheduler(every_n=8)
+        gts = [DurableTenant(mk_ds(), root=root, tenant=f"grp{i}",
+                             policy=sched.policy(),
+                             snapshot_every=None) for i in range(4)]
+        for t in gts:
+            t.apply_delta(adds={0: [1]})                      # warm
+        sched.commit()
+        f0 = _ctr("rb_journal_fsyncs_total")
+        c0 = _ctr("rb_journal_group_commits_total")
+        t0 = time.perf_counter()
+        for k, (a, rm) in enumerate(stream):
+            gts[k % 4].apply_delta(adds=a, removes=rm)
+        sched.commit()                         # shutdown barrier
+        group_s = time.perf_counter() - t0
+        fsyncs = _ctr("rb_journal_fsyncs_total") - f0
+        for t in gts:
+            t.close()
+        out["journal"]["group"] = {
+            "tenants": 4, "deltas": n, "fsyncs": fsyncs,
+            "group_commits":
+                _ctr("rb_journal_group_commits_total") - c0,
+            "fsync_per_delta": round(fsyncs / n, 3),
+            "group_overhead_x": round(
+                group_s / max(plain_s, 1e-9), 3)}
         # (b) recovery wall vs tenant count
         rec = {}
         for count in (1, 4):
@@ -1754,6 +1790,166 @@ def durability_cell_main() -> None:
     print(json.dumps(out))
 
 
+def pod_replay_phase() -> dict:
+    """Wire data-plane lane (ISSUE 20, docs/WIRE.md): the million-user
+    pod replay harness driven through BOTH arms — in-process on the
+    fault clock and over TCP against a REAL second OS process
+    (wire.bootstrap) — reporting wire_vs_inproc_x (NEUTRAL: the
+    network boundary's price, pinned not gated), the pipelined-vs-
+    one-request-per-round-trip amortization on the same socket
+    (HIGHER, the tentpole claim), and sustained QPS at >=90% SLO
+    attainment with p99 under an overload ladder."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--pod-replay-cell"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            timeout=1200, env=_dryrun_env(8),
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:
+        return {"error":
+                f"pod_replay cell failed: {type(e).__name__}: {e}"}
+
+
+def pod_replay_cell_main() -> None:
+    """Subprocess body for pod_replay_phase (8 CPU devices): one
+    bootstrap server process, one seeded workload, three measurements
+    over the same socket."""
+    from roaringbitmap_tpu.parallel import DeviceBitmapSet
+    from roaringbitmap_tpu.parallel.multiset import MultiSetBatchEngine
+    from roaringbitmap_tpu.runtime import guard
+    from roaringbitmap_tpu.serving import (ServingLoop, ServingPolicy,
+                                           replay)
+    from roaringbitmap_tpu.wire import WireClient
+
+    profile = replay.ReplayProfile(
+        sets=2, sources=8, tenants=8, users=1 << 20, density=3000,
+        requests=160, duration_s=1.0, seed=0x20)
+    nosleep = guard.GuardPolicy(backoff_base=0.0, sleep=lambda _s: None)
+
+    def mk_loop():
+        bitmap_sets, columns = replay.build_dataset(profile)
+        sets = [DeviceBitmapSet(b, layout="dense")
+                for b in bitmap_sets]
+        replay.attach_columns(sets, profile, columns)
+        return ServingLoop(MultiSetBatchEngine(sets), ServingPolicy(
+            pool_target=8, max_queue=4096,
+            default_deadline_ms=60_000.0, guard=nosleep))
+
+    events = replay.generate(profile)
+    queries = [e[2] for e in events if e[0] == "query"]
+    out: dict = {}
+
+    server = subprocess.Popen(
+        [sys.executable, "-m", "roaringbitmap_tpu.wire.bootstrap",
+         "--seed", str(profile.seed), "--sets", str(profile.sets),
+         "--sources", str(profile.sources),
+         "--tenants", str(profile.tenants),
+         "--density", str(profile.density),
+         "--users", str(profile.users),
+         "--pool-target", "8", "--max-queue", "4096",
+         "--deadline-ms", "60000"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=_dryrun_env(8),
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        info = json.loads(server.stdout.readline())
+        addr = (info["host"], info["port"])
+
+        # warm both processes' compile caches off the clock
+        warm = WireClient(addr, timeout=300)
+        for r in queries[:6]:
+            warm.call(r, 300)
+        warm.close()
+        loop = mk_loop()
+        for r in queries[:6]:
+            loop.submit(r)
+        loop.drain()
+
+        # (a) in-process arm on the fault clock (replay_stream
+        # semantics) vs the SAME workload pipelined over the wire
+        inproc = replay.run_inproc(mk_loop(), events)
+        cl = WireClient(addr, timeout=300)
+        wire = replay.run_wire(cl, events, pace=False, timeout=300)
+        out["inproc"] = inproc
+        out["wire"] = wire
+        # NEUTRAL: the boundary's price on client-observed throughput
+        out["wire_vs_inproc_x"] = round(
+            wire["qps"] / max(inproc["qps"], 1e-9), 3)
+
+        # (b) pipelining amortization on the SAME socket: coalesced
+        # many-in-flight submission vs one request per round trip.
+        # Uniform cheap flat cardinality queries isolate the per-request
+        # floor (syscall + framing + admission + dispatch) the
+        # pipelining exists to amortize — the mixed replay pools above
+        # are compute-bound, so their per-query engine time would
+        # measure the workload, not the wire
+        from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+        from roaringbitmap_tpu.serving.loop import ServingRequest
+        rng = np.random.default_rng(7)
+        rtt_reqs = []
+        for i in range(64):
+            picked = rng.choice(profile.sources, size=2, replace=False)
+            rtt_reqs.append(ServingRequest(
+                set_id=i % profile.sets,
+                query=BatchQuery(str(rng.choice(["and", "or"])),
+                                 tuple(int(v) for v in picked),
+                                 "cardinality"),
+                tenant=f"t{i % profile.tenants}"))
+        # two warm passes: TCP segmentation can split a cold burst
+        # into odd-sized pools whose XLA compiles would otherwise
+        # land on the clock (shapes stabilize after one pass)
+        for _ in range(2):
+            for t in cl.submit_many(rtt_reqs):
+                t.wait(300)
+        for r in rtt_reqs[:4]:               # ... and the singleton path
+            cl.call(r, 300)
+        rtt_s = pipe_s = float("inf")
+        for _ in range(3):                   # best-of-3, both arms
+            t0 = time.perf_counter()
+            for r in rtt_reqs:
+                cl.call(r, 300)
+            rtt_s = min(rtt_s, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            tickets = cl.submit_many(rtt_reqs)
+            for t in tickets:
+                t.wait(300)
+            pipe_s = min(pipe_s, time.perf_counter() - t0)
+            assert all(t.ok for t in tickets)
+        out["rtt_arm"] = {
+            "requests": len(rtt_reqs),
+            "rtt_qps": round(len(rtt_reqs) / rtt_s, 1),
+            "pipelined_qps": round(len(rtt_reqs) / pipe_s, 1),
+            # the tentpole claim: >=3x on the same socket
+            "pipelined_vs_rtt_x": round(rtt_s / max(pipe_s, 1e-9), 3)}
+        out["pipelined_vs_rtt_x"] = out["rtt_arm"]["pipelined_vs_rtt_x"]
+
+        # (c) overload ladder, both arms: sustained QPS at >=90%
+        # attainment + p99 at the sustained rung
+        rates = [1.0, 4.0, 16.0]
+        out["sustained_inproc"] = replay.sustained(
+            lambda r: replay.run_inproc(mk_loop(), events,
+                                        rate_scale=r), rates)
+        out["sustained_wire"] = replay.sustained(
+            lambda r: replay.run_wire(cl, events, rate_scale=r,
+                                      pace=True, timeout=300), rates)
+        out["sustained_qps_wire"] = \
+            out["sustained_wire"]["sustained_qps"]
+        out["sustained_qps_inproc"] = \
+            out["sustained_inproc"]["sustained_qps"]
+        out["overload_p99_ms"] = \
+            out["sustained_wire"]["sustained_p99_ms"]
+        cl.close()
+    finally:
+        server.stdin.close()
+        try:
+            server.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            server.kill()
+    print(json.dumps(out))
+
+
 #: hard byte cap on the final stdout summary line.  The driver captures a
 #: BOUNDED tail of stdout (ADVICE r5: the r05 summary still came back
 #: "parsed": null with the JSON head truncated), so the line must fit a
@@ -1768,7 +1964,8 @@ SUMMARY_MAX_BYTES = 2048
 #: pathological dataset count.  The ISSUE 6 cost/SLO lanes shed FIRST:
 #: they are trend inputs for the sentry, not driver-gate fields, and the
 #: full doc always keeps them
-SUMMARY_DROP_ORDER = ("phase_ms", "cost", "durability", "resident",
+SUMMARY_DROP_ORDER = ("phase_ms", "cost", "pod_replay", "durability",
+                      "resident",
                       "olap", "pod",
                       "lattice",
                       "mutation", "serving", "sharded", "expression",
@@ -1801,6 +1998,7 @@ LANE_SCHEMA = {
     "resident": {"platforms": "any", "rungs": ["megakernel"]},
     "pod": {"platforms": "any", "rungs": ["auto"]},
     "durability": {"platforms": "any", "rungs": ["auto"]},
+    "pod_replay": {"platforms": "any", "rungs": ["auto"]},
     # xprof kernel attribution needs real device traces
     "detail.profile_kernel_us": {"platforms": ["tpu"], "rungs": []},
     "detail.profile_trace_dir": {"platforms": ["tpu"], "rungs": []},
@@ -2000,11 +2198,29 @@ def build_summary(out: dict, full_path: str) -> dict:
                    du["journal"].get("journal_overhead_x")}
         for key, row in (du.get("recovery") or {}).items():
             du_lane[f"recovery_ms_{key}"] = row.get("recovery_ms")
+        grp = du["journal"].get("group") or {}
+        if "fsync_per_delta" in grp:
+            # group commit: fsyncs amortized across tenants' appends
+            du_lane["group_fsync_per_delta"] = grp["fsync_per_delta"]
+            du_lane["group_overhead_x"] = grp.get("group_overhead_x")
         mig = du.get("migration") or {}
         if "migration_blip_ms" in mig:
             du_lane["migration_blip_ms"] = mig["migration_blip_ms"]
             du_lane["migration_failed"] = mig.get("failed_or_shed")
         s["durability"] = du_lane
+    # pod_replay lane, compact: the wire boundary's price (NEUTRAL),
+    # the pipelining amortization headline (>=3x is the tentpole
+    # claim), and sustained QPS at >=90% attainment + p99 under the
+    # overload ladder, both arms (bench.py pod_replay_phase,
+    # docs/WIRE.md)
+    pr = out.get("pod_replay") or {}
+    if "pipelined_vs_rtt_x" in pr:
+        s["pod_replay"] = {
+            "wire_vs_inproc_x": pr.get("wire_vs_inproc_x"),
+            "pipelined_vs_rtt_x": pr["pipelined_vs_rtt_x"],
+            "sustained_qps_wire": pr.get("sustained_qps_wire"),
+            "sustained_qps_inproc": pr.get("sustained_qps_inproc"),
+            "overload_p99_ms": pr.get("overload_p99_ms")}
     return s
 
 
@@ -2123,6 +2339,10 @@ def main() -> None:
     ap.add_argument("--durability-cell", action="store_true",
                     help="internal: run the durable-tenant cells in a "
                          "CPU dry-run subprocess and exit")
+    ap.add_argument("--pod-replay-cell", action="store_true",
+                    help="internal: run the wire replay lane (real "
+                         "second process over TCP) in a CPU dry-run "
+                         "subprocess and exit")
     ap.add_argument("--pod-worker", nargs=3, metavar=("PID", "PORT", "N"),
                     help="internal: one pod-cluster worker (process id, "
                          "coordinator port, process count) and exit")
@@ -2146,6 +2366,9 @@ def main() -> None:
         return
     if args.durability_cell:
         durability_cell_main()
+        return
+    if args.pod_replay_cell:
+        pod_replay_cell_main()
         return
 
     # stdout hygiene: everything during the run (library prints, warnings
@@ -2191,6 +2414,7 @@ def main() -> None:
     resident = resident_phase()
     pod = pod_phase()
     durability = durability_phase()
+    pod_replay = pod_replay_phase()
 
     # Medianize BEFORE assembling the document, so the headline is built
     # exactly once.  A single steady-state marginal at VMEM-resident
@@ -2252,6 +2476,7 @@ def main() -> None:
     out["resident"] = resident
     out["pod"] = pod
     out["durability"] = durability
+    out["pod_replay"] = pod_replay
     out["platform"] = jax.default_backend()
     out["lane_schema"] = LANE_SCHEMA
 

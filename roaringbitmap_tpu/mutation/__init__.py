@@ -43,7 +43,8 @@ docs/DURABILITY.md for the durable write path.
 
 from .delta import apply_delta, drift_report, host_bitmaps, repack_in_place
 from .durability import (DeltaJournal, DurableTenant, FlushPolicy,
-                         load_snapshot, recover_tenant, scan_journal)
+                         GroupCommitScheduler, load_snapshot,
+                         recover_tenant, scan_journal)
 from .maintenance import MaintenanceWorker
 from .result_cache import (ENV_RESULT_CACHE, ResultCache, from_env,
                            node_key, notify_version_bump, query_key,
@@ -51,7 +52,8 @@ from .result_cache import (ENV_RESULT_CACHE, ResultCache, from_env,
 
 __all__ = [
     "apply_delta", "drift_report", "host_bitmaps", "repack_in_place",
-    "DeltaJournal", "DurableTenant", "FlushPolicy", "load_snapshot",
+    "DeltaJournal", "DurableTenant", "FlushPolicy",
+    "GroupCommitScheduler", "load_snapshot",
     "recover_tenant", "scan_journal",
     "MaintenanceWorker",
     "ENV_RESULT_CACHE", "ResultCache", "from_env", "node_key",

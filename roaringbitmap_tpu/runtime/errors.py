@@ -13,6 +13,8 @@ on mechanically:
   demote (deterministic) -> EngineLoweringError
   fatal (input's fault)  -> CorruptInput (== format.spec.InvalidRoaringFormat)
   fatal (engine's fault) -> ShadowMismatch
+  wire boundary          -> WireError tree (docs/WIRE.md): hello/auth/
+                            backpressure/peer-closed/remote-failed
 
 ``classify`` maps a raw exception to a taxonomy instance, or ``None`` when
 the exception looks like a programming error — the guard re-raises those
@@ -94,6 +96,72 @@ class InjectedCrash(RoaringRuntimeError):
     durability layer may catch-and-continue past a crash point; the only
     legal continuation is a fresh recovery (durability.recover_tenant),
     which is exactly what the crash-recovery property tests drive."""
+
+
+class WireError(RoaringRuntimeError):
+    """Base of the wire-boundary taxonomy (docs/WIRE.md).  Everything
+    the binary RPC front door can do to a caller surfaces as one of
+    these (or as a re-hydrated serving/runtime type carried inside a
+    typed error frame) — raw ``socket``/``struct``/``json`` errors
+    never cross the boundary in either direction.  ``code`` is the wire
+    error-frame code the class round-trips through."""
+
+    code = "wire"
+
+    def __init__(self, msg: str = "", **context):
+        super().__init__(msg)
+        #: JSON-able detail that rode the error frame (reason, tenant,
+        #: req_id, ...) — mirrors AdmissionRejected's context dict
+        self.context = dict(context)
+
+
+class WireHelloMismatch(WireError):
+    """The versioned hello failed: wrong magic, wrong protocol version,
+    or a non-hello first frame.  Connection-fatal by contract (there is
+    no common dialect to continue in), but still delivered as a typed
+    error frame before the close."""
+
+    code = "hello_mismatch"
+
+
+class AuthRejected(WireError):
+    """The boundary check refused the caller BEFORE any bytes reached a
+    ServingLoop: unknown token at hello (connection-fatal) or a submit
+    naming a tenant outside the token's grant (per-request; the
+    connection and its other in-flight requests live on)."""
+
+    code = "auth"
+
+
+class WireBackpressure(WireError):
+    """The per-connection pipelining window is full: the server refuses
+    the submit with a typed frame instead of buffering unboundedly or
+    dropping the connection.  Retryable — drain some in-flight
+    responses and resubmit."""
+
+    code = "backpressure"
+    retryable = True
+
+
+class PeerClosed(WireError):
+    """The peer vanished mid-pipeline (conn_drop fault, process death,
+    network partition): every in-flight request on the connection fails
+    with this, typed, instead of raw ``ConnectionResetError`` /
+    ``BrokenPipeError`` shapes.  Retryable on a fresh connection — the
+    server never dispatched-and-dropped silently (an admitted request's
+    outcome frame was simply lost with the socket)."""
+
+    code = "peer_closed"
+    retryable = True
+
+
+class RemoteFailed(WireError):
+    """A server-side ticket failed with an exception class the client
+    could not re-hydrate into a local type (the error frame carries the
+    class name + message in ``context``).  The catch-all that keeps the
+    no-raw-escapes contract total."""
+
+    code = "failed"
 
 
 class TornJournalTail(CorruptInput):

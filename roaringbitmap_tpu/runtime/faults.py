@@ -19,7 +19,12 @@ kind   one of ``transient`` (retryable device hiccup), ``oom`` (device
        ``crash`` (simulated process death at the durability layer's
        journal/apply seams only — ``maybe_crash`` below; the special
        scope ``@torn`` additionally tears the journal's last record
-       mid-frame before dying, the classic torn-write shape).
+       mid-frame before dying, the classic torn-write shape),
+       ``wire`` (RPC-boundary faults only — ``maybe_wire`` below; the
+       scope is REQUIRED and picks the shape: ``@conn_drop`` drops the
+       socket mid-pipeline, ``@slow_peer`` advances the fault clock on
+       the response path, ``@garbage`` corrupts an outgoing frame so
+       the receiver dies typed ``CorruptInput``).
 scope  optional dispatch-site name ("batch_engine", "aggregation",
        "sharding", "multihost") or engine rung ("pallas", "xla",
        "xla-vmap", "sharded", "coordinator"); omitted = everywhere.
@@ -66,12 +71,18 @@ from . import errors
 ENV_VAR = "ROARING_TPU_FAULTS"
 
 KINDS = ("transient", "oom", "lowering", "corrupt", "coordinator", "silent",
-         "slow", "crash")
+         "slow", "crash", "wire")
 #: kinds that raise at the boundary (silent corrupts results in place,
 #: slow advances the fault clock, crash only fires at the durability
-#: layer's journal/apply seams via maybe_crash — none of the three raise
-#: from the generic engine-boundary hook)
+#: layer's journal/apply seams via maybe_crash, wire only fires at the
+#: RPC boundary via maybe_wire — none of the four raise from the
+#: generic engine-boundary hook)
 RAISING_KINDS = KINDS[:5]
+
+#: scopes a ``wire`` rule must name (wire@conn_drop etc.): the peer
+#: vanishing mid-pipeline, a slow-loris peer (fault-clock latency on the
+#: response path), or a garbled/torn frame on the socket
+WIRE_SCOPES = ("conn_drop", "slow_peer", "garbage")
 
 #: virtual latency one firing ``slow`` rule injects, seconds — sized so a
 #: handful of fires blows a ms-scale serving deadline but a single fire
@@ -125,6 +136,10 @@ class FaultPlan:
             if kind not in KINDS:
                 raise ValueError(
                     f"unknown fault kind {kind!r} (one of {KINDS})")
+            if kind == "wire" and scope not in WIRE_SCOPES:
+                raise ValueError(
+                    f"wire faults need a scope in {WIRE_SCOPES}, got "
+                    f"{scope!r} in {entry!r}")
             if not 0.0 < rate <= 1.0:
                 raise ValueError(
                     f"fault rate must be in (0, 1], got {rate} in {entry!r}")
@@ -280,6 +295,40 @@ def maybe_crash(site: str, point: str | None = None,
             continue
         if plan._draw(i, f"{site}/{point}") < r.rate:
             return mode
+    return None
+
+
+def maybe_wire(site: str) -> str | None:
+    """The RPC-boundary hook (wire/server, wire/client): when a ``wire``
+    rule fires for ``site``, return its scope — the fault SHAPE the
+    caller must enact:
+
+      ``"conn_drop"``  close the socket mid-pipeline, no goodbye frame
+                       (in-flight requests on the peer must fail typed
+                       ``PeerClosed``, never raw ConnectionResetError);
+      ``"slow_peer"``  advance the fault clock by SLOW_LATENCY_S before
+                       the write — a slow-loris peer visible to every
+                       deadline reader, with zero real sleeping;
+      ``"garbage"``    corrupt the outgoing frame's payload bytes (CRC
+                       intact length, broken body) — the receiver must
+                       die typed ``CorruptInput``, never a raw struct/
+                       json error.
+
+    None when no rule fires.  Grammar: ``wire@<scope>[=rate]`` with the
+    scope REQUIRED (validated at parse time) — a scopeless wire fault
+    has no defined shape.  ``site`` keys the deterministic draw only
+    (``wire.server`` / ``wire.client``), so server- and client-side
+    schedules are independent streams off one seed."""
+    plan = active()
+    if plan is None:
+        return None
+    for i, r in enumerate(plan.rules):
+        if r.kind != "wire":
+            continue
+        if plan._draw(i, f"{site}/{r.scope}") < r.rate:
+            if r.scope == "slow_peer":
+                advance_clock(SLOW_LATENCY_S)
+            return r.scope
     return None
 
 

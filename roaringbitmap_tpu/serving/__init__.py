@@ -38,6 +38,8 @@ from .loop import (AdmissionRejected, PumpDriver, RequestShed,
 from .migration import (MigrationError, MigrationSession,
                         begin_migration, host_join, host_leave,
                         migrate_tenant, restore_host_tenants)
+from .replay import (ReplayProfile, build_dataset, generate,
+                     run_inproc, run_wire, sustained)
 from .resident import (DescriptorRing, ResidentEscape, ResidentQueue,
                        RingBackpressure)
 
@@ -47,4 +49,5 @@ __all__ = ["ServingLoop", "ServingPolicy", "ServingRequest",
            "DescriptorRing", "ResidentEscape", "RingBackpressure",
            "MigrationSession", "MigrationError", "begin_migration",
            "migrate_tenant", "host_join", "host_leave",
-           "restore_host_tenants"]
+           "restore_host_tenants", "ReplayProfile", "build_dataset",
+           "generate", "run_inproc", "run_wire", "sustained"]

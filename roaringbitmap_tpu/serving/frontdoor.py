@@ -126,6 +126,11 @@ class PodFrontDoor:
         self._rate_t0 = faults.clock()
         self.stats = {"routed": 0, "forwarded": 0, "reroutes": 0,
                       "host_drops": 0, "single_demotions": 0}
+        #: remote-submission seam (wire/server): observers of every
+        #: completed-ticket batch this front door's pump produces —
+        #: registered HERE, not on the member loops, so reroutes are
+        #: already resolved when the wire layer sees an outcome
+        self._completion_listeners: list = []
         self._build()
         # plain obs.statusz() folds this front door's per-host docs in
         # (weakly held: a dropped front door silently leaves the report)
@@ -337,7 +342,26 @@ class PodFrontDoor:
             if self._single_loop is not None:
                 out.extend(self._single_loop.pump(force))
             self._push_gauges()
+            if out:
+                for fn in list(self._completion_listeners):
+                    try:
+                        fn(out)
+                    except Exception:
+                        _log.exception(
+                            "%s: completion listener failed", SITE)
             return out
+
+    def add_completion_listener(self, fn) -> None:
+        """Register a remote-submission observer (see
+        ``ServingLoop.add_completion_listener``; the wire server maps
+        completed tickets to response frames here)."""
+        with self._lock:
+            self._completion_listeners.append(fn)
+
+    def remove_completion_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._completion_listeners:
+                self._completion_listeners.remove(fn)
 
     def drain(self) -> list:
         """Force every queued request out (the stream-end flush)."""

@@ -368,6 +368,12 @@ class ServingLoop:
             self._resident.seal_vocab()
         self.stats = {"admitted": 0, "rejected": 0, "served": 0,
                       "shed": 0, "failed": 0, "pools": 0, "degraded": 0}
+        #: remote-submission seam (wire/server): callables invoked with
+        #: each non-empty completed-ticket batch from inside the pump
+        #: lock, so a wire front door sees EVERY outcome regardless of
+        #: who pumped (its own pump thread, a PumpDriver, or an
+        #: in-process caller) — no ticket can complete unobserved
+        self._completion_listeners: list = []
 
     # ------------------------------------------------------------ admission
 
@@ -494,7 +500,30 @@ class ServingLoop:
             if not progressed:
                 break
         self._queue_gauge()
+        self._notify_completions(out)
         return out
+
+    def add_completion_listener(self, fn) -> None:
+        """Register a remote-submission observer: ``fn(tickets)`` runs
+        under the loop lock with every non-empty completed batch (the
+        wire server maps each ticket to a response frame here)."""
+        with self._lock:
+            self._completion_listeners.append(fn)
+
+    def remove_completion_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._completion_listeners:
+                self._completion_listeners.remove(fn)
+
+    def _notify_completions(self, out: list) -> None:
+        if not out or not self._completion_listeners:
+            return
+        for fn in list(self._completion_listeners):
+            try:
+                fn(out)
+            except Exception:          # a broken observer must never
+                _log.exception(        # wedge the serving loop itself
+                    "%s: completion listener failed", SITE)
 
     def drain(self) -> list:
         """Force every queued request out (dispatch or shed) — the

@@ -194,11 +194,30 @@ def begin_migration(fd, sid: int, to_host: int) -> MigrationSession:
     return session
 
 
-def migrate_tenant(fd, sid: int, to_host: int, during=None) -> dict:
+def migrate_tenant(fd, sid: int, to_host: int | None = None,
+                   during=None, via=None, tenant: str | None = None
+                   ) -> dict:
     """One-shot live migration: begin -> copy -> [``during(fd)`` — the
     test/bench hook that drives traffic and deltas inside the dual-write
     window] -> finish.  Serves bit-exactly throughout; the whole move is
-    one ``pod.migrate`` span."""
+    one ``pod.migrate`` span.
+
+    ``via`` (a ``wire.WireClient``) switches the transport: when source
+    and destination are separate OS processes, the snapshot + journal
+    tail ship as wire frames to whatever server the client points at
+    (``to_host`` is then unused — the destination process installs the
+    tenant; docs/WIRE.md "Migration").  Same dual-write window, same
+    zero-non-expired-failure property, and the commit ACK's per-source
+    CRCs are verified against the source's own post-drain state."""
+    if via is not None:
+        from ..wire.migrate import migrate_tenant_wire
+
+        return migrate_tenant_wire(fd, sid, via, during=during,
+                                   tenant=tenant)
+    if to_host is None:
+        raise MigrationError(
+            "in-process migration needs to_host= (via= is the "
+            "cross-process transport)")
     with obs_trace.span("pod.migrate", site=SITE, set_id=int(sid),
                         to=str(int(to_host))) as sp:
         session = begin_migration(fd, sid, to_host)

@@ -1,0 +1,28 @@
+"""Binary RPC data plane: the network boundary of the serving stack.
+
+``wire`` turns the library-with-a-loop into a service (docs/WIRE.md):
+a length+CRC framed, versioned binary protocol over TCP whose bitmap
+payloads are the portable ``format/spec.py`` bytes verbatim, with
+per-connection request pipelining + frame coalescing, typed wire error
+frames for every outcome (admission rejections, sheds, auth refusals,
+backpressure — never a dropped connection), auth/tenancy checked at
+the boundary before any bytes reach a ServingLoop, and ``rpc.*`` spans
+riding the trace-propagation envelope across the socket.
+
+- :mod:`.protocol` — frame grammar + codecs (transport-free);
+- :mod:`.server` — threaded front door over a ServingLoop/PodFrontDoor;
+- :mod:`.client` — pipelining client (``submit_many`` coalesces);
+- :mod:`.migrate` — live tenant migration streamed as wire frames;
+- :mod:`.bootstrap` — ``python -m roaringbitmap_tpu.wire.bootstrap``:
+  a deterministic second-process server for tests and benches.
+"""
+
+from .client import WireClient, WireTicket
+from .migrate import WireMigrationSession, migrate_tenant_wire
+from .protocol import (MAX_FRAME_BYTES, WIRE_MAGIC, WIRE_VERSION,
+                       WireResult)
+from .server import WireServer
+
+__all__ = ["WireServer", "WireClient", "WireTicket", "WireResult",
+           "WireMigrationSession", "migrate_tenant_wire",
+           "WIRE_MAGIC", "WIRE_VERSION", "MAX_FRAME_BYTES"]

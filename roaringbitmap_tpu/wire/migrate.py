@@ -1,0 +1,234 @@
+"""Live tenant migration over the wire (docs/WIRE.md "Migration").
+
+The in-process :class:`serving.migration.MigrationSession` hands the
+captured snapshot dict to ``durability.restore_state`` directly — fine
+inside one process, useless across two.  This module ships the SAME
+snapshot (``durability.capture_state``, spec.py bitmap bytes verbatim)
+plus the journal-tail catch-up records as wire frames when source and
+destination are separate OS processes:
+
+    MIG_BEGIN  {mig_id, tenant, meta}       snapshot metadata, blob
+                                            slots as {"__blob__": i}
+    MIG_STATE  {mig_id} + blobs             snapshot bytes, chunked
+    MIG_DELTA  {mig_id, records: [...]}     journal-vocabulary records
+                                            (the dual-write window's
+                                            catch-up tail)
+    MIG_COMMIT {mig_id}                     destination restores +
+                                            replays + installs
+    MIG_ACK    {source_crcs, bytes, ...}    bit-exactness evidence
+
+The destination re-applies records through ``durability.replay_record``
+— replay is apply, so the commit ACK's per-source CRCs must equal the
+source's own post-drain CRCs; :func:`migrate_tenant_wire` checks that
+pin and reports the mismatch typed.  The source keeps serving the
+tenant untouched throughout (ownership of the local routing tables
+never moves — the REMOTE process gains a bit-exact live twin), so the
+zero-non-expired-failure property of in-process migration holds by
+construction.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+
+from ..mutation import delta as mut_delta
+from ..mutation import durability
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..runtime import errors
+from . import protocol as wp
+
+SITE = "wire"
+
+#: blob bytes per MIG_STATE frame before a new frame starts (well under
+#: protocol.MAX_FRAME_BYTES; small enough to interleave with traffic)
+STATE_CHUNK_BYTES = 4 << 20
+#: catch-up records per MIG_DELTA frame
+DELTA_CHUNK_RECORDS = 64
+
+
+# ------------------------------------------------------ state flattening
+
+def flatten_state(state: dict) -> tuple:
+    """Snapshot dict -> (pure-JSON meta, ordered blob list): every
+    ``bytes`` value is replaced by ``{"__blob__": index}`` so the
+    metadata rides a frame header and the bitmap bytes ride as frame
+    blobs verbatim."""
+    blobs: list = []
+
+    def walk(v):
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            blobs.append(bytes(v))
+            return {"__blob__": len(blobs) - 1}
+        if isinstance(v, dict):
+            return {k: walk(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [walk(x) for x in v]
+        return v
+
+    return walk(dict(state)), blobs
+
+
+def unflatten_state(meta, blobs: list) -> dict:
+    """Inverse of :func:`flatten_state`; malformed slots die typed."""
+
+    def walk(v):
+        if isinstance(v, dict):
+            if set(v.keys()) == {"__blob__"}:
+                i = int(v["__blob__"])
+                if not 0 <= i < len(blobs):
+                    raise errors.CorruptInput(
+                        f"{SITE}: migration blob slot {i} out of range "
+                        f"(got {len(blobs)} blobs)")
+                return blobs[i]
+            return {k: walk(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [walk(x) for x in v]
+        return v
+
+    out = walk(meta)
+    if not isinstance(out, dict):
+        raise errors.CorruptInput(
+            f"{SITE}: migration meta is not an object")
+    return out
+
+
+def source_crcs(ds) -> list:
+    """Per-source CRC32 of the spec.py serialization — the bit-exact
+    fingerprint both ends of a wire migration compare."""
+    return [zlib.crc32(bm.serialize())
+            for bm in mut_delta.host_bitmaps(ds)]
+
+
+# --------------------------------------------------------- source session
+
+class WireMigrationSession:
+    """Source half of a cross-process migration: rides the front door's
+    dual-write window (``fd._dual_writes``) exactly like the in-process
+    session, but forwards the snapshot and catch-up tail as frames."""
+
+    def __init__(self, fd, sid: int, client, tenant: str | None = None):
+        self.fd = fd
+        self.sid = int(sid)
+        self.client = client
+        self.tenant = tenant or f"sid{int(sid)}"
+        self.mig_id = f"{self.tenant}-{id(self):x}"
+        self.state: dict | None = None
+        self.bytes_streamed = 0
+        self._records: list = []      # journal-vocabulary catch-up tail
+        self._seq = 0
+        self.trace_ctx = obs_trace.inject()
+
+    # the dual-write window hook (called by PodFrontDoor.apply_delta
+    # under the front-door lock)
+    def on_delta(self, adds, removes, repack: str = "auto") -> None:
+        with obs_trace.span_from(self.trace_ctx, "pod.dual_write",
+                                 site=SITE, set_id=self.sid,
+                                 to="wire", buffered=True):
+            self._seq += 1
+            self._records.append({
+                "kind": "delta", "seq": self._seq,
+                "adds": durability._jsonable_delta(adds or {}),
+                "removes": durability._jsonable_delta(removes or {})})
+
+    def begin(self) -> None:
+        from ..serving.migration import MigrationError
+
+        fd, sid = self.fd, self.sid
+        if fd.plan.regime(sid) == "sharded":
+            raise MigrationError(
+                f"tenant {sid} is sharded-regime: it already spans "
+                f"every pod host — it has no single image to ship")
+        with fd._lock:
+            if sid in fd._dual_writes:
+                raise MigrationError(
+                    f"tenant {sid} is already migrating")
+            self.state = durability.capture_state(fd._sets[sid],
+                                                  tenant=self.tenant)
+            fd._dual_writes[sid] = self
+
+    def copy(self) -> None:
+        """Ship the snapshot: BEGIN + chunked STATE frames, pipelined
+        in one coalesced write, acked by the destination."""
+        meta, blobs = flatten_state(self.state)
+        frames = [(wp.T_MIG_BEGIN,
+                   {"mig_id": self.mig_id, "tenant": self.tenant,
+                    "meta": meta}, ())]
+        chunk: list = []
+        size = 0
+        for b in blobs:
+            chunk.append(b)
+            size += len(b)
+            if size >= STATE_CHUNK_BYTES:
+                frames.append((wp.T_MIG_STATE,
+                               {"mig_id": self.mig_id,
+                                "tenant": self.tenant}, tuple(chunk)))
+                chunk, size = [], 0
+        if chunk:
+            frames.append((wp.T_MIG_STATE,
+                           {"mig_id": self.mig_id,
+                            "tenant": self.tenant}, tuple(chunk)))
+        self.bytes_streamed = sum(len(b) for b in blobs)
+        obs_metrics.counter("rb_migration_bytes_total").inc(
+            self.bytes_streamed)
+        self.client.migrate_frames(frames)
+
+    def finish(self) -> dict:
+        """Drain the catch-up tail, commit on the destination, verify
+        the bit-exact pin, close the dual-write window."""
+        fd, sid = self.fd, self.sid
+        t0 = time.perf_counter()
+        with fd._lock:
+            records, self._records = self._records, []
+            fd._dual_writes.pop(sid, None)
+            local_crcs = source_crcs(fd._sets[sid])
+        frames = []
+        for i in range(0, len(records), DELTA_CHUNK_RECORDS):
+            frames.append((wp.T_MIG_DELTA,
+                           {"mig_id": self.mig_id, "tenant": self.tenant,
+                            "records":
+                                records[i:i + DELTA_CHUNK_RECORDS]}, ()))
+        frames.append((wp.T_MIG_COMMIT,
+                       {"mig_id": self.mig_id, "tenant": self.tenant},
+                       ()))
+        ack = self.client.migrate_frames(frames)
+        blip_ms = (time.perf_counter() - t0) * 1e3
+        remote_crcs = list(ack.get("source_crcs") or ())
+        if remote_crcs != local_crcs:
+            raise errors.ShadowMismatch(
+                f"{SITE}: migrated tenant {self.tenant!r} diverged from "
+                f"the source after catch-up: remote CRCs {remote_crcs} "
+                f"!= local {local_crcs}")
+        return {"set_id": sid, "tenant": self.tenant, "to": "wire",
+                "bytes": self.bytes_streamed,
+                "catch_up_records": len(records),
+                "source_crcs": local_crcs,
+                "blip_ms": round(blip_ms, 3)}
+
+
+def migrate_tenant_wire(fd, sid: int, client, during=None,
+                        tenant: str | None = None) -> dict:
+    """One-shot cross-process migration: begin -> copy -> [``during``
+    drives traffic + deltas inside the dual-write window] -> finish.
+    The whole move is one ``pod.migrate`` span (``to="wire"``) with
+    ``rpc.*`` spans nested under the frame exchanges."""
+    with obs_trace.span("pod.migrate", site=SITE, set_id=int(sid),
+                        to="wire") as sp:
+        session = WireMigrationSession(fd, sid, client, tenant=tenant)
+        session.begin()
+        try:
+            session.copy()
+            if during is not None:
+                during(fd)
+            report = session.finish()
+        except BaseException:
+            with fd._lock:
+                fd._dual_writes.pop(int(sid), None)
+            obs_metrics.counter("rb_migration_total",
+                                status="failed").inc()
+            raise
+        sp.tag(bytes=report["bytes"], blip_ms=report["blip_ms"],
+               records=report["catch_up_records"])
+        obs_metrics.counter("rb_migration_total", status="ok").inc()
+    return report

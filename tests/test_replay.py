@@ -1,0 +1,293 @@
+"""Pod replay harness acceptance (ISSUE 20).
+
+Pins:
+- the seeded generator is deterministic (same profile -> identical
+  datasets AND identical event streams, the property cross-process
+  parity rests on) and actually mixed (flat + expression + analytics +
+  delta events, Zipf-skewed tenants, nondecreasing diurnal arrivals);
+- the in-process arm runs on the fault clock with ``replay_stream``
+  semantics: full attainment under easy deadlines, typed-only outcomes
+  and shed/rejected accounting under an overload ladder;
+- ``sustained`` picks the highest ladder rung clearing the SLO target;
+- group-commit durability (``FlushPolicy(mode="group")``): one fsync
+  covers many tenants' appends (``rb_journal_group_commits_total``),
+  fsyncs per applied delta drop vs ``always``, and a crash between
+  group members recovers bit-exactly at every armed crash point.
+"""
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import RoaringBitmap, obs
+from roaringbitmap_tpu.mutation import delta as mut_delta
+from roaringbitmap_tpu.mutation.durability import (DurableTenant,
+                                                   FlushPolicy,
+                                                   GroupCommitScheduler,
+                                                   recover_tenant)
+from roaringbitmap_tpu.parallel import expr
+from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+from roaringbitmap_tpu.parallel.multiset import MultiSetBatchEngine
+from roaringbitmap_tpu.runtime import errors, faults, guard
+from roaringbitmap_tpu.serving import (ServingLoop, ServingPolicy,
+                                       replay)
+
+NOSLEEP = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)
+
+PROFILE = replay.ReplayProfile(sets=2, sources=6, tenants=6,
+                               density=500, users=1 << 16,
+                               requests=80, duration_s=1.0, seed=21)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.disable()
+    obs.reset()
+    faults.reset_clock()
+    yield
+    obs.disable()
+    obs.reset()
+    faults.reset_clock()
+
+
+def _loop(profile=PROFILE, **kw):
+    bitmap_sets, columns = replay.build_dataset(profile)
+    sets = [DeviceBitmapSet(b, layout="dense") for b in bitmap_sets]
+    replay.attach_columns(sets, profile, columns)
+    kw.setdefault("pool_target", 4)
+    kw.setdefault("guard", NOSLEEP)
+    kw.setdefault("default_deadline_ms", 300_000.0)
+    return ServingLoop(MultiSetBatchEngine(sets), ServingPolicy(**kw))
+
+
+# ------------------------------------------------------------- generator
+
+def test_dataset_and_stream_deterministic():
+    """Two independent builds from one profile agree bit for bit — the
+    foundation of cross-process parity without shipping data."""
+    a_sets, a_cols = replay.build_dataset(PROFILE)
+    b_sets, b_cols = replay.build_dataset(PROFILE)
+    for sa, sb in zip(a_sets, b_sets):
+        for x, y in zip(sa, sb):
+            assert np.array_equal(x.to_array(), y.to_array())
+    for ca, cb in zip(a_cols, b_cols):
+        assert np.array_equal(ca[0], cb[0])
+        assert np.array_equal(ca[1], cb[1])
+    from roaringbitmap_tpu.wire import protocol as wp
+
+    ev_a, ev_b = replay.generate(PROFILE), replay.generate(PROFILE)
+    assert len(ev_a) == len(ev_b) == PROFILE.requests
+    for ea, eb in zip(ev_a, ev_b):
+        assert ea[0] == eb[0] and ea[1] == eb[1]
+        if ea[0] == "query":
+            # the wire codec is the canonical form (AdHoc leaves have
+            # no stable repr): identical header + identical blob bytes
+            assert wp.encode_query(ea[2].query) \
+                == wp.encode_query(eb[2].query)
+            assert ea[2].tenant == eb[2].tenant
+
+
+def test_stream_is_mixed_skewed_and_ordered():
+    profile = replay.ReplayProfile(sets=2, sources=6, tenants=8,
+                                   density=500, users=1 << 16,
+                                   requests=400, duration_s=4.0,
+                                   zipf_alpha=1.3, seed=3)
+    events = replay.generate(profile)
+    times = [e[1] for e in events]
+    assert times == sorted(times)             # nondecreasing arrivals
+    kinds = {"flat": 0, "expression": 0, "analytics": 0, "delta": 0}
+    per_tenant: dict = {}
+    for e in events:
+        if e[0] == "delta":
+            kinds["delta"] += 1
+            continue
+        q = e[2].query
+        if isinstance(q, expr.ExprQuery):
+            kinds["analytics" if expr.is_agg(q.expr)
+                  or _has_pred(q.expr) else "expression"] += 1
+        else:
+            kinds["flat"] += 1
+        per_tenant[e[2].tenant] = per_tenant.get(e[2].tenant, 0) + 1
+    assert all(v > 0 for v in kinds.values()), kinds
+    counts = sorted(per_tenant.values(), reverse=True)
+    assert counts[0] >= 3 * counts[-1]        # Zipf skew is real
+
+
+def _has_pred(e):
+    if isinstance(e, expr.ValuePred):
+        return True
+    if isinstance(e, expr.Agg):
+        return True
+    if isinstance(e, expr.Node):
+        return any(_has_pred(c) for c in e.children)
+    return False
+
+
+# ---------------------------------------------------------- in-process arm
+
+def test_run_inproc_full_attainment_under_easy_deadline():
+    loop = _loop()
+    rep = replay.run_inproc(loop, replay.generate(PROFILE))
+    assert rep["queries"] + rep["deltas"] == PROFILE.requests
+    assert rep["done"] == rep["queries"]
+    assert rep["attainment"] == 1.0
+    assert rep["typed_only"]
+    assert rep["p99_ms"] >= rep["p50_ms"] >= 0.0
+
+
+def test_run_inproc_overload_is_typed_and_accounted():
+    """A tight deadline + compressed arrivals: sheds and rejections
+    appear, every one typed, and the counts reconcile exactly."""
+    profile = replay.ReplayProfile(
+        sets=2, sources=6, tenants=6, density=500, users=1 << 16,
+        requests=60, duration_s=0.5, deadline_ms=1.0, seed=21)
+    loop = _loop(profile, max_queue=4)
+    rep = replay.run_inproc(loop, replay.generate(profile),
+                            rate_scale=50.0)
+    assert rep["typed_only"], rep
+    assert (rep["done"] + rep["shed"] + rep["failed"]
+            + rep["rejected"]) == rep["queries"]
+    assert rep["shed"] + rep["rejected"] > 0, rep
+    assert rep["attainment"] < 1.0
+
+
+def test_sustained_picks_highest_clearing_rung():
+    reports = {1.0: {"qps": 100.0, "attainment": 0.99, "p99_ms": 5.0,
+                     "typed_only": True},
+               2.0: {"qps": 180.0, "attainment": 0.93, "p99_ms": 9.0,
+                     "typed_only": True},
+               4.0: {"qps": 200.0, "attainment": 0.55, "p99_ms": 40.0,
+                     "typed_only": True}}
+
+    def run_one(rate):
+        r = dict(reports[rate])
+        r.update(queries=1, deltas=0, done=1, shed=0, failed=0,
+                 rejected=0, p50_ms=1.0, wall_s=1.0)
+        return r
+
+    out = replay.sustained(run_one, [1.0, 2.0, 4.0], slo_target=0.9)
+    assert out["sustained_rate_x"] == 2.0
+    assert out["sustained_qps"] == 180.0
+    assert len(out["ladder"]) == 3
+
+
+# ----------------------------------------------------------- group commit
+
+def _mk_ds(seed):
+    rng = np.random.default_rng(seed)
+    return DeviceBitmapSet([RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 14, 300).astype(np.uint32)))
+        for _ in range(3)], layout="dense")
+
+
+def _counter_total(name):
+    return sum(r["value"]
+               for r in obs.snapshot()["counters"].get(name, []))
+
+
+def test_group_commit_amortizes_fsyncs(tmp_path):
+    """One scheduler, 4 tenants: the fsyncs-per-applied-delta ratio
+    must come in strictly below ``always`` (1.0), and the group-commit
+    counter must tick."""
+    sched = GroupCommitScheduler(every_n=8)
+    tenants = [DurableTenant(_mk_ds(40 + i), root=str(tmp_path),
+                             tenant=f"t{i}", policy=sched.policy())
+               for i in range(4)]
+    f0 = _counter_total("rb_journal_fsyncs_total")
+    applies = 0
+    for k in range(6):
+        for t in tenants:
+            t.apply_delta(adds={k % 3: np.array([60000 + k], np.uint32)})
+            applies += 1
+    sched.commit()                            # shutdown barrier
+    fsyncs = _counter_total("rb_journal_fsyncs_total") - f0
+    commits = _counter_total("rb_journal_group_commits_total")
+    assert commits >= 2
+    assert fsyncs < applies, (fsyncs, applies)
+    assert sched.stats["appends"] == applies
+    ref = [[bm.serialize() for bm in mut_delta.host_bitmaps(t.ds)]
+           for t in tenants]
+    for t in tenants:
+        t.close()
+    for i in range(4):
+        rec, _ = recover_tenant(root=str(tmp_path), tenant=f"t{i}",
+                                policy=FlushPolicy(mode="never"))
+        got = [bm.serialize() for bm in mut_delta.host_bitmaps(rec.ds)]
+        assert got == ref[i], f"t{i} lost a group-buffered record"
+        rec.close()
+
+
+@pytest.mark.parametrize("point", ["pre_append", "pre_apply", "torn",
+                                   "post_apply"])
+def test_group_commit_crash_between_members_bit_exact(tmp_path, point):
+    """Crash while one group member is mid-append: BOTH tenants recover
+    bit-exactly vs never-crashed host oracles — the un-acked record is
+    lost or kept exactly as its own journal says, never cross-tenant."""
+    root = str(tmp_path / point)
+    sched = GroupCommitScheduler(every_n=3)
+    tenants = [DurableTenant(_mk_ds(70 + i), root=root, tenant=f"g{i}",
+                             policy=sched.policy()) for i in range(2)]
+    oracles = [_oracle(70 + i) for i in range(2)]
+    rng = np.random.default_rng(9)
+
+    def step(k):
+        return {int(rng.integers(3)):
+                np.unique(rng.integers(0, 1 << 14, 12)).astype(
+                    np.uint32)}
+
+    k = 0
+    crashed_i = None
+    with faults.inject(f"crash@{point}=0.25:5"):
+        try:
+            for k in range(10):
+                for i, t in enumerate(tenants):
+                    crashed_i = i
+                    adds = step(k)
+                    t.apply_delta(adds=adds)
+                    _oracle_apply(oracles[i], adds)
+        except errors.InjectedCrash:
+            pass
+        else:
+            pytest.skip(f"crash@{point} never fired in 20 applies")
+    committed = point in ("pre_apply", "post_apply")
+    if committed:
+        # the crashing tenant's record IS durable: oracle keeps it
+        _oracle_apply(oracles[crashed_i], adds)
+    for t in tenants:
+        t.journal.close()
+    for i in range(2):
+        rec, report = recover_tenant(root=root, tenant=f"g{i}",
+                                     policy=FlushPolicy(mode="never"))
+        got = [bm.serialize() for bm in mut_delta.host_bitmaps(rec.ds)]
+        want = [bm.serialize() for bm in oracles[i]]
+        assert got == want, (f"tenant g{i} diverged after crash at "
+                             f"{point} (crashing member: g{crashed_i})")
+        if i == crashed_i:
+            assert report["torn"] == (point == "torn")
+        rec.close()
+
+
+def _oracle(seed):
+    rng = np.random.default_rng(seed)
+    return [RoaringBitmap.from_values(np.unique(
+        rng.integers(0, 1 << 14, 300).astype(np.uint32)))
+        for _ in range(3)]
+
+
+def _oracle_apply(hosts, adds):
+    for src, vs in adds.items():
+        a = RoaringBitmap()
+        a.add_many(np.asarray(vs, np.uint32))
+        hosts[src] = hosts[src] | a
+
+
+def test_group_policy_validation():
+    with pytest.raises(ValueError):
+        FlushPolicy(mode="group")             # no scheduler handle
+    with pytest.raises(ValueError):
+        FlushPolicy(mode="group", every_n=0,
+                    group=GroupCommitScheduler())
+    with pytest.raises(ValueError):
+        GroupCommitScheduler(every_n=0)
+    p = GroupCommitScheduler(every_n=5).policy()
+    assert p.mode == "group" and p.every_n == 5

@@ -1,0 +1,564 @@
+"""Binary wire front door acceptance (ISSUE 20).
+
+Pins:
+- a REAL second OS process (``wire.bootstrap`` subprocess) serves mixed
+  flat/expression/analytics traffic over TCP bit-exactly vs the local
+  reference engine built from the same seeded dataset;
+- pipelined submission completes OUT OF ORDER by req_id — the client's
+  observed completion order is the server's completion order, not the
+  submission order;
+- every overload outcome is a typed wire error frame on the LIVE
+  connection: admission rejections, backpressure past the in-flight
+  cap, auth/tenant refusals, malformed frames (CorruptInput) — never a
+  silent drop, never a raw socket/struct escape;
+- ``wire@{conn_drop,slow_peer,garbage}`` fault rules die as typed
+  ``PeerClosed`` / ``CorruptInput`` / fault-clock latency;
+- live migration over the wire lands a bit-exact twin (per-source CRC
+  pin) with catch-up deltas from the dual-write window;
+- the slow-lane soak replays the Zipf/diurnal generator over the wire
+  under fault injection with typed-only failures.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from roaringbitmap_tpu import obs
+from roaringbitmap_tpu.mutation import delta as mut_delta
+from roaringbitmap_tpu.parallel import (MultiSetBatchEngine, expr,
+                                        podmesh)
+from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+from roaringbitmap_tpu.runtime import errors, faults, guard
+from roaringbitmap_tpu.serving import (PodFrontDoor, ServingLoop,
+                                       ServingPolicy, ServingRequest,
+                                       Ticket, migrate_tenant, replay)
+from roaringbitmap_tpu.wire import (WireClient, WireServer,
+                                    migrate_tenant_wire)
+from roaringbitmap_tpu.wire import protocol as wp
+
+NOSLEEP = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)
+EASY_MS = 300_000.0
+
+PROFILE = replay.ReplayProfile(sets=2, sources=6, tenants=4,
+                               density=600, users=1 << 16, seed=11)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.disable()
+    obs.reset()
+    faults.reset_clock()
+    yield
+    obs.disable()
+    obs.reset()
+    faults.reset_clock()
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    return replay.build_dataset(PROFILE)
+
+
+def _sets(dataset):
+    sets = [DeviceBitmapSet(b, layout="dense") for b in dataset[0]]
+    replay.attach_columns(sets, PROFILE, dataset[1])
+    return sets
+
+
+def _loop(dataset, **kw):
+    kw.setdefault("pool_target", 4)
+    kw.setdefault("guard", NOSLEEP)
+    kw.setdefault("default_deadline_ms", EASY_MS)
+    return ServingLoop(MultiSetBatchEngine(_sets(dataset)),
+                       ServingPolicy(**kw))
+
+
+def _requests(n, seed=5, n_sets=2, n_sources=6):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        sid = int(rng.integers(n_sets))
+        form = "bitmap" if i % 3 == 0 else "cardinality"
+        if i % 5 == 2:
+            q = expr.ExprQuery(
+                expr.and_(expr.or_(0, 1), expr.not_(2)), form=form)
+        elif i % 5 == 4:
+            q = expr.ExprQuery(expr.sum_("v", expr.or_(0, 1)),
+                               form="cardinality")
+        else:
+            op = ("or", "and", "xor", "andnot")[int(rng.integers(4))]
+            k = int(rng.integers(2, 5))
+            q = BatchQuery(op, tuple(int(x) for x in rng.choice(
+                n_sources, size=k, replace=False)), form=form)
+        out.append(ServingRequest(sid, q, tenant=f"t{sid}"))
+    return out
+
+
+def _assert_wire_exact(engine, req, res):
+    ref = engine._engines[req.set_id]._sequential_result(req.query)
+    assert res.cardinality == ref.cardinality, req
+    if req.query.form == "bitmap" and not res.degraded:
+        assert res.bitmap == ref.bitmap, req
+    if ref.value is not None:
+        assert res.value == ref.value, req
+
+
+# ----------------------------------------------------- loopback data plane
+
+def test_hello_welcome_and_ping(dataset):
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        assert cl.server["version"] == wp.WIRE_VERSION
+        assert cl.server["n_sets"] == 2
+        cl.ping()
+        cl.close()
+
+
+def test_loopback_parity_all_query_shapes(dataset):
+    """Flat, expression, and analytics queries (both forms) served over
+    TCP are bit-exact vs the sequential per-set reference."""
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        reqs = _requests(20)
+        tickets = cl.submit_many(reqs)
+        for t, r in zip(tickets, reqs):
+            _assert_wire_exact(loop._engine, r, t.value(timeout=60))
+        cl.close()
+
+
+def test_bad_magic_is_typed_hello_mismatch(dataset):
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        s = socket.create_connection(srv.address, timeout=5)
+        s.sendall(b"NOTMAGIC" + wp.encode_frame(
+            wp.T_HELLO, 0, {"version": wp.WIRE_VERSION}))
+        ftype, req_id, h, _ = wp.read_frame(s)
+        assert ftype == wp.T_ERROR and h["code"] == "hello_mismatch"
+        s.close()
+
+
+def test_version_skew_is_typed(dataset):
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        s = socket.create_connection(srv.address, timeout=5)
+        s.sendall(wp.WIRE_MAGIC + wp.encode_frame(
+            wp.T_HELLO, 0, {"version": 999}))
+        ftype, _, h, _ = wp.read_frame(s)
+        assert ftype == wp.T_ERROR and h["code"] == "hello_mismatch"
+        s.close()
+
+
+def test_garbage_inbound_dies_as_corrupt_input(dataset):
+    """A garbled inbound frame loses framing sync: the server answers
+    ONE connection-level typed CorruptInput frame, then closes — no raw
+    struct/socket error anywhere."""
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        t = cl._reserve()                     # in flight when sync dies
+        good = wp.encode_frame(wp.T_PING, 99, {})
+        with cl._wlock:
+            cl._sock.sendall(wp.garble(good))
+        t.wait(10)
+        assert t.status == "failed"
+        assert isinstance(t.error, errors.CorruptInput)
+        cl.close()
+
+
+# ----------------------------------------------- pipelining + out of order
+
+class _LifoTarget:
+    """Completes every drained batch in REVERSE submission order — a
+    deterministic out-of-order completer for pipelining pins."""
+
+    n_sets = 1
+
+    def __init__(self):
+        # reentrant by the target contract (ServingLoop and
+        # PodFrontDoor both expose an RLock): the server nests a
+        # burst-wide acquisition around the per-submit one
+        self._lock = threading.RLock()
+        self._listeners = []
+        self._q = []
+
+    def add_completion_listener(self, fn):
+        self._listeners.append(fn)
+
+    def remove_completion_listener(self, fn):
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    def submit(self, request, arrival=None):
+        t = Ticket(request=request)
+        self._q.append(t)
+        return t
+
+    def backlog(self):
+        return len(self._q)
+
+    def pump(self, force=False):
+        return []
+
+    def drain(self):
+        out, self._q = list(reversed(self._q)), []
+        for t in out:
+            t.status = "done"
+            from roaringbitmap_tpu.parallel.batch_engine import BatchResult
+            t.result = BatchResult(cardinality=t.request.set_id,
+                                   bitmap=None, value=None)
+            t.missed = False
+        for fn in list(self._listeners):
+            fn(out)
+        return out
+
+
+def test_pipelined_completion_is_out_of_order():
+    """N pipelined submits on ONE connection complete in the server's
+    order (here: deterministically reversed), resolved by req_id."""
+    with WireServer(_LifoTarget(), coalesce_s=0.05) as srv:
+        cl = WireClient(srv.address)
+        reqs = [ServingRequest(0, BatchQuery("or", (0, 1)),
+                               tenant="t") for _ in range(8)]
+        tickets = cl.submit_many(reqs)
+        for t in tickets:
+            t.wait(30)
+        assert all(t.ok for t in tickets)
+        ids = [t.req_id for t in tickets]
+        assert cl.completion_order == list(reversed(ids))
+        cl.close()
+
+
+class _StuckTarget(_LifoTarget):
+    """Accepts submits but never completes them — the backpressure
+    window fills and stays full."""
+
+    def drain(self):
+        return []
+
+
+def test_backpressure_past_inflight_cap_is_typed():
+    with WireServer(_StuckTarget(), max_inflight=3) as srv:
+        cl = WireClient(srv.address)
+        reqs = [ServingRequest(0, BatchQuery("or", (0, 1)), tenant="t")
+                for _ in range(6)]
+        tickets = cl.submit_many(reqs)
+        # frames process in order: the first 3 admit (and sit in the
+        # stuck target forever), the overflow 3 answer typed at once
+        bp = [t for t in tickets[3:] if t._event.wait(10)]
+        assert len(bp) == 3, [t.status for t in tickets]
+        for t in bp:
+            assert t.status == "failed"
+            assert isinstance(t.error, errors.WireBackpressure)
+            assert t.error.retryable and t.error.context["cap"] == 3
+        assert all(t.status == "pending" for t in tickets[:3])
+        # the connection survived: a ping still round-trips
+        cl.ping()
+        cl.close()
+
+
+def test_admission_rejection_rides_the_wire_typed(dataset):
+    """A full tenant queue rejects typed over the wire; the connection
+    keeps serving afterwards."""
+    loop = _loop(dataset, max_queue=2, pool_target=64)
+    with WireServer(loop, coalesce_s=0.05) as srv:
+        cl = WireClient(srv.address)
+        q = BatchQuery("or", (0, 1, 2))
+        reqs = [ServingRequest(0, q, tenant="t0") for _ in range(10)]
+        tickets = cl.submit_many(reqs)
+        for t in tickets:
+            t.wait(60)
+        rejected = [t for t in tickets if t.status == "failed"]
+        assert rejected, "queue cap 2 never rejected out of 10"
+        for t in rejected:
+            from roaringbitmap_tpu.serving import AdmissionRejected
+            assert isinstance(t.error, AdmissionRejected)
+            assert t.error.reason == "queue_full"
+        done = [t for t in tickets if t.ok]
+        assert done and len(done) + len(rejected) == 10  # zero silent
+        cl.ping()
+        cl.close()
+
+
+# ----------------------------------------------------------- auth boundary
+
+def test_unknown_token_refused_before_any_submit(dataset):
+    loop = _loop(dataset)
+    with WireServer(loop, auth={"good": ["t0"]}) as srv:
+        with pytest.raises(errors.AuthRejected):
+            WireClient(srv.address, token="evil")
+        with pytest.raises(errors.AuthRejected):
+            WireClient(srv.address)          # missing token entirely
+        assert loop.stats["admitted"] == 0   # nothing reached the loop
+
+
+def test_tenant_grant_enforced_per_request(dataset):
+    loop = _loop(dataset)
+    with WireServer(loop, auth={"tok": ["t0"], "root": ["*"]}) as srv:
+        cl = WireClient(srv.address, token="tok")
+        q = BatchQuery("or", (0, 1, 2))
+        ok = cl.submit(ServingRequest(0, q, tenant="t0"))
+        bad = cl.submit(ServingRequest(0, q, tenant="t1"))
+        assert ok.value(60).cardinality >= 0
+        with pytest.raises(errors.AuthRejected) as ei:
+            bad.value(60)
+        assert ei.value.context["tenant"] == "t1"
+        cl.ping()                            # connection still live
+        cl.close()
+        root = WireClient(srv.address, token="root")
+        assert root.call(
+            ServingRequest(1, q, tenant="t1"), 60).cardinality >= 0
+        root.close()
+
+
+# --------------------------------------------------------- fault injection
+
+def test_wire_fault_conn_drop_fails_typed(dataset):
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        with faults.inject("wire@conn_drop=1.0:1"):
+            with pytest.raises(errors.PeerClosed):
+                cl.submit(ServingRequest(
+                    0, BatchQuery("or", (0, 1)), tenant="t0"))
+        cl.close()
+
+
+def test_wire_fault_garbage_on_response_fails_typed(dataset):
+    """Server-side garbled response frame: the client's reader loses
+    sync and fails everything in flight with CorruptInput — typed, not
+    a struct.error."""
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        t = cl.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                     tenant="t0"))
+        with faults.inject("wire@garbage=1.0:1"):
+            with pytest.raises(errors.CorruptInput):
+                t.value(30)
+        cl.close()
+
+
+def test_wire_fault_slow_peer_advances_fault_clock(dataset):
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        t0 = faults.clock()
+        with faults.inject("wire@slow_peer=1.0:1"):
+            t = cl.submit(ServingRequest(0, BatchQuery("or", (0, 1)),
+                                         tenant="t0"))
+            t.value(60)
+        assert faults.clock() - t0 >= faults.SLOW_LATENCY_S
+        cl.close()
+
+
+def test_wire_rule_requires_scope():
+    with pytest.raises(ValueError):
+        faults.FaultPlan.from_spec("wire=1.0:1")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.from_spec("wire@bogus=1.0:1")
+
+
+# ------------------------------------------------------------ remote delta
+
+def test_delta_over_wire_then_query_bit_exact(dataset):
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        q = BatchQuery("or", (0, 1), form="bitmap")
+        before = cl.call(ServingRequest(0, q, tenant="t0"), 60)
+        vals = np.array([1_000_001, 1_000_002], np.uint32)
+        report = cl.apply_delta(0, adds={0: vals})
+        assert report and isinstance(report, dict)
+        after = cl.call(ServingRequest(0, q, tenant="t0"), 60)
+        ref = before.bitmap.to_array()
+        want = np.union1d(ref, vals)
+        assert np.array_equal(after.bitmap.to_array(), want)
+        cl.close()
+
+
+def test_delta_repack_serialized_with_dispatch(dataset):
+    """A structural delta (new container key -> escalated repack, which
+    FREES the set's old device buffers) racing a pipelined query pool
+    must not lose tickets: the wire reader serializes the apply with
+    the loop's pump lock, so every in-flight query reaches a terminal
+    status and post-delta queries are bit-exact.  Regression: the
+    unserialized apply let a mid-dispatch pool die on the freed
+    buffers ('buffer deleted', unclassified) — a silent drop."""
+    loop = _loop(dataset, pool_target=8)
+    with WireServer(loop, coalesce_s=0.02) as srv:
+        cl = WireClient(srv.address)
+        for round_ in range(4):
+            reqs = _requests(10, seed=60 + round_)
+            tickets = cl.submit_many(reqs)
+            # structural: values far above the build universe force a
+            # fresh container while the pool above is still in flight
+            base = 2_000_000 + 10_000 * round_
+            report = cl.apply_delta(
+                0, adds={0: np.arange(base, base + 64, dtype=np.uint32)},
+                timeout=120)
+            assert isinstance(report, dict)
+            for t in tickets:
+                assert t.wait(120), "ticket lost in the delta race"
+                assert t.status in ("done", "failed")
+                if t.status == "failed":
+                    assert isinstance(t.error,
+                                      errors.RoaringRuntimeError)
+        # the connection survived and serves the post-delta image
+        res = cl.call(ServingRequest(
+            0, BatchQuery("or", (0, 1), form="bitmap"), tenant="t0"), 60)
+        ref = loop._engine._engines[0]._sequential_result(
+            BatchQuery("or", (0, 1), form="bitmap"))
+        assert res.bitmap == ref.bitmap
+        cl.close()
+
+
+# -------------------------------------------------------- cross-process
+
+def _spawn_bootstrap(*extra):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "roaringbitmap_tpu.wire.bootstrap",
+         "--seed", str(PROFILE.seed), "--sets", str(PROFILE.sets),
+         "--sources", str(PROFILE.sources),
+         "--tenants", str(PROFILE.tenants),
+         "--density", str(PROFILE.density),
+         "--users", str(PROFILE.users), *extra],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    info = json.loads(proc.stdout.readline())
+    return proc, (info["host"], info["port"])
+
+
+def test_cross_process_submission_bit_exact(dataset):
+    """THE acceptance pin: a separate OS process serves mixed traffic
+    over TCP bit-exactly vs the local reference engine built from the
+    same seeded dataset."""
+    proc, addr = _spawn_bootstrap()
+    try:
+        reference = MultiSetBatchEngine(_sets(dataset))
+        cl = WireClient(addr, timeout=120)
+        reqs = _requests(24)
+        tickets = cl.submit_many(reqs)
+        for t, r in zip(tickets, reqs):
+            _assert_wire_exact(reference, r, t.value(timeout=120))
+        assert cl.stats["results"] == len(reqs)
+        cl.close()
+    finally:
+        proc.stdin.close()
+        assert proc.wait(timeout=15) == 0
+
+
+def test_cross_process_delta_convergence(dataset):
+    """Deltas shipped over the wire mutate the remote process; the
+    remote result converges bit-exactly with a local twin applying the
+    same delta."""
+    proc, addr = _spawn_bootstrap()
+    try:
+        sets = _sets(dataset)
+        cl = WireClient(addr, timeout=120)
+        vals = np.array([7, 77, 777], np.uint32)
+        cl.apply_delta(1, adds={2: vals})
+        sets[1].apply_delta({2: vals}, None)
+        reference = MultiSetBatchEngine(sets)
+        q = BatchQuery("or", (0, 2), form="bitmap")
+        req = ServingRequest(1, q, tenant="t1")
+        _assert_wire_exact(reference, req, cl.call(req, 120))
+        cl.close()
+    finally:
+        proc.stdin.close()
+        assert proc.wait(timeout=15) == 0
+
+
+# ------------------------------------------------------------- migration
+
+def _front_door(dataset):
+    sets = _sets(dataset)
+    return PodFrontDoor(
+        sets, pod=podmesh.PodMesh.simulate(2),
+        policy=ServingPolicy(pool_target=4, guard=NOSLEEP,
+                             default_deadline_ms=EASY_MS))
+
+
+def test_wire_migration_bit_exact_with_catch_up(dataset):
+    """migrate_tenant(via=client) ships snapshot + dual-write catch-up
+    tail as frames; the destination's restored twin passes the per-
+    source CRC pin, and the source keeps serving throughout."""
+    fd = _front_door(dataset)
+    dest_loop = _loop(dataset)
+    with WireServer(dest_loop, name="dest") as srv:
+        cl = WireClient(srv.address)
+
+        def during(fd_):
+            # traffic + mutation INSIDE the dual-write window
+            t = fd_.submit(ServingRequest(
+                1, BatchQuery("or", (0, 1)), tenant="t1"))
+            fd_.apply_delta(1, {0: np.array([31337], np.uint32)}, None)
+            fd_.drain()
+            assert t.ok
+
+        report = migrate_tenant(fd, 1, via=cl, tenant="mig-t1",
+                                during=during)
+        assert report["to"] == "wire"
+        assert report["catch_up_records"] >= 1
+        ds = srv.migrated["mig-t1"]
+        src = mut_delta.host_bitmaps(fd._sets[1])
+        got = mut_delta.host_bitmaps(ds)
+        assert got == src                      # bit-exact twin
+        assert 31337 in got[0]
+        # source unaffected: still serving tenant 1
+        t = fd.submit(ServingRequest(1, BatchQuery("or", (0, 1)),
+                                     tenant="t1"))
+        fd.drain()
+        assert t.ok
+        cl.close()
+
+
+def test_wire_migration_cross_process(dataset):
+    """Full two-process migration: snapshot + tail land in a bootstrap
+    subprocess, CRC pin checked end to end."""
+    proc, addr = _spawn_bootstrap()
+    try:
+        fd = _front_door(dataset)
+        cl = WireClient(addr, timeout=120)
+        report = migrate_tenant_wire(fd, 0, cl, tenant="xp-t0")
+        assert report["bytes"] > 0
+        assert report["source_crcs"]           # pin verified inside
+        cl.close()
+    finally:
+        proc.stdin.close()
+        assert proc.wait(timeout=15) == 0
+
+
+# ------------------------------------------------------------------- soak
+
+@pytest.mark.slow
+def test_soak_replay_over_wire_typed_only(dataset):
+    """The Zipf/diurnal replay generator over a live wire under fault
+    injection: every ticket resolves, every failure is typed, the
+    connection-level fault (garbage) yields CorruptInput — zero raw
+    escapes, zero silent drops."""
+    profile = replay.ReplayProfile(sets=2, sources=6, tenants=6,
+                                   density=600, users=1 << 16,
+                                   requests=120, duration_s=1.0,
+                                   seed=PROFILE.seed)
+    events = replay.generate(profile)
+    loop = _loop(dataset)
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address, timeout=120)
+        with faults.inject("wire@garbage=0.02:7"):
+            try:
+                rep = replay.run_wire(cl, events, pace=False,
+                                      timeout=120)
+            except (errors.PeerClosed, errors.CorruptInput):
+                rep = None                     # typed connection death
+        if rep is not None:
+            assert rep["typed_only"], rep
+            assert (rep["done"] + rep["shed"] + rep["failed"]
+                    + rep["rejected"]) == rep["queries"]
+        cl.close()

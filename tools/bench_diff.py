@@ -119,15 +119,27 @@ import sys
 #: price is pinned, not gated: a flush-policy change legitimately moves
 #: it either way; durability semantics are gated by tests, not trend);
 #: ``recovery_ms_tenants{N}`` and ``migration_blip_ms`` ride the ``_ms``
-#: LOWER fragment, ``migration_failed`` is a 0-pin asserted in-phase.
+#: LOWER fragment, ``migration_failed`` is a 0-pin asserted in-phase;
+#: ``group_fsync_per_delta`` (ISSUE 20 group commit) rides the
+#: ``fsync`` LOWER fragment and ``group_overhead_x`` the
+#: ``journal_overhead``/``overhead_x`` NEUTRAL rule.
+#: The pod_replay wire lane (bench.py pod_replay_phase, ISSUE 20,
+#: docs/WIRE.md) adds ``pipelined_vs_rtt_x`` — HIGHER (via
+#: ``pipelined_vs``: the tentpole amortization claim, many-in-flight
+#: coalesced submission vs one request per round trip on the SAME
+#: socket) and ``sustained_qps_{wire,inproc}`` (via the generic
+#: ``qps``); ``overload_p99_ms`` rides the ``_ms`` LOWER fragment.
+#: ``wire_vs_inproc_x`` is NEUTRAL (via ``wire_vs``): the network
+#: boundary's price is pinned, not gated — a faster in-process engine
+#: legitimately moves the ratio down with the wire arm unchanged.
 HIGHER = ("qps", "ops_per_sec", "vs_baseline", "amortization", "speedup",
           "overlap_ratio", "launches_saved", "pooled_vs", "sharded_vs",
           "fused_vs", "mega_olap", "mega_vs", "resident_vs",
           "vs_repack", "vs_recompute", "attain",
-          "pod_vs", "cluster2_vs")
+          "pod_vs", "cluster2_vs", "pipelined_vs")
 LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
          "shard_balance", "warm_restart", "escapes", "padding",
-         "p99_over_p50", "compiles")
+         "p99_over_p50", "compiles", "fsync")
 #: checked before HIGHER/LOWER: lanes whose good direction is genuinely
 #: ambiguous.  host_overlapped_ms scales with total host time in BOTH
 #: directions (more overlap at fixed host_ms is good, but so is less
@@ -145,7 +157,8 @@ LOWER = ("_us", "_ms", "_seconds", "us_per", "ms_per", "bytes",
 #: with higher survivor attainment can be the better trade); the
 #: ``x4`` cells' serving direction signal is ``slo_attainment``.
 NEUTRAL = ("host_overlapped", "phase_ms", "noshed", "shed_rate",
-           "compiles_cold", "twophase", "journal_overhead")
+           "compiles_cold", "twophase", "journal_overhead",
+           "wire_vs", "group_overhead")
 
 
 def salvage_tail_json(tail: str) -> dict | None:

@@ -108,6 +108,16 @@ a schema-valid flight-recorder dump, and the merged ``fd.statusz()``
 must report both hosts with an idempotent monotone counter merge —
 nothing about the trace/flight/statusz plane may go silent before the
 bench trends it rides on are gated.
+
+``--smoke-wire`` (ISSUE 20, docs/WIRE.md) prepends the binary wire
+front-door smoke: pipelined mixed flat/expression/analytics traffic
+over a loopback ``WireServer`` must come back bit-exact vs the
+sequential per-set reference; a full tenant queue, an unknown token,
+and an ungranted tenant must each answer TYPED wire error frames on a
+connection that keeps serving; a garbled inbound frame must die as
+``CorruptInput`` — zero silent drops, zero raw socket/struct escapes —
+guarding the ``pod_replay.*`` bench lanes' correctness before their
+trend is gated.
 """
 
 from __future__ import annotations
@@ -1124,6 +1134,139 @@ def obs_smoke() -> int:
     return 0 if ok else 1
 
 
+def wire_smoke() -> int:
+    """Binary wire front-door smoke (ISSUE 20, docs/WIRE.md): pipelined
+    mixed traffic over a loopback WireServer must come back bit-exact
+    vs the sequential per-set reference; overload (full tenant queue)
+    and the auth boundary (unknown token, ungranted tenant) must answer
+    TYPED wire error frames on a live connection; a garbled inbound
+    frame must die as CorruptInput — zero silent drops, zero raw
+    socket/struct escapes.  Returns 0 when every contract holds, 1
+    otherwise."""
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import numpy as np
+
+    from roaringbitmap_tpu.parallel import expr
+    from roaringbitmap_tpu.parallel.aggregation import DeviceBitmapSet
+    from roaringbitmap_tpu.parallel.batch_engine import BatchQuery
+    from roaringbitmap_tpu.parallel.multiset import MultiSetBatchEngine
+    from roaringbitmap_tpu.runtime import errors, guard
+    from roaringbitmap_tpu.serving import (AdmissionRejected,
+                                           ServingLoop, ServingPolicy,
+                                           ServingRequest, replay)
+    from roaringbitmap_tpu.wire import WireClient, WireServer
+    from roaringbitmap_tpu.wire import protocol as wp
+
+    profile = replay.ReplayProfile(sets=2, sources=6, tenants=4,
+                                   density=400, users=1 << 16, seed=7)
+    nosleep = guard.GuardPolicy(backoff_base=0.0, sleep=lambda s: None)
+
+    def mk_loop(**kw):
+        bitmap_sets, columns = replay.build_dataset(profile)
+        sets = [DeviceBitmapSet(b, layout="dense") for b in bitmap_sets]
+        replay.attach_columns(sets, profile, columns)
+        kw.setdefault("pool_target", 4)
+        kw.setdefault("guard", nosleep)
+        kw.setdefault("default_deadline_ms", 600_000.0)
+        return ServingLoop(MultiSetBatchEngine(sets),
+                           ServingPolicy(**kw))
+
+    rng = np.random.default_rng(0x31)
+
+    def mk_reqs(n):
+        out = []
+        for i in range(n):
+            sid = int(rng.integers(2))
+            form = "bitmap" if i % 3 == 0 else "cardinality"
+            if i % 5 == 2:
+                q = expr.ExprQuery(expr.and_(expr.or_(0, 1),
+                                             expr.not_(2)), form=form)
+            elif i % 5 == 4:
+                q = expr.ExprQuery(expr.sum_("v", expr.or_(0, 1)),
+                                   form="cardinality")
+            else:
+                op = ("or", "and", "xor")[int(rng.integers(3))]
+                q = BatchQuery(op, tuple(int(x) for x in rng.choice(
+                    6, size=3, replace=False)), form=form)
+            out.append(ServingRequest(sid, q, tenant=f"t{sid}"))
+        return out
+
+    checks: dict = {}
+    # (a) pipelined parity: mixed shapes over TCP vs the sequential ref
+    loop = mk_loop()
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        reqs = mk_reqs(18)
+        tickets = cl.submit_many(reqs)
+        exact = True
+        for t, r in zip(tickets, reqs):
+            res = t.value(timeout=120)
+            ref = loop._engine._engines[r.set_id]._sequential_result(
+                r.query)
+            exact = exact and res.cardinality == ref.cardinality
+            if r.query.form == "bitmap" and not res.degraded:
+                exact = exact and res.bitmap == ref.bitmap
+            if ref.value is not None:
+                exact = exact and res.value == ref.value
+        checks["pipelined_parity"] = exact
+        cl.close()
+    # (b) overload answers typed on a LIVE connection, zero silent
+    q = BatchQuery("or", (0, 1, 2))
+    loop = mk_loop(max_queue=2, pool_target=64)
+    with WireServer(loop, coalesce_s=0.05) as srv:
+        cl = WireClient(srv.address)
+        tickets = cl.submit_many(
+            [ServingRequest(0, q, tenant="t0") for _ in range(10)])
+        for t in tickets:
+            t.wait(60)
+        rej = [t for t in tickets if t.status == "failed"]
+        done = [t for t in tickets if t.ok]
+        checks["overload_typed"] = (
+            bool(rej)
+            and all(isinstance(t.error, AdmissionRejected)
+                    for t in rej)
+            and len(done) + len(rej) == 10)
+        try:
+            cl.ping()
+            checks["conn_survives_rejection"] = True
+        except errors.RoaringRuntimeError:
+            checks["conn_survives_rejection"] = False
+        cl.close()
+    # (c) auth boundary: unknown token refused before the loop, tenant
+    # grants enforced per request on a connection that stays live
+    loop = mk_loop()
+    with WireServer(loop, auth={"tok": ["t0"]}) as srv:
+        try:
+            WireClient(srv.address, token="evil")
+            checks["auth_token"] = False
+        except errors.AuthRejected:
+            checks["auth_token"] = loop.stats["admitted"] == 0
+        cl = WireClient(srv.address, token="tok")
+        bad = cl.submit(ServingRequest(0, q, tenant="t1"))
+        try:
+            bad.value(60)
+            checks["auth_tenant"] = False
+        except errors.AuthRejected:
+            checks["auth_tenant"] = True
+        cl.close()
+    # (d) a garbled inbound frame dies as CorruptInput, never a raw
+    # struct/socket escape
+    loop = mk_loop()
+    with WireServer(loop) as srv:
+        cl = WireClient(srv.address)
+        t = cl._reserve()
+        with cl._wlock:
+            cl._sock.sendall(wp.garble(wp.encode_frame(
+                wp.T_PING, 99, {})))
+        t.wait(30)
+        checks["garbage_typed"] = (t.status == "failed" and isinstance(
+            t.error, errors.CorruptInput))
+        cl.close()
+    ok = all(checks.values())
+    print(json.dumps({"smoke_wire": checks, "ok": ok}))
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="trajectory regression sentry over bench round files")
@@ -1201,6 +1344,12 @@ def main() -> int:
                          "rerouted request, a schema-valid flight dump "
                          "on host loss, merged 2-host statusz; exit 1 "
                          "on violation)")
+    ap.add_argument("--smoke-wire", action="store_true",
+                    help="first run the binary wire front-door smoke "
+                         "(pipelined TCP parity vs the sequential "
+                         "reference, typed overload/auth/garbage "
+                         "outcomes on live connections, zero silent "
+                         "drops; exit 1 on violation)")
     args = ap.parse_args()
 
     if args.smoke_sharded:
@@ -1241,6 +1390,10 @@ def main() -> int:
             return rc
     if args.smoke_obs:
         rc = obs_smoke()
+        if rc:
+            return rc
+    if args.smoke_wire:
+        rc = wire_smoke()
         if rc:
             return rc
 

@@ -118,6 +118,16 @@ and a wide aggregation — then (2) validates every emitted line:
   schema-valid flight dump from the forced host loss, and a merged
   ``fd.statusz()`` reporting both simulated hosts.
 
+- wire RPC semantics (ISSUE 20, docs/WIRE.md): the ``rpc.call`` /
+  ``rpc.submit`` / ``rpc.result`` / ``rpc.hello`` span schemas are
+  validated on arbitrary dumps (``rpc.submit`` must always carry a
+  boundary ``outcome`` — admitted or typed-rejected, never silent);
+  the --workload run additionally drives ONE cross-process submit over
+  TCP against a real ``wire.bootstrap`` server process tracing into
+  its own dump (``<path>.wire``, pooled automatically), demanding a
+  single trace id that covers ``rpc.call`` → ``rpc.submit`` →
+  ``serving.admit`` → ``serving.request`` across the socket.
+
 Validation-only mode (``python tools/check_trace.py <path> [path ...]``)
 checks existing dumps, e.g. captured from serving processes: several
 paths validate as ONE pooled span set, so per-host dumps of a forwarded
@@ -347,6 +357,7 @@ def validate(paths, workload_semantics: bool = False,
         errors += _resident_semantics([s for _, s in spans])
         errors += _durability_semantics([s for _, s in spans])
         errors += _propagation_semantics([s for _, s in spans])
+        errors += _rpc_semantics([s for _, s in spans])
     return errors
 
 
@@ -432,6 +443,7 @@ def _workload_semantics(spans: list[dict],
     errors += _resident_semantics(spans, require=budget_semantics)
     errors += _durability_semantics(spans, require=budget_semantics)
     errors += _propagation_semantics(spans, require=budget_semantics)
+    errors += _rpc_semantics(spans, require=budget_semantics)
     return errors
 
 
@@ -639,6 +651,83 @@ def _propagation_semantics(spans: list[dict],
                 "no single trace id stitches the forwarded+rerouted "
                 f"request lifecycle {STITCHED_NAMES} — closest trace "
                 f"held {sorted(set(STITCHED_NAMES) & best)}")
+    return errors
+
+
+#: one trace id must cover the client's call, the server's boundary
+#: decision, admission, and the per-ticket outcome — across the SOCKET
+#: (the client and server dumps are separate files pooled by main()).
+WIRE_STITCHED_NAMES = ("rpc.call", "rpc.submit", "serving.admit",
+                       "serving.request")
+
+
+def _rpc_semantics(spans: list[dict], require: bool = False) -> list[str]:
+    """Binary wire RPC vocabulary (ISSUE 20, wire/, docs/WIRE.md).
+    Arbitrary dumps validate the ``rpc.*`` span schemas wherever they
+    appear: ``rpc.call`` (client-side framing), ``rpc.submit`` (the
+    server boundary decision — ``outcome`` is mandatory: every inbound
+    submit is admitted or typed-rejected, never silent), ``rpc.result``
+    (completion delivery, outcome = the ticket's terminal status) and
+    ``rpc.hello`` (handshake verdict).  ``require`` (the --workload
+    run, which drives one cross-process submit over TCP with the server
+    process tracing into its own dump) additionally demands ONE trace
+    id whose pooled spans cover ``WIRE_STITCHED_NAMES`` — proof trace
+    propagation survives the socket."""
+    errors: list[str] = []
+    for s in spans:
+        name = s.get("name")
+        if name not in ("rpc.call", "rpc.submit", "rpc.result",
+                        "rpc.hello"):
+            continue
+        tags = s.get("tags") or {}
+        if name == "rpc.call":
+            if not isinstance(tags.get("req_id"), int):
+                errors.append(
+                    f"rpc.call span without an integer req_id: {tags!r}")
+            if not isinstance(tags.get("set_id"), int):
+                errors.append(
+                    f"rpc.call span without an integer set_id: {tags!r}")
+        elif name == "rpc.submit":
+            if not isinstance(tags.get("req_id"), int):
+                errors.append(f"rpc.submit span without an integer "
+                              f"req_id: {tags!r}")
+            if not tags.get("tenant"):
+                errors.append(
+                    f"rpc.submit span without a tenant: {tags!r}")
+            if not tags.get("outcome"):
+                errors.append(f"rpc.submit span without a boundary "
+                              f"outcome (silent drop?): {tags!r}")
+        elif name == "rpc.result":
+            if not isinstance(tags.get("req_id"), int):
+                errors.append(f"rpc.result span without an integer "
+                              f"req_id: {tags!r}")
+            if not tags.get("outcome"):
+                errors.append(f"rpc.result span without the ticket's "
+                              f"terminal outcome: {tags!r}")
+        elif name == "rpc.hello":
+            if not tags.get("outcome"):
+                errors.append(f"rpc.hello span without a handshake "
+                              f"verdict: {tags!r}")
+        # frame_bytes is written after the encode — type-check only
+        # when present (a span closed on an encode error lacks it)
+        if "frame_bytes" in tags \
+                and not isinstance(tags["frame_bytes"], (int, float)):
+            errors.append(f"{name} frame_bytes not numeric: {tags!r}")
+    if require:
+        by_trace: dict = {}
+        for s in spans:
+            tid = s.get("trace_id")
+            if tid:
+                by_trace.setdefault(tid, set()).add(s.get("name"))
+        if not any(set(WIRE_STITCHED_NAMES) <= names
+                   for names in by_trace.values()):
+            best = max(by_trace.values(),
+                       key=lambda n: len(set(WIRE_STITCHED_NAMES) & n),
+                       default=set())
+            errors.append(
+                "no single trace id stitches the cross-process wire "
+                f"submit {WIRE_STITCHED_NAMES} — closest trace held "
+                f"{sorted(set(WIRE_STITCHED_NAMES) & best)}")
     return errors
 
 
@@ -1786,6 +1875,49 @@ def run_workload(path: str) -> None:
         finally:
             shutil.rmtree(dur_root, ignore_errors=True)
             shutil.rmtree(flight_dir, ignore_errors=True)
+
+        # wire lane (ISSUE 20, docs/WIRE.md): ONE cross-process submit
+        # over TCP against a REAL second OS process (wire.bootstrap).
+        # The client's rpc.call spans land in THIS dump; the server
+        # traces rpc.submit / serving.* into its OWN dump at
+        # path + ".wire" via the same env activation knob — main()
+        # pools both files, and _rpc_semantics demands one trace id
+        # covering the whole cross-socket lifecycle
+        import subprocess
+
+        from roaringbitmap_tpu.wire import WireClient
+
+        wire_path = path + ".wire"
+        if os.path.exists(wire_path):
+            os.unlink(wire_path)
+        wire_env = dict(os.environ)
+        wire_env["ROARING_TPU_TRACE"] = wire_path
+        wire_srv = subprocess.Popen(
+            [sys.executable, "-m", "roaringbitmap_tpu.wire.bootstrap",
+             "--seed", "3", "--sets", "2", "--sources", "6",
+             "--tenants", "4", "--density", "400",
+             "--users", str(1 << 16), "--no-columns"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=wire_env)
+        try:
+            winfo = json.loads(wire_srv.stdout.readline())
+            wcl = WireClient((winfo["host"], winfo["port"]))
+            wq = BatchQuery("or", (0, 1, 2))
+            assert wcl.call(ServingRequest(0, wq, tenant="t0"),
+                            300).cardinality >= 0
+            wts = wcl.submit_many([ServingRequest(s, wq,
+                                                  tenant=f"t{s}")
+                                   for s in (0, 1)])
+            for wt_ in wts:
+                assert wt_.value(timeout=300).cardinality >= 0, \
+                    "pipelined cross-process submit failed"
+            wcl.close()
+        finally:
+            wire_srv.stdin.close()
+            try:
+                wire_srv.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                wire_srv.kill()
     finally:
         obs.disable()
 
@@ -1800,6 +1932,10 @@ def main() -> int:
         return 2
     if workload:
         run_workload(args[0])
+        # the wire server subprocess traced into its own dump: pool it
+        # with the client's so the cross-socket stitch can resolve
+        if os.path.exists(args[0] + ".wire"):
+            args.append(args[0] + ".wire")
     # several paths (per-host dumps + flight/statusz artifacts) validate
     # as one pooled span set: refs and the stitched-trace semantics
     # resolve against the union
